@@ -1,0 +1,25 @@
+"""distar_tpu — a TPU-native (JAX/XLA/Pallas/pjit) framework with the
+capabilities of opendilab/DI-star.
+
+Built from scratch against the structural blueprint in /root/repo/SURVEY.md:
+an AlphaStar-style distributed RL training platform — supervised learning from
+replays, league self-play RL (V-trace/UPGO/TD-lambda), PFSP matchmaking, an
+actor fleet feeding TPU learners, and play/eval tooling — re-architected for
+TPU rather than ported from the reference's PyTorch/CUDA implementation.
+
+Layer map (mirrors reference layers, see SURVEY.md §1):
+  distar_tpu.bin       CLI entry points (rl_train, sl_train, play)
+  distar_tpu.league    control plane: players, PFSP, payoff, ELO
+  distar_tpu.learner   training runtime: hook-driven learners on pjit meshes
+  distar_tpu.actor     CPU actor fleet + batched jitted inference
+  distar_tpu.model     Flax policy/value network (encoders, LSTM core, heads)
+  distar_tpu.ops       TPU compute primitives (pallas kernels, scan RNN, rl ops)
+  distar_tpu.losses    RL and SL losses as pure jnp functions
+  distar_tpu.parallel  mesh/sharding abstraction, optimizer, grad clip
+  distar_tpu.comm      coordinator broker + TCP adapter data plane
+  distar_tpu.envs      env interface + mock env (SC2 binary optional)
+  distar_tpu.lib       feature/action data contract shared by all layers
+  distar_tpu.utils     config cascade, logging/meters, timing, checkpoint
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,90 @@
+"""Layered YAML config cascade.
+
+The reference framework merges a per-module ``*_default_config.yaml`` with the
+user config at every constructor via ``deep_merge_dicts``
+(reference: distar/ctools/utils/config_helper.py). We keep the same cascade
+semantics but carry configs in an attribute-accessible dict (``Config``)
+instead of EasyDict.
+"""
+from __future__ import annotations
+
+import copy
+import os
+from typing import Any, Dict, Mapping
+
+import yaml
+
+
+class Config(dict):
+    """A dict with attribute access, recursively applied. YAML-friendly."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__()
+        d = dict(*args, **kwargs)
+        for k, v in d.items():
+            self[k] = v
+
+    @staticmethod
+    def _wrap(value: Any) -> Any:
+        if isinstance(value, Mapping) and not isinstance(value, Config):
+            return Config(value)
+        if isinstance(value, (list, tuple)):
+            return type(value)(Config._wrap(v) for v in value)
+        return value
+
+    def __setitem__(self, key, value):
+        super().__setitem__(key, Config._wrap(value))
+
+    def __setattr__(self, key, value):
+        self[key] = value
+
+    def __getattr__(self, key):
+        try:
+            return self[key]
+        except KeyError as e:
+            raise AttributeError(key) from e
+
+    def __deepcopy__(self, memo):
+        return Config({k: copy.deepcopy(v, memo) for k, v in self.items()})
+
+    def to_dict(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {}
+        for k, v in self.items():
+            if isinstance(v, Config):
+                out[k] = v.to_dict()
+            elif isinstance(v, (list, tuple)):
+                out[k] = type(v)(x.to_dict() if isinstance(x, Config) else x for x in v)
+            else:
+                out[k] = v
+        return out
+
+
+def deep_merge_dicts(base: Mapping, override: Mapping) -> Config:
+    """Return a new Config = base overridden by ``override``, recursively.
+
+    Semantics match the reference's deep_merge_dicts: nested dicts merge
+    key-by-key, any non-dict value in ``override`` wins wholesale.
+    """
+    out = Config(copy.deepcopy(dict(base)))
+    for k, v in override.items():
+        if isinstance(v, Mapping) and isinstance(out.get(k), Mapping):
+            out[k] = deep_merge_dicts(out[k], v)
+        else:
+            out[k] = copy.deepcopy(v)
+    return out
+
+
+def read_config(path: str) -> Config:
+    """Load a YAML file into a Config. Missing file -> empty Config."""
+    if not os.path.exists(path):
+        raise FileNotFoundError(path)
+    with open(path, "r") as f:
+        data = yaml.safe_load(f)
+    return Config(data or {})
+
+
+def save_config(cfg: Mapping, path: str) -> None:
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    body = cfg.to_dict() if isinstance(cfg, Config) else dict(cfg)
+    with open(path, "w") as f:
+        yaml.safe_dump(body, f, default_flow_style=False, sort_keys=False)

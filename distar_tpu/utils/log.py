@@ -1,0 +1,149 @@
+"""Logging and windowed metric meters.
+
+Covers the roles of the reference's log_helper (TextLogger, VariableRecord,
+MoveAverage/EMA meters; reference: distar/ctools/utils/log_helper.py). The
+TensorBoard sink is optional — when tensorboardX is unavailable we fall back
+to a JSONL scalar sink so training metrics are always recorded.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import time
+from collections import deque
+from typing import Dict, Optional
+
+
+class AverageMeter:
+    """Windowed moving average over the last ``length`` values."""
+
+    def __init__(self, length: int = 100):
+        assert length > 0
+        self._values: deque = deque(maxlen=length)
+
+    def update(self, value) -> None:
+        self._values.append(float(value))
+
+    @property
+    def val(self) -> float:
+        return self._values[-1] if self._values else 0.0
+
+    @property
+    def avg(self) -> float:
+        return sum(self._values) / len(self._values) if self._values else 0.0
+
+
+class EMAMeter:
+    """Exponential moving average meter with debias at startup."""
+
+    def __init__(self, alpha: float = 0.99):
+        self._alpha = alpha
+        self._ema: Optional[float] = None
+        self._last = 0.0
+
+    def update(self, value) -> None:
+        value = float(value)
+        self._last = value
+        if self._ema is None:
+            self._ema = value
+        else:
+            self._ema = self._alpha * self._ema + (1.0 - self._alpha) * value
+
+    @property
+    def val(self) -> float:
+        return self._last
+
+    @property
+    def avg(self) -> float:
+        return self._ema if self._ema is not None else 0.0
+
+
+class VariableRecord:
+    """A named collection of meters with tabulated text rendering.
+
+    Mirrors the role of the reference's VariableRecord (windowed meters keyed
+    by variable name, rendered into the iteration log line).
+    """
+
+    def __init__(self, length: int = 100):
+        self._length = length
+        self._meters: Dict[str, AverageMeter] = {}
+
+    def register_var(self, name: str) -> None:
+        self._meters.setdefault(name, AverageMeter(self._length))
+
+    def update_var(self, info: Dict[str, float]) -> None:
+        for k, v in info.items():
+            self.register_var(k)
+            self._meters[k].update(v)
+
+    def get(self, name: str) -> AverageMeter:
+        return self._meters[name]
+
+    def vars(self):
+        return dict(self._meters)
+
+    def get_vars_text(self) -> str:
+        rows = [
+            "{:<40s} {:>12.5f} {:>12.5f}".format(k, m.val, m.avg)
+            for k, m in sorted(self._meters.items())
+        ]
+        header = "{:<40s} {:>12s} {:>12s}".format("name", "value", "avg")
+        return "\n".join([header] + rows)
+
+
+class TextLogger:
+    """File + console logger, one per role/rank."""
+
+    def __init__(self, path: str, name: str = "distar_tpu", to_console: bool = True):
+        os.makedirs(path, exist_ok=True)
+        self._logger = logging.getLogger(f"{name}.{id(self)}")
+        self._logger.setLevel(logging.INFO)
+        self._logger.propagate = False
+        fmt = logging.Formatter("[%(asctime)s][%(levelname)s] %(message)s")
+        fh = logging.FileHandler(os.path.join(path, f"{name}.log"))
+        fh.setFormatter(fmt)
+        self._logger.addHandler(fh)
+        if to_console:
+            ch = logging.StreamHandler()
+            ch.setFormatter(fmt)
+            self._logger.addHandler(ch)
+
+    def info(self, msg: str) -> None:
+        self._logger.info(msg)
+
+    def error(self, msg: str) -> None:
+        self._logger.error(msg)
+
+
+class ScalarSink:
+    """Scalar metric sink: tensorboardX when available, else JSONL."""
+
+    def __init__(self, path: str):
+        os.makedirs(path, exist_ok=True)
+        self._tb = None
+        try:  # pragma: no cover - depends on optional dep
+            from tensorboardX import SummaryWriter
+
+            self._tb = SummaryWriter(path)
+        except Exception:
+            self._file = open(os.path.join(path, "scalars.jsonl"), "a")
+
+    def add_scalar(self, name: str, value: float, global_step: int) -> None:
+        if self._tb is not None:  # pragma: no cover
+            self._tb.add_scalar(name, value, global_step)
+        else:
+            self._file.write(
+                json.dumps({"ts": time.time(), "step": global_step, name: float(value)}) + "\n"
+            )
+            self._file.flush()
+
+    def add_scalars(self, info: Dict[str, float], global_step: int) -> None:
+        for k, v in info.items():
+            self.add_scalar(k, v, global_step)
+
+
+def build_logger(path: str, name: str, to_console: bool = True):
+    """Return (TextLogger, ScalarSink, VariableRecord) triple for a role."""
+    return TextLogger(path, name, to_console), ScalarSink(os.path.join(path, "scalars")), VariableRecord()

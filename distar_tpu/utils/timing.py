@@ -1,0 +1,74 @@
+"""Timing helpers.
+
+``EasyTimer`` mirrors the reference's CUDA-event-aware timer
+(distar/ctools/utils/time_helper.py) — on TPU the analogue of a device sync
+is ``jax.block_until_ready`` on the step outputs, which callers invoke before
+leaving the timed region (the timer itself stays device-agnostic).
+"""
+from __future__ import annotations
+
+import time
+
+
+class EasyTimer:
+    """Context-manager wall-clock timer: ``with timer: ...; timer.value``."""
+
+    def __init__(self):
+        self.value = 0.0
+        self._start = 0.0
+
+    def __enter__(self):
+        self._start = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self.value = time.perf_counter() - self._start
+        return False
+
+
+class StopWatch:
+    """Hierarchical named profiler, role of pysc2's stopwatch.sw decorator."""
+
+    def __init__(self, enabled: bool = False):
+        self.enabled = enabled
+        self.times = {}
+
+    def __call__(self, name: str):
+        return _SWContext(self, name)
+
+    def decorate(self, name: str):
+        def wrapper(fn):
+            def inner(*args, **kwargs):
+                with self(name):
+                    return fn(*args, **kwargs)
+
+            return inner
+
+        return wrapper
+
+    def summary(self):
+        return {
+            k: {"sum": sum(v), "num": len(v), "avg": sum(v) / len(v)}
+            for k, v in self.times.items()
+            if v
+        }
+
+
+class _SWContext:
+    def __init__(self, sw: StopWatch, name: str):
+        self._sw = sw
+        self._name = name
+        self._start = 0.0
+
+    def __enter__(self):
+        if self._sw.enabled:
+            self._start = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        if self._sw.enabled:
+            self._sw.times.setdefault(self._name, []).append(time.perf_counter() - self._start)
+        return False
+
+
+sw = StopWatch()

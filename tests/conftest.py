@@ -1,0 +1,20 @@
+"""Test harness: force an 8-device virtual CPU platform before JAX import.
+
+This is the TPU analogue of the reference's FakeLink fake distributed backend
+(distar/ctools/utils/fake_linklink.py) — multi-device collective code paths
+run single-process on virtual devices.
+"""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)

@@ -1,0 +1,67 @@
+import os
+
+import pytest
+
+from distar_tpu.utils import (
+    AverageMeter,
+    Config,
+    EMAMeter,
+    EasyTimer,
+    VariableRecord,
+    deep_merge_dicts,
+    read_config,
+    save_config,
+)
+
+
+def test_config_attribute_access():
+    cfg = Config({"model": {"encoder": {"dim": 256}}, "lst": [{"a": 1}]})
+    assert cfg.model.encoder.dim == 256
+    assert cfg.lst[0].a == 1
+    cfg.model.encoder.dim = 128
+    assert cfg["model"]["encoder"]["dim"] == 128
+
+
+def test_deep_merge_semantics():
+    base = Config({"a": {"b": 1, "c": 2}, "d": [1, 2]})
+    override = {"a": {"c": 3}, "d": [9]}
+    merged = deep_merge_dicts(base, override)
+    assert merged.a.b == 1 and merged.a.c == 3
+    assert merged.d == [9]
+    # base untouched
+    assert base.a.c == 2
+
+
+def test_yaml_roundtrip(tmp_path):
+    cfg = Config({"learner": {"lr": 1e-4, "betas": [0.0, 0.99]}})
+    p = os.path.join(tmp_path, "cfg.yaml")
+    save_config(cfg, p)
+    loaded = read_config(p)
+    assert loaded.learner.lr == pytest.approx(1e-4)
+    assert loaded.learner.betas == [0.0, 0.99]
+
+
+def test_meters():
+    m = AverageMeter(length=3)
+    for v in [1, 2, 3, 4]:
+        m.update(v)
+    assert m.val == 4 and m.avg == pytest.approx(3.0)
+    e = EMAMeter(alpha=0.5)
+    e.update(0.0)
+    e.update(1.0)
+    assert e.avg == pytest.approx(0.5)
+
+
+def test_variable_record():
+    rec = VariableRecord(length=10)
+    rec.update_var({"loss": 1.0, "acc": 0.5})
+    rec.update_var({"loss": 3.0})
+    assert rec.get("loss").avg == pytest.approx(2.0)
+    assert "loss" in rec.get_vars_text()
+
+
+def test_timer():
+    t = EasyTimer()
+    with t:
+        pass
+    assert t.value >= 0.0
