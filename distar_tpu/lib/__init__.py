@@ -1,0 +1,3 @@
+from . import actions, features
+
+__all__ = ["actions", "features"]

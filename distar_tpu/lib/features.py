@@ -1,0 +1,235 @@
+"""Feature-space contract: observation/action schemas and fixed shapes.
+
+This is the compatibility keel of the framework — the schema every layer
+(env, agent, dataloader, model, losses) agrees on. Dimensions and field lists
+match the reference contract (reference: distar/agent/default/lib/features.py:31-145)
+but the fixtures are plain numpy (host side) with fixed shapes chosen for XLA:
+entity arrays are always padded to MAX_ENTITY_NUM and selected-units to
+MAX_SELECTED_UNITS_NUM so every jit sees one static shape.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+
+from .actions import (
+    NUM_ACTIONS,
+    NUM_BEGINNING_ORDER_ACTIONS,
+    NUM_CUMULATIVE_STAT_ACTIONS,
+    NUM_UNIT_MIX_ABILITIES,
+    NUM_UNIT_TYPES,
+    NUM_UPGRADES,
+)
+
+# Fixed sizes (reference: features.py:31-38)
+SPATIAL_SIZE = (152, 160)  # (y, x)
+BUFF_LENGTH = 3
+UPGRADE_LENGTH = 20
+MAX_DELAY = 127
+BEGINNING_ORDER_LENGTH = 20
+MAX_SELECTED_UNITS_NUM = 64
+MAX_ENTITY_NUM = 512
+EFFECT_LENGTH = 100
+
+DEFAULT_SPATIAL_SIZE = SPATIAL_SIZE
+
+# Spatial planes: name -> dtype. 'effect_*' planes arrive as flat-index
+# coordinate lists of length EFFECT_LENGTH and are scattered on device.
+SPATIAL_INFO = {
+    "height_map": np.uint8,
+    "visibility_map": np.uint8,
+    "creep": np.uint8,
+    "player_relative": np.uint8,
+    "alerts": np.uint8,
+    "pathable": np.uint8,
+    "buildable": np.uint8,
+    "effect_PsiStorm": np.int16,
+    "effect_NukeDot": np.int16,
+    "effect_LiberatorDefenderZone": np.int16,
+    "effect_BlindingCloud": np.int16,
+    "effect_CorrosiveBile": np.int16,
+    "effect_LurkerSpines": np.int16,
+}
+
+# Scalar features: name -> (dtype, shape)
+SCALAR_INFO = {
+    "home_race": (np.uint8, ()),
+    "away_race": (np.uint8, ()),
+    "upgrades": (np.int16, (NUM_UPGRADES,)),
+    "time": (np.float32, ()),
+    "unit_counts_bow": (np.uint8, (NUM_UNIT_TYPES,)),
+    "agent_statistics": (np.float32, (10,)),
+    "cumulative_stat": (np.uint8, (NUM_CUMULATIVE_STAT_ACTIONS,)),
+    "beginning_order": (np.int16, (BEGINNING_ORDER_LENGTH,)),
+    "last_queued": (np.int16, ()),
+    "last_delay": (np.int16, ()),
+    "last_action_type": (np.int16, ()),
+    "bo_location": (np.int16, (BEGINNING_ORDER_LENGTH,)),
+    "unit_order_type": (np.uint8, (NUM_UNIT_MIX_ABILITIES,)),
+    "unit_type_bool": (np.uint8, (NUM_UNIT_TYPES,)),
+    "enemy_unit_type_bool": (np.uint8, (NUM_UNIT_TYPES,)),
+}
+
+# Per-entity features (each a [MAX_ENTITY_NUM] vector): name -> dtype
+ENTITY_INFO = {
+    "unit_type": np.int16,
+    "alliance": np.uint8,
+    "cargo_space_taken": np.uint8,
+    "build_progress": np.float16,
+    "health_ratio": np.float16,
+    "shield_ratio": np.float16,
+    "energy_ratio": np.float16,
+    "display_type": np.uint8,
+    "x": np.uint8,
+    "y": np.uint8,
+    "cloak": np.uint8,
+    "is_blip": np.uint8,
+    "is_powered": np.uint8,
+    "mineral_contents": np.float16,
+    "vespene_contents": np.float16,
+    "cargo_space_max": np.uint8,
+    "assigned_harvesters": np.uint8,
+    "weapon_cooldown": np.uint8,
+    "order_length": np.uint8,
+    "order_id_0": np.int16,
+    "order_id_1": np.int16,
+    "is_hallucination": np.uint8,
+    "buff_id_0": np.uint8,
+    "buff_id_1": np.uint8,
+    "addon_unit_type": np.uint8,
+    "is_active": np.uint8,
+    "order_progress_0": np.float16,
+    "order_progress_1": np.float16,
+    "order_id_2": np.int16,
+    "order_id_3": np.int16,
+    "is_in_cargo": np.uint8,
+    "attack_upgrade_level": np.uint8,
+    "armor_upgrade_level": np.uint8,
+    "shield_upgrade_level": np.uint8,
+    "last_selected_units": np.int8,
+    "last_targeted_unit": np.int8,
+}
+
+ACTION_HEADS = ("action_type", "delay", "queued", "selected_units", "target_unit", "target_location")
+
+# Per-head logit widths; selected_units has MAX_ENTITY_NUM+1 classes (the +1
+# is the end-flag token).
+LOGIT_SHAPES = {
+    "action_type": (NUM_ACTIONS,),
+    "delay": (MAX_DELAY + 1,),
+    "queued": (2,),
+    "selected_units": (MAX_SELECTED_UNITS_NUM, MAX_ENTITY_NUM + 1),
+    "target_unit": (MAX_ENTITY_NUM,),
+    "target_location": (SPATIAL_SIZE[0] * SPATIAL_SIZE[1],),
+}
+
+ACTION_SHAPES = {
+    "action_type": (),
+    "delay": (),
+    "queued": (),
+    "selected_units": (MAX_SELECTED_UNITS_NUM,),
+    "target_unit": (),
+    "target_location": (),
+}
+
+
+def _zeros(shape, dtype):
+    return np.zeros(shape, dtype=dtype)
+
+
+def fake_spatial_info(size=SPATIAL_SIZE) -> Dict[str, np.ndarray]:
+    out = {}
+    for k, dtype in SPATIAL_INFO.items():
+        if k.startswith("effect_"):
+            out[k] = _zeros((EFFECT_LENGTH,), dtype)
+        else:
+            out[k] = _zeros(size, dtype)
+    return out
+
+
+def fake_scalar_info() -> Dict[str, np.ndarray]:
+    return {k: _zeros(shape, dtype) for k, (dtype, shape) in SCALAR_INFO.items()}
+
+
+def fake_entity_info() -> Dict[str, np.ndarray]:
+    return {k: _zeros((MAX_ENTITY_NUM,), dtype) for k, dtype in ENTITY_INFO.items()}
+
+
+def fake_action_info() -> Dict[str, np.ndarray]:
+    return {k: _zeros(shape, np.int64) for k, shape in ACTION_SHAPES.items()}
+
+
+def fake_action_logp() -> Dict[str, np.ndarray]:
+    return {k: _zeros(ACTION_SHAPES[k], np.float32) for k in ACTION_HEADS}
+
+
+def fake_action_logits() -> Dict[str, np.ndarray]:
+    return {k: _zeros(shape, np.float32) for k, shape in LOGIT_SHAPES.items()}
+
+
+def fake_action_mask() -> Dict[str, np.ndarray]:
+    return {k: np.ones((), dtype=bool) for k in ACTION_HEADS}
+
+
+def fake_step_data(
+    train: bool = True,
+    rng: Optional[np.random.Generator] = None,
+    size=SPATIAL_SIZE,
+) -> Dict:
+    """A schema-complete single observation (no batch dim).
+
+    Role of the reference's fake_step_data (features.py:95-127): model warmup,
+    shape contract for batched inference, and test fixture.
+    """
+    rng = rng or np.random.default_rng(0)
+    ret = {
+        "spatial_info": fake_spatial_info(size),
+        "scalar_info": fake_scalar_info(),
+        "entity_info": fake_entity_info(),
+        "entity_num": np.asarray(rng.integers(1, MAX_ENTITY_NUM), dtype=np.int64),
+    }
+    if train:
+        ret.update(
+            {
+                "action_info": fake_action_info(),
+                "action_mask": fake_action_mask(),
+                "selected_units_num": np.asarray(
+                    rng.integers(0, MAX_SELECTED_UNITS_NUM), dtype=np.int64
+                ),
+            }
+        )
+    return ret
+
+
+def fake_model_output(hidden_layers: int = 3, hidden_size: int = 384, teacher: bool = False) -> Dict:
+    """Schema-complete model output (no batch dim); the device-buffer layout
+    for batched actor inference (role of reference features.py:130-145)."""
+    ret = {
+        "logit": fake_action_logits(),
+        "entity_num": np.asarray(0, dtype=np.int64),
+        "selected_units_num": np.asarray(0, dtype=np.int64),
+        "hidden_state": [
+            (_zeros((hidden_size,), np.float32), _zeros((hidden_size,), np.float32))
+            for _ in range(hidden_layers)
+        ],
+    }
+    if not teacher:
+        ret.update(
+            {
+                "action_info": fake_action_info(),
+                "action_logp": fake_action_logp(),
+                "extra_units": _zeros((MAX_ENTITY_NUM + 1,), np.float32),
+            }
+        )
+    return ret
+
+
+def batch_tree(trees, stack=np.stack):
+    """Stack a list of nested dict/tuple/array structures along axis 0."""
+    first = trees[0]
+    if isinstance(first, dict):
+        return {k: batch_tree([t[k] for t in trees], stack) for k in first}
+    if isinstance(first, (tuple, list)):
+        return type(first)(batch_tree([t[i] for t in trees], stack) for i in range(len(first)))
+    return stack([np.asarray(t) for t in trees])

@@ -1,0 +1,45 @@
+from .blocks import (
+    GLU,
+    FCBlock,
+    Conv2DBlock,
+    ResBlock,
+    ResFCBlock,
+    GatedResBlock,
+    FiLM,
+    binary_encode,
+    one_hot,
+    sequence_mask,
+)
+from .transformer import Attention, Transformer, AttentionPool
+from .lstm import LayerNormLSTMCell, PlainLSTMCell, StackedLSTM
+from .scatter import scatter_connection
+from .rl import (
+    generalized_lambda_returns,
+    vtrace_advantages,
+    upgo_returns,
+    td_lambda_loss,
+)
+
+__all__ = [
+    "GLU",
+    "FCBlock",
+    "Conv2DBlock",
+    "ResBlock",
+    "ResFCBlock",
+    "GatedResBlock",
+    "FiLM",
+    "binary_encode",
+    "one_hot",
+    "sequence_mask",
+    "Attention",
+    "Transformer",
+    "AttentionPool",
+    "LayerNormLSTMCell",
+    "PlainLSTMCell",
+    "StackedLSTM",
+    "scatter_connection",
+    "generalized_lambda_returns",
+    "vtrace_advantages",
+    "upgo_returns",
+    "td_lambda_loss",
+]

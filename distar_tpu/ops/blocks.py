@@ -1,0 +1,182 @@
+"""Core NN building blocks (Flax linen), TPU-first.
+
+These fill the roles of the reference's fc/conv/res/GLU block zoo
+(reference: distar/ctools/torch_utils/network/nn_module.py, res_block.py,
+module_utils.py:204-353,508-525) but are designed for XLA: channels-last
+convolutions (NHWC maps onto TPU conv layouts), optional bfloat16 compute
+dtype on every matmul/conv, and one-hot/binary encodings expressed as
+gathers so the compiler fuses them into the consuming matmul.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+Dtype = Any
+
+ACTIVATIONS: dict = {
+    "relu": jax.nn.relu,
+    "gelu": jax.nn.gelu,
+    "tanh": jnp.tanh,
+    "sigmoid": jax.nn.sigmoid,
+    None: lambda x: x,
+    "none": lambda x: x,
+}
+
+
+def build_activation(name: Optional[str]) -> Callable:
+    if callable(name):
+        return name
+    return ACTIVATIONS[name]
+
+
+def one_hot(x: jnp.ndarray, num_classes: int, clamp: bool = True) -> jnp.ndarray:
+    """One-hot with the reference's clamp-don't-crash semantics
+    (entity_encoder.py:72): out-of-range ids clip to the last class."""
+    x = x.astype(jnp.int32)
+    if clamp:
+        x = jnp.clip(x, 0, num_classes - 1)
+    return jax.nn.one_hot(x, num_classes, dtype=jnp.float32)
+
+
+def binary_encode(x: jnp.ndarray, bit_num: int) -> jnp.ndarray:
+    """Fixed-width binary expansion of non-negative ints (low bit last,
+    matching the reference's get_binary_embed_mat big-endian bit order)."""
+    x = x.astype(jnp.int32)
+    shifts = jnp.arange(bit_num - 1, -1, -1, dtype=jnp.int32)
+    return ((x[..., None] >> shifts) & 1).astype(jnp.float32)
+
+
+def sequence_mask(lengths: jnp.ndarray, max_len: int) -> jnp.ndarray:
+    """[..., max_len] boolean mask: position i valid iff i < length."""
+    return jnp.arange(max_len)[None, :] < lengths[..., None]
+
+
+class FCBlock(nn.Module):
+    """Dense + optional LayerNorm + activation."""
+
+    features: int
+    activation: Optional[str] = "relu"
+    norm: Optional[str] = None
+    dtype: Dtype = jnp.float32
+    kernel_init: Callable = nn.initializers.lecun_uniform()
+    bias_init: Callable = nn.initializers.zeros_init()
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.Dense(
+            self.features, dtype=self.dtype, kernel_init=self.kernel_init, bias_init=self.bias_init
+        )(x)
+        if self.norm == "LN":
+            x = nn.LayerNorm(dtype=self.dtype)(x)
+        return build_activation(self.activation)(x)
+
+
+class Conv2DBlock(nn.Module):
+    """NHWC conv + optional norm + activation."""
+
+    features: int
+    kernel_size: int = 3
+    strides: int = 1
+    padding: Any = "SAME"
+    activation: Optional[str] = "relu"
+    norm: Optional[str] = None
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        pad = self.padding
+        if isinstance(pad, int):
+            pad = [(pad, pad), (pad, pad)]
+        x = nn.Conv(
+            self.features,
+            (self.kernel_size, self.kernel_size),
+            strides=(self.strides, self.strides),
+            padding=pad,
+            dtype=self.dtype,
+        )(x)
+        if self.norm == "LN":
+            x = nn.LayerNorm(dtype=self.dtype)(x)
+        return build_activation(self.activation)(x)
+
+
+class ResBlock(nn.Module):
+    """Two 3x3 convs with a skip: act(x + conv(conv(x)))."""
+
+    features: int
+    activation: str = "relu"
+    norm: Optional[str] = None
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        act = build_activation(self.activation)
+        y = Conv2DBlock(self.features, 3, 1, "SAME", self.activation, self.norm, self.dtype)(x)
+        y = Conv2DBlock(self.features, 3, 1, "SAME", None, self.norm, self.dtype)(y)
+        return act(x + y)
+
+
+class ResFCBlock(nn.Module):
+    """Residual fc block: act(x + fc(fc(x))), norm per fc as configured."""
+
+    features: int
+    activation: str = "relu"
+    norm: Optional[str] = "LN"
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        act = build_activation(self.activation)
+        y = FCBlock(self.features, self.activation, self.norm, self.dtype)(x)
+        y = FCBlock(self.features, None, self.norm, self.dtype)(y)
+        return act(x + y)
+
+
+class GLU(nn.Module):
+    """Gated linear unit conditioned on a context vector
+    (role of reference module_utils.py:508-525): out = (sigmoid(W_c ctx) * x) W."""
+
+    features: int
+    context_features: Optional[int] = None
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, context):
+        gate = nn.Dense(x.shape[-1], dtype=self.dtype)(context)
+        gate = jax.nn.sigmoid(gate)
+        return nn.Dense(self.features, dtype=self.dtype)(gate * x)
+
+
+class GatedResBlock(nn.Module):
+    """Conv res block whose residual is gated by a noise/context map
+    (role of reference module_utils.py:204-231)."""
+
+    features: int
+    activation: str = "relu"
+    norm: Optional[str] = None
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, gate_map):
+        act = build_activation(self.activation)
+        y = Conv2DBlock(self.features, 3, 1, "SAME", self.activation, self.norm, self.dtype)(x)
+        y = Conv2DBlock(self.features, 3, 1, "SAME", None, self.norm, self.dtype)(y)
+        g = gate_map
+        for a in (self.activation, self.activation, self.activation, None):
+            g = Conv2DBlock(self.features, 1, 1, "SAME", a, None, self.dtype)(g)
+        scale = self.param("update_sp", nn.initializers.constant(0.1), (1,))
+        y = jnp.tanh(y * jax.nn.sigmoid(g)) * scale
+        return act(x + y)
+
+
+class FiLM(nn.Module):
+    """Feature-wise linear modulation over NHWC maps."""
+
+    @nn.compact
+    def __call__(self, x, gammas, betas):
+        gammas = gammas[:, None, None, :]
+        betas = betas[:, None, None, :]
+        return gammas * x + betas

@@ -1,0 +1,112 @@
+"""LSTM cells and a stacked scan-based runner.
+
+Fills the role of the reference's TorchScript LN-LSTM core
+(reference: distar/agent/default/model/lstm.py: LSTMCell :69-93,
+LayerNormLSTMCell :120+, StackedLSTM). TPU-first design: the time loop is a
+single `jax.lax.scan` whose body is one fused cell step per layer — XLA
+unrolls nothing, compiles once for any T, and the 4*hidden gate matmul lands
+on the MXU. State layout is a tuple of (h, c) pairs, one per layer, each
+[B, hidden].
+"""
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+Dtype = Any
+LSTMState = Tuple[jnp.ndarray, jnp.ndarray]  # (h, c) each [B, H]
+
+
+class PlainLSTMCell(nn.Module):
+    """Standard LSTM cell: gates = x W_ih + h W_hh + b."""
+
+    hidden_size: int
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, state: LSTMState) -> Tuple[jnp.ndarray, LSTMState]:
+        h, c = state
+        gates = nn.Dense(4 * self.hidden_size, dtype=self.dtype, name="ih")(x) + nn.Dense(
+            4 * self.hidden_size, dtype=self.dtype, name="hh"
+        )(h)
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        c_new = jax.nn.sigmoid(f) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
+        h_new = jax.nn.sigmoid(o) * jnp.tanh(c_new)
+        return h_new, (h_new, c_new)
+
+
+class LayerNormLSTMCell(nn.Module):
+    """LSTM cell with layer-normalised input/recurrent projections and cell
+    state, matching the reference's LayerNormLSTMCell gate structure:
+    gates = LN(x W_ih) + LN(h W_hh); c' = LN(f*c + i*g); h' = o * tanh(c')."""
+
+    hidden_size: int
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, state: LSTMState) -> Tuple[jnp.ndarray, LSTMState]:
+        h, c = state
+        ih = nn.LayerNorm(dtype=self.dtype, name="ln_ih")(
+            nn.Dense(4 * self.hidden_size, use_bias=False, dtype=self.dtype, name="ih")(x)
+        )
+        hh = nn.LayerNorm(dtype=self.dtype, name="ln_hh")(
+            nn.Dense(4 * self.hidden_size, use_bias=False, dtype=self.dtype, name="hh")(h)
+        )
+        gates = ih + hh
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        c_new = nn.LayerNorm(dtype=self.dtype, name="ln_c")(
+            jax.nn.sigmoid(f) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
+        )
+        h_new = jax.nn.sigmoid(o) * jnp.tanh(c_new)
+        return h_new, (h_new, c_new)
+
+
+class StackedLSTM(nn.Module):
+    """N stacked cells scanned over time.
+
+    Input [T, B, D] -> output [T, B, H] plus final per-layer states. The scan
+    carries all layer states; per step each layer feeds the next.
+    """
+
+    hidden_size: int
+    num_layers: int
+    norm: str = "LN"  # 'LN' -> LayerNormLSTMCell, 'none' -> PlainLSTMCell
+    dtype: Dtype = jnp.float32
+
+    def setup(self):
+        cell_cls = LayerNormLSTMCell if self.norm == "LN" else PlainLSTMCell
+        self.cells = [
+            cell_cls(self.hidden_size, self.dtype, name=f"layer{i}")
+            for i in range(self.num_layers)
+        ]
+
+    def init_state(self, batch_size: int) -> Tuple[LSTMState, ...]:
+        z = jnp.zeros((batch_size, self.hidden_size), dtype=self.dtype)
+        return tuple((z, z) for _ in range(self.num_layers))
+
+    def _step(self, states, x):
+        new_states = []
+        for cell, st in zip(self.cells, states):
+            x, st = cell(x, st)
+            new_states.append(st)
+        return tuple(new_states), x
+
+    def __call__(
+        self, xs: jnp.ndarray, states: Optional[Tuple[LSTMState, ...]] = None
+    ) -> Tuple[jnp.ndarray, Tuple[LSTMState, ...]]:
+        if states is None:
+            states = self.init_state(xs.shape[1])
+        if self.is_initializing():
+            # trace one step eagerly so params exist before scan
+            final, y = self._step(states, xs[0])
+            ys = jnp.broadcast_to(y[None], (xs.shape[0],) + y.shape)
+            return ys, final
+        final, ys = nn.transforms.scan(
+            lambda mdl, carry, x: mdl._step(carry, x),
+            variable_broadcast="params",
+            split_rngs={"params": False},
+        )(self, states, xs)
+        return ys, final

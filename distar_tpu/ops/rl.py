@@ -1,0 +1,115 @@
+"""RL return/advantage primitives as pure jnp functions.
+
+Functional equivalents of the reference's return computations
+(reference: distar/agent/default/rl_training/as_rl_utils.py:157-312), with
+the reverse time recursions expressed as `jax.lax.scan` over the reversed
+time axis instead of Python loops — one compiled kernel for any T.
+
+Shape convention matches the reference: time-major [T, B] rewards and
+[T+1, B] bootstrap values.
+"""
+from __future__ import annotations
+
+from typing import Union
+
+import jax
+import jax.numpy as jnp
+
+Scalar = Union[float, jnp.ndarray]
+
+
+def _as_tb(x: Scalar, like: jnp.ndarray) -> jnp.ndarray:
+    return x * jnp.ones_like(like) if not isinstance(x, jnp.ndarray) or x.ndim == 0 else x
+
+
+def multistep_forward_view(
+    rewards: jnp.ndarray,  # [T, B]
+    gammas: jnp.ndarray,  # [T, B]
+    bootstrap_values: jnp.ndarray,  # [T, B] = V[1..T]
+    lambda_: jnp.ndarray,  # [T, B]
+) -> jnp.ndarray:
+    """Sutton & Barto (12.18) lambda-return recursion:
+    result[T-1] = r[T-1] + g[T-1] V[T];
+    result[t] = r[t] + g[t] (l[t] result[t+1] + (1-l[t]) V[t+1])."""
+    discounts = gammas * lambda_
+
+    def step(carry, xs):
+        r, g, d, v = xs
+        ret = r + d * carry + (g - d) * v
+        return ret, ret
+
+    last = rewards[-1] + gammas[-1] * bootstrap_values[-1]
+    xs = (rewards[:-1], gammas[:-1], discounts[:-1], bootstrap_values[:-1])
+    _, rest = jax.lax.scan(step, last, xs, reverse=True)
+    return jnp.concatenate([rest, last[None]], axis=0)
+
+
+def generalized_lambda_returns(
+    rewards: jnp.ndarray,  # [T, B]
+    gammas: Scalar,
+    bootstrap_values: jnp.ndarray,  # [T+1, B]
+    lambda_: Scalar,
+) -> jnp.ndarray:
+    gammas = _as_tb(gammas, rewards)
+    lambda_ = _as_tb(lambda_, rewards)
+    return multistep_forward_view(rewards, gammas, bootstrap_values[1:], lambda_)
+
+
+def td_lambda_loss(
+    values: jnp.ndarray,  # [T+1, B]
+    rewards: jnp.ndarray,  # [T, B]
+    gamma: Scalar = 1.0,
+    lambda_: Scalar = 0.8,
+    mask: jnp.ndarray = None,  # [T, B] optional
+) -> jnp.ndarray:
+    """0.5 * (G_lambda - V)^2 with targets stop-gradiented, mean-reduced."""
+    returns = jax.lax.stop_gradient(
+        generalized_lambda_returns(rewards, gamma, values, lambda_)
+    )
+    loss = 0.5 * jnp.square(returns - values[:-1])
+    if mask is not None:
+        loss = loss * mask
+    return loss.mean()
+
+
+def upgo_returns(rewards: jnp.ndarray, bootstrap_values: jnp.ndarray) -> jnp.ndarray:
+    """UPGO targets: lambda-returns where the trace continues (lambda=1)
+    iff r_{t+1} + V_{t+2} >= V_{t+1} (shifted as in the reference)."""
+    lambdas = (rewards + bootstrap_values[1:]) >= bootstrap_values[:-1]
+    lambdas = jnp.concatenate([lambdas[1:], jnp.ones_like(lambdas[-1:])], axis=0)
+    return generalized_lambda_returns(rewards, 1.0, bootstrap_values, lambdas.astype(rewards.dtype))
+
+
+def vtrace_advantages(
+    clipped_rhos: jnp.ndarray,  # [T, B]
+    clipped_cs: jnp.ndarray,  # [T, B]
+    rewards: jnp.ndarray,  # [T, B]
+    bootstrap_values: jnp.ndarray,  # [T+1, B]
+    clipped_pg_rhos: jnp.ndarray = None,
+    gammas: Scalar = 1.0,
+    lambda_: Scalar = 0.8,
+) -> jnp.ndarray:
+    """IMPALA V-trace advantages (Espeholt et al. 2018), lambda-weighted as
+    in the reference: vs_t = V_t + delta_t + g l c_t (vs_{t+1} - V_{t+1});
+    adv = pg_rho * (r + g vs_{t+1} - V_t)."""
+    gammas = _as_tb(gammas, rewards)
+    lambda_ = _as_tb(lambda_, rewards)
+    deltas = clipped_rhos * (rewards + gammas * bootstrap_values[1:] - bootstrap_values[:-1])
+
+    def step(carry, xs):
+        delta, g, lam, c = xs
+        # carry = vs_{t+1} - V_{t+1}
+        diff = delta + g * lam * c * carry
+        return diff, diff
+
+    _, diffs = jax.lax.scan(
+        step,
+        jnp.zeros_like(bootstrap_values[-1]),
+        (deltas, gammas, lambda_, clipped_cs),
+        reverse=True,
+    )
+    vs = bootstrap_values[:-1] + diffs  # [T, B]
+    vs_tp1 = jnp.concatenate([vs[1:], bootstrap_values[-1:]], axis=0)
+    if clipped_pg_rhos is None:
+        clipped_pg_rhos = clipped_rhos
+    return clipped_pg_rhos * (rewards + gammas * vs_tp1 - bootstrap_values[:-1])

@@ -1,0 +1,145 @@
+"""Masked set-attention transformer and learned-query attention pooling.
+
+Fills the role of the reference's entity transformer
+(reference: distar/agent/default/model/module_utils.py:71-199,37-69). The
+attention here is over *sets of <=512 entities*, not long sequences — one
+fused softmax(QK^T)V per layer maps cleanly onto the MXU at these sizes, so
+the default path is plain XLA (which fuses mask+softmax well). The mask is a
+key-validity vector broadcast over queries.
+
+For genuinely long sequences the natural extension point is a sequence-
+parallel mesh axis (ring attention over shards); `Attention` takes logical
+axis names so heads/features can be sharded via pjit when that axis exists.
+"""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from .blocks import FCBlock, build_activation
+
+Dtype = Any
+
+NEG_INF = -1e9
+
+
+class Attention(nn.Module):
+    """Multi-head self-attention over a set, with key-validity masking."""
+
+    head_dim: int
+    head_num: int
+    output_dim: int
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, mask: Optional[jnp.ndarray] = None):
+        B, N, _ = x.shape
+        qkv = nn.Dense(3 * self.head_dim * self.head_num, dtype=self.dtype)(x)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+
+        def heads(t):
+            return t.reshape(B, N, self.head_num, self.head_dim).transpose(0, 2, 1, 3)
+
+        q, k, v = heads(q), heads(k), heads(v)
+        score = jnp.einsum("bhqd,bhkd->bhqk", q, k) / jnp.sqrt(float(self.head_dim))
+        if mask is not None:
+            # mask: [B, N] key validity -> broadcast over heads and queries
+            score = jnp.where(mask[:, None, None, :], score, NEG_INF)
+        score = jax.nn.softmax(score, axis=-1)
+        out = jnp.einsum("bhqk,bhkd->bhqd", score, v)
+        out = out.transpose(0, 2, 1, 3).reshape(B, N, self.head_num * self.head_dim)
+        return nn.Dense(self.output_dim, dtype=self.dtype)(out)
+
+
+class TransformerLayer(nn.Module):
+    head_dim: int
+    hidden_dim: int
+    output_dim: int
+    head_num: int
+    mlp_num: int
+    activation: str = "relu"
+    ln_type: str = "post"
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, mask: Optional[jnp.ndarray] = None):
+        attn = Attention(self.head_dim, self.head_num, self.output_dim, self.dtype)
+        dims = [self.hidden_dim] * (self.mlp_num - 1) + [self.output_dim]
+
+        def mlp(h):
+            for d in dims:
+                h = FCBlock(d, self.activation, dtype=self.dtype)(h)
+            return h
+
+        if self.ln_type == "post":
+            x = nn.LayerNorm(dtype=self.dtype)(x + attn(x, mask))
+            x = nn.LayerNorm(dtype=self.dtype)(x + mlp(x))
+        elif self.ln_type == "pre":
+            x = x + attn(nn.LayerNorm(dtype=self.dtype)(x), mask)
+            x = x + mlp(nn.LayerNorm(dtype=self.dtype)(x))
+        else:
+            raise NotImplementedError(self.ln_type)
+        return x
+
+
+class Transformer(nn.Module):
+    """Embedding fc + N transformer layers, masked over invalid set slots."""
+
+    head_dim: int = 128
+    hidden_dim: int = 1024
+    output_dim: int = 256
+    head_num: int = 2
+    mlp_num: int = 2
+    layer_num: int = 3
+    activation: str = "relu"
+    ln_type: str = "pre"
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, mask: Optional[jnp.ndarray] = None):
+        x = FCBlock(self.output_dim, self.activation, dtype=self.dtype)(x)
+        for _ in range(self.layer_num):
+            x = TransformerLayer(
+                self.head_dim,
+                self.hidden_dim,
+                self.output_dim,
+                self.head_num,
+                self.mlp_num,
+                self.activation,
+                self.ln_type,
+                self.dtype,
+            )(x, mask)
+        return x
+
+
+class AttentionPool(nn.Module):
+    """Learned-query pooling over a masked set, optional count embedding
+    (role of reference module_utils.py:37-69)."""
+
+    head_num: int
+    output_dim: int
+    max_num: Optional[int] = None
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, num: Optional[jnp.ndarray] = None, mask: Optional[jnp.ndarray] = None):
+        B, N, C = x.shape
+        queries = self.param("queries", nn.initializers.xavier_uniform(), (1, 1, self.head_num, C))
+        score = (x[:, :, None, :] * queries).sum(-1)  # B, N, H
+        if mask is not None:
+            if mask.ndim == 3:
+                mask = mask[..., 0]
+            score = jnp.where(mask[:, :, None].astype(bool), score, NEG_INF)
+        score = jax.nn.softmax(score, axis=1)
+        pooled = jnp.einsum("bnc,bnh->bhc", x, score).reshape(B, self.head_num * C)
+        pooled = nn.Dense(self.output_dim, dtype=self.dtype)(pooled)
+        if self.max_num is not None:
+            assert num is not None
+            count = nn.Embed(self.max_num, self.output_dim, dtype=self.dtype)(
+                jnp.clip(num.astype(jnp.int32), 0, self.max_num - 1)
+            )
+            pooled = pooled + jax.nn.relu(count)
+        return jax.nn.relu(pooled)

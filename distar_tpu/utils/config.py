@@ -48,15 +48,16 @@ class Config(dict):
         return Config({k: copy.deepcopy(v, memo) for k, v in self.items()})
 
     def to_dict(self) -> Dict[str, Any]:
-        out: Dict[str, Any] = {}
-        for k, v in self.items():
-            if isinstance(v, Config):
-                out[k] = v.to_dict()
-            elif isinstance(v, (list, tuple)):
-                out[k] = type(v)(x.to_dict() if isinstance(x, Config) else x for x in v)
-            else:
-                out[k] = v
-        return out
+        return _unwrap(self)
+
+
+def _unwrap(value: Any) -> Any:
+    """Recursively convert Config/Mapping nodes back to plain dicts."""
+    if isinstance(value, Mapping):
+        return {k: _unwrap(v) for k, v in value.items()}
+    if isinstance(value, (list, tuple)):
+        return type(value)(_unwrap(v) for v in value)
+    return value
 
 
 def deep_merge_dicts(base: Mapping, override: Mapping) -> Config:
@@ -75,7 +76,8 @@ def deep_merge_dicts(base: Mapping, override: Mapping) -> Config:
 
 
 def read_config(path: str) -> Config:
-    """Load a YAML file into a Config. Missing file -> empty Config."""
+    """Load a YAML file into a Config. Raises FileNotFoundError when absent
+    (optional layers should check existence and pass {})."""
     if not os.path.exists(path):
         raise FileNotFoundError(path)
     with open(path, "r") as f:
@@ -85,6 +87,6 @@ def read_config(path: str) -> Config:
 
 def save_config(cfg: Mapping, path: str) -> None:
     os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
-    body = cfg.to_dict() if isinstance(cfg, Config) else dict(cfg)
+    body = _unwrap(cfg)
     with open(path, "w") as f:
         yaml.safe_dump(body, f, default_flow_style=False, sort_keys=False)

@@ -96,9 +96,13 @@ class VariableRecord:
 class TextLogger:
     """File + console logger, one per role/rank."""
 
+    _instances = 0
+
     def __init__(self, path: str, name: str = "distar_tpu", to_console: bool = True):
         os.makedirs(path, exist_ok=True)
-        self._logger = logging.getLogger(f"{name}.{id(self)}")
+        TextLogger._instances += 1
+        self._logger = logging.getLogger(f"{name}.{TextLogger._instances}")
+        self._logger.handlers.clear()
         self._logger.setLevel(logging.INFO)
         self._logger.propagate = False
         fmt = logging.Formatter("[%(asctime)s][%(levelname)s] %(message)s")
@@ -135,7 +139,10 @@ class ScalarSink:
             self._tb.add_scalar(name, value, global_step)
         else:
             self._file.write(
-                json.dumps({"ts": time.time(), "step": global_step, name: float(value)}) + "\n"
+                json.dumps(
+                    {"ts": time.time(), "step": global_step, "name": name, "value": float(value)}
+                )
+                + "\n"
             )
             self._file.flush()
 

@@ -7,6 +7,7 @@ leaving the timed region (the timer itself stays device-agnostic).
 """
 from __future__ import annotations
 
+import functools
 import time
 
 
@@ -38,6 +39,7 @@ class StopWatch:
 
     def decorate(self, name: str):
         def wrapper(fn):
+            @functools.wraps(fn)
             def inner(*args, **kwargs):
                 with self(name):
                     return fn(*args, **kwargs)

@@ -1,15 +1,25 @@
-"""Test harness: force an 8-device virtual CPU platform before JAX import.
+"""Test harness: force an 8-device virtual CPU platform.
 
 This is the TPU analogue of the reference's FakeLink fake distributed backend
 (distar/ctools/utils/fake_linklink.py) — multi-device collective code paths
 run single-process on virtual devices.
+
+The image's sitecustomize registers the 'axon' TPU tunnel backend at
+interpreter start and pins the jax platform to axon *via jax.config* (so
+setting JAX_PLATFORMS here is too late). We override the config back to cpu
+before any backend is initialised. The real-TPU path is exercised by
+bench.py / __graft_entry__.py, not by tests — the single tunneled chip
+admits one client at a time and tests must not hold it.
 """
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
 
 import numpy as np
 import pytest

@@ -1,0 +1,93 @@
+"""Contract tests: derived action tables must match the reference's published
+counts (actor_critic_default_config.yaml:1-11) and internal consistency."""
+import numpy as np
+
+from distar_tpu.lib import actions as A
+from distar_tpu.lib import features as F
+
+
+def test_vocabulary_sizes():
+    assert A.NUM_ACTIONS == 327
+    assert A.NUM_UNIT_TYPES == 260
+    assert A.NUM_BUFFS == 50
+    assert A.NUM_UPGRADES == 90
+    assert A.NUM_ADDON == 9
+    assert A.NUM_UNIT_MIX_ABILITIES == 269
+
+
+def test_derived_action_subset_sizes():
+    # reference: actor_critic_default_config.yaml:6-8. NB the reference yaml
+    # says NUM_QUEUE_ACTIONS=49 but its own derivation (actions.py:358-364)
+    # yields 109 — runtime inputs are clamped into the 49-wide embedding
+    # (entity_encoder.py:72). We keep the true derived count here and mirror
+    # the 49-wide embedding (with clamp) in the model config.
+    assert A.NUM_QUEUE_ACTIONS == 109
+    assert A.QUEUE_ACTION_EMBEDDING_DIM == 49
+    assert A.NUM_BEGINNING_ORDER_ACTIONS == 174
+    assert A.NUM_CUMULATIVE_STAT_ACTIONS == 167
+
+
+def test_reorder_arrays():
+    # every unit type maps back to its dense index
+    for dense, game_id in enumerate(A.UNIT_TYPES[:20]):
+        assert A.UNIT_TYPES_REORDER_ARRAY[game_id] == dense
+    # ids outside the vocabulary are -1
+    missing = [i for i in range(len(A.UNIT_TYPES_REORDER_ARRAY)) if i not in A.UNIT_TYPES]
+    assert A.UNIT_TYPES_REORDER_ARRAY[missing[0]] == -1
+
+
+def test_ability_remaps():
+    assert A.UNIT_ABILITY_REORDER[0] == 0
+    # spot check: every specific ability maps into the mix vocabulary
+    for spec in A.UNIT_SPECIFIC_ABILITIES[:50]:
+        idx = A.UNIT_ABILITY_REORDER[spec]
+        assert 0 <= idx < A.NUM_UNIT_MIX_ABILITIES
+        assert A.UNIT_MIX_ABILITIES[idx] == A.ABILITY_TO_GABILITY[spec]
+    assert A.ABILITY_TO_QUEUE_ACTION[0] == 0
+    assert A.ABILITY_TO_QUEUE_ACTION.max() == A.NUM_QUEUE_ACTIONS
+
+
+def test_head_masks():
+    assert A.SELECTED_UNITS_MASK.shape == (327,)
+    # no_op selects nothing
+    assert not A.SELECTED_UNITS_MASK[0]
+    # Attack_unit (func_id 3) targets a unit
+    attack_unit = A.FUNC_ID_TO_ACTION_TYPE[3]
+    assert A.TARGET_UNIT_MASK[attack_unit]
+    assert A.SELECTED_UNITS_MASK[attack_unit]
+    assert not A.TARGET_LOCATION_MASK[attack_unit]
+
+
+def test_queue_actions_are_train_or_research():
+    for idx in A.QUEUE_ACTIONS:
+        name = A.ACTIONS[idx]["name"]
+        assert "Train_" in name or "Research" in name
+
+
+def test_fake_step_data_schema():
+    d = F.fake_step_data(train=True)
+    assert set(d) == {
+        "spatial_info", "scalar_info", "entity_info", "entity_num",
+        "action_info", "action_mask", "selected_units_num",
+    }
+    assert d["spatial_info"]["height_map"].shape == F.SPATIAL_SIZE
+    assert d["spatial_info"]["effect_PsiStorm"].shape == (F.EFFECT_LENGTH,)
+    assert d["scalar_info"]["beginning_order"].shape == (20,)
+    assert d["entity_info"]["unit_type"].shape == (F.MAX_ENTITY_NUM,)
+    assert d["action_info"]["selected_units"].shape == (F.MAX_SELECTED_UNITS_NUM,)
+
+
+def test_fake_model_output_schema():
+    out = F.fake_model_output()
+    assert out["logit"]["selected_units"].shape == (64, 513)
+    assert out["logit"]["target_location"].shape == (152 * 160,)
+    assert len(out["hidden_state"]) == 3
+    teacher = F.fake_model_output(teacher=True)
+    assert "action_info" not in teacher
+
+
+def test_batch_tree():
+    trees = [F.fake_step_data(train=False, rng=np.random.default_rng(i)) for i in range(3)]
+    batched = F.batch_tree(trees)
+    assert batched["spatial_info"]["height_map"].shape == (3, *F.SPATIAL_SIZE)
+    assert batched["entity_num"].shape == (3,)

@@ -1,0 +1,125 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distar_tpu.ops import (
+    AttentionPool,
+    FCBlock,
+    GLU,
+    LayerNormLSTMCell,
+    ResBlock,
+    ResFCBlock,
+    StackedLSTM,
+    Transformer,
+    binary_encode,
+    one_hot,
+    scatter_connection,
+    sequence_mask,
+)
+
+
+def test_one_hot_clamps():
+    x = jnp.array([0, 5, 99])
+    out = one_hot(x, 6)
+    assert out.shape == (3, 6)
+    assert out[2, 5] == 1.0  # out-of-range clamps to last class
+
+
+def test_binary_encode():
+    out = np.asarray(binary_encode(jnp.array([5]), 4))
+    np.testing.assert_array_equal(out[0], [0, 1, 0, 1])
+
+
+def test_sequence_mask():
+    m = np.asarray(sequence_mask(jnp.array([0, 2, 4]), 4))
+    assert m.sum() == 6
+    assert m[1, 1] and not m[1, 2]
+
+
+def test_fc_res_blocks():
+    x = jnp.ones((2, 16))
+    for mod in (FCBlock(32), ResFCBlock(16, norm="LN")):
+        params = mod.init(jax.random.PRNGKey(0), x)
+        y = mod.apply(params, x)
+        assert y.shape[0] == 2
+
+
+def test_conv_res_block():
+    x = jnp.ones((2, 8, 8, 4))
+    mod = ResBlock(4)
+    y = mod.apply(mod.init(jax.random.PRNGKey(0), x), x)
+    assert y.shape == (2, 8, 8, 4)
+
+
+def test_glu():
+    x, ctx = jnp.ones((2, 16)), jnp.ones((2, 8))
+    mod = GLU(32)
+    y = mod.apply(mod.init(jax.random.PRNGKey(0), x, ctx), x, ctx)
+    assert y.shape == (2, 32)
+
+
+def test_transformer_masked_invariance():
+    """Padded entity slots must not influence valid entity outputs."""
+    B, N, D = 2, 8, 12
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((B, N, D)).astype(np.float32)
+    lengths = jnp.array([5, 8])
+    mask = sequence_mask(lengths, N)
+    mod = Transformer(head_dim=8, hidden_dim=16, output_dim=16, layer_num=2)
+    params = mod.init(jax.random.PRNGKey(0), jnp.asarray(x), mask)
+    y1 = mod.apply(params, jnp.asarray(x), mask)
+    # perturb padding slots of batch 0 (idx >= 5)
+    x2 = x.copy()
+    x2[0, 5:] += 100.0
+    y2 = mod.apply(params, jnp.asarray(x2), mask)
+    np.testing.assert_allclose(np.asarray(y1[0, :5]), np.asarray(y2[0, :5]), atol=1e-4)
+    np.testing.assert_allclose(np.asarray(y1[1]), np.asarray(y2[1]), atol=1e-4)
+
+
+def test_attention_pool():
+    B, N, C = 2, 6, 8
+    x = jnp.ones((B, N, C))
+    mask = sequence_mask(jnp.array([3, 6]), N)[..., None]
+    mod = AttentionPool(head_num=2, output_dim=16, max_num=7)
+    params = mod.init(jax.random.PRNGKey(0), x, jnp.array([3, 6]), mask)
+    y = mod.apply(params, x, jnp.array([3, 6]), mask)
+    assert y.shape == (2, 16)
+
+
+def test_lstm_cell_and_stack():
+    T, B, D, H = 5, 2, 12, 16
+    xs = jnp.asarray(np.random.default_rng(0).standard_normal((T, B, D)), dtype=jnp.float32)
+    mod = StackedLSTM(hidden_size=H, num_layers=3)
+    params = mod.init(jax.random.PRNGKey(0), xs)
+    ys, final = mod.apply(params, xs)
+    assert ys.shape == (T, B, H)
+    assert len(final) == 3 and final[0][0].shape == (B, H)
+    # carrying state: running [T] then [T:] from the carried state == running all at once
+    ys_a, st = mod.apply(params, xs[:3])
+    ys_b, _ = mod.apply(params, xs[3:], st)
+    np.testing.assert_allclose(np.asarray(jnp.concatenate([ys_a, ys_b], 0)), np.asarray(ys), atol=1e-5)
+
+
+def test_scatter_connection_add():
+    B, N, D, H, W = 2, 4, 3, 5, 6
+    emb = jnp.ones((B, N, D))
+    # two entities share a cell in batch 0 -> embeddings add
+    loc = jnp.array(
+        [[[1, 2], [1, 2], [0, 0], [5, 4]], [[3, 1], [2, 2], [0, 4], [9, 9]]]
+    )
+    out = np.asarray(scatter_connection(emb, loc, (H, W), "add"))
+    assert out.shape == (B, H, W, D)
+    np.testing.assert_array_equal(out[0, 2, 1], [2, 2, 2])  # (x=1,y=2) doubled
+    np.testing.assert_array_equal(out[0, 0, 0], [1, 1, 1])
+    # out-of-range location clamps into the map
+    np.testing.assert_array_equal(out[1, 4, 5], [1, 1, 1])
+
+
+def test_scatter_connection_cover():
+    B, N, D, H, W = 1, 2, 2, 3, 3
+    emb = jnp.array([[[1.0, 1.0], [5.0, 5.0]]])
+    loc = jnp.array([[[1, 1], [1, 1]]])
+    out = np.asarray(scatter_connection(emb, loc, (H, W), "cover"))
+    # cover: one of the writes wins (scatter, not add)
+    assert out[0, 1, 1, 0] in (1.0, 5.0)

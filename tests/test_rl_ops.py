@@ -1,0 +1,102 @@
+"""Golden tests for return/advantage primitives against plain-numpy oracles
+written directly from the recursions (Sutton & Barto 12.18; IMPALA eq. 1)."""
+import numpy as np
+import jax.numpy as jnp
+
+from distar_tpu.ops import (
+    generalized_lambda_returns,
+    td_lambda_loss,
+    upgo_returns,
+    vtrace_advantages,
+)
+
+T, B = 7, 3
+
+
+def np_lambda_returns(r, gamma, v_tp1, lam):
+    # v_tp1: [T, B] = V[1..T]; G[t] = r[t] + gamma*(lam*G[t+1] + (1-lam)*V[t+1])
+    Tn = r.shape[0]
+    out = np.zeros_like(r)
+    out[-1] = r[-1] + gamma[-1] * v_tp1[-1]
+    for t in range(Tn - 2, -1, -1):
+        out[t] = r[t] + gamma[t] * (lam[t] * out[t + 1] + (1 - lam[t]) * v_tp1[t])
+    return out
+
+
+def np_vtrace(rhos, cs, r, v, gamma, lam):
+    Tn = r.shape[0]
+    deltas = rhos * (r + gamma * v[1:] - v[:-1])
+    vs = np.zeros_like(v)
+    vs[-1] = v[-1]
+    for t in range(Tn - 1, -1, -1):
+        vs[t] = v[t] + deltas[t] + gamma * lam * cs[t] * (vs[t + 1] - v[t + 1])
+    return rhos * (r + gamma * vs[1:] - v[:-1])
+
+
+def _rand(rng, *shape):
+    return rng.standard_normal(shape).astype(np.float32)
+
+
+def test_generalized_lambda_returns(rng):
+    r = _rand(rng, T, B)
+    v = _rand(rng, T + 1, B)
+    gamma, lam = 0.9, 0.8
+    got = generalized_lambda_returns(jnp.asarray(r), gamma, jnp.asarray(v), lam)
+    want = np_lambda_returns(r, np.full((T, B), gamma), v[1:], np.full((T, B), lam))
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5, atol=1e-5)
+
+
+def test_td_lambda_loss_matches_manual(rng):
+    r = _rand(rng, T, B)
+    v = _rand(rng, T + 1, B)
+    got = float(td_lambda_loss(jnp.asarray(v), jnp.asarray(r), 1.0, 0.8))
+    returns = np_lambda_returns(r, np.ones((T, B)), v[1:], np.full((T, B), 0.8))
+    want = float((0.5 * (returns - v[:-1]) ** 2).mean())
+    assert abs(got - want) < 1e-5
+
+
+def test_td_lambda_mask(rng):
+    r = _rand(rng, T, B)
+    v = _rand(rng, T + 1, B)
+    mask = np.zeros((T, B), np.float32)
+    assert float(td_lambda_loss(jnp.asarray(v), jnp.asarray(r), mask=jnp.asarray(mask))) == 0.0
+
+
+def test_upgo_returns(rng):
+    r = _rand(rng, T, B)
+    v = _rand(rng, T + 1, B)
+    got = np.asarray(upgo_returns(jnp.asarray(r), jnp.asarray(v)))
+    lambdas = ((r + v[1:]) >= v[:-1]).astype(np.float32)
+    lambdas = np.concatenate([lambdas[1:], np.ones_like(lambdas[-1:])], axis=0)
+    want = np_lambda_returns(r, np.ones((T, B)), v[1:], lambdas)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_vtrace_advantages(rng):
+    r = _rand(rng, T, B)
+    v = _rand(rng, T + 1, B)
+    rhos = np.clip(np.exp(_rand(rng, T, B)), None, 1.0).astype(np.float32)
+    got = np.asarray(
+        vtrace_advantages(jnp.asarray(rhos), jnp.asarray(rhos), jnp.asarray(r), jnp.asarray(v),
+                          gammas=1.0, lambda_=0.8)
+    )
+    want = np_vtrace(rhos, rhos, r, v, 1.0, 0.8)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_vtrace_on_policy_reduces_to_lambda_advantage(rng):
+    # with rhos == cs == 1 and lambda=1, vtrace target == full return
+    r = _rand(rng, T, B)
+    v = _rand(rng, T + 1, B)
+    ones = np.ones((T, B), np.float32)
+    adv = np.asarray(
+        vtrace_advantages(jnp.asarray(ones), jnp.asarray(ones), jnp.asarray(r), jnp.asarray(v),
+                          gammas=1.0, lambda_=1.0)
+    )
+    # oracle: G_t = sum_{s>=t} r_s + V_T; adv = G_t - V_t
+    G = np.zeros_like(r)
+    acc = v[-1]
+    for t in range(T - 1, -1, -1):
+        acc = r[t] + acc
+        G[t] = acc
+    np.testing.assert_allclose(adv, G - v[:-1], rtol=1e-4, atol=1e-4)

@@ -1,0 +1,78 @@
+"""Extract the game-data contract from the reference repo into JSON.
+
+The 327-entry action table and the unit/buff/upgrade/ability id vocabularies
+are *game data*, not code — the new framework must agree with the reference on
+them bit-for-bit or nothing (replays, Z files, pretrained ckpts) interops.
+This tool AST-parses the reference sources (never imports them, no torch
+needed) and emits ``distar_tpu/data/game_contract.json``.
+
+Sources (reference):
+  distar/agent/default/lib/actions.py   — ACTIONS table literal
+  distar/pysc2/lib/static_data.py       — id vocabularies + ability remaps
+
+Run:  python tools/extract_contract.py
+"""
+import ast
+import json
+import os
+
+REF = "/root/reference"
+OUT = os.path.join(os.path.dirname(__file__), "..", "distar_tpu", "data", "game_contract.json")
+
+
+def literal_assignments(path, names):
+    """Return {name: literal_value} for top-level assignments in ``path``."""
+    with open(path) as f:
+        tree = ast.parse(f.read())
+    found = {}
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            t = node.targets[0]
+            if isinstance(t, ast.Name) and t.id in names:
+                try:
+                    found[t.id] = ast.literal_eval(node.value)
+                except (ValueError, TypeError):
+                    pass
+    missing = set(names) - set(found)
+    if missing:
+        raise SystemExit(f"missing literals in {path}: {missing}")
+    return found
+
+
+def main():
+    actions = literal_assignments(
+        os.path.join(REF, "distar/agent/default/lib/actions.py"), ["ACTIONS"]
+    )["ACTIONS"]
+    static = literal_assignments(
+        os.path.join(REF, "distar/pysc2/lib/static_data.py"),
+        [
+            "ABILITIES",
+            "UNIT_TYPES",
+            "BUFFS",
+            "UPGRADES",
+            "ADDON",
+            "UNIT_SPECIFIC_ABILITIES",
+            "UNIT_GENERAL_ABILITIES",
+            "UNIT_MIX_ABILITIES",
+            "ORDER_ACTIONS",
+        ],
+    )
+
+    contract = {
+        "_provenance": {
+            "reference": "opendilab/DI-star @ /root/reference",
+            "actions_source": "distar/agent/default/lib/actions.py (ACTIONS literal)",
+            "static_source": "distar/pysc2/lib/static_data.py (id vocabularies)",
+        },
+        "actions": actions,
+        **{k.lower(): v for k, v in static.items()},
+    }
+    os.makedirs(os.path.dirname(OUT), exist_ok=True)
+    with open(OUT, "w") as f:
+        json.dump(contract, f, separators=(",", ":"))
+    sizes = {k: (len(v) if isinstance(v, list) else "-") for k, v in contract.items()}
+    print(json.dumps(sizes, indent=2))
+
+
+if __name__ == "__main__":
+    main()
