@@ -1,0 +1,332 @@
+"""Autoregressive policy heads.
+
+Role parity with the reference heads (reference: distar/agent/default/model/
+head/action_type_head.py, action_arg_head.py). The autoregressive chain is
+action_type -> delay -> queued -> selected_units -> target_unit -> location,
+each head consuming and extending a 1024-d autoregressive embedding.
+
+TPU-first reformulations:
+* Every sampling path takes an explicit PRNG key and uses
+  jax.random.categorical — no in-place logit mutation; temperature is a
+  static config scalar folded into the logits once.
+* SelectedUnitsHead runs a fixed MAX_SELECTED_UNITS_NUM-step `lax.scan` for
+  BOTH teacher-forced training and sampling inference (the reference's
+  dynamic-length Python loops, action_arg_head.py:168-313, cannot compile to
+  a single XLA program). Ended lanes are masked no-ops, preserving the
+  reference's semantics with static shapes.
+* LocationHead upsamples with jax.image.resize (bilinear) over NHWC maps.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from .config import static_cfg
+from ..lib.features import MAX_ENTITY_NUM, MAX_SELECTED_UNITS_NUM
+from ..ops import GLU, Conv2DBlock, FCBlock, GatedResBlock, ResBlock, ResFCBlock, sequence_mask
+from ..ops.blocks import build_activation
+from ..ops.lstm import PlainLSTMCell
+
+NEG_INF = -1e9
+
+
+class ActionTypeHead(nn.Module):
+    """ResFC tower + GLU logits over 327 action types; emits the initial
+    autoregressive embedding (role of reference action_type_head.py:18-67)."""
+
+    cfg: dict
+    dtype = jnp.float32
+
+    @nn.compact
+    def __call__(
+        self,
+        lstm_output: jnp.ndarray,
+        scalar_context: jnp.ndarray,
+        action_type: Optional[jnp.ndarray] = None,
+        rng: Optional[jax.Array] = None,
+        legal_mask: Optional[jnp.ndarray] = None,
+    ):
+        hc = static_cfg(self.cfg).policy.action_type_head
+        x = FCBlock(hc.res_dim, "relu", dtype=self.dtype)(lstm_output)
+        for _ in range(hc.res_num):
+            x = ResFCBlock(hc.res_dim, "relu", hc.norm_type, dtype=self.dtype)(x)
+        logits = GLU(hc.action_num, dtype=self.dtype, name="action_glu")(x, scalar_context)
+        logits = logits / static_cfg(self.cfg).temperature
+        if legal_mask is not None:
+            logits = jnp.where(legal_mask.astype(bool), logits, NEG_INF)
+        if action_type is None:
+            action_type = jax.random.categorical(rng, logits, axis=-1)
+        one_hot_action = jax.nn.one_hot(action_type, hc.action_num, dtype=jnp.float32)
+        e1 = FCBlock(hc.action_map_dim, "relu", dtype=self.dtype)(one_hot_action)
+        e1 = FCBlock(hc.action_map_dim, None, dtype=self.dtype)(e1)
+        e1 = GLU(hc.gate_dim, dtype=self.dtype, name="glu1")(e1, scalar_context)
+        e2 = GLU(hc.gate_dim, dtype=self.dtype, name="glu2")(lstm_output, scalar_context)
+        return logits, action_type, e1 + e2
+
+
+class DelayHead(nn.Module):
+    """128-way delay logits; no temperature (reference action_arg_head.py:27-53)."""
+
+    cfg: dict
+    dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, embedding, delay=None, rng=None):
+        hc = static_cfg(self.cfg).policy.delay_head
+        x = FCBlock(hc.decode_dim, "relu", dtype=self.dtype)(embedding)
+        x = FCBlock(hc.decode_dim, "relu", dtype=self.dtype)(x)
+        logits = FCBlock(hc.delay_dim, None, dtype=self.dtype)(x)
+        if delay is None:
+            delay = jax.random.categorical(rng, logits, axis=-1)
+        dh = jax.nn.one_hot(delay, hc.delay_dim, dtype=jnp.float32)
+        e = FCBlock(hc.delay_map_dim, "relu", dtype=self.dtype)(dh)
+        e = FCBlock(embedding.shape[-1], None, dtype=self.dtype)(e)
+        return logits, delay, embedding + e
+
+
+class QueuedHead(nn.Module):
+    """Binary queued flag (reference action_arg_head.py:56-86)."""
+
+    cfg: dict
+    dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, embedding, queued=None, rng=None):
+        hc = static_cfg(self.cfg).policy.queued_head
+        x = FCBlock(hc.decode_dim, "relu", dtype=self.dtype)(embedding)
+        x = FCBlock(hc.decode_dim, "relu", dtype=self.dtype)(x)
+        logits = FCBlock(hc.queued_dim, None, dtype=self.dtype)(x) / static_cfg(self.cfg).temperature
+        if queued is None:
+            queued = jax.random.categorical(rng, logits, axis=-1)
+        qh = jax.nn.one_hot(queued, hc.queued_dim, dtype=jnp.float32)
+        e = FCBlock(hc.queued_map_dim, "relu", dtype=self.dtype)(qh)
+        e = FCBlock(embedding.shape[-1], None, dtype=self.dtype)(e)
+        return logits, queued, embedding + e
+
+
+class SelectedUnitsHead(nn.Module):
+    """LSTM pointer network selecting <=64 units with an end-flag token.
+
+    Fixed-length scan over MAX_SELECTED_UNITS_NUM steps; per-step the query
+    LSTM attends over entity keys (+1 end slot at index entity_num). Masking
+    schedule matches the reference (action_arg_head.py:151-314): step 0
+    disables the end slot, steps >=1 enable it and disable already-selected
+    units; after a lane selects the end token all its updates become no-ops.
+    """
+
+    cfg: dict
+    dtype = jnp.float32
+
+    def setup(self):
+        hc = static_cfg(self.cfg).policy.selected_units_head
+        self.key_fc = FCBlock(hc.key_dim, None, dtype=self.dtype, name="key_fc")
+        self.query_fc1 = FCBlock(hc.func_dim, "relu", dtype=self.dtype, name="query_fc1")
+        self.query_fc2 = FCBlock(hc.key_dim, None, dtype=self.dtype, name="query_fc2")
+        self.embed_fc1 = FCBlock(hc.func_dim, "relu", dtype=self.dtype, name="embed_fc1")
+        self.embed_fc2 = FCBlock(
+            static_cfg(self.cfg).policy.action_type_head.gate_dim, None, dtype=self.dtype, name="embed_fc2"
+        )
+        self.lstm = PlainLSTMCell(hc.hidden_dim, dtype=self.dtype, name="lstm")
+        self.end_embedding = self.param(
+            "end_embedding", nn.initializers.uniform(scale=2.0 / (32 ** 0.5)), (hc.key_dim,)
+        )
+
+    def _keys(self, entity_embedding, entity_num):
+        """Per-entity keys with the end token written at index entity_num.
+        Returns key [B, N+1, K] and validity mask [B, N+1]."""
+        B, N, _ = entity_embedding.shape
+        key = self.key_fc(entity_embedding)  # B, N, K
+        key = jnp.concatenate([key, jnp.zeros_like(key[:, :1])], axis=1)  # B, N+1, K
+        is_end = jnp.arange(N + 1)[None, :] == entity_num[:, None]  # B, N+1
+        key = jnp.where(is_end[..., None], self.end_embedding[None, None, :], key)
+        mask = sequence_mask(entity_num + 1, N + 1)
+        return key, mask
+
+    def _ae_update(self, base_ae, key, sel_onehot, count):
+        """ae = base + embed(mean of selected keys); zero-selection lanes keep base."""
+        s = (key * sel_onehot[..., None]).sum(axis=1)
+        denom = jnp.maximum(count, 1.0)[:, None]
+        emb = self.embed_fc2(self.embed_fc1(s / denom))
+        return base_ae + jnp.where((count > 0)[:, None], emb, 0.0)
+
+    def _su_step(self, carry, result_fn, temperature: float = 1.0):
+        """One pointer-decode step; ``result_fn(logits)`` picks the unit."""
+        key, valid, entity_num = carry["key"], carry["valid"], carry["entity_num"]
+        N1 = key.shape[1]
+        q = self.query_fc2(self.query_fc1(carry["ae"]))
+        out, lstm_state = self.lstm(q, carry["lstm_state"])
+        logits = (out[:, None, :] * key).sum(-1)  # B, N+1
+        logits = jnp.where(carry["logit_mask"], logits, NEG_INF) / temperature
+        result = result_fn(logits)
+        picked_end = result == entity_num
+        newly_end = picked_end & ~carry["end_flag"]
+        num = jnp.where(newly_end, carry["i"] + 1, carry["num"])
+        end_flag = carry["end_flag"] | picked_end
+        slot = jnp.arange(N1)[None, :] == result[:, None]
+        add = (~end_flag)[:, None] & slot
+        sel_onehot = jnp.maximum(carry["sel_onehot"], add.astype(jnp.float32))
+        count = sel_onehot.sum(axis=1)
+        ae = self._ae_update(carry["base_ae"], key, sel_onehot, count)
+        is_end_slot = jnp.arange(N1)[None, :] == entity_num[:, None]
+        logit_mask = carry["logit_mask"] | (is_end_slot & valid)  # end selectable from step 1
+        logit_mask = logit_mask & ~(slot & ~picked_end[:, None])  # chosen unit now off
+        new_carry = dict(
+            carry,
+            lstm_state=lstm_state,
+            ae=ae,
+            logit_mask=logit_mask,
+            sel_onehot=sel_onehot,
+            end_flag=end_flag,
+            num=num,
+            i=carry["i"] + 1,
+        )
+        return new_carry, (logits, result)
+
+    def _su_step_train(self, carry, label):
+        return self._su_step(carry, lambda logits: label)
+
+    def _su_step_sample(self, carry, step_rng):
+        # temperature folds into the *returned* logits so action_logp is
+        # computed under the same distribution that sampled (the reference's
+        # in-place logit.div_ in _get_pred_with_logit has the same effect,
+        # action_arg_head.py:145-149)
+        return self._su_step(
+            carry,
+            lambda logits: jax.random.categorical(step_rng, logits, axis=-1),
+            temperature=static_cfg(self.cfg).temperature,
+        )
+
+    def __call__(
+        self,
+        embedding: jnp.ndarray,  # [B, 1024] autoregressive embedding
+        entity_embedding: jnp.ndarray,  # [B, N, 256]
+        entity_num: jnp.ndarray,  # [B]
+        selected_units: Optional[jnp.ndarray] = None,  # [B, S] teacher labels
+        selected_units_num: Optional[jnp.ndarray] = None,  # [B]
+        su_mask: Optional[jnp.ndarray] = None,  # [B] does this action select units
+        rng: Optional[jax.Array] = None,
+    ):
+        hc = static_cfg(self.cfg).policy.selected_units_head
+        B, N, _ = entity_embedding.shape
+        S = MAX_SELECTED_UNITS_NUM
+        key, valid = self._keys(entity_embedding, entity_num)
+        base_ae = embedding
+        h0 = jnp.zeros((B, hc.hidden_dim), self.dtype)
+        init_mask = valid & (jnp.arange(N + 1)[None, :] != entity_num[:, None])  # end off at step 0
+
+        train = selected_units is not None
+        if train:
+            labels = selected_units[:, :S].astype(jnp.int32)
+            if labels.shape[1] < S:
+                labels = jnp.pad(labels, ((0, 0), (0, S - labels.shape[1])))
+            xs = labels.T  # [S, B]
+        else:
+            xs = jax.random.split(rng, S)
+
+        end0 = jnp.zeros((B,), bool)
+        num0 = jnp.full((B,), S, jnp.int32)
+        if su_mask is not None:
+            end0 = ~su_mask.astype(bool)
+            num0 = jnp.where(su_mask.astype(bool), num0, 0)
+        carry0 = dict(
+            lstm_state=(h0, h0),
+            ae=self._ae_update(
+                base_ae, key, jnp.zeros((B, N + 1), jnp.float32), jnp.zeros((B,))
+            ),
+            logit_mask=init_mask,
+            sel_onehot=jnp.zeros((B, N + 1), jnp.float32),
+            end_flag=end0,
+            num=num0,
+            i=jnp.zeros((), jnp.int32),
+            # loop-invariant context, threaded through the carry so the
+            # lifted-scan step sees it without closures
+            key=key,
+            valid=valid,
+            base_ae=base_ae,
+            entity_num=entity_num,
+        )
+
+        step_method = self._su_step_train if train else self._su_step_sample
+        if self.is_initializing():
+            carry, (logits0, result0) = step_method(carry0, jax.tree.map(lambda a: a[0], xs))
+            logits_seq = jnp.broadcast_to(logits0[None], (S, B, N + 1))
+            results_seq = jnp.broadcast_to(result0[None], (S, B))
+            final = carry
+        else:
+            final, (logits_seq, results_seq) = nn.transforms.scan(
+                type(self)._su_step_train if train else type(self)._su_step_sample,
+                variable_broadcast="params",
+                split_rngs={"params": False},
+            )(self, carry0, xs)
+
+        ae = final["ae"]
+        end_flag = final["end_flag"]
+        num = final["num"]
+        logits_seq = logits_seq.transpose(1, 0, 2)  # B, S, N+1
+        results_seq = results_seq.transpose(1, 0)  # B, S
+        if train:
+            out_num = selected_units_num
+        else:
+            out_num = num
+        # extra-units proposal: entities scoring above the end token at the
+        # final step, for lanes that never ended (reference :307-309)
+        last_logits = logits_seq[:, -1, :]
+        end_logit = jnp.take_along_axis(last_logits, entity_num[:, None], axis=1)
+        extra_units = ((last_logits > end_logit) & ~end_flag[:, None]).astype(jnp.float32)
+        return logits_seq, results_seq, ae, out_num, extra_units
+
+
+class TargetUnitHead(nn.Module):
+    """Key-query attention over entities (reference action_arg_head.py:331-363)."""
+
+    cfg: dict
+    dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, embedding, entity_embedding, entity_num, target_unit=None, rng=None):
+        hc = static_cfg(self.cfg).policy.target_unit_head
+        key = FCBlock(hc.key_dim, None, dtype=self.dtype)(entity_embedding)
+        q = FCBlock(hc.key_dim, "relu", dtype=self.dtype)(embedding)
+        q = FCBlock(hc.key_dim, None, dtype=self.dtype)(q)
+        logits = (q[:, None, :] * key).sum(-1)
+        mask = sequence_mask(entity_num, entity_embedding.shape[1])
+        logits = jnp.where(mask, logits, NEG_INF) / static_cfg(self.cfg).temperature
+        if target_unit is None:
+            target_unit = jax.random.categorical(rng, logits, axis=-1)
+        return logits, target_unit
+
+
+class LocationHead(nn.Module):
+    """Gated res stack over map_skip + 3x bilinear upsample to 152x160 logits
+    (reference action_arg_head.py:366-450; gate=True, film/unet off)."""
+
+    cfg: dict
+    dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, embedding, map_skip: List[jnp.ndarray], location=None, rng=None):
+        hc = static_cfg(self.cfg).policy.location_head
+        H8, W8 = static_cfg(self.cfg).spatial_y // 8, static_cfg(self.cfg).spatial_x // 8
+        proj = FCBlock(H8 * W8 * hc.reshape_channel, "relu", dtype=self.dtype)(embedding)
+        proj = proj.reshape(-1, H8, W8, hc.reshape_channel)
+        x = jnp.concatenate([proj, map_skip[-1]], axis=-1)
+        x = jax.nn.relu(x)
+        x = Conv2DBlock(hc.res_dim, 1, 1, "SAME", "relu", dtype=self.dtype)(x)
+        for i in range(hc.res_num):
+            x = x + map_skip[len(map_skip) - i - 1]
+            if hc.gate:
+                x = GatedResBlock(hc.res_dim, "relu", dtype=self.dtype)(x, x)
+            else:
+                x = ResBlock(hc.res_dim, "relu", dtype=self.dtype)(x)
+        for i, ch in enumerate(hc.upsample_dims):
+            B, h, w, c = x.shape
+            x = jax.image.resize(x, (B, h * 2, w * 2, c), "bilinear")
+            act = "relu" if i < len(hc.upsample_dims) - 1 else None
+            x = Conv2DBlock(ch, 3, 1, "SAME", act, dtype=self.dtype)(x)
+        logits = x.reshape(x.shape[0], -1) / static_cfg(self.cfg).temperature
+        if location is None:
+            location = jax.random.categorical(rng, logits, axis=-1)
+        return logits, location

@@ -1,0 +1,34 @@
+"""Per-baseline value towers (role of reference model/value.py:9-39)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from ..ops import FCBlock, ResFCBlock
+
+PI = 3.141592653589793
+
+
+class ValueBaseline(nn.Module):
+    """fc -> res_num x ResFC -> scalar; optional atan squash into (-1, 1)."""
+
+    res_dim: int = 256
+    res_num: int = 16
+    norm_type: str = "LN"
+    atan: bool = False
+    dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        x = FCBlock(self.res_dim, "relu", dtype=self.dtype)(x)
+        for _ in range(self.res_num):
+            x = ResFCBlock(self.res_dim, "relu", self.norm_type, dtype=self.dtype)(x)
+        v = nn.Dense(
+            1,
+            dtype=self.dtype,
+            kernel_init=nn.initializers.variance_scaling(0.01, "fan_in", "truncated_normal"),
+        )(x)
+        v = v[..., 0]
+        if self.atan:
+            v = (2.0 / PI) * jnp.arctan((PI / 2.0) * v)
+        return v

@@ -140,7 +140,6 @@ class GLU(nn.Module):
     (role of reference module_utils.py:508-525): out = (sigmoid(W_c ctx) * x) W."""
 
     features: int
-    context_features: Optional[int] = None
     dtype: Dtype = jnp.float32
 
     @nn.compact

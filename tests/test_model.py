@@ -1,0 +1,187 @@
+"""Model forward-mode tests on reduced shapes (full field schema, smaller
+spatial map via config override) — mirrors the reference's fake_step_data
+warmup contract (agent.py:120-127)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distar_tpu.lib import features as F
+from distar_tpu.model import Model, default_model_config
+
+B = 2
+
+
+@pytest.fixture(scope="module")
+def small_cfg():
+    cfg = default_model_config()
+    # shrink heavy dims for test speed; field schema stays complete
+    cfg.encoder.entity.layer_num = 1
+    cfg.encoder.entity.hidden_dim = 64
+    cfg.encoder.entity.output_dim = 32
+    cfg.encoder.entity.head_dim = 16
+    cfg.encoder.spatial.down_channels = [8, 8, 16]
+    cfg.encoder.spatial.project_dim = 8
+    cfg.encoder.spatial.resblock_num = 1
+    cfg.encoder.spatial.fc_dim = 32
+    cfg.encoder.scatter.output_dim = 8
+    cfg.encoder.core_lstm.hidden_size = 64
+    cfg.encoder.core_lstm.num_layers = 2
+    cfg.policy.action_type_head.res_dim = 32
+    cfg.policy.action_type_head.res_num = 1
+    cfg.policy.action_type_head.gate_dim = 64
+    cfg.policy.delay_head.decode_dim = 32
+    cfg.policy.queued_head.decode_dim = 32
+    cfg.policy.selected_units_head.func_dim = 32
+    cfg.policy.location_head.res_dim = 16
+    cfg.policy.location_head.res_num = 1
+    cfg.policy.location_head.upsample_dims = [8, 8, 1]
+    cfg.policy.location_head.map_skip_dim = 16
+    cfg.value.res_dim = 16
+    cfg.value.res_num = 1
+    cfg.use_value_network = True
+    return cfg
+
+
+def _batch_obs(n, train=False):
+    obs = [F.fake_step_data(train=train, rng=np.random.default_rng(i)) for i in range(n)]
+    batched = F.batch_tree(obs)
+    return jax.tree.map(jnp.asarray, batched)
+
+
+def _hidden(cfg, batch):
+    H = cfg.encoder.core_lstm.hidden_size
+    z = jnp.zeros((batch, H))
+    return tuple((z, z) for _ in range(cfg.encoder.core_lstm.num_layers))
+
+
+@pytest.fixture(scope="module")
+def model_and_params(small_cfg):
+    model = Model(small_cfg)
+    # init through rl_forward: it traces encoder + teacher-forced policy +
+    # every value tower, creating the complete parameter tree (the sampling
+    # path shares all its params with the train path)
+    T = 1
+    data = _batch_obs((T + 1) * B)
+    action_info = {
+        "action_type": jnp.zeros((T, B), jnp.int32),
+        "delay": jnp.zeros((T, B), jnp.int32),
+        "queued": jnp.zeros((T, B), jnp.int32),
+        "selected_units": jnp.zeros((T, B, F.MAX_SELECTED_UNITS_NUM), jnp.int32),
+        "target_unit": jnp.zeros((T, B), jnp.int32),
+        "target_location": jnp.zeros((T, B), jnp.int32),
+    }
+    sun = jnp.ones((T, B), jnp.int32)
+    params = model.init(
+        jax.random.PRNGKey(0),
+        data["spatial_info"], data["entity_info"], data["scalar_info"], data["entity_num"],
+        _hidden(small_cfg, B), action_info, sun, B, T,
+        method=model.rl_forward,
+    )
+    return model, params
+
+
+def test_sample_action_shapes(small_cfg, model_and_params):
+    model, params = model_and_params
+    data = _batch_obs(B)
+    out = jax.jit(
+        lambda p, d, h, r: model.apply(
+            p, d["spatial_info"], d["entity_info"], d["scalar_info"], d["entity_num"], h, r,
+            method=model.sample_action)
+    )(params, data, _hidden(small_cfg, B), jax.random.PRNGKey(2))
+    a = out["action_info"]
+    assert a["action_type"].shape == (B,)
+    assert a["selected_units"].shape == (B, F.MAX_SELECTED_UNITS_NUM)
+    assert out["logit"]["selected_units"].shape == (B, 64, F.MAX_ENTITY_NUM + 1)
+    assert out["logit"]["target_location"].shape == (B, 152 * 160)
+    assert out["action_logp"]["selected_units"].shape == (B, 64)
+    assert len(out["hidden_state"]) == small_cfg.encoder.core_lstm.num_layers
+    # delays are in range
+    assert int(a["delay"].max()) <= F.MAX_DELAY
+    # selected_units_num <= 64
+    assert int(out["selected_units_num"].max()) <= 64
+
+
+def test_selected_units_respects_su_mask(small_cfg, model_and_params):
+    """Sampled action types that don't select units must yield num == 0."""
+    model, params = model_and_params
+    data = _batch_obs(B)
+    out = model.apply(
+        params, data["spatial_info"], data["entity_info"], data["scalar_info"],
+        data["entity_num"], _hidden(small_cfg, B), jax.random.PRNGKey(3),
+        method=model.sample_action,
+    )
+    from distar_tpu.lib.actions import SELECTED_UNITS_MASK
+
+    su = np.asarray(SELECTED_UNITS_MASK)[np.asarray(out["action_info"]["action_type"])]
+    num = np.asarray(out["selected_units_num"])
+    assert (num[~su] == 0).all()
+
+
+def test_rl_forward_shapes(small_cfg, model_and_params):
+    model, params = model_and_params
+    T = 3
+    n = (T + 1) * B
+    data = _batch_obs(n, train=False)
+    action_info = {
+        "action_type": jnp.zeros((T, B), jnp.int32),
+        "delay": jnp.zeros((T, B), jnp.int32),
+        "queued": jnp.zeros((T, B), jnp.int32),
+        "selected_units": jnp.zeros((T, B, F.MAX_SELECTED_UNITS_NUM), jnp.int32),
+        "target_unit": jnp.zeros((T, B), jnp.int32),
+        "target_location": jnp.zeros((T, B), jnp.int32),
+    }
+    sun = jnp.full((T, B), 2, jnp.int32)
+    out = model.apply(
+        params,
+        data["spatial_info"], data["entity_info"], data["scalar_info"], data["entity_num"],
+        _hidden(small_cfg, B), action_info, sun, B, T,
+        method=model.rl_forward,
+    )
+    assert out["target_logit"]["action_type"].shape == (T, B, 327)
+    assert out["target_logit"]["selected_units"].shape == (T, B, 64, 513)
+    for k, v in out["value"].items():
+        assert v.shape == (T + 1, B), k
+    # winloss squashed into (-1, 1)
+    assert np.abs(np.asarray(out["value"]["winloss"])).max() < 1.0
+
+
+def test_teacher_and_sl_forward(small_cfg, model_and_params):
+    model, params = model_and_params
+    data = _batch_obs(B)
+    action_info = {
+        "action_type": jnp.zeros((B,), jnp.int32),
+        "delay": jnp.zeros((B,), jnp.int32),
+        "queued": jnp.zeros((B,), jnp.int32),
+        "selected_units": jnp.zeros((B, F.MAX_SELECTED_UNITS_NUM), jnp.int32),
+        "target_unit": jnp.zeros((B,), jnp.int32),
+        "target_location": jnp.zeros((B,), jnp.int32),
+    }
+    sun = jnp.ones((B,), jnp.int32)
+    out = model.apply(
+        params, data["spatial_info"], data["entity_info"], data["scalar_info"],
+        data["entity_num"], _hidden(small_cfg, B), action_info, sun,
+        method=model.teacher_logits,
+    )
+    assert out["logit"]["action_type"].shape == (B, 327)
+
+    # SL: batch of 1 trajectory x T=2 steps
+    T = 2
+    data2 = _batch_obs(T)  # B=1 trajectory of len 2 flat
+    logits, state = model.apply(
+        params, data2["spatial_info"], data2["entity_info"], data2["scalar_info"],
+        data2["entity_num"],
+        {k: jnp.repeat(v, 1, axis=0) for k, v in {
+            "action_type": jnp.zeros((T,), jnp.int32),
+            "delay": jnp.zeros((T,), jnp.int32),
+            "queued": jnp.zeros((T,), jnp.int32),
+            "selected_units": jnp.zeros((T, F.MAX_SELECTED_UNITS_NUM), jnp.int32),
+            "target_unit": jnp.zeros((T,), jnp.int32),
+            "target_location": jnp.zeros((T,), jnp.int32),
+        }.items()},
+        jnp.full((T,), 1, jnp.int32),
+        _hidden(small_cfg, 1), 1,
+        method=model.sl_forward,
+    )
+    assert logits["action_type"].shape == (T, 327)
+    assert len(state) == small_cfg.encoder.core_lstm.num_layers
