@@ -1,0 +1,61 @@
+"""Benchmark: SL learner throughput on the real chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Metric: supervised-learning replay-frames/sec on a single chip with the FULL
+flagship model (the reference's headline SL number is ~384 frames/s per A100
+— 56xA100, total batch 336 x traj 64 at ~1s/iter; see BASELINE.md). A frame
+is one (obs, action) trajectory step through forward+loss+backward+adam.
+"""
+from __future__ import annotations
+
+import json
+import time
+
+
+def main():
+    import jax
+
+    from distar_tpu.learner import SLLearner
+
+    BASELINE_FRAMES_PER_SEC_PER_CHIP = 384.0  # A100, reference large-scale SL
+
+    batch_size, unroll_len = 4, 16
+    cfg = {
+        "common": {"experiment_name": "bench_sl"},
+        "learner": {
+            "batch_size": batch_size,
+            "unroll_len": unroll_len,
+            "save_freq": 10 ** 9,
+            "log_freq": 10 ** 9,
+        },
+    }
+    learner = SLLearner(cfg)
+
+    # warmup (compile)
+    data = next(learner._dataloader)
+    learner._train(dict(data))
+    jax.block_until_ready(learner.state["params"])
+
+    iters = 4
+    start = time.perf_counter()
+    for _ in range(iters):
+        learner._train(dict(data))
+    jax.block_until_ready(learner.state["params"])
+    elapsed = time.perf_counter() - start
+
+    frames_per_sec = batch_size * unroll_len * iters / elapsed
+    print(
+        json.dumps(
+            {
+                "metric": "SL replay-frames/sec/chip (full model, fwd+loss+bwd+adam)",
+                "value": round(frames_per_sec, 2),
+                "unit": "frames/s",
+                "vs_baseline": round(frames_per_sec / BASELINE_FRAMES_PER_SEC_PER_CHIP, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,40 @@
+from .algorithms import pfsp
+from .api import LeagueAPIServer, league_request
+from .elo import ELORating
+from .league import LEAGUE_DEFAULTS, League
+from .payoff import Payoff
+from .player import (
+    ActivePlayer,
+    AdaptiveEvolutionaryExploiterPlayer,
+    ExpertExploiterPlayer,
+    ExpertPlayer,
+    ExploiterPlayer,
+    HistoricalPlayer,
+    MainExploiterPlayer,
+    MainPlayer,
+    Player,
+    active_player_type,
+)
+from .stats import EmaMeter, WindowedMeter
+
+__all__ = [
+    "pfsp",
+    "LeagueAPIServer",
+    "league_request",
+    "ELORating",
+    "LEAGUE_DEFAULTS",
+    "League",
+    "Payoff",
+    "ActivePlayer",
+    "AdaptiveEvolutionaryExploiterPlayer",
+    "ExpertExploiterPlayer",
+    "ExpertPlayer",
+    "ExploiterPlayer",
+    "HistoricalPlayer",
+    "MainExploiterPlayer",
+    "MainPlayer",
+    "Player",
+    "active_player_type",
+    "EmaMeter",
+    "WindowedMeter",
+]

@@ -1,0 +1,29 @@
+"""Prioritized Fictitious Self-Play opponent weighting.
+
+Same three weightings as the reference (reference: distar/ctools/worker/
+league/algorithms.py:58-86): 'squared' (1-w)^2 favours opponents you lose to,
+'variance' w(1-w) favours even matches, 'normal' min(0.5, 1-w).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+WEIGHTINGS = {
+    "squared": lambda x: (1 - x) ** 2,
+    "variance": lambda x: x * (1 - x),
+    "normal": lambda x: np.minimum(0.5, 1 - x),
+}
+
+
+def pfsp(win_rates: np.ndarray, weighting: str = "variance") -> np.ndarray:
+    if weighting not in WEIGHTINGS:
+        raise KeyError(f"invalid pfsp weighting: {weighting}")
+    win_rates = np.asarray(win_rates, dtype=np.float64)
+    assert win_rates.ndim == 1 and win_rates.shape[0] >= 1
+    if win_rates.sum() < 1e-8:
+        return np.full_like(win_rates, 1.0 / len(win_rates))
+    w = WEIGHTINGS[weighting](win_rates)
+    s = w.sum()
+    if s < 1e-12:
+        return np.full_like(win_rates, 1.0 / len(win_rates))
+    return w / s

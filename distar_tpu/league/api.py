@@ -1,0 +1,98 @@
+"""League HTTP API (stdlib http.server, JSON bodies).
+
+Role parity with the reference Flask routes (reference: distar/ctools/worker/
+league/league_api.py:14-249): the four core RPCs used by learners/actors plus
+the live admin surface (show/save payoff + ELO, save/load resume, add/remove
+player, reset stats). Flask isn't assumed in the image; a ThreadingHTTPServer
+with a JSON dispatch table covers the same contract.
+
+POST /league/<route> with a JSON body; responds {"code": 0, "info": ...}.
+"""
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from .league import League
+
+
+def _routes(league: League):
+    return {
+        "register_learner": lambda b: league.register_learner(**b),
+        "learner_send_train_info": lambda b: league.learner_send_train_info(**b),
+        "actor_ask_for_job": lambda b: league.actor_ask_for_job(b),
+        "actor_send_result": lambda b: league.actor_send_result(b),
+        # admin
+        "show_payoff": lambda b: {
+            pid: p.payoff.get_text() for pid, p in league.all_players.items()
+        },
+        "show_elo": lambda b: league.elo.ratings(),
+        "refit_elo": lambda b: league.elo.refit(),
+        "show_players": lambda b: {
+            "active": list(league.active_players.keys()),
+            "historical": list(league.historical_players.keys()),
+        },
+        "add_player": lambda b: league.add_active_player(**b),
+        "remove_player": lambda b: league.remove_player(b["player_id"]),
+        "reset_player_stats": lambda b: league.all_players[b["player_id"]].reset_payoff(),
+        "save_resume": lambda b: league.save_resume(b["path"]),
+        "load_resume": lambda b: league.load_resume(b["path"]),
+    }
+
+
+class LeagueAPIServer:
+    """Threaded HTTP wrapper around a League instance."""
+
+    def __init__(self, league: League, host: str = "127.0.0.1", port: int = 0):
+        routes = _routes(league)
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):  # quiet
+                pass
+
+            def do_POST(self):
+                name = self.path.strip("/").split("/")[-1]
+                length = int(self.headers.get("Content-Length", 0))
+                try:
+                    body = json.loads(self.rfile.read(length) or b"{}")
+                    fn = routes.get(name)
+                    if fn is None:
+                        payload = {"code": 404, "info": f"no route {name}"}
+                    else:
+                        payload = {"code": 0, "info": fn(body)}
+                except Exception as e:  # surface errors to the caller
+                    payload = {"code": 1, "info": repr(e)}
+                data = json.dumps(payload, default=str).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self.host, self.port = self._server.server_address
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._server.serve_forever, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+
+def league_request(host: str, port: int, route: str, body: Optional[dict] = None, timeout=10.0):
+    """Client helper used by learner/actor comm."""
+    import urllib.request
+
+    req = urllib.request.Request(
+        f"http://{host}:{port}/league/{route}",
+        data=json.dumps(body or {}).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read())

@@ -1,0 +1,81 @@
+"""Incremental ELO ladder (K=44) with payoff-consistency refit.
+
+Role of the reference ELORating (reference: distar/ctools/worker/ladder/
+elo.py:9-100+): incremental updates per game, plus an iterative refit that
+finds ratings maximising consistency with the observed clipped payoff matrix
+(the reference runs a fixed-point iteration over a discretised mmr grid; here
+a simple gradient fixed-point on expected-vs-observed score, same objective).
+"""
+from __future__ import annotations
+
+import math
+from collections import defaultdict
+from functools import partial
+from typing import Dict
+
+WIN, DRAW, LOSS = 1, 0, -1
+
+
+class ELORating:
+    def __init__(self, K: float = 44.0, init_elo: float = 1000.0, minimum_games: int = 0):
+        self.K = K
+        self.init_elo = init_elo
+        self.minimum_games = minimum_games
+        self.elos: Dict[str, float] = defaultdict(float)  # stored as offsets from init
+        self.wins = defaultdict(partial(defaultdict, int))
+        self.games = defaultdict(partial(defaultdict, int))
+        self.game_count = 0
+
+    def expected(self, p1: str, p2: str) -> float:
+        return 1.0 / (1.0 + 10 ** ((self.elos[p2] - self.elos[p1]) / 400.0))
+
+    def update(self, p1: str, p2: str, result: int) -> None:
+        e = self.expected(p1, p2)
+        if result == WIN:
+            self.wins[p1][p2] += 1
+            score = 1.0
+        elif result == LOSS:
+            self.wins[p2][p1] += 1
+            score = 0.0
+        else:
+            score = 0.5
+        self.games[p1][p2] += 1
+        self.games[p2][p1] += 1
+        self.elos[p1] += self.K * (score - e)
+        self.elos[p2] -= self.K * (score - e)
+        self.game_count += 1
+
+    def ratings(self, start_from_zero: bool = True) -> Dict[str, float]:
+        out = {k: v + self.init_elo for k, v in self.elos.items()}
+        if start_from_zero and out:
+            low = min(out.values())
+            out = {k: v - low for k, v in out.items()}
+        return out
+
+    def refit(self, iterations: int = 200, lr: float = 20.0) -> Dict[str, float]:
+        """Payoff-consistency refit: adjust ratings so expected scores match
+        the observed (clipped) pairwise winrates over pairs with enough games."""
+        players = list(self.elos.keys())
+        r = {p: self.elos[p] for p in players}
+        pairs = []
+        for p1 in players:
+            for p2 in players:
+                if p1 != p2 and self.games[p1][p2] > self.minimum_games:
+                    wr = self.wins[p1][p2] / max(self.games[p1][p2], 1)
+                    pairs.append((p1, p2, min(max(wr, 0.1), 0.9)))
+        if not pairs:
+            return self.ratings()
+        for _ in range(iterations):
+            grad = defaultdict(float)
+            for p1, p2, wr in pairs:
+                e = 1.0 / (1.0 + 10 ** ((r[p2] - r[p1]) / 400.0))
+                grad[p1] += wr - e
+                grad[p2] -= wr - e
+            for p in players:
+                r[p] += lr * grad[p] / max(len(players) - 1, 1)
+        low = min(r.values())
+        return {p: v - low + self.init_elo for p, v in r.items()}
+
+    def get_text(self) -> str:
+        rows = sorted(self.ratings().items(), key=lambda kv: -kv[1])
+        return "\n".join(f"{k:<40s} {v:>8.1f}" for k, v in rows)

@@ -1,0 +1,61 @@
+"""Per-opponent match statistics with a 0.5 winrate prior below min games
+(role of reference distar/ctools/worker/league/payoff.py)."""
+from __future__ import annotations
+
+from collections import defaultdict
+from functools import partial
+from typing import Dict
+
+from .stats import WindowedMeter
+
+DATA_KEYS = ("winrate", "game_steps", "game_iters", "game_duration")
+
+
+def _stat_entry(warm_up_size: int) -> Dict[str, WindowedMeter]:
+    return {k: WindowedMeter(warm_up_size) for k in DATA_KEYS}
+
+
+class Payoff:
+    def __init__(self, decay: float = 0.999, warm_up_size: int = 1000, min_win_rate_games: int = 1000):
+        self._decay = decay
+        self._warm_up_size = warm_up_size
+        self._min_win_rate_games = min_win_rate_games
+        # partial over a module-level fn keeps the defaultdict picklable
+        # (league resume snapshots pickle whole players)
+        self._record: Dict[str, Dict[str, WindowedMeter]] = defaultdict(
+            partial(_stat_entry, warm_up_size)
+        )
+
+    def update(self, opponent_id: str, stat_info: Dict[str, float]) -> None:
+        for k in DATA_KEYS:
+            if k in stat_info:
+                self._record[opponent_id][k].update(stat_info[k])
+
+    def win_rate_opponent(self, opponent_id: str, use_prior: bool = True) -> float:
+        meter = self._record[opponent_id]["winrate"]
+        if use_prior and meter.count < self._min_win_rate_games:
+            return 0.5
+        return meter.val
+
+    @property
+    def pfsp_winrate_info_dict(self) -> Dict[str, float]:
+        return {p: self.win_rate_opponent(p) for p in self._record}
+
+    @property
+    def stat_info_record(self):
+        return self._record
+
+    @property
+    def game_count(self) -> Dict[str, int]:
+        return {p: v["winrate"].count for p, v in self._record.items()}
+
+    def get_text(self) -> str:
+        rows = []
+        for opp, stats in sorted(self._record.items()):
+            rows.append(
+                "{:<40s} ".format(opp)
+                + " ".join(f"{stats[k].val:>10.3f}" for k in DATA_KEYS)
+                + f" {stats['winrate'].count:>8d}"
+            )
+        header = "{:<40s} ".format("opponent") + " ".join(f"{k:>10s}" for k in DATA_KEYS) + f" {'games':>8s}"
+        return "\n".join([header] + rows)

@@ -1,0 +1,455 @@
+"""League player taxonomy.
+
+Role parity with the reference players (reference: distar/ctools/worker/
+league/player.py): Player / HistoricalPlayer / ActivePlayer plus the five
+active types and their matchmaking branches:
+
+  MainPlayer              sp 50% (weak-vs-main falls back to that main's
+                          history via variance-pfsp) / pfsp 'squared' / eval
+  ExploiterPlayer         pfsp 'normal' over all history; 25% random reset
+  MainExploiterPlayer     vs_main (falls back to that main's history when
+                          winrate < 0.2) / pfsp / eval; always resets
+  ExpertPlayer            pfsp 'variance' over non-exploiter history
+  ExpertExploiterPlayer   like exploiter but rotates a hand-picked Z list
+  AdaptiveEvolutionaryExploiterPlayer
+                          vs_main family; resets to the historical ckpt
+                          best-matched (winrate in [0.2, 0.5]) vs main
+
+Player ids follow the reference convention: MP* main, ME* main exploiter,
+EP* exploiter, EX* expert(-exploiter), AE* adaptive, *H<n> historical
+snapshots carrying parent_id.
+"""
+from __future__ import annotations
+
+import random
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .algorithms import pfsp
+from .payoff import Payoff
+
+FRAC_ID = {0: ["zerg", "terran", "protoss"], 1: ["zerg"], 2: ["terran"], 3: ["protoss"]}
+
+
+class Player:
+    name = "BasePlayer"
+
+    def __init__(
+        self,
+        checkpoint_path: str,
+        player_id: str,
+        pipeline: str = "default",
+        frac_id: int = 1,
+        z_path: str = "3map.json",
+        z_prob: float = 0.0,
+        teacher_id: str = "none",
+        teacher_checkpoint_path: str = "none",
+        total_agent_step: int = 0,
+        decay: float = 0.995,
+        warm_up_size: int = 1000,
+        min_win_rate_games: int = 200,
+        total_game_count: int = 0,
+    ):
+        self.checkpoint_path = checkpoint_path
+        self.player_id = player_id
+        self.pipeline = pipeline
+        self.frac_id = frac_id
+        self.z_path = z_path
+        self.z_prob = z_prob
+        self.teacher_id = teacher_id
+        self.teacher_checkpoint_path = teacher_checkpoint_path
+        self.total_agent_step = total_agent_step
+        self.decay = decay
+        self.warm_up_size = warm_up_size
+        self.min_win_rate_games = min_win_rate_games
+        self.total_game_count = total_game_count
+        self.payoff = Payoff(decay, warm_up_size, min_win_rate_games)
+
+    def get_race(self) -> str:
+        return random.choice(FRAC_ID[self.frac_id])
+
+    def reset_payoff(self) -> None:
+        self.payoff = Payoff(self.decay, self.warm_up_size, self.min_win_rate_games)
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self.player_id}, ckpt={self.checkpoint_path})"
+
+
+class HistoricalPlayer(Player):
+    name = "HistoricalPlayer"
+
+    def __init__(self, *args, parent_id: str = "none", **kwargs):
+        super().__init__(*args, **kwargs)
+        self.parent_id = parent_id
+
+
+class ActivePlayer(Player):
+    name = "ActivePlayer"
+
+    def __init__(
+        self,
+        *args,
+        chosen_weight: float = 1.0,
+        one_phase_step: int = int(2e8),
+        last_enough_step: int = 0,
+        snapshot_times: int = 0,
+        strong_win_rate: float = 0.7,
+        successive_model_path: Optional[str] = None,
+        **kwargs,
+    ):
+        super().__init__(*args, **kwargs)
+        self.chosen_weight = chosen_weight
+        self.one_phase_step = int(one_phase_step)
+        self.last_enough_step = last_enough_step
+        self.snapshot_times = snapshot_times
+        self.strong_win_rate = strong_win_rate
+        self.snapshot_flag = False
+        self.reset_flag = False
+        self.successive_model_path = successive_model_path or self.checkpoint_path
+        self.last_successive_step = last_enough_step
+        self.teammate_payoff = Payoff(self.decay, self.warm_up_size, self.min_win_rate_games)
+        self.opponent_payoff = Payoff(self.decay, self.warm_up_size, self.min_win_rate_games)
+
+    # ------------------------------------------------------------- helpers
+    def _non_bot_history(self, historical: Dict[str, HistoricalPlayer], include_bots: bool):
+        if include_bots:
+            return list(historical.keys())
+        return [pid for pid, p in historical.items() if p.pipeline != "bot"]
+
+    def _pfsp_pick(self, keys: List[str], weighting: str, default_wr: float = 0.5) -> str:
+        weights = [self.payoff.pfsp_winrate_info_dict.get(pid, default_wr) for pid in keys]
+        probs = pfsp(np.array(weights), weighting=weighting)
+        return random.choices(keys, weights=probs, k=1)[0]
+
+    def _phase_gate(self) -> Optional[bool]:
+        """Common trained-enough preamble. Returns True/False when decided,
+        None when the winrate sweep should decide."""
+        if self.snapshot_flag:
+            self.snapshot_flag = False
+            self.last_enough_step = self.total_agent_step
+            return True
+        step_passed = self.total_agent_step - self.last_enough_step
+        if step_passed >= self.one_phase_step:
+            self.last_enough_step = self.total_agent_step
+            return True
+        return None
+
+    def _winrate_sweep(self, opponent_keys: List[str]) -> bool:
+        """True iff winrate vs every listed opponent exceeds strong_win_rate
+        with enough games."""
+        rec = self.payoff.stat_info_record
+        for pid in opponent_keys:
+            if pid not in rec:
+                return False
+            m = rec[pid]["winrate"]
+            if not (m.val > self.strong_win_rate and m.count >= self.warm_up_size):
+                return False
+        self.last_enough_step = self.total_agent_step
+        return True
+
+    def is_save_successive_model(self) -> bool:
+        if self.total_agent_step - self.last_successive_step > self.one_phase_step / 2:
+            self.last_successive_step = self.total_agent_step
+            return True
+        return False
+
+    def snapshot(self) -> HistoricalPlayer:
+        self.snapshot_times += 1
+        h_id = f"{self.player_id}H{self.snapshot_times}"
+        base, _, _ = self.checkpoint_path.partition(".ckpt")
+        h_path = f"{base}_{self.total_agent_step}.ckpt"
+        return HistoricalPlayer(
+            h_path,
+            h_id,
+            pipeline=self.pipeline,
+            frac_id=self.frac_id,
+            z_path=self.z_path,
+            z_prob=self.z_prob,
+            total_agent_step=self.total_agent_step,
+            decay=self.decay,
+            warm_up_size=self.warm_up_size,
+            min_win_rate_games=self.min_win_rate_games,
+            parent_id=self.player_id,
+        )
+
+    def is_reset(self) -> bool:
+        return False
+
+    def reset_checkpoint(self, active_players, historical_players, new_player_id) -> str:
+        return self.teacher_checkpoint_path
+
+    # ------------------------------------------------------------ abstract
+    def get_branch_opponent(self, historical_players, active_players, branch_probs, pfsp_train_bot=False):
+        raise NotImplementedError
+
+    def is_trained_enough(self, historical_players, active_players, pfsp_train_bot=False) -> bool:
+        raise NotImplementedError
+
+
+def _choose_branch(branch_probs: Dict[str, float]) -> str:
+    names = list(branch_probs.keys())
+    return random.choices(names, weights=list(branch_probs.values()), k=1)[0]
+
+
+class MainPlayer(ActivePlayer):
+    name = "MainPlayer"
+
+    def get_branch_opponent(self, historical_players, active_players, branch_probs, pfsp_train_bot=False):
+        branch = _choose_branch(branch_probs[self.name])
+        if branch == "sp":
+            mains = [p for p in active_players.values() if isinstance(p, MainPlayer)]
+            opponent = random.choice(mains)
+            if (
+                opponent is not self
+                and self.payoff.pfsp_winrate_info_dict.get(opponent.player_id, 0.5) < 0.3
+            ):
+                keys = [
+                    pid for pid, p in historical_players.items() if p.parent_id == opponent.player_id
+                ] or self._non_bot_history(historical_players, False)
+                opponent = historical_players[self._pfsp_pick(keys, "variance")]
+        elif branch == "pfsp":
+            keys = self._non_bot_history(historical_players, pfsp_train_bot)
+            assert keys, "pfsp branch needs historical players"
+            opponent = historical_players[self._pfsp_pick(keys, "squared")]
+        elif branch == "eval":
+            opponent = historical_players[random.choice(list(historical_players.keys()))]
+        else:
+            raise NotImplementedError(branch)
+        return branch, [self], [opponent]
+
+    def is_trained_enough(self, historical_players, active_players, pfsp_train_bot=False) -> bool:
+        gate = self._phase_gate()
+        if gate is not None:
+            return gate
+        if self.total_agent_step - self.last_enough_step < self.one_phase_step / 2:
+            return False
+        hist_keys = self._non_bot_history(historical_players, pfsp_train_bot)
+        # strong sweep over history alone (with margin) is enough
+        rec = self.payoff.stat_info_record
+        if hist_keys and all(
+            pid in rec
+            and rec[pid]["winrate"].val > self.strong_win_rate + 0.1
+            and rec[pid]["winrate"].count >= self.warm_up_size
+            for pid in hist_keys
+        ):
+            return True
+        others = [pid for pid in active_players if pid != self.player_id]
+        return self._winrate_sweep(hist_keys + others)
+
+
+class ExploiterPlayer(ActivePlayer):
+    name = "ExploiterPlayer"
+    reset_prob = 0.25
+
+    def get_branch_opponent(self, historical_players, active_players, branch_probs, pfsp_train_bot=False):
+        branch = _choose_branch(branch_probs[self.name])
+        if branch == "pfsp":
+            keys = self._non_bot_history(historical_players, pfsp_train_bot)
+            opponent = historical_players[self._pfsp_pick(keys, "normal")]
+        elif branch == "eval":
+            opponent = historical_players[random.choice(list(historical_players.keys()))]
+        else:
+            raise NotImplementedError(branch)
+        return branch, [self], [opponent]
+
+    def is_trained_enough(self, historical_players, active_players, pfsp_train_bot=False) -> bool:
+        gate = self._phase_gate()
+        if gate is not None:
+            return gate
+        if self.total_agent_step - self.last_enough_step < self.one_phase_step / 2:
+            return False
+        return self._winrate_sweep(self._non_bot_history(historical_players, pfsp_train_bot))
+
+    def is_reset(self) -> bool:
+        if self.reset_flag:
+            self.reset_flag = False
+            return True
+        return np.random.uniform() < self.reset_prob
+
+
+class MainExploiterPlayer(ActivePlayer):
+    name = "MainExploiterPlayer"
+
+    def _main_id(self, active_players) -> str:
+        return f"MP{self.player_id[-1]}"
+
+    def get_branch_opponent(self, historical_players, active_players, branch_probs, pfsp_train_bot=False):
+        main = active_players[self._main_id(active_players)]
+        branch = _choose_branch(branch_probs[self.name])
+        if branch == "vs_main":
+            if self.payoff.pfsp_winrate_info_dict.get(main.player_id, 0.5) > 0.2:
+                return branch, [self], [main]
+            branch = "pfsp"
+        elif branch == "eval":
+            return "vs_main_eval", [self], [main]
+        if branch == "pfsp":
+            keys = [
+                pid for pid, p in historical_players.items() if p.parent_id == main.player_id
+            ]
+            opponent = historical_players[self._pfsp_pick(keys, "variance")]
+            return branch, [self], [opponent]
+        raise NotImplementedError(branch)
+
+    def is_trained_enough(self, historical_players, active_players, pfsp_train_bot=False) -> bool:
+        gate = self._phase_gate()
+        if gate is not None:
+            return gate
+        mains = [pid for pid in active_players if "MP" in pid]
+        return self._winrate_sweep(mains)
+
+    def is_reset(self) -> bool:
+        return True
+
+
+class ExpertPlayer(ActivePlayer):
+    name = "ExpertPlayer"
+
+    def get_branch_opponent(self, historical_players, active_players, branch_probs, pfsp_train_bot=False):
+        branch = _choose_branch(branch_probs[self.name])
+        if branch == "pfsp":
+            keys = [pid for pid in historical_players if "EX" not in pid]
+            assert keys
+            opponent = historical_players[self._pfsp_pick(keys, "variance", default_wr=0.1)]
+        elif branch == "eval":
+            opponent = historical_players[random.choice(list(historical_players.keys()))]
+        else:
+            raise NotImplementedError(branch)
+        return branch, [self], [opponent]
+
+    def is_trained_enough(self, historical_players, active_players, pfsp_train_bot=False) -> bool:
+        gate = self._phase_gate()
+        if gate is not None:
+            return gate
+        return self._winrate_sweep(self._non_bot_history(historical_players, pfsp_train_bot))
+
+
+class ExpertExploiterPlayer(ActivePlayer):
+    """Exploiter rotating a hand-picked Z list on every reset
+    (reference player.py:425-525)."""
+
+    name = "ExpertExploiterPlayer"
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        assert isinstance(self.z_path, (list, tuple)), "ExpertExploiter takes a z_path list"
+        self.z_paths = list(self.z_path)
+        self.z_path = random.choice(self.z_paths)
+
+    def get_branch_opponent(self, historical_players, active_players, branch_probs, pfsp_train_bot=False):
+        branch = _choose_branch(branch_probs[self.name])
+        if branch == "pfsp":
+            keys = self._non_bot_history(historical_players, pfsp_train_bot)
+            opponent = historical_players[self._pfsp_pick(keys, "normal")]
+        elif branch == "eval":
+            opponent = historical_players[random.choice(list(historical_players.keys()))]
+        else:
+            raise NotImplementedError(branch)
+        return branch, [self], [opponent]
+
+    def is_trained_enough(self, historical_players, active_players, pfsp_train_bot=False) -> bool:
+        gate = self._phase_gate()
+        if gate is not None:
+            return gate
+        return self._winrate_sweep(self._non_bot_history(historical_players, pfsp_train_bot))
+
+    def is_reset(self) -> bool:
+        self.z_path = random.choice(self.z_paths)
+        return True
+
+    def snapshot(self) -> HistoricalPlayer:
+        snap = super().snapshot()
+        snap.player_id = f"{self.player_id}H{self.snapshot_times}_{str(self.z_path).split('.')[0]}"
+        return snap
+
+    def reset_checkpoint(self, active_players, historical_players, new_player_id) -> str:
+        mains = sorted(
+            [pid for pid in historical_players if "MP" in pid],
+            key=lambda x: int(x.split("H")[-1].split("_")[0]),
+        )
+        return historical_players[mains[-1]].checkpoint_path
+
+
+class AdaptiveEvolutionaryExploiterPlayer(ActivePlayer):
+    """Resets to the historical checkpoint best-matched against the main
+    player (winrate in [0.2, 0.5]) — evolutionary selection over its own
+    lineage (reference player.py:640-760)."""
+
+    name = "AdaptiveEvolutionaryExploiterPlayer"
+    reset_prob = 0.25
+
+    def __init__(self, *args, init_players: Optional[List[str]] = None, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.init_players: List[str] = list(init_players or [])
+
+    def get_branch_opponent(self, historical_players, active_players, branch_probs, pfsp_train_bot=False):
+        main_id = random.choice([pid for pid in active_players if "MP" in pid])
+        main = active_players[main_id]
+        branch = _choose_branch(branch_probs[self.name])
+        if branch == "vs_main":
+            if self.payoff.pfsp_winrate_info_dict.get(main.player_id, 0.5) > 0.2:
+                return branch, [self], [main]
+            branch = "pfsp"
+        elif branch == "eval":
+            return "vs_main_eval", [self], [main]
+        if branch == "pfsp":
+            keys = [pid for pid, p in historical_players.items() if p.parent_id == main_id]
+            opponent = historical_players[self._pfsp_pick(keys, "variance")]
+            return branch, [self], [opponent]
+        raise NotImplementedError(branch)
+
+    def is_trained_enough(self, historical_players, active_players, pfsp_train_bot=False) -> bool:
+        gate = self._phase_gate()
+        if gate is not None:
+            return gate
+        mains = [pid for pid in active_players if "MP" in pid]
+        return self._winrate_sweep(mains)
+
+    def is_reset(self) -> bool:
+        return True
+
+    def reset_checkpoint(self, active_players, historical_players, new_player_id) -> str:
+        main_id = random.choice([pid for pid in active_players if "MP" in pid])
+        if random.random() < self.reset_prob:
+            if new_player_id is not None:
+                self.init_players.append(new_player_id)
+            return self.teacher_checkpoint_path
+        # candidates: the fresh snapshot (best_idx -1) and this lineage's
+        # previous init snapshots; pick the one with winrate-vs-main closest
+        # from within [0.2, 0.5] (highest wins)
+        best_id, best_wr, best_idx = None, 0.0, None
+        wr = self.payoff.stat_info_record[main_id]["winrate"].val
+        if 0.2 <= wr <= 0.5:
+            best_id, best_wr, best_idx = new_player_id, wr, -1
+        main_payoff = active_players[main_id].payoff.stat_info_record
+        for idx, pid in enumerate(self.init_players):
+            if pid not in main_payoff:
+                continue
+            wr = 1 - main_payoff[pid]["winrate"].val
+            if 0.2 <= wr <= 0.5 and wr > best_wr:
+                best_id, best_wr, best_idx = pid, wr, idx
+        if best_idx is not None and best_idx != -1:
+            # an older lineage member wins: rotate it out, track the snapshot
+            del self.init_players[best_idx]
+            if new_player_id is not None:
+                self.init_players.append(new_player_id)
+        if best_id is not None and best_id in historical_players:
+            return historical_players[best_id].checkpoint_path
+        return self.teacher_checkpoint_path
+
+
+PLAYER_TYPES = {
+    "MP": MainPlayer,
+    "ME": MainExploiterPlayer,
+    "EP": ExploiterPlayer,
+    "EX": ExpertExploiterPlayer,
+    "AE": AdaptiveEvolutionaryExploiterPlayer,
+    "XP": ExpertPlayer,
+}
+
+
+def active_player_type(player_id: str):
+    """Map a player id prefix to its class (reference league.py convention:
+    MP main, ME main-exploiter, EP exploiter, EX expert-exploiter, AE
+    adaptive-evolutionary, XP expert)."""
+    return PLAYER_TYPES.get(player_id[:2])

@@ -1,0 +1,82 @@
+"""Meters used by the league's per-opponent statistics.
+
+WindowedMeter reproduces the reference MoveAverageMeter semantics
+(reference: distar/ctools/utils/log_helper.py:483-520): a true moving average
+over the last ``length`` values, with ``count`` tracking lifetime updates
+(the payoff's warm-up gate keys off count, not window fill).
+"""
+from __future__ import annotations
+
+from collections import deque
+
+
+class WindowedMeter:
+    def __init__(self, length: int = 1000):
+        self.length = length
+        self.reset()
+
+    def reset(self) -> None:
+        self._history: deque = deque(maxlen=self.length)
+        self._val = 0.0
+        self._count = 0
+
+    def update(self, value) -> None:
+        value = float(value)
+        self._count += 1
+        n = len(self._history)
+        if n < self.length:
+            self._val = (1 - 1.0 / (n + 1)) * self._val + value / (n + 1)
+            self._history.append(value)
+        else:
+            left = self._history.popleft()
+            self._val = self._val + (value - left) / self.length
+            self._history.append(value)
+
+    @property
+    def val(self) -> float:
+        return self._val
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    def state(self) -> dict:
+        return {"length": self.length, "history": list(self._history), "count": self._count}
+
+    @classmethod
+    def from_state(cls, s: dict) -> "WindowedMeter":
+        m = cls(s["length"])
+        for v in s["history"]:
+            m.update(v)
+        m._count = s["count"]
+        return m
+
+
+class EmaMeter:
+    """EMA with linear warm-up (reference log_helper.py:570+)."""
+
+    def __init__(self, decay: float, warm_up_size: int):
+        assert 0 <= decay <= 1 and warm_up_size > 0
+        self._decay = decay
+        self._warm_up_size = warm_up_size
+        self.reset()
+
+    def reset(self) -> None:
+        self._count = 0
+        self._val = 0.0
+
+    def update(self, value) -> None:
+        value = float(value)
+        if self._count < self._warm_up_size:
+            self._val = (self._val * self._count + value) / (self._count + 1)
+        else:
+            self._val = self._decay * self._val + (1 - self._decay) * value
+        self._count += 1
+
+    @property
+    def val(self) -> float:
+        return self._val
+
+    @property
+    def count(self) -> int:
+        return self._count

@@ -1,0 +1,21 @@
+from .base_learner import BaseLearner
+from .data import FakeRLDataloader, FakeSLDataloader, fake_rl_batch, fake_sl_batch
+from .hooks import Hook, HookRegistry, LambdaHook, default_hooks
+from .rl_learner import RLLearner, make_rl_train_step
+from .sl_learner import SLLearner, make_sl_train_step
+
+__all__ = [
+    "BaseLearner",
+    "FakeRLDataloader",
+    "FakeSLDataloader",
+    "fake_rl_batch",
+    "fake_sl_batch",
+    "Hook",
+    "HookRegistry",
+    "LambdaHook",
+    "default_hooks",
+    "RLLearner",
+    "make_rl_train_step",
+    "SLLearner",
+    "make_sl_train_step",
+]

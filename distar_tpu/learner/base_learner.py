@@ -1,0 +1,111 @@
+"""Abstract hook-driven train engine.
+
+Role of the reference BaseLearner (reference: distar/ctools/worker/learner/
+base_learner.py:24-272): owns the model/optimizer state, a dataloader
+iterator, the hook registry, timing, logging, and the crash-safe run loop.
+Subclasses implement `_setup_state()` (build params/opt) and `_train(data)`
+(one jitted step). Distributed-ness is ambient: the train step is pjit'd
+over a mesh, rank == jax.process_index().
+"""
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Dict, Iterator, Optional
+
+import jax
+
+from ..utils import Config, EasyTimer, build_logger, deep_merge_dicts
+from ..utils.checkpoint import CountVar, auto_checkpoint, load_checkpoint, save_checkpoint
+from .hooks import HookRegistry, default_hooks
+
+DEFAULT_LEARNER_CONFIG = Config(
+    {
+        "common": {"experiment_name": "default_experiment", "save_path": ""},
+        "learner": {
+            "job_type": "train",
+            "learning_rate": 1e-5,
+            "save_freq": 1000,
+            "log_freq": 100,
+            "load_path": "",
+            "max_iterations": 10 ** 9,
+            "grad_clip": {"type": "none", "threshold": 1.0},
+        },
+    }
+)
+
+
+class BaseLearner:
+    def __init__(self, cfg: Optional[dict] = None):
+        self.cfg = deep_merge_dicts(DEFAULT_LEARNER_CONFIG, cfg or {})
+        self.rank = jax.process_index()
+        self.world_size = jax.process_count()
+        exp = self.cfg.common.experiment_name
+        root = self.cfg.common.save_path or os.path.join(os.getcwd(), "experiments", exp)
+        self.save_dir = root
+        self.logger, self.scalar_sink, self.variable_record = build_logger(
+            os.path.join(root, "logs"), f"{self.name}_rank{self.rank}", to_console=self.rank == 0
+        )
+        self.timer = EasyTimer()
+        self.last_iter = CountVar(0)
+        self.log_buffer: Dict[str, Any] = {}
+        self.hooks: HookRegistry = default_hooks(
+            save_freq=self.cfg.learner.save_freq, log_freq=self.cfg.learner.log_freq
+        )
+        self._state = None  # TrainState pytree (params, opt_state, step)
+        self._dataloader: Optional[Iterator] = None
+        self._setup_dataloader()
+        self._setup_state()
+
+    # -------------------------------------------------------------- plumbing
+    @property
+    def name(self) -> str:
+        return type(self).__name__.lower()
+
+    @property
+    def state(self):
+        return self._state
+
+    def checkpoint_path(self) -> str:
+        return os.path.join(self.save_dir, "checkpoints", f"iteration_{self.last_iter.val}.ckpt")
+
+    def save(self, path: str) -> None:
+        save_checkpoint(path, self._state, metadata={"last_iter": self.last_iter.val})
+
+    def restore(self, path: str) -> None:
+        out = load_checkpoint(path, target=self._state)
+        self._state = out["state"]
+        self.last_iter.update(out["metadata"].get("last_iter", 0))
+
+    # -------------------------------------------------------------- abstract
+    def _setup_state(self) -> None:  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def _setup_dataloader(self) -> None:  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def _train(self, data) -> Dict[str, Any]:  # pragma: no cover - abstract
+        """One optimisation step; returns the log dict."""
+        raise NotImplementedError
+
+    # ------------------------------------------------------------------ run
+    def run(self, max_iterations: Optional[int] = None) -> None:
+        max_iterations = max_iterations or self.cfg.learner.max_iterations
+
+        @auto_checkpoint(lambda: self.save(self.checkpoint_path()))
+        def _run():
+            self.hooks.call("before_run", self)
+            while self.last_iter.val < max_iterations:
+                with self.timer:
+                    data = next(self._dataloader)
+                self.log_buffer["data_time"] = self.timer.value
+                self.hooks.call("before_iter", self)
+                with self.timer:
+                    log_vars = self._train(data)
+                self.log_buffer["train_time"] = self.timer.value
+                self.log_buffer.update(log_vars)
+                self.last_iter.add(1)
+                self.hooks.call("after_iter", self)
+            self.hooks.call("after_run", self)
+
+        _run()

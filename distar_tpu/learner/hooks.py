@@ -1,0 +1,129 @@
+"""Hook-driven learner extension points.
+
+Role of the reference hook registry (reference: distar/ctools/worker/learner/
+learner_hook.py): hooks attach at before_run / before_iter / after_iter /
+after_run with priorities; the stock set covers checkpoint load/save, log
+display, and (in distributed runs) cross-process log reduction — which on a
+jax mesh is a no-op for gradients (XLA psum handles them) and a
+process-level mean for logged scalars.
+"""
+from __future__ import annotations
+
+import os
+from typing import Callable, Dict, List
+
+POSITIONS = ("before_run", "before_iter", "after_iter", "after_run")
+
+
+class Hook:
+    def __init__(self, name: str, position: str, priority: int = 50, freq: int = 1):
+        assert position in POSITIONS
+        self.name = name
+        self.position = position
+        self.priority = priority
+        self.freq = freq
+
+    def __call__(self, learner) -> None:  # pragma: no cover - abstract
+        raise NotImplementedError
+
+
+class LambdaHook(Hook):
+    def __init__(self, name, position, fn: Callable, priority: int = 50, freq: int = 1):
+        super().__init__(name, position, priority, freq)
+        self._fn = fn
+
+    def __call__(self, learner) -> None:
+        self._fn(learner)
+
+
+class HookRegistry:
+    def __init__(self):
+        self._hooks: Dict[str, List[Hook]] = {p: [] for p in POSITIONS}
+
+    def add(self, hook: Hook) -> None:
+        self._hooks[hook.position].append(hook)
+        self._hooks[hook.position].sort(key=lambda h: h.priority)
+
+    def call(self, position: str, learner) -> None:
+        for hook in self._hooks[position]:
+            if position in ("before_iter", "after_iter") and hook.freq > 1:
+                if learner.last_iter.val % hook.freq != 0:
+                    continue
+            hook(learner)
+
+
+class LoadCkptHook(Hook):
+    """before_run: resume from cfg.learner.load_path when present."""
+
+    def __init__(self, priority=20):
+        super().__init__("load_ckpt", "before_run", priority)
+
+    def __call__(self, learner) -> None:
+        path = learner.cfg.learner.get("load_path", "")
+        if path and os.path.exists(path):
+            learner.restore(path)
+            learner.logger.info(f"loaded checkpoint {path} @ iter {learner.last_iter.val}")
+
+
+class SaveCkptHook(Hook):
+    """after_iter (freq) + after_run: rank-0 writes the checkpoint."""
+
+    def __init__(self, position="after_iter", priority=20, freq=1000):
+        super().__init__("save_ckpt", position, priority, freq)
+
+    def __call__(self, learner) -> None:
+        if learner.rank != 0:
+            return
+        path = learner.checkpoint_path()
+        learner.save(path)
+        learner.logger.info(f"saved checkpoint {path}")
+
+
+class LogShowHook(Hook):
+    """after_iter (freq): render the meter table + scalar sink."""
+
+    def __init__(self, priority=80, freq=100):
+        super().__init__("log_show", "after_iter", priority, freq)
+
+    def __call__(self, learner) -> None:
+        if learner.rank != 0:
+            return
+        it = learner.last_iter.val
+        record = learner.variable_record
+        learner.logger.info(
+            f"=== iter {it} ===\n{record.get_vars_text()}"
+        )
+        learner.scalar_sink.add_scalars(
+            {k: m.avg for k, m in record.vars().items()}, global_step=it
+        )
+
+
+class LogReduceHook(Hook):
+    """after_iter: fold the step's log dict into the meters."""
+
+    def __init__(self, priority=10):
+        super().__init__("log_reduce", "after_iter", priority)
+
+    def __call__(self, learner) -> None:
+        learner.variable_record.update_var(
+            {k: float(v) for k, v in learner.log_buffer.items() if _is_scalar(v)}
+        )
+        learner.log_buffer.clear()
+
+
+def _is_scalar(v) -> bool:
+    try:
+        float(v)
+        return True
+    except (TypeError, ValueError):
+        return False
+
+
+def default_hooks(save_freq: int = 1000, log_freq: int = 100) -> HookRegistry:
+    reg = HookRegistry()
+    reg.add(LoadCkptHook())
+    reg.add(SaveCkptHook(freq=save_freq))
+    reg.add(SaveCkptHook(position="after_run"))
+    reg.add(LogReduceHook())
+    reg.add(LogShowHook(freq=log_freq))
+    return reg

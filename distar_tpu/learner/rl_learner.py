@@ -1,0 +1,211 @@
+"""RL learner: pjit data-parallel V-trace/UPGO training on a device mesh.
+
+Role of the reference RLLearner (reference: distar/agent/default/
+rl_learner.py:23-160): model with value towers, Adam(0, 0.99) + grad clip,
+staleness tracking, value-pretrain gate, weight publication hooks.
+
+TPU-first train step: ONE jitted function carries forward + loss + backward
++ optimizer update; inputs arrive sharded [*, B/dp, ...] over the mesh's dp
+axis, params/opt-state replicated, and XLA inserts the gradient psum over
+ICI (replacing DistModule.sync_gradients' per-param NCCL loop,
+dist_helper.py:421-431). Params and opt state are donated, so the update is
+in-place in HBM.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..losses import ReinforcementLossConfig, compute_rl_loss
+from ..model import Model, default_model_config
+from ..parallel import GradClipConfig, MeshSpec, build_optimizer, make_mesh
+from ..utils import Config, deep_merge_dicts
+from .base_learner import DEFAULT_LEARNER_CONFIG, BaseLearner
+from .data import FakeRLDataloader
+
+RL_LEARNER_DEFAULTS = deep_merge_dicts(
+    DEFAULT_LEARNER_CONFIG,
+    {
+        "learner": {
+            "player_id": "MP0",
+            "batch_size": 4,
+            "unroll_len": 16,
+            "learning_rate": 1e-5,
+            "betas": [0.0, 0.99],
+            "eps": 1e-5,
+            "grad_clip": {"type": "norm", "threshold": 10.0},
+            "value_pretrain_iters": -1,
+            "use_dapo": False,
+        },
+        "model": {},
+    },
+)
+
+
+def _flatten_time(tree):
+    return jax.tree.map(lambda x: x.reshape((-1,) + x.shape[2:]), tree)
+
+
+def make_rl_train_step(model: Model, loss_cfg: ReinforcementLossConfig, optimizer,
+                       batch_size: int, unroll_len: int):
+    """Build the pure train-step fn (params, opt_state, batch) -> updated."""
+
+    def loss_fn(params, batch, only_update_value):
+        obs = {
+            "spatial_info": _flatten_time(batch["spatial_info"]),
+            "entity_info": _flatten_time(batch["entity_info"]),
+            "scalar_info": _flatten_time(batch["scalar_info"]),
+            "entity_num": batch["entity_num"].reshape(-1),
+        }
+        out = model.apply(
+            params,
+            obs["spatial_info"], obs["entity_info"], obs["scalar_info"], obs["entity_num"],
+            batch["hidden_state"], batch["action_info"], batch["selected_units_num"],
+            batch_size, unroll_len,
+            value_feature=batch.get("value_feature"),
+            method=model.rl_forward,
+        )
+        inputs = {
+            "target_logit": out["target_logit"],
+            "value": out["value"],
+            "action_log_prob": batch["behaviour_logp"],
+            "teacher_logit": batch["teacher_logit"],
+            "action": batch["action_info"],
+            "reward": batch["reward"],
+            "step": batch["step"],
+            "mask": batch["mask"],
+            "entity_num": batch["entity_num"].reshape(-1, batch_size)[:unroll_len],
+            "selected_units_num": batch["selected_units_num"],
+        }
+        if loss_cfg.use_dapo:
+            inputs["successive_logit"] = batch["successive_logit"]
+        import dataclasses
+
+        cfg = dataclasses.replace(loss_cfg, only_update_value=False)
+        total, info = compute_rl_loss(inputs, cfg)
+        total_value_only = info["td/total"]
+        total = jnp.where(only_update_value, total_value_only, total)
+        return total, info
+
+    def train_step(params, opt_state, batch, only_update_value):
+        (_, info), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            params, batch, only_update_value
+        )
+        info["grad_norm"] = optax.global_norm(grads)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, info
+
+    return train_step
+
+
+class RLLearner(BaseLearner):
+    """Data-parallel league-RL learner."""
+
+    def __init__(self, cfg: Optional[dict] = None, mesh=None):
+        cfg = deep_merge_dicts(RL_LEARNER_DEFAULTS, cfg or {})
+        self.mesh = mesh if mesh is not None else make_mesh(MeshSpec())
+        self.model_cfg = deep_merge_dicts(default_model_config(), cfg.get("model", {}))
+        self.model_cfg.use_value_network = True
+        self.model = Model(self.model_cfg)
+        self.loss_cfg = ReinforcementLossConfig(use_dapo=cfg.learner.use_dapo)
+        self._remaining_value_pretrain = cfg.learner.get("value_pretrain_iters", -1)
+        super().__init__(cfg)
+
+    # ------------------------------------------------------------ state init
+    def _setup_dataloader(self) -> None:
+        lc = self.cfg.learner if hasattr(self, "cfg") else RL_LEARNER_DEFAULTS.learner
+        self._dataloader = iter(
+            FakeRLDataloader(
+                batch_size=lc.batch_size,
+                unroll_len=lc.unroll_len,
+                hidden_size=self.model_cfg.encoder.core_lstm.hidden_size,
+                hidden_layers=self.model_cfg.encoder.core_lstm.num_layers,
+            )
+        )
+
+    def set_dataloader(self, it) -> None:
+        self._dataloader = iter(it)
+
+    def _setup_state(self) -> None:
+        lc = self.cfg.learner
+        B, T = lc.batch_size, lc.unroll_len
+        batch = next(self._dataloader)
+        self.optimizer = build_optimizer(
+            learning_rate=lc.learning_rate,
+            betas=tuple(lc.betas),
+            eps=lc.eps,
+            clip=GradClipConfig(**lc.grad_clip),
+        )
+        # jit the init: eager init dispatches thousands of tiny ops, which is
+        # painfully slow on a remote/tunneled device
+        def init_fn(rng, spatial, entity, scalar, entity_num, hidden, action, sun):
+            return self.model.init(
+                rng, spatial, entity, scalar, entity_num, hidden, action, sun, B, T,
+                method=self.model.rl_forward,
+            )
+
+        batch = jax.tree.map(jnp.asarray, batch)
+        params = jax.jit(init_fn)(
+            jax.random.PRNGKey(0),
+            *(_flatten_time(batch[k]) for k in ("spatial_info", "entity_info", "scalar_info")),
+            batch["entity_num"].reshape(-1),
+            batch["hidden_state"],
+            batch["action_info"],
+            batch["selected_units_num"],
+        )
+        repl = NamedSharding(self.mesh, P())
+        params = jax.device_put(params, repl)
+        self._state = {
+            "params": params,
+            "opt_state": jax.device_put(self.optimizer.init(params), repl),
+        }
+        step_fn = make_rl_train_step(self.model, self.loss_cfg, self.optimizer, B, T)
+        self._shardings = dict(
+            repl=repl,
+            batch=NamedSharding(self.mesh, P(None, "dp")),  # [T(,+1), B, ...]
+            flat=NamedSharding(self.mesh, P("dp")),  # [B]-leading leaves
+        )
+        self._train_step = jax.jit(step_fn, donate_argnums=(0, 1))
+
+    def shard_batch(self, batch):
+        """Place a host batch onto the mesh: B sharded over dp everywhere
+        (axis 1 for time-major leaves, axis 0 for hidden_state)."""
+        hidden = batch.pop("hidden_state")
+        out = jax.tree.map(lambda x: jax.device_put(jnp.asarray(x), self._shardings["batch"]), batch)
+        out["hidden_state"] = jax.tree.map(
+            lambda x: jax.device_put(jnp.asarray(x), self._shardings["flat"]), hidden
+        )
+        batch["hidden_state"] = hidden
+        return out
+
+    # ------------------------------------------------------------- training
+    def step_value_pretrain(self) -> bool:
+        """Value-pretrain gate (reference rl_learner.py:160-180): during the
+        first value_pretrain_iters only the critics train."""
+        if self._remaining_value_pretrain > 0:
+            self._remaining_value_pretrain -= 1
+            return True
+        return False
+
+    def _train(self, data) -> Dict[str, Any]:
+        only_value = self.step_value_pretrain()
+        model_last_iter = np.asarray(data.pop("model_last_iter"))
+        staleness = self.last_iter.val - model_last_iter
+        data = self.shard_batch(data)
+        params, opt_state, info = self._train_step(
+            self._state["params"], self._state["opt_state"], data,
+            jnp.asarray(only_value),
+        )
+        self._state = {"params": params, "opt_state": opt_state}
+        log = {k: float(v) for k, v in info.items()}
+        log["staleness/mean"] = float(staleness.mean())
+        log["staleness/max"] = float(staleness.max())
+        log["staleness/std"] = float(staleness.std())
+        return log

@@ -1,0 +1,146 @@
+"""Supervised learner: behaviour cloning from decoded replays.
+
+Role of the reference SLLearner (reference: distar/agent/default/
+sl_learner.py:23-86): teacher-forced CE training with LSTM hidden state
+carried across iterations and reset on new episodes. The carry lives in the
+learner (host-managed [B, H] arrays fed back into the jitted step), matching
+the reference's stateful-BPTT-across-windows design.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..losses import SupervisedLossConfig, compute_sl_loss
+from ..model import Model, default_model_config
+from ..parallel import GradClipConfig, MeshSpec, build_optimizer, make_mesh
+from ..utils import deep_merge_dicts
+from .base_learner import DEFAULT_LEARNER_CONFIG, BaseLearner
+from .data import FakeSLDataloader
+
+SL_LEARNER_DEFAULTS = deep_merge_dicts(
+    DEFAULT_LEARNER_CONFIG,
+    {
+        "learner": {
+            "batch_size": 2,
+            "unroll_len": 32,
+            "learning_rate": 1e-3,
+            "betas": [0.9, 0.999],
+            "eps": 1e-8,
+            "weight_decay": 1e-5,
+            "grad_clip": {"type": "norm", "threshold": 1.0},
+            "label_smooth": 0.0,
+        },
+        "model": {},
+    },
+)
+
+
+def make_sl_train_step(model: Model, loss_cfg: SupervisedLossConfig, optimizer, batch_size: int):
+    def loss_fn(params, batch, hidden_state):
+        logits, out_state = model.apply(
+            params,
+            batch["spatial_info"], batch["entity_info"], batch["scalar_info"],
+            batch["entity_num"], batch["action_info"], batch["selected_units_num"],
+            hidden_state, batch_size,
+            method=model.sl_forward,
+        )
+        total, info = compute_sl_loss(
+            logits,
+            batch["action_info"],
+            batch["action_mask"],
+            batch["selected_units_num"],
+            batch["entity_num"],
+            loss_cfg,
+        )
+        return total, (info, out_state)
+
+    def train_step(params, opt_state, batch, hidden_state):
+        (_, (info, out_state)), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            params, batch, hidden_state
+        )
+        info["grad_norm"] = optax.global_norm(grads)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, out_state, info
+
+    return train_step
+
+
+class SLLearner(BaseLearner):
+    def __init__(self, cfg: Optional[dict] = None, mesh=None):
+        cfg = deep_merge_dicts(SL_LEARNER_DEFAULTS, cfg or {})
+        self.mesh = mesh if mesh is not None else make_mesh(MeshSpec())
+        self.model_cfg = deep_merge_dicts(default_model_config(), cfg.get("model", {}))
+        self.model = Model(self.model_cfg)
+        self.loss_cfg = SupervisedLossConfig(label_smooth=cfg.learner.label_smooth)
+        super().__init__(cfg)
+
+    def _setup_dataloader(self) -> None:
+        lc = self.cfg.learner
+        self._dataloader = iter(FakeSLDataloader(lc.batch_size, lc.unroll_len))
+
+    def set_dataloader(self, it) -> None:
+        self._dataloader = iter(it)
+
+    def _setup_state(self) -> None:
+        lc = self.cfg.learner
+        B = lc.batch_size
+        core = self.model_cfg.encoder.core_lstm
+        self._hidden = tuple(
+            (jnp.zeros((B, core.hidden_size)), jnp.zeros((B, core.hidden_size)))
+            for _ in range(core.num_layers)
+        )
+        self.optimizer = build_optimizer(
+            learning_rate=lc.learning_rate,
+            betas=tuple(lc.betas),
+            eps=lc.eps,
+            weight_decay=lc.get("weight_decay", 0.0),
+            clip=GradClipConfig(**lc.grad_clip),
+        )
+        batch = next(self._dataloader)
+        batch = jax.tree.map(jnp.asarray, batch)
+
+        def init_fn(rng, spatial, entity, scalar, entity_num, action, sun, hidden):
+            return self.model.init(
+                rng, spatial, entity, scalar, entity_num, action, sun, hidden, B,
+                method=self.model.sl_forward,
+            )
+
+        params = jax.jit(init_fn)(
+            jax.random.PRNGKey(0),
+            batch["spatial_info"], batch["entity_info"], batch["scalar_info"],
+            batch["entity_num"], batch["action_info"], batch["selected_units_num"],
+            self._hidden,
+        )
+        repl = NamedSharding(self.mesh, P())
+        params = jax.device_put(params, repl)
+        self._state = {"params": params, "opt_state": jax.device_put(self.optimizer.init(params), repl)}
+        self._shardings = dict(repl=repl, flat=NamedSharding(self.mesh, P("dp")))
+        self._train_step = jax.jit(
+            make_sl_train_step(self.model, self.loss_cfg, self.optimizer, B),
+            donate_argnums=(0, 1),
+        )
+
+    def _train(self, data) -> Dict[str, Any]:
+        new_episodes = np.asarray(data.pop("new_episodes"))
+        data.pop("traj_lens", None)
+        if new_episodes.any():
+            # reset hidden state for restarted trajectories (reference
+            # sl_learner.py:31-35)
+            keep = jnp.asarray(~new_episodes, jnp.float32)[:, None]
+            self._hidden = tuple((h * keep, c * keep) for h, c in self._hidden)
+        data = jax.tree.map(
+            lambda x: jax.device_put(jnp.asarray(x), self._shardings["flat"]), data
+        )
+        params, opt_state, out_state, info = self._train_step(
+            self._state["params"], self._state["opt_state"], data, self._hidden
+        )
+        self._state = {"params": params, "opt_state": opt_state}
+        self._hidden = jax.tree.map(jax.lax.stop_gradient, out_state)
+        return {k: float(v) for k, v in info.items()}

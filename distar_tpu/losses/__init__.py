@@ -1,0 +1,9 @@
+from .rl_loss import ReinforcementLossConfig, compute_rl_loss
+from .sl_loss import SupervisedLossConfig, compute_sl_loss
+
+__all__ = [
+    "ReinforcementLossConfig",
+    "compute_rl_loss",
+    "SupervisedLossConfig",
+    "compute_sl_loss",
+]

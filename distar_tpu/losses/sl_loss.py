@@ -1,0 +1,146 @@
+"""Supervised (behaviour-cloning) loss: per-head cross entropy + metrics.
+
+Pure-jnp equivalent of the reference SupervisedLoss
+(reference: distar/agent/default/sl_training/sl_loss.py). Per-head CE with
+optional label smoothing, per-head applicability masks, the selected-units
+candidate masking trick (su_mask: at step i every *other* ground-truth unit
+is removed from the softmax so order permutations aren't penalised,
+sl_loss.py:176-192), end-flag loss, and the accuracy metric grid
+(action_type_acc, delay L1, queued acc, selected-units IoU, target_unit acc,
+location L2). Default weights mirror default_supervised_loss.yaml.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..ops import sequence_mask
+
+NEG_INF = -1e9
+
+
+@dataclasses.dataclass(frozen=True)
+class SupervisedLossConfig:
+    action_type: float = 30.0
+    delay: float = 9.0
+    queued: float = 1.0
+    selected_units: float = 4.0
+    target_unit: float = 4.0
+    target_location: float = 8.0
+    label_smooth: float = 0.0  # 0.1 in the reference when label_smooth: True
+    su_candidate_mask: bool = True
+    spatial_x: int = 160
+
+    def weights(self) -> Dict[str, float]:
+        return {
+            "action_type": self.action_type,
+            "delay": self.delay,
+            "queued": self.queued,
+            "selected_units": self.selected_units,
+            "target_unit": self.target_unit,
+            "target_location": self.target_location,
+        }
+
+
+def _ce(logits, labels, smoothing: float = 0.0):
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, labels[..., None].astype(jnp.int32), axis=-1)[..., 0]
+    if smoothing > 0.0:
+        smooth = -logp.mean(axis=-1)
+        return (1.0 - smoothing) * nll + smoothing * smooth
+    return nll
+
+
+def _masked_mean(x, mask):
+    valid = mask.sum()
+    return jnp.where(valid > 0, (x * mask).sum() / jnp.maximum(valid, 1), 0.0)
+
+
+def compute_sl_loss(
+    logits: Dict[str, jnp.ndarray],
+    actions: Dict[str, jnp.ndarray],
+    action_masks: Dict[str, jnp.ndarray],
+    selected_units_num: jnp.ndarray,  # [B]
+    entity_num: jnp.ndarray,  # [B]
+    cfg: SupervisedLossConfig = SupervisedLossConfig(),
+    infer_selected_units: Optional[jnp.ndarray] = None,  # [B, S] sampled, for IoU
+) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
+    info: Dict[str, jnp.ndarray] = {}
+    w = cfg.weights()
+    total = 0.0
+
+    # ------------------------------------------------------------ flat heads
+    for head in ("action_type", "delay", "queued", "target_unit", "target_location"):
+        lab = actions[head].astype(jnp.int32)
+        mask = action_masks[head].astype(jnp.float32)
+        ce = _ce(logits[head], lab, cfg.label_smooth)
+        loss = _masked_mean(ce, mask)
+        info[f"{head}_loss"] = loss
+        total += loss * w[head]
+        pred = logits[head].argmax(-1)
+        if head == "action_type":
+            info["action_type_acc"] = (pred == lab).mean()
+        elif head == "delay":
+            info["delay_distance_L1"] = _masked_mean(jnp.abs(pred - lab), mask)
+        elif head == "queued":
+            info["queued_acc"] = _masked_mean(jnp.abs(pred - lab), mask)
+        elif head == "target_unit":
+            info["target_unit_acc"] = _masked_mean((pred == lab).astype(jnp.float32), mask)
+        elif head == "target_location":
+            W = cfg.spatial_x
+            d2 = (pred % W - lab % W) ** 2 + (pred // W - lab // W) ** 2
+            info["target_location_distance_L2"] = _masked_mean(jnp.sqrt(d2.astype(jnp.float32)), mask)
+
+    # --------------------------------------------------------- selected units
+    su_logits = logits["selected_units"]  # [B, S, N+1]
+    B, S, N1 = su_logits.shape
+    labels = actions["selected_units"].astype(jnp.int32)[:, :S]  # [B, S]
+    lengths = selected_units_num.astype(jnp.int32)
+    mask = action_masks["selected_units"].astype(jnp.float32)  # [B]
+
+    if cfg.su_candidate_mask:
+        # at step i mask out every ground-truth unit except the step's own
+        # label (end-flag positions use a dummy class so they mask nothing)
+        len_wo_end = jnp.maximum(lengths - 1, 0)
+        real_pos = sequence_mask(len_wo_end, S)  # [B, S] non-end label slots
+        dummy = N1  # one-past-last class
+        eff_labels = jnp.where(real_pos, labels, dummy)
+        labeled_any = jax.nn.one_hot(eff_labels, N1 + 1, dtype=jnp.float32).sum(1) > 0  # [B, N+2)
+        labeled_any = labeled_any[:, :N1]  # drop dummy
+        step_own = jax.nn.one_hot(eff_labels, N1 + 1, dtype=jnp.float32)[..., :N1].astype(bool)
+        allowed = ~labeled_any[:, None, :] | step_own  # [B, S, N+1]
+        su_logits = jnp.where(allowed, su_logits, NEG_INF)
+
+    ce = _ce(su_logits, labels)  # [B, S]
+    select_mask = sequence_mask(lengths, S)
+    ce = jnp.where(select_mask, ce, 0.0) * mask[:, None]
+    su_loss = ce.sum() / B
+    info["selected_units_loss"] = su_loss
+    info["selected_units_loss_norm"] = ce.sum() / (lengths.sum() + 1e-6)
+    end_idx = jnp.clip(lengths - 1, 0, S - 1)
+    info["selected_units_end_flag_loss"] = jnp.take_along_axis(ce, end_idx[:, None], axis=1).mean()
+    total += su_loss * w["selected_units"]
+
+    # IoU between sampled and labelled unit sets (ignoring order)
+    if infer_selected_units is not None:
+        preds = infer_selected_units.astype(jnp.int32)[:, :S]
+        # count predicted steps up to (and incl.) the first end token
+        is_end = preds == entity_num[:, None]
+        any_end = is_end.any(axis=1)
+        first_end = jnp.argmax(is_end, axis=1)
+        pred_len = jnp.where(any_end, first_end, S)
+        pred_mask = sequence_mask(pred_len, S)
+        lab_mask = sequence_mask(len_wo_end if cfg.su_candidate_mask else lengths, S)
+        pred_bag = (jax.nn.one_hot(preds, N1, dtype=jnp.float32) * pred_mask[..., None]).sum(1) > 0
+        lab_bag = (jax.nn.one_hot(labels, N1, dtype=jnp.float32) * lab_mask[..., None]).sum(1) > 0
+        inter = (pred_bag & lab_bag).sum(-1)
+        union = (pred_bag | lab_bag).sum(-1)
+        info["selected_units_iou"] = _masked_mean(inter / jnp.maximum(union, 1), mask)
+    else:
+        info["selected_units_iou"] = jnp.zeros(())
+
+    info["total_loss"] = total
+    return total, info

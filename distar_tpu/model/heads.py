@@ -122,6 +122,8 @@ class SelectedUnitsHead(nn.Module):
 
     def setup(self):
         hc = static_cfg(self.cfg).policy.selected_units_head
+        # the query LSTM's output dots against the keys, so widths must match
+        assert hc.hidden_dim == hc.key_dim, "selected_units_head: hidden_dim must equal key_dim"
         self.key_fc = FCBlock(hc.key_dim, None, dtype=self.dtype, name="key_fc")
         self.query_fc1 = FCBlock(hc.func_dim, "relu", dtype=self.dtype, name="query_fc1")
         self.query_fc2 = FCBlock(hc.key_dim, None, dtype=self.dtype, name="query_fc2")

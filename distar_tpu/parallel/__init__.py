@@ -1,0 +1,13 @@
+from .mesh import MeshSpec, make_mesh, batch_sharding, replicated_sharding
+from .grad_clip import GradClipConfig, build_grad_clip
+from .optimizer import build_optimizer
+
+__all__ = [
+    "MeshSpec",
+    "make_mesh",
+    "batch_sharding",
+    "replicated_sharding",
+    "GradClipConfig",
+    "build_grad_clip",
+    "build_optimizer",
+]

@@ -1,0 +1,67 @@
+"""Device-mesh abstraction for the learner.
+
+Role of the reference's NCCL data-parallel plumbing (reference:
+distar/ctools/utils/dist_helper.py:321-439 — manual per-param allreduce
+`DistModule.sync_gradients`): here data parallelism is one axis of a general
+`jax.sharding.Mesh`, the gradient allreduce is an XLA-scheduled psum over ICI
+inserted by the partitioner, and rank-0-only logic maps to
+`jax.process_index() == 0`.
+
+The mesh is declared with up to four logical axes — dp (data), fsdp
+(parameter shard), tp (tensor), sp (sequence/context) — so wider shardings
+(tensor-parallel heads, ring-attention over a long time axis) slot in without
+touching the learner. The reference model (~50-100M params) only needs dp;
+the other axes default to size 1 but stay first-class in every pjit spec.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+AXES = ("dp", "fsdp", "tp", "sp")
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    dp: int = -1  # -1: all remaining devices
+    fsdp: int = 1
+    tp: int = 1
+    sp: int = 1
+
+    def sizes(self, n_devices: int) -> Sequence[int]:
+        fixed = self.fsdp * self.tp * self.sp
+        dp = self.dp if self.dp != -1 else n_devices // fixed
+        assert dp * fixed == n_devices, (
+            f"mesh {dp}x{self.fsdp}x{self.tp}x{self.sp} != {n_devices} devices"
+        )
+        return (dp, self.fsdp, self.tp, self.sp)
+
+
+def make_mesh(spec: MeshSpec = MeshSpec(), devices: Optional[Sequence] = None) -> Mesh:
+    devices = list(devices if devices is not None else jax.devices())
+    sizes = spec.sizes(len(devices))
+    arr = np.asarray(devices).reshape(sizes)
+    return Mesh(arr, AXES)
+
+
+def batch_sharding(mesh: Mesh, batch_axis: int = 0) -> NamedSharding:
+    """Shard the batch dimension over dp (and fsdp if >1), replicate the rest."""
+    spec = [None] * (batch_axis + 1)
+    spec[batch_axis] = ("dp", "fsdp") if mesh.shape["fsdp"] > 1 else "dp"
+    return NamedSharding(mesh, P(*spec))
+
+
+def time_batch_sharding(mesh: Mesh) -> NamedSharding:
+    """[T, B, ...] arrays: shard B (axis 1) over dp; T stays whole (or moves
+    to sp when a sequence-parallel mesh is configured)."""
+    if mesh.shape["sp"] > 1:
+        return NamedSharding(mesh, P("sp", "dp"))
+    return NamedSharding(mesh, P(None, "dp"))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())

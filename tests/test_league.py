@@ -1,0 +1,209 @@
+"""League simulation tests — no game needed (league logic is game-agnostic).
+
+Covers what the reference's test suite lacks entirely (SURVEY.md §4): pfsp
+weighting properties, payoff warm-up priors, ELO convergence, matchmaking
+branches, snapshot/reset lifecycle, resume roundtrip, and the HTTP API.
+"""
+import random
+
+import numpy as np
+import pytest
+
+from distar_tpu.league import (
+    ELORating,
+    League,
+    LeagueAPIServer,
+    MainPlayer,
+    Payoff,
+    league_request,
+    pfsp,
+)
+
+
+def _league(n_hist=2, one_phase_step=1000):
+    cfg = {
+        "league": {
+            "active_players": {
+                "player_id": ["MP0", "ME0", "EP0"],
+                "checkpoint_path": ["mp0.ckpt", "me0.ckpt", "ep0.ckpt"],
+                "pipeline": ["default"] * 3,
+                "frac_id": [1] * 3,
+                "z_path": ["3map.json"] * 3,
+                "z_prob": [0.0] * 3,
+                "teacher_id": ["T", "T", "T"],
+                "teacher_path": ["teacher.ckpt"] * 3,
+                "one_phase_step": [one_phase_step] * 3,
+                "chosen_weight": [1.0] * 3,
+            },
+            "historical_players": {
+                "player_id": [f"HP{i}" for i in range(n_hist)],
+                "checkpoint_path": [f"hp{i}.ckpt" for i in range(n_hist)],
+                "pipeline": ["default"] * n_hist,
+                "frac_id": [1] * n_hist,
+                "z_path": ["3map.json"] * n_hist,
+                "z_prob": [0.0] * n_hist,
+            },
+        }
+    }
+    return League(cfg)
+
+
+def test_pfsp_weightings():
+    wr = np.array([0.1, 0.5, 0.9])
+    sq = pfsp(wr, "squared")
+    assert sq[0] > sq[1] > sq[2]  # favours opponents we lose to
+    var = pfsp(wr, "variance")
+    assert var[1] > var[0] and var[1] > var[2]  # favours even matches
+    assert abs(pfsp(wr, "normal").sum() - 1) < 1e-9
+    # all-zero winrates -> uniform
+    np.testing.assert_allclose(pfsp(np.zeros(4)), np.full(4, 0.25))
+
+
+def test_payoff_prior_and_update():
+    p = Payoff(min_win_rate_games=10)
+    assert p.win_rate_opponent("X") == 0.5  # prior below min games
+    for _ in range(20):
+        p.update("X", {"winrate": 1.0, "game_steps": 100, "game_iters": 5, "game_duration": 60})
+    assert p.win_rate_opponent("X") == pytest.approx(1.0)
+    assert p.game_count["X"] == 20
+
+
+def test_elo_winner_gains():
+    elo = ELORating()
+    for _ in range(50):
+        elo.update("A", "B", 1)
+    r = elo.ratings(start_from_zero=False)
+    assert r["A"] > r["B"]
+    refit = elo.refit()
+    assert refit["A"] > refit["B"]
+
+
+def test_job_generation_branches():
+    random.seed(0)
+    lg = _league()
+    branches = set()
+    for _ in range(50):
+        job = lg.actor_ask_for_job({"job_type": "train"})
+        assert len(job["player_ids"]) == 2
+        assert job["env_info"]["map_name"] == "KairosJunction"
+        assert set(job) >= {
+            "checkpoint_paths", "teacher_player_ids", "send_data_players",
+            "update_players", "frac_ids", "z_path", "z_prob",
+        }
+        branches.add(job["branch"])
+    assert branches & {"sp", "pfsp", "vs_main", "vs_main_eval"}
+
+
+def test_vs_bot_job():
+    lg = _league()
+    lg.cfg.vs_bot = True
+    job = lg.actor_ask_for_job({"job_type": "train"})
+    assert job["branch"] == "train_bot"
+    assert job["bot_id"].startswith("bot")
+    assert len(job["env_info"]["player_ids"]) == 2
+
+
+def test_snapshot_and_reset_lifecycle():
+    lg = _league(one_phase_step=100)
+    n_hist0 = len(lg.historical_players)
+    # main player crosses one_phase_step -> snapshot, no reset (MainPlayer)
+    reply = lg.learner_send_train_info("MP0", train_steps=150)
+    assert len(lg.historical_players) == n_hist0 + 1
+    assert "MP0H1" in lg.historical_players
+    assert reply == {}
+    # main exploiter always resets after snapshot -> reset path returned
+    reply = lg.learner_send_train_info("ME0", train_steps=150)
+    assert reply.get("reset_checkpoint_path") == "teacher.ckpt"
+    assert any(pid.startswith("ME0H") for pid in lg.historical_players)
+
+
+def test_result_ingestion_updates_payoff_and_elo():
+    lg = _league()
+    result = {
+        "game_steps": 1000,
+        "game_iters": 50,
+        "game_duration": 600.0,
+        "0": {"player_id": "MP0", "opponent_id": "HP0", "winloss": 1},
+        "1": {"player_id": "HP0", "opponent_id": "MP0", "winloss": -1},
+    }
+    for _ in range(5):
+        lg.actor_send_result(dict(result))
+    mp0 = lg.active_players["MP0"]
+    assert mp0.payoff.stat_info_record["HP0"]["winrate"].val == pytest.approx(1.0)
+    assert mp0.total_game_count == 5
+    assert lg.elo.ratings(start_from_zero=False)["MP0"] > lg.elo.ratings(start_from_zero=False)["HP0"]
+
+
+def test_register_learner_and_resume(tmp_path):
+    lg = _league()
+    info = lg.register_learner("MP0", "127.0.0.1", 1234, 0, 1)
+    assert info["checkpoint_path"] == "mp0.ckpt"
+    lg.learner_send_train_info("MP0", train_steps=42)
+    p = str(tmp_path / "league.resume")
+    lg.save_resume(p)
+    lg2 = _league()
+    lg2.load_resume(p)
+    assert lg2.active_players["MP0"].total_agent_step == 42
+
+
+def test_http_api_roundtrip():
+    lg = _league()
+    server = LeagueAPIServer(lg)
+    server.start()
+    try:
+        out = league_request(server.host, server.port, "actor_ask_for_job", {"job_type": "train"})
+        assert out["code"] == 0 and len(out["info"]["player_ids"]) == 2
+        out = league_request(server.host, server.port, "register_learner",
+                             {"player_id": "MP0", "ip": "x", "port": 1, "rank": 0})
+        assert out["info"]["checkpoint_path"] == "mp0.ckpt"
+        out = league_request(server.host, server.port, "show_players", {})
+        assert "MP0" in out["info"]["active"]
+        out = league_request(server.host, server.port, "nonexistent", {})
+        assert out["code"] == 404
+    finally:
+        server.stop()
+
+
+def test_main_player_weak_opponent_fallback():
+    """sp branch vs a weak main must fall back to that main's history."""
+    random.seed(1)
+    cfg_players = ["MP0", "MP1"]
+    cfg = {
+        "league": {
+            "branch_probs": {"MainPlayer": {"sp": 1.0}},
+            "active_players": {
+                "player_id": cfg_players,
+                "checkpoint_path": ["a.ckpt", "b.ckpt"],
+                "pipeline": ["default"] * 2,
+                "frac_id": [1] * 2,
+                "z_path": ["3map.json"] * 2,
+                "z_prob": [0.0] * 2,
+                "teacher_id": ["T"] * 2,
+                "teacher_path": ["t.ckpt"] * 2,
+                "one_phase_step": [10 ** 9] * 2,
+                "chosen_weight": [1.0] * 2,
+            },
+            "historical_players": {
+                "player_id": ["HP0"],
+                "checkpoint_path": ["hp0.ckpt"],
+                "pipeline": ["default"],
+                "frac_id": [1],
+                "z_path": ["3map.json"],
+                "z_prob": [0.0],
+            },
+            "payoff_min_win_rate_games": 1,
+        }
+    }
+    lg = League(cfg)
+    mp0 = lg.active_players["MP0"]
+    # make MP0 terrible against MP1 -> sp branch must swap to history
+    for _ in range(10):
+        mp0.payoff.update("MP1", {"winrate": 0.0, "game_steps": 0, "game_iters": 0, "game_duration": 0})
+    found_hist = False
+    for _ in range(40):
+        branch, home, away = mp0.get_branch_opponent(
+            lg.historical_players, lg.active_players, lg.cfg.branch_probs, False
+        )
+        if away[0].player_id == "HP0":
+            found_hist = True
+    assert found_hist

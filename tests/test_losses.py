@@ -1,0 +1,172 @@
+"""Loss-function tests: shapes, masking semantics, gradient sanity."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distar_tpu.lib.features import MAX_SELECTED_UNITS_NUM
+from distar_tpu.losses import (
+    ReinforcementLossConfig,
+    SupervisedLossConfig,
+    compute_rl_loss,
+    compute_sl_loss,
+)
+
+T, B, S, N = 4, 3, MAX_SELECTED_UNITS_NUM, 16
+HEADS = ("action_type", "delay", "queued", "selected_units", "target_unit", "target_location")
+SIZES = {"action_type": 327, "delay": 128, "queued": 2, "target_unit": N, "target_location": 80}
+
+
+def _rl_inputs(rng, use_dapo=False):
+    def logits(shape):
+        return jnp.asarray(rng.standard_normal(shape).astype(np.float32))
+
+    target_logit, teacher_logit, actions, blogp = {}, {}, {}, {}
+    for h in HEADS:
+        if h == "selected_units":
+            target_logit[h] = logits((T, B, S, N + 1))
+            teacher_logit[h] = logits((T, B, S, N + 1))
+            actions[h] = jnp.asarray(rng.integers(0, N, (T, B, S)))
+            blogp[h] = logits((T, B, S)) * 0.1
+        else:
+            n = SIZES[h]
+            target_logit[h] = logits((T, B, n))
+            teacher_logit[h] = logits((T, B, n))
+            actions[h] = jnp.asarray(rng.integers(0, n, (T, B)))
+            blogp[h] = logits((T, B)) * 0.1
+    fields = ["winloss", "build_order", "built_unit", "effect", "upgrade", "battle"]
+    values = {f: logits((T + 1, B)) for f in fields}
+    rewards = {f: jnp.asarray(rng.integers(-1, 2, (T, B)).astype(np.float32)) for f in fields}
+    sun = jnp.asarray(rng.integers(1, S, (T, B)))
+    masks = {
+        "actions_mask": {h: jnp.ones((T, B)) for h in HEADS},
+        "selected_units_mask": jnp.arange(S)[None, None] < sun[..., None],
+        "build_order_mask": jnp.ones((T, B)),
+        "built_unit_mask": jnp.ones((T, B)),
+        "effect_mask": jnp.ones((T, B)),
+        "cum_action_mask": jnp.ones((T, B)),
+    }
+    inputs = {
+        "target_logit": target_logit,
+        "value": values,
+        "action_log_prob": blogp,
+        "teacher_logit": teacher_logit,
+        "action": actions,
+        "reward": rewards,
+        "step": jnp.broadcast_to(jnp.arange(T)[:, None] * 100.0, (T, B)),
+        "mask": masks,
+        "entity_num": jnp.full((T, B), N - 2),
+        "selected_units_num": sun,
+    }
+    if use_dapo:
+        inputs["successive_logit"] = teacher_logit
+    return inputs
+
+
+def test_rl_loss_runs_and_is_finite(rng):
+    inputs = _rl_inputs(rng)
+    total, info = jax.jit(compute_rl_loss)(inputs)
+    assert jnp.isfinite(total)
+    for k, v in info.items():
+        assert jnp.isfinite(v), k
+    assert "pg/winloss/action_type" in info and "td/winloss" in info
+    assert "kl/extra_at" in info
+
+
+def test_rl_loss_only_update_value(rng):
+    inputs = _rl_inputs(rng)
+    cfg = ReinforcementLossConfig(only_update_value=True)
+    total, info = compute_rl_loss(inputs, cfg)
+    assert jnp.allclose(total, info["td/total"])
+
+
+def test_rl_loss_teacher_equals_target_kl_zero(rng):
+    inputs = _rl_inputs(rng)
+    inputs["teacher_logit"] = inputs["target_logit"]
+    _, info = compute_rl_loss(inputs)
+    assert abs(float(info["kl/total"])) < 1e-4
+    assert abs(float(info["kl/extra_at"])) < 1e-5
+
+
+def test_rl_loss_gradients_flow(rng):
+    inputs = _rl_inputs(rng)
+
+    def loss_fn(target_logit):
+        i = dict(inputs)
+        i["target_logit"] = target_logit
+        return compute_rl_loss(i)[0]
+
+    g = jax.grad(loss_fn)(inputs["target_logit"])
+    gn = sum(float(jnp.abs(v).sum()) for v in jax.tree.leaves(g))
+    assert np.isfinite(gn) and gn > 0
+
+
+def test_rl_loss_dapo(rng):
+    inputs = _rl_inputs(rng, use_dapo=True)
+    inputs["successive_logit"] = inputs["target_logit"]
+    cfg = ReinforcementLossConfig(use_dapo=True, dapo_weight=0.1)
+    total, info = compute_rl_loss(inputs, cfg)
+    assert "dapo/total" in info
+    # successive == target -> zero dapo
+    assert abs(float(info["dapo/total"])) < 1e-4
+
+
+def _sl_inputs(rng):
+    logits = {
+        "action_type": jnp.asarray(rng.standard_normal((B, 327)).astype(np.float32)),
+        "delay": jnp.asarray(rng.standard_normal((B, 128)).astype(np.float32)),
+        "queued": jnp.asarray(rng.standard_normal((B, 2)).astype(np.float32)),
+        "selected_units": jnp.asarray(rng.standard_normal((B, S, N + 1)).astype(np.float32)),
+        "target_unit": jnp.asarray(rng.standard_normal((B, N)).astype(np.float32)),
+        "target_location": jnp.asarray(rng.standard_normal((B, 80)).astype(np.float32)),
+    }
+    actions = {
+        "action_type": jnp.asarray(rng.integers(0, 327, (B,))),
+        "delay": jnp.asarray(rng.integers(0, 128, (B,))),
+        "queued": jnp.asarray(rng.integers(0, 2, (B,))),
+        "selected_units": jnp.asarray(rng.integers(0, N, (B, S))),
+        "target_unit": jnp.asarray(rng.integers(0, N, (B,))),
+        "target_location": jnp.asarray(rng.integers(0, 80, (B,))),
+    }
+    masks = {k: jnp.ones((B,)) for k in logits}
+    sun = jnp.asarray(rng.integers(1, 8, (B,)))
+    en = jnp.full((B,), N - 2)
+    return logits, actions, masks, sun, en
+
+
+def test_sl_loss_runs(rng):
+    logits, actions, masks, sun, en = _sl_inputs(rng)
+    total, info = jax.jit(compute_sl_loss)(logits, actions, masks, sun, en)
+    assert jnp.isfinite(total)
+    for k in ("action_type_loss", "selected_units_loss", "target_location_distance_L2",
+              "selected_units_end_flag_loss", "action_type_acc"):
+        assert k in info and jnp.isfinite(info[k]), k
+
+
+def test_sl_loss_masked_head_contributes_zero(rng):
+    logits, actions, masks, sun, en = _sl_inputs(rng)
+    masks = dict(masks)
+    masks["target_unit"] = jnp.zeros((B,))
+    _, info = compute_sl_loss(logits, actions, masks, sun, en)
+    assert float(info["target_unit_loss"]) == 0.0
+
+
+def test_sl_loss_perfect_logits_low_loss(rng):
+    logits, actions, masks, sun, en = _sl_inputs(rng)
+    # make action_type logits nail the labels
+    perfect = jax.nn.one_hot(actions["action_type"], 327) * 50.0
+    logits = dict(logits, action_type=perfect)
+    _, info = compute_sl_loss(logits, actions, masks, sun, en)
+    assert float(info["action_type_loss"]) < 1e-3
+    assert float(info["action_type_acc"]) == 1.0
+
+
+def test_sl_loss_iou(rng):
+    logits, actions, masks, sun, en = _sl_inputs(rng)
+    # predictions exactly equal labels (with end token at position sun)
+    preds = actions["selected_units"].copy()
+    preds = preds.at[jnp.arange(B), jnp.clip(sun - 1, 0, S - 1)].set(en[0])
+    _, info = compute_sl_loss(
+        logits, actions, masks, sun, en, infer_selected_units=preds
+    )
+    assert 0.0 <= float(info["selected_units_iou"]) <= 1.0
