@@ -1,0 +1,13 @@
+from .actor import Actor, ACTOR_DEFAULTS
+from .agent import Agent, sample_fake_z, time_decay_factor
+from .inference import BatchedInference, decollate
+
+__all__ = [
+    "Actor",
+    "ACTOR_DEFAULTS",
+    "Agent",
+    "sample_fake_z",
+    "time_decay_factor",
+    "BatchedInference",
+    "decollate",
+]

@@ -1,0 +1,220 @@
+"""Actor: job lifecycle + lockstep env fleet with batched device inference.
+
+Role parity with the reference Actor (reference: distar/actor/actor.py:23-353
+and actor_comm.py): ask the league for a job, drive env<->agent loops, ship
+trajectories to the learner over the Adapter, pull fresh weights
+periodically, report results.
+
+TPU-first divergence (documented design choice): the reference forks one
+process per env and funnels inference through shared-memory slots
+(actor.py:301-319, agent.py:715-739). Here the env fleet steps in lockstep
+inside one process and every slot's observation joins ONE fixed-shape jitted
+batch (inference.BatchedInference) — the natural shape for a TPU host, where
+a single batched forward amortises dispatch and the MXU. SC2-process
+concurrency (the real env's slow step) belongs to the env layer's own worker
+pool behind the same interface.
+"""
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, List, Optional
+
+import jax
+import numpy as np
+
+from ..comm import Adapter
+from ..envs import BaseEnv, MockEnv
+from ..league import League
+from ..model import Model, default_model_config
+from ..utils import Config, deep_merge_dicts
+from .agent import Agent, sample_fake_z
+from .inference import BatchedInference, decollate
+
+ACTOR_DEFAULTS = Config(
+    {
+        "actor": {
+            "env_num": 2,
+            "traj_len": 16,
+            "episodes_per_job": 1,
+            "model_update_interval_s": 10.0,
+            "seed": 0,
+        }
+    }
+)
+
+
+class Actor:
+    def __init__(
+        self,
+        cfg: Optional[dict] = None,
+        league: Optional[League] = None,
+        adapter: Optional[Adapter] = None,
+        model_cfg: Optional[dict] = None,
+        env_fn: Optional[Callable[[], BaseEnv]] = None,
+        init_params: Optional[dict] = None,
+    ):
+        whole = deep_merge_dicts(ACTOR_DEFAULTS, cfg or {})
+        self.cfg = whole.actor
+        self.league = league
+        self.adapter = adapter
+        self.model_cfg = deep_merge_dicts(default_model_config(), model_cfg or {})
+        self.model_cfg.use_value_network = False
+        self.model = Model(self.model_cfg)
+        self._env_fn = env_fn or (lambda: MockEnv(seed=self.cfg.seed))
+        self._init_params = init_params
+        self._rng = np.random.default_rng(self.cfg.seed)
+        self.results: List[dict] = []
+
+    # ---------------------------------------------------------------- params
+    def _initial_params(self):
+        if self._init_params is not None:
+            return self._init_params
+        from ..lib import features as F
+        import jax.numpy as jnp
+
+        obs = F.batch_tree([F.fake_step_data(train=False, rng=self._rng)])
+        obs = jax.tree.map(jnp.asarray, obs)
+        H = self.model_cfg.encoder.core_lstm.hidden_size
+        hidden = tuple(
+            (jnp.zeros((1, H)), jnp.zeros((1, H)))
+            for _ in range(self.model_cfg.encoder.core_lstm.num_layers)
+        )
+
+        def init_fn(rng, o, h, k):
+            return self.model.init(
+                rng, o["spatial_info"], o["entity_info"], o["scalar_info"], o["entity_num"],
+                h, k, method=self.model.sample_action,
+            )
+
+        self._init_params = jax.jit(init_fn)(
+            jax.random.PRNGKey(self.cfg.seed), obs, hidden, jax.random.PRNGKey(1)
+        )
+        return self._init_params
+
+    def _load_player_params(self, player_id: str):
+        """Fresh weights from the learner when published, else initial."""
+        if self.adapter is not None:
+            data = self.adapter.pull(f"{player_id}model", block=False)
+            if data is not None:
+                self._model_iters[player_id] = data.get("iter", 0)
+                return jax.tree.map(np.asarray, data["params"])
+        return self._initial_params()
+
+    # ------------------------------------------------------------------- run
+    def run_job(self, episodes: Optional[int] = None) -> List[dict]:
+        """Ask for one job and play it out; returns per-episode results."""
+        episodes = episodes or self.cfg.episodes_per_job
+        job = (
+            self.league.actor_ask_for_job({"job_type": "train"})
+            if self.league is not None
+            else {
+                "player_ids": ["MP0", "HP0"],
+                "send_data_players": ["MP0"],
+                "update_players": ["MP0"],
+                "teacher_player_ids": ["T", "none"],
+                "branch": "standalone",
+                "env_info": {"map_name": "mock"},
+            }
+        )
+        self._model_iters: Dict[str, int] = {}
+        player_ids = job["player_ids"][:2]
+        n_env = self.cfg.env_num
+        envs = [self._env_fn() for _ in range(n_env)]
+
+        # slots: (env, side); one BatchedInference per side (player)
+        params = {pid: self._load_player_params(pid) for pid in set(player_ids)}
+        infer = {
+            side: BatchedInference(self.model, params[pid], n_env, seed=side)
+            for side, pid in enumerate(player_ids)
+        }
+        teacher_hidden = {side: infer[side]._zero_hidden() for side in infer}
+        agents = {
+            (e, side): Agent(
+                pid,
+                z=sample_fake_z(self._rng),
+                traj_len=self.cfg.traj_len,
+                seed=self.cfg.seed + e * 2 + side,
+            )
+            for e in range(n_env)
+            for side, pid in enumerate(player_ids)
+        }
+        for (e, side), ag in agents.items():
+            ag.model_last_iter = self._model_iters.get(ag.player_id, 0)
+        hidden_backup = {
+            (e, side): infer[side].hidden_for_slot(e) for e in range(n_env) for side in (0, 1)
+        }
+
+        obs = {e: envs[e].reset() for e in range(n_env)}
+        episodes_done, results = 0, []
+        while episodes_done < episodes:
+            env_actions: Dict[int, dict] = {e: {} for e in range(n_env)}
+            prepared_by_side: Dict[int, list] = {}
+            outputs_by_side: Dict[int, list] = {}
+            for side, pid in enumerate(player_ids):
+                prepared = [agents[(e, side)].pre_process(obs[e][side]) for e in range(n_env)]
+                prepared_by_side[side] = prepared
+                outs = infer[side].sample(prepared)
+                outputs_by_side[side] = outs
+                for e in range(n_env):
+                    env_actions[e][side] = agents[(e, side)].post_process(outs[e])
+            # teacher logits for the sampled actions (teacher == own params
+            # here until distinct teacher ckpts are wired)
+            teacher_by_side = {}
+            for side in infer:
+                t_logits, teacher_hidden[side] = infer[side].teacher_logits(
+                    params[player_ids[side]], prepared_by_side[side], teacher_hidden[side],
+                    outputs_by_side[side],
+                )
+                teacher_by_side[side] = t_logits
+
+            for e in range(n_env):
+                next_obs, rewards, done, info = envs[e].step(env_actions[e])
+                for side in (0, 1):
+                    ag = agents[(e, side)]
+                    traj = ag.collect_data(
+                        next_obs[side],
+                        rewards[side],
+                        done,
+                        teacher_by_side[side][e],
+                        hidden_backup[(e, side)],
+                    )
+                    if traj is not None:
+                        hidden_backup[(e, side)] = infer[side].hidden_for_slot(e)
+                        if self.adapter is not None and ag.player_id in job["send_data_players"]:
+                            self.adapter.push(f"{ag.player_id}traj", traj, timeout_ms=120_000)
+                if done:
+                    episodes_done += 1
+                    result = {
+                        "game_steps": info.get("game_loop", 0),
+                        "game_iters": 0,
+                        "game_duration": 0.0,
+                        "0": {
+                            "player_id": player_ids[0],
+                            "opponent_id": player_ids[1],
+                            "winloss": int(rewards[0]),
+                        },
+                        "1": {
+                            "player_id": player_ids[1],
+                            "opponent_id": player_ids[0],
+                            "winloss": int(rewards[1]),
+                        },
+                    }
+                    results.append(result)
+                    if self.league is not None:
+                        self.league.actor_send_result(result)
+                    obs[e] = envs[e].reset()
+                    for side in (0, 1):
+                        agents[(e, side)].reset(z=sample_fake_z(self._rng))
+                        infer[side].reset_slot(e)
+                        # the teacher's LSTM carry is per-episode too
+                        teacher_hidden[side] = tuple(
+                            (h.at[e].set(0.0), c.at[e].set(0.0))
+                            for h, c in teacher_hidden[side]
+                        )
+                        hidden_backup[(e, side)] = infer[side].hidden_for_slot(e)
+                else:
+                    obs[e] = next_obs
+        for env in envs:
+            env.close()
+        self.results.extend(results)
+        return results

@@ -1,0 +1,320 @@
+"""Stateful per-episode agent.
+
+Role parity with the reference Agent (reference: distar/agent/default/
+agent.py:92-750): Z-target sampling and conditioning, observation
+augmentation with last-action fields (_pre_process :257-304), action decode
+(_post_process :347-393), pseudo-rewards against the target strategy Z via
+levenshtein/hamming (_update_fake_reward :619-713 with the time-decay factor
+:741-750), and trajectory assembly incl. teacher logits (collect_data
+:475-607).
+
+TPU-first split: the agent holds NO network — the Actor batches all envs'
+prepared observations into one jitted forward on fixed-shape device buffers
+(replacing the reference's shared-memory GPU slot protocol, agent.py:715-739).
+The agent is the pure-Python per-slot state machine around that.
+"""
+from __future__ import annotations
+
+import copy
+from collections import deque
+from functools import partial
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..lib import actions as ACT
+from ..lib import features as F
+from ..ops.metric import hamming_distance, l2_distance, levenshtein_distance
+
+BO_NORM = 20.0
+CUM_NORM = 30.0
+BATTLE_NORM = 30.0
+
+
+def time_decay_factor(game_step: float) -> float:
+    """Pseudo-reward decay over game time (reference agent.py:741-750)."""
+    if game_step < 10_000:
+        return 1.0
+    if game_step < 20_000:
+        return 0.5
+    if game_step < 30_000:
+        return 0.25
+    return 0.0
+
+
+def sample_fake_z(rng: Optional[np.random.Generator] = None) -> dict:
+    """A synthetic target strategy with the real Z schema (stand-in for the
+    map/race/born-location-keyed Z json libraries, agent.py:176-243)."""
+    rng = rng or np.random.default_rng(0)
+    n_bo = int(rng.integers(5, F.BEGINNING_ORDER_LENGTH))
+    bo = rng.integers(1, ACT.NUM_BEGINNING_ORDER_ACTIONS, n_bo).tolist()
+    loc = rng.integers(0, F.SPATIAL_SIZE[0] * F.SPATIAL_SIZE[1], n_bo).tolist()
+    cum = np.zeros(ACT.NUM_CUMULATIVE_STAT_ACTIONS, dtype=np.int64)
+    cum[rng.integers(1, ACT.NUM_CUMULATIVE_STAT_ACTIONS, 20)] = 1
+    return {"beginning_order": bo, "bo_location": loc, "cumulative_stat": cum.tolist()}
+
+
+class Agent:
+    HAS_MODEL = True
+
+    def __init__(
+        self,
+        player_id: str,
+        z: Optional[dict] = None,
+        traj_len: int = 16,
+        use_bo_reward: bool = True,
+        use_cum_reward: bool = True,
+        clip_bo: bool = False,
+        seed: int = 0,
+    ):
+        self.player_id = player_id
+        self._traj_len = traj_len
+        self.use_bo_reward = use_bo_reward
+        self.use_cum_reward = use_cum_reward
+        self._clip_bo = clip_bo
+        self._rng = np.random.default_rng(seed)
+        self._z = z or sample_fake_z(self._rng)
+        self.model_last_iter = 0
+        self.reset()
+
+    # ----------------------------------------------------------------- reset
+    def reset(self, z: Optional[dict] = None) -> None:
+        if z is not None:
+            self._z = z
+        zl = len(self._z["beginning_order"])
+        pad = F.BEGINNING_ORDER_LENGTH - zl
+        self._target_building_order = list(self._z["beginning_order"])
+        self._target_bo_location = list(self._z["bo_location"])
+        self._target_z_bo = np.asarray(
+            self._z["beginning_order"] + [0] * pad, dtype=np.int64
+        )
+        self._target_z_loc = np.asarray(self._z["bo_location"] + [0] * pad, dtype=np.int64)
+        self._target_cumulative_stat = np.asarray(self._z["cumulative_stat"], dtype=np.int64)
+
+        self._behaviour_building_order: List[int] = []
+        self._behaviour_bo_location: List[int] = []
+        self._behaviour_cumulative_stat = np.zeros(
+            ACT.NUM_CUMULATIVE_STAT_ACTIONS, dtype=np.int64
+        )
+        self._old_bo_reward = (
+            -levenshtein_distance(np.asarray([]), np.asarray(self._target_building_order))
+            / BO_NORM
+        )
+        self._old_cum_reward = (
+            -hamming_distance(self._behaviour_cumulative_stat, self._target_cumulative_stat)
+            / CUM_NORM
+        )
+        self._bo_zergling_count = 0
+        self._exceed_flag = True
+        self._last_action = {k: 0 for k in F.ACTION_HEADS}
+        self._battle_score = 0.0
+        self._opponent_battle_score = 0.0
+        self._game_step = 0
+        self._data_buffer: deque = deque()
+        self._observation: Optional[dict] = None
+        self._output: Optional[dict] = None
+        self._hidden_state_backup = None  # set by actor at traj starts
+        self._result = 0
+
+    # ------------------------------------------------------------ pre-process
+    def pre_process(self, obs: dict) -> dict:
+        """Augment a feature-level obs with last-action fields and the Z
+        conditioning targets (reference _pre_process :257-304)."""
+        obs = copy.copy(obs)
+        scalar = dict(obs["scalar_info"])
+        scalar["last_action_type"] = np.asarray(self._last_action["action_type"], np.int16)
+        scalar["last_delay"] = np.asarray(self._last_action["delay"], np.int16)
+        scalar["last_queued"] = np.asarray(self._last_action["queued"], np.int16)
+        scalar["beginning_order"] = self._target_z_bo.astype(np.int16)
+        scalar["bo_location"] = self._target_z_loc.astype(np.int16)
+        scalar["cumulative_stat"] = self._target_cumulative_stat.astype(np.uint8)
+        obs["scalar_info"] = scalar
+        self._game_step = float(np.asarray(scalar["time"]))
+        self._observation = {
+            "spatial_info": obs["spatial_info"],
+            "entity_info": obs["entity_info"],
+            "scalar_info": scalar,
+            "entity_num": obs["entity_num"],
+        }
+        self._raw_obs = obs
+        return self._observation
+
+    # ----------------------------------------------------------- post-process
+    def post_process(self, output: dict) -> dict:
+        """Store the model output, return the env-facing action dict
+        (reference _post_process :347-393 — tag mapping happens in the real
+        env binding; the feature-level contract passes indices through)."""
+        self._output = output
+        a = output["action_info"]
+        self._last_action = {k: int(np.asarray(a[k]).reshape(-1)[0]) if k != "selected_units"
+                             else 0 for k in F.ACTION_HEADS}
+        self._last_action["selected_units"] = 0
+        return {
+            "action_type": np.asarray(a["action_type"]),
+            "delay": np.asarray(a["delay"]),
+            "queued": np.asarray(a["queued"]),
+            "selected_units": np.asarray(a["selected_units"]),
+            "target_unit": np.asarray(a["target_unit"]),
+            "target_location": np.asarray(a["target_location"]),
+        }
+
+    # --------------------------------------------------------- pseudo-rewards
+    def update_fake_reward(self, next_obs: dict) -> Dict[str, float]:
+        action_type = int(self._last_action["action_type"])
+        location = int(self._last_action["target_location"])
+        bo_reward, cum_reward = 0.0, 0.0
+
+        battle_score = float(next_obs.get("battle_score", 0.0))
+        opp_score = float(next_obs.get("opponent_battle_score", 0.0))
+        battle_reward = (
+            (battle_score - self._battle_score) - (opp_score - self._opponent_battle_score)
+        ) / BATTLE_NORM
+        self._battle_score = battle_score
+        self._opponent_battle_score = opp_score
+
+        success = bool(next_obs.get("action_result", [1])[0] == 1)
+        if not self._exceed_flag:
+            return {"build_order": bo_reward, "built_unit": cum_reward, "battle": battle_reward}
+
+        if action_type in ACT.BEGINNING_ORDER_ACTIONS and success:
+            # zergling spam guard (reference :632-635)
+            if action_type == 322:
+                self._bo_zergling_count += 1
+                if self._bo_zergling_count > 8:
+                    return {
+                        "build_order": bo_reward, "built_unit": cum_reward, "battle": battle_reward,
+                    }
+            order_index = ACT.BEGINNING_ORDER_ACTIONS.index(action_type)
+            if len(self._behaviour_building_order) < len(self._target_building_order):
+                self._behaviour_building_order.append(order_index)
+                self._behaviour_bo_location.append(
+                    location if ACT.ACTIONS[action_type]["target_location"] else 0
+                )
+                if self.use_bo_reward:
+                    if self._clip_bo:
+                        tz = self._target_building_order[: len(self._behaviour_building_order)]
+                        tz_lo = self._target_bo_location[: len(self._behaviour_building_order)]
+                    else:
+                        tz, tz_lo = self._target_building_order, self._target_bo_location
+                    new_bo = (
+                        -levenshtein_distance(
+                            np.asarray(self._behaviour_building_order),
+                            np.asarray(tz),
+                            np.asarray(self._behaviour_bo_location),
+                            np.asarray(tz_lo),
+                            partial(l2_distance, spatial_x=F.SPATIAL_SIZE[1]),
+                        )
+                        / BO_NORM
+                    )
+                    bo_reward = new_bo - self._old_bo_reward
+                    self._old_bo_reward = new_bo
+
+        cum_flag = False
+        if action_type in ACT.CUMULATIVE_STAT_ACTIONS and success:
+            cum_flag = True
+            self._behaviour_cumulative_stat[
+                ACT.CUMULATIVE_STAT_ACTIONS.index(action_type)
+            ] += 1
+        if self.use_cum_reward and cum_flag:
+            new_cum = (
+                -hamming_distance(self._behaviour_cumulative_stat, self._target_cumulative_stat)
+                / CUM_NORM
+            )
+            cum_reward = (new_cum - self._old_cum_reward) * time_decay_factor(self._game_step)
+            self._old_cum_reward = new_cum
+        return {"build_order": bo_reward, "built_unit": cum_reward, "battle": battle_reward}
+
+    def get_behavior_z(self) -> dict:
+        pad = F.BEGINNING_ORDER_LENGTH - len(self._behaviour_building_order)
+        return {
+            "beginning_order": np.asarray(self._behaviour_building_order + [0] * pad, np.int64),
+            "bo_location": np.asarray(self._behaviour_bo_location + [0] * pad, np.int64),
+            "cumulative_stat": (self._behaviour_cumulative_stat > 0).astype(np.int64),
+        }
+
+    # ------------------------------------------------------------ trajectory
+    def collect_data(
+        self,
+        next_obs: Optional[dict],
+        reward: float,
+        done: bool,
+        teacher_logit: dict,
+        hidden_state_backup,
+    ) -> Optional[list]:
+        """Assemble one trajectory step; returns a completed trajectory
+        (list of step dicts + bootstrap step) every traj_len steps or at
+        episode end (reference collect_data :475-607)."""
+        pseudo = self.update_fake_reward(next_obs or {})
+        a = self._output["action_info"]
+        action_type = int(np.asarray(a["action_type"]).reshape(-1)[0])
+        spec = ACT.ACTIONS[action_type]
+        mask = {
+            "actions_mask": {
+                "action_type": 1.0,
+                "delay": 1.0,
+                "queued": float(spec["queued"]),
+                "selected_units": float(spec["selected_units"]),
+                "target_unit": float(spec["target_unit"]),
+                "target_location": float(spec["target_location"]),
+            },
+            "cum_action_mask": 1.0,
+            "build_order_mask": float(self.use_bo_reward),
+            "built_unit_mask": float(self.use_cum_reward),
+            "effect_mask": 1.0,
+        }
+        step_data = {
+            "spatial_info": self._observation["spatial_info"],
+            "entity_info": self._observation["entity_info"],
+            "scalar_info": self._observation["scalar_info"],
+            "entity_num": self._observation["entity_num"],
+            "selected_units_num": np.asarray(self._output["selected_units_num"]).reshape(()),
+            "hidden_state": hidden_state_backup,
+            "action_info": {k: np.asarray(v) for k, v in a.items()},
+            "behaviour_logp": {k: np.asarray(v) for k, v in self._output["action_logp"].items()},
+            "teacher_logit": {k: np.asarray(v) for k, v in teacher_logit.items()},
+            "reward": {
+                "winloss": float(reward),
+                "build_order": pseudo["build_order"],
+                "built_unit": pseudo["built_unit"],
+                "effect": 0.0,
+                "upgrade": 0.0,
+                "battle": pseudo["battle"],
+            },
+            "step": float(self._game_step),
+            "mask": mask,
+            "model_last_iter": float(self.model_last_iter),
+        }
+        self._data_buffer.append(step_data)
+        if len(self._data_buffer) >= self._traj_len or done:
+            # fixed-shape contract: an episode ending mid-window pads the
+            # trajectory to traj_len by repeating the final step with masks,
+            # rewards, and logps zeroed — padded steps contribute nothing to
+            # any loss term but keep T static for XLA
+            while done and len(self._data_buffer) < self._traj_len:
+                pad = copy.deepcopy(self._data_buffer[-1])
+                pad["mask"] = {
+                    "actions_mask": {k: 0.0 for k in pad["mask"]["actions_mask"]},
+                    "cum_action_mask": 0.0,
+                    "build_order_mask": 0.0,
+                    "built_unit_mask": 0.0,
+                    "effect_mask": 0.0,
+                }
+                pad["reward"] = {k: 0.0 for k in pad["reward"]}
+                pad["behaviour_logp"] = {
+                    k: np.zeros_like(v) for k, v in pad["behaviour_logp"].items()
+                }
+                self._data_buffer.append(pad)
+            # bootstrap step: the NEXT observation when the episode goes on
+            # (value bootstraps from it); on done the learner ignores it, so
+            # the current obs stands in (reference :572-598)
+            bootstrap_src = self._observation if done else self.pre_process(next_obs)
+            last_step = {
+                "spatial_info": bootstrap_src["spatial_info"],
+                "entity_info": bootstrap_src["entity_info"],
+                "scalar_info": bootstrap_src["scalar_info"],
+                "entity_num": bootstrap_src["entity_num"],
+            }
+            traj = list(self._data_buffer) + [last_step]
+            self._data_buffer.clear()
+            return traj
+        return None

@@ -1,0 +1,95 @@
+"""Batched jitted inference for the actor fleet.
+
+Role of the reference's gpu_batch_inference (reference: distar/agent/default/
+agent.py:715-739 and actor.py:268-299 — shared-memory slots + spin-wait
+signals feeding one GPU forward): here every env slot's prepared observation
+is stacked into ONE fixed-shape device batch and a single jitted
+``sample_action`` serves all slots; teacher logits batch the same way. No
+shared memory, no signal tensors — the batch IS the protocol, and fixed
+shapes mean one compilation.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..lib import features as F
+from ..model import Model
+
+
+def decollate(tree, idx: int):
+    """Slice one slot out of a batched output pytree (host numpy)."""
+    return jax.tree.map(lambda x: np.asarray(x)[idx], tree)
+
+
+class BatchedInference:
+    """Owns params + hidden states for all slots of one player_id."""
+
+    def __init__(self, model: Model, params, num_slots: int, seed: int = 0):
+        self.model = model
+        self.params = params
+        self.num_slots = num_slots
+        cfg = model.cfg
+        self._hidden_size = cfg["encoder"]["core_lstm"]["hidden_size"]
+        self._num_layers = cfg["encoder"]["core_lstm"]["num_layers"]
+        self.hidden = self._zero_hidden()
+        self._rng = jax.random.PRNGKey(seed)
+
+        self._sample = jax.jit(
+            lambda p, d, h, r: model.apply(
+                p, d["spatial_info"], d["entity_info"], d["scalar_info"], d["entity_num"],
+                h, r, method=model.sample_action,
+            )
+        )
+        self._teacher = jax.jit(
+            lambda p, d, h, a, n: model.apply(
+                p, d["spatial_info"], d["entity_info"], d["scalar_info"], d["entity_num"],
+                h, a, n, method=model.teacher_logits,
+            )
+        )
+
+    def _zero_hidden(self):
+        z = jnp.zeros((self.num_slots, self._hidden_size))
+        return tuple((z, z) for _ in range(self._num_layers))
+
+    def reset_slot(self, idx: int) -> None:
+        """Zero one slot's hidden state (episode boundary)."""
+        self.hidden = tuple(
+            (h.at[idx].set(0.0), c.at[idx].set(0.0)) for h, c in self.hidden
+        )
+
+    def hidden_for_slot(self, idx: int):
+        return tuple(
+            (np.asarray(h[idx]), np.asarray(c[idx])) for h, c in self.hidden
+        )
+
+    def sample(self, prepared: List[dict]) -> List[dict]:
+        """One batched forward over all slots; returns per-slot outputs."""
+        assert len(prepared) == self.num_slots
+        batch = jax.tree.map(jnp.asarray, F.batch_tree(prepared))
+        self._rng, key = jax.random.split(self._rng)
+        out = self._sample(self.params, batch, self.hidden, key)
+        self.hidden = out["hidden_state"]
+        outs = []
+        host = jax.tree.map(np.asarray, {k: v for k, v in out.items() if k != "hidden_state"})
+        for i in range(self.num_slots):
+            outs.append(jax.tree.map(lambda x: x[i], host))
+        return outs
+
+    def teacher_logits(
+        self, teacher_params, prepared: List[dict], teacher_hidden, outputs: List[dict]
+    ):
+        """Teacher-forced logits for the freshly sampled actions; returns
+        (per-slot logit dicts, new teacher hidden)."""
+        batch = jax.tree.map(jnp.asarray, F.batch_tree(prepared))
+        action_info = jax.tree.map(
+            jnp.asarray, F.batch_tree([o["action_info"] for o in outputs])
+        )
+        sun = jnp.asarray(np.stack([np.asarray(o["selected_units_num"]) for o in outputs]))
+        out = self._teacher(teacher_params, batch, teacher_hidden, action_info, sun)
+        host_logit = jax.tree.map(np.asarray, out["logit"])
+        per_slot = [jax.tree.map(lambda x: x[i], host_logit) for i in range(self.num_slots)]
+        return per_slot, out["hidden_state"]

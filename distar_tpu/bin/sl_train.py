@@ -1,0 +1,53 @@
+"""Supervised-learning launcher.
+
+Role parity with the reference (reference: distar/bin/sl_train.py:28-50):
+learner / replay-actor roles. Until the SC2 replay decoder lands, --fake-data
+drives the learner with schema-complete batches (the reference's
+FakeDataloader path) — same model, loss, and meters as real training.
+"""
+from __future__ import annotations
+
+import argparse
+
+from ..learner import SLLearner
+from ..utils import read_config
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--config", default="")
+    p.add_argument("--iters", type=int, default=4)
+    p.add_argument("--batch-size", type=int, default=2)
+    p.add_argument("--traj-len", type=int, default=8)
+    p.add_argument("--experiment-name", default="sl_train")
+    p.add_argument("--fake-data", action="store_true", default=True)
+    p.add_argument("--smoke-model", action="store_true", default=True)
+    p.add_argument("--full-model", dest="smoke_model", action="store_false")
+    args = p.parse_args()
+
+    from .rl_train import SMOKE_MODEL
+
+    user_cfg = read_config(args.config) if args.config else {}
+    model_cfg = user_cfg.get("model", SMOKE_MODEL if args.smoke_model else {})
+    learner = SLLearner(
+        {
+            "common": {"experiment_name": args.experiment_name},
+            "learner": {
+                "batch_size": args.batch_size,
+                "unroll_len": args.traj_len,
+                "log_freq": max(args.iters // 4, 1),
+                "save_freq": 10 ** 9,
+            },
+            "model": model_cfg,
+        }
+    )
+    learner.run(max_iterations=args.iters)
+    print(
+        f"sl_train done: {learner.last_iter.val} iters, "
+        f"loss={learner.variable_record.get('total_loss').avg:.4f}, "
+        f"action_type_acc={learner.variable_record.get('action_type_acc').avg:.4f}"
+    )
+
+
+if __name__ == "__main__":
+    main()

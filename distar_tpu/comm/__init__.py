@@ -1,0 +1,14 @@
+from .adapter import Adapter
+from .coordinator import Coordinator, CoordinatorServer, coordinator_request
+from .serializer import dumps, loads
+from . import shuttle
+
+__all__ = [
+    "Adapter",
+    "Coordinator",
+    "CoordinatorServer",
+    "coordinator_request",
+    "dumps",
+    "loads",
+    "shuttle",
+]

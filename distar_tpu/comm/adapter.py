@@ -1,0 +1,114 @@
+"""Adapter: the peer-to-peer bulk data plane.
+
+Role parity with the reference Adapter (reference: distar/ctools/worker/
+coordinator/adapter.py:66-246): push = serialise, serve the payload on an
+ephemeral socket (C++ shuttle), register the endpoint with the coordinator
+under a token; pull = ask the coordinator for an endpoint, connect, receive.
+Failed fetches strike the dead endpoint. A background pull loop feeds a
+bounded deque (backpressure = the reference's maxlen cache, adapter.py:31).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Optional
+
+from . import shuttle
+from .coordinator import Coordinator, coordinator_request
+from .serializer import dumps, loads
+
+
+class Adapter:
+    def __init__(
+        self,
+        coordinator: Optional[Coordinator] = None,
+        coordinator_addr: Optional[tuple] = None,
+        my_ip: str = "127.0.0.1",
+        compress: bool = True,
+    ):
+        """Either a local Coordinator object (in-process wiring) or
+        (host, port) of a CoordinatorServer."""
+        assert (coordinator is None) != (coordinator_addr is None)
+        self._co = coordinator
+        self._co_addr = coordinator_addr
+        self._my_ip = my_ip
+        self._compress = compress
+        self._caches: dict = {}
+        self._pull_threads: dict = {}
+        self._stop = threading.Event()
+
+    # -------------------------------------------------------------- plumbing
+    def _register(self, token: str, port: int) -> None:
+        if self._co is not None:
+            self._co.register(token, self._my_ip, port)
+        else:
+            coordinator_request(
+                *self._co_addr, "register", {"token": token, "ip": self._my_ip, "port": port}
+            )
+
+    def _ask(self, token: str) -> Optional[dict]:
+        if self._co is not None:
+            return self._co.ask(token)
+        return coordinator_request(*self._co_addr, "ask", {"token": token})["info"]
+
+    def _strike(self, ip: str, port: int) -> None:
+        if self._co is not None:
+            self._co.strike(ip, port)
+        else:
+            coordinator_request(*self._co_addr, "strike", {"ip": ip, "port": port})
+
+    # ------------------------------------------------------------------- api
+    def push(self, token: str, data: Any, accept_count: int = 1, timeout_ms: int = 60_000) -> int:
+        """Serve ``data`` to ``accept_count`` consumers; returns the port."""
+        blob = dumps(data, compress=self._compress)
+        port = shuttle.serve(blob, accept_count=accept_count, timeout_ms=timeout_ms)
+        self._register(token, port)
+        return port
+
+    def pull(self, token: str, block: bool = True, timeout: float = 60.0, poll_s: float = 0.05):
+        """Fetch one payload for ``token``; None when non-blocking and empty."""
+        deadline = time.time() + timeout
+        while True:
+            rec = self._ask(token)
+            if rec is not None:
+                try:
+                    blob = shuttle.fetch(rec["ip"], rec["port"], timeout_ms=int(timeout * 1000))
+                    return loads(blob)
+                except (OSError, ConnectionError):
+                    self._strike(rec["ip"], rec["port"])
+                    continue
+            if not block:
+                return None
+            if time.time() > deadline:
+                raise TimeoutError(f"pull({token}) timed out")
+            time.sleep(poll_s)
+
+    def start_pull_loop(self, token: str, maxlen: int = 8) -> deque:
+        """Background loop keeping a bounded cache of payloads for ``token``.
+        Backpressure: when the cache is full the loop pauses (payload stays
+        with the producer until its serve window expires)."""
+        cache: deque = deque(maxlen=maxlen)
+        self._caches[token] = cache
+
+        def run():
+            while not self._stop.is_set():
+                if len(cache) >= maxlen:
+                    time.sleep(0.02)
+                    continue
+                try:
+                    data = self.pull(token, block=False)
+                except (TimeoutError, OSError):
+                    data = None
+                if data is None:
+                    time.sleep(0.02)
+                else:
+                    cache.append(data)
+
+        t = threading.Thread(target=run, daemon=True)
+        t.start()
+        self._pull_threads[token] = t
+        return cache
+
+    def stop(self) -> None:
+        self._stop.set()

@@ -1,0 +1,123 @@
+"""Coordinator: token-keyed metadata broker for the peer-to-peer data plane.
+
+Role parity with the reference Coordinator (reference: distar/ctools/worker/
+coordinator/coordinator.py:62-232): producers register "payload ready at
+ip:port" records under a token; consumers pop a record and connect directly —
+the broker never touches tensor payloads. Dead producers accumulate strikes
+on failed fetches and are dropped after 5 (coordinator.py:114-128).
+
+Transport here is the same stdlib HTTP/JSON server as the league API.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+from collections import defaultdict, deque
+from typing import Dict, Optional
+
+from ..utils import Config
+
+
+class Coordinator:
+    def __init__(self, maxlen_per_token: int = 512):
+        self._maxlen = maxlen_per_token
+        self._records: Dict[str, deque] = defaultdict(lambda: deque(maxlen=self._maxlen))
+        self._strikes: Dict[str, int] = defaultdict(int)
+        self._lock = threading.RLock()
+
+    def register(self, token: str, ip: str, port: int, meta: Optional[dict] = None) -> bool:
+        with self._lock:
+            self._records[token].append(
+                {"ip": ip, "port": port, "meta": meta or {}, "ts": time.time()}
+            )
+            return True
+
+    def ask(self, token: str) -> Optional[dict]:
+        """Pop the oldest ready record for a token (None when empty)."""
+        with self._lock:
+            q = self._records.get(token)
+            if not q:
+                return None
+            return q.popleft()
+
+    def strike(self, ip: str, port: int) -> None:
+        """Report a dead producer endpoint; 5 strikes purges its records."""
+        key = f"{ip}:{port}"
+        with self._lock:
+            self._strikes[key] += 1
+            if self._strikes[key] >= 5:
+                for q in self._records.values():
+                    dead = [r for r in q if f"{r['ip']}:{r['port']}" == key]
+                    for r in dead:
+                        q.remove(r)
+                self._strikes.pop(key)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {token: len(q) for token, q in self._records.items()}
+
+
+class CoordinatorServer:
+    """HTTP wrapper: POST /coordinator/<register|ask|strike|stats>."""
+
+    def __init__(self, coordinator: Optional[Coordinator] = None, host="127.0.0.1", port=0):
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        self.coordinator = coordinator or Coordinator()
+        co = self.coordinator
+        routes = {
+            "register": lambda b: co.register(**b),
+            "ask": lambda b: co.ask(b["token"]),
+            "strike": lambda b: co.strike(b["ip"], b["port"]),
+            "stats": lambda b: co.stats(),
+        }
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):
+                pass
+
+            def do_POST(self):
+                name = self.path.strip("/").split("/")[-1]
+                length = int(self.headers.get("Content-Length", 0))
+                try:
+                    body = json.loads(self.rfile.read(length) or b"{}")
+                    fn = routes.get(name)
+                    payload = (
+                        {"code": 404, "info": f"no route {name}"}
+                        if fn is None
+                        else {"code": 0, "info": fn(body)}
+                    )
+                except Exception as e:
+                    payload = {"code": 1, "info": repr(e)}
+                data = json.dumps(payload, default=str).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self.host, self.port = self._server.server_address
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self._server.serve_forever, daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        self._server.shutdown()
+        self._server.server_close()
+
+
+def coordinator_request(host: str, port: int, route: str, body: Optional[dict] = None, timeout=10.0):
+    import urllib.request
+
+    req = urllib.request.Request(
+        f"http://{host}:{port}/coordinator/{route}",
+        data=json.dumps(body or {}).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read())

@@ -1,0 +1,164 @@
+// High-throughput framed socket shuttle for the actor<->learner data plane.
+//
+// Native-code role: the reference's data plane rides Python sockets +
+// C-extension pickling (distar/ctools/worker/coordinator/adapter.py); here
+// the hot path — serving and fetching multi-MB length-prefixed payloads —
+// runs in C++ threads with no Python involvement (the GIL is released for
+// the duration of every call), so trajectory shipping never stalls the
+// actor's inference loop or the learner's host thread.
+//
+// Wire format: 8-byte big-endian length + payload (matches
+// distar_tpu/comm/serializer.py frame()).
+//
+// Exposed C ABI (ctypes):
+//   int  shuttle_serve(const uint8_t* data, uint64_t len, int accept_count,
+//                      int timeout_ms)      -> listening port (<0 on error);
+//                      detaches a thread that serves the payload to up to
+//                      accept_count connections, then closes.
+//   int  shuttle_fetch(const char* host, int port, int timeout_ms,
+//                      uint8_t** out, uint64_t* out_len) -> 0 on success;
+//                      caller frees with shuttle_free.
+//   void shuttle_free(uint8_t* p)
+#include <arpa/inet.h>
+#include <cerrno>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <poll.h>
+#include <sys/socket.h>
+#include <thread>
+#include <unistd.h>
+#include <vector>
+
+namespace {
+
+bool send_all(int fd, const uint8_t* buf, uint64_t len) {
+  uint64_t sent = 0;
+  while (sent < len) {
+    ssize_t n = ::send(fd, buf + sent, len - sent, MSG_NOSIGNAL);
+    if (n <= 0) {
+      if (n < 0 && (errno == EINTR)) continue;
+      return false;
+    }
+    sent += static_cast<uint64_t>(n);
+  }
+  return true;
+}
+
+bool recv_all(int fd, uint8_t* buf, uint64_t len, int timeout_ms) {
+  uint64_t got = 0;
+  while (got < len) {
+    pollfd p{fd, POLLIN, 0};
+    int pr = ::poll(&p, 1, timeout_ms);
+    if (pr <= 0) return false;
+    ssize_t n = ::recv(fd, buf + got, len - got, 0);
+    if (n <= 0) {
+      if (n < 0 && errno == EINTR) continue;
+      return false;
+    }
+    got += static_cast<uint64_t>(n);
+  }
+  return true;
+}
+
+void write_be64(uint8_t* out, uint64_t v) {
+  for (int i = 7; i >= 0; --i) {
+    out[7 - i] = static_cast<uint8_t>((v >> (8 * i)) & 0xff);
+  }
+}
+
+uint64_t read_be64(const uint8_t* in) {
+  uint64_t v = 0;
+  for (int i = 0; i < 8; ++i) v = (v << 8) | in[i];
+  return v;
+}
+
+}  // namespace
+
+extern "C" {
+
+int shuttle_serve(const uint8_t* data, uint64_t len, int accept_count, int timeout_ms) {
+  int listener = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (listener < 0) return -1;
+  int one = 1;
+  ::setsockopt(listener, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = 0;  // ephemeral
+  if (::bind(listener, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0 ||
+      ::listen(listener, 16) < 0) {
+    ::close(listener);
+    return -2;
+  }
+  socklen_t alen = sizeof(addr);
+  ::getsockname(listener, reinterpret_cast<sockaddr*>(&addr), &alen);
+  int port = ntohs(addr.sin_port);
+
+  // own the payload: the Python buffer is only valid during this call
+  std::vector<uint8_t>* payload = new std::vector<uint8_t>(len + 8);
+  write_be64(payload->data(), len);
+  std::memcpy(payload->data() + 8, data, len);
+
+  std::thread([listener, payload, accept_count, timeout_ms]() {
+    for (int i = 0; i < accept_count; ++i) {
+      pollfd p{listener, POLLIN, 0};
+      if (::poll(&p, 1, timeout_ms) <= 0) break;  // nobody came: expire
+      int conn = ::accept(listener, nullptr, nullptr);
+      if (conn < 0) break;
+      int one = 1;
+      ::setsockopt(conn, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      send_all(conn, payload->data(), payload->size());
+      ::shutdown(conn, SHUT_WR);
+      ::close(conn);
+    }
+    ::close(listener);
+    delete payload;
+  }).detach();
+
+  return port;
+}
+
+int shuttle_fetch(const char* host, int port, int timeout_ms, uint8_t** out, uint64_t* out_len) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return -1;
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::inet_pton(AF_INET, host, &addr.sin_addr) != 1) {
+    ::close(fd);
+    return -2;
+  }
+  if (::connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0) {
+    ::close(fd);
+    return -3;
+  }
+  int one = 1;
+  ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  uint8_t hdr[8];
+  if (!recv_all(fd, hdr, 8, timeout_ms)) {
+    ::close(fd);
+    return -4;
+  }
+  uint64_t len = read_be64(hdr);
+  uint8_t* buf = static_cast<uint8_t*>(std::malloc(len));
+  if (buf == nullptr) {
+    ::close(fd);
+    return -5;
+  }
+  if (!recv_all(fd, buf, len, timeout_ms)) {
+    std::free(buf);
+    ::close(fd);
+    return -6;
+  }
+  ::close(fd);
+  *out = buf;
+  *out_len = len;
+  return 0;
+}
+
+void shuttle_free(uint8_t* p) { std::free(p); }
+
+}  // extern "C"

@@ -1,0 +1,45 @@
+"""Payload (de)serialization for the data plane.
+
+Role of the reference's dumps/loads multi-codec (reference: distar/ctools/
+utils/file_helper.py:21-120 — pickle/nppickle/pyarrow + lz4). lz4 isn't in
+this image, so the compressed codec is zlib-1 (fast setting); pickle
+protocol 5 with out-of-band buffers keeps large numpy arrays zero-copy on
+the serialise side.
+"""
+from __future__ import annotations
+
+import pickle
+import struct
+import zlib
+from typing import Any, Tuple
+
+MAGIC_RAW = b"DTR0"
+MAGIC_ZLIB = b"DTZ0"
+
+
+def dumps(obj: Any, compress: bool = True) -> bytes:
+    payload = pickle.dumps(obj, protocol=5)
+    if compress:
+        return MAGIC_ZLIB + zlib.compress(payload, level=1)
+    return MAGIC_RAW + payload
+
+
+def loads(blob: bytes) -> Any:
+    magic, body = blob[:4], blob[4:]
+    if magic == MAGIC_ZLIB:
+        return pickle.loads(zlib.decompress(body))
+    if magic == MAGIC_RAW:
+        return pickle.loads(body)
+    raise ValueError(f"unknown payload magic {magic!r}")
+
+
+def frame(blob: bytes) -> bytes:
+    """Length-prefix a payload (8-byte big-endian), the adapter wire format
+    (role of the reference's length-prefixed frames, adapter.py:140-151)."""
+    return struct.pack(">Q", len(blob)) + blob
+
+
+def read_frame(recv_exact) -> bytes:
+    """Read one frame via a ``recv_exact(n) -> bytes`` callable."""
+    (n,) = struct.unpack(">Q", recv_exact(8))
+    return recv_exact(n)

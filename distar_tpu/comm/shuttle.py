@@ -1,0 +1,135 @@
+"""ctypes binding for the C++ socket shuttle, with a pure-Python fallback.
+
+Builds ``native/shuttle.cpp`` on first use (g++ -O2 -shared -fPIC); when the
+toolchain or build is unavailable the Python implementation (threads +
+stdlib sockets — IO releases the GIL anyway, but framing runs in Python)
+keeps everything working.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import socket
+import struct
+import subprocess
+import threading
+from typing import Optional, Tuple
+
+_DIR = os.path.dirname(__file__)
+_SRC = os.path.join(_DIR, "native", "shuttle.cpp")
+_SO = os.path.join(_DIR, "native", "libshuttle.so")
+
+_lib = None
+_lib_lock = threading.Lock()
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        if not os.path.exists(_SO) or os.path.getmtime(_SO) < os.path.getmtime(_SRC):
+            try:
+                subprocess.run(
+                    ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", "-o", _SO, _SRC, "-lpthread"],
+                    check=True,
+                    capture_output=True,
+                )
+            except (OSError, subprocess.CalledProcessError):
+                return None
+        try:
+            lib = ctypes.CDLL(_SO)
+        except OSError:
+            return None
+        lib.shuttle_serve.argtypes = [
+            ctypes.POINTER(ctypes.c_uint8), ctypes.c_uint64, ctypes.c_int, ctypes.c_int,
+        ]
+        lib.shuttle_serve.restype = ctypes.c_int
+        lib.shuttle_fetch.argtypes = [
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+            ctypes.POINTER(ctypes.c_uint64),
+        ]
+        lib.shuttle_fetch.restype = ctypes.c_int
+        lib.shuttle_free.argtypes = [ctypes.POINTER(ctypes.c_uint8)]
+        _lib = lib
+        return _lib
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+def serve(payload: bytes, accept_count: int = 1, timeout_ms: int = 30_000) -> int:
+    """Serve ``payload`` (framed) on an ephemeral port to up to
+    ``accept_count`` connections; returns the port."""
+    lib = _load()
+    if lib is not None:
+        buf = (ctypes.c_uint8 * len(payload)).from_buffer_copy(payload)
+        port = lib.shuttle_serve(buf, len(payload), accept_count, timeout_ms)
+        if port > 0:
+            return port
+        raise OSError(f"shuttle_serve failed: {port}")
+    return _py_serve(payload, accept_count, timeout_ms)
+
+
+def fetch(host: str, port: int, timeout_ms: int = 30_000) -> bytes:
+    """Fetch one framed payload from host:port."""
+    lib = _load()
+    if lib is not None:
+        out = ctypes.POINTER(ctypes.c_uint8)()
+        out_len = ctypes.c_uint64()
+        rc = lib.shuttle_fetch(
+            host.encode(), port, timeout_ms, ctypes.byref(out), ctypes.byref(out_len)
+        )
+        if rc != 0:
+            raise OSError(f"shuttle_fetch failed: {rc}")
+        try:
+            return ctypes.string_at(out, out_len.value)
+        finally:
+            lib.shuttle_free(out)
+    return _py_fetch(host, port, timeout_ms)
+
+
+# ------------------------------------------------------------ python fallback
+def _py_serve(payload: bytes, accept_count: int, timeout_ms: int) -> int:
+    listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    listener.bind(("0.0.0.0", 0))
+    listener.listen(16)
+    listener.settimeout(timeout_ms / 1000.0)
+    port = listener.getsockname()[1]
+    framed = struct.pack(">Q", len(payload)) + payload
+
+    def run():
+        try:
+            for _ in range(accept_count):
+                try:
+                    conn, _ = listener.accept()
+                except socket.timeout:
+                    break
+                with conn:
+                    conn.sendall(framed)
+        finally:
+            listener.close()
+
+    threading.Thread(target=run, daemon=True).start()
+    return port
+
+
+def _py_fetch(host: str, port: int, timeout_ms: int) -> bytes:
+    with socket.create_connection((host, port), timeout=timeout_ms / 1000.0) as s:
+        s.settimeout(timeout_ms / 1000.0)
+
+        def recv_exact(n: int) -> bytes:
+            chunks = []
+            while n > 0:
+                chunk = s.recv(min(n, 1 << 20))
+                if not chunk:
+                    raise ConnectionError("short read")
+                chunks.append(chunk)
+                n -= len(chunk)
+            return b"".join(chunks)
+
+        (length,) = struct.unpack(">Q", recv_exact(8))
+        return recv_exact(length)

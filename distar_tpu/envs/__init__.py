@@ -1,0 +1,4 @@
+from .env import BaseEnv
+from .mock_env import MockEnv
+
+__all__ = ["BaseEnv", "MockEnv"]

@@ -1,0 +1,33 @@
+"""Environment interface.
+
+The contract mirrors the reference SC2Env surface (reference: distar/envs/
+env.py:96-455): ``reset() -> {agent_idx: obs}``, ``step(actions) ->
+(obs, rewards, done, info)`` with per-agent variable ``skip_steps`` delays
+(the AlphaStar delay-action model, env.py:333-375). Observations are
+*feature-level* dicts matching distar_tpu.lib.features — the real SC2
+binding (protobuf -> features transform over the websocket protocol) plugs
+in behind this interface; MockEnv provides the game-free implementation for
+training-stack development and tests (role of the reference's
+mock_sc2_env.py).
+"""
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+
+class BaseEnv:
+    """Two-player env contract used by the actor."""
+
+    num_agents: int = 2
+
+    def reset(self) -> Dict[int, dict]:
+        raise NotImplementedError
+
+    def step(self, actions: Dict[int, dict]) -> Tuple[Dict[int, dict], Dict[int, float], bool, dict]:
+        """``actions[idx]`` = {action_type, delay, queued, selected_units,
+        target_unit, target_location} (+ skip_steps implied by delay).
+        Returns (obs, winloss rewards on done, done, info)."""
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass

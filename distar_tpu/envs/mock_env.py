@@ -1,0 +1,73 @@
+"""Game-free mock environment.
+
+Role of the reference's mock env (reference: distar/pysc2/env/
+mock_sc2_env.py:28-50 — constant timesteps per spec, no binary): produces
+schema-complete feature-level observations, advances a game loop by each
+agent's requested delay (the variable skip_steps model, env.py:333-375),
+terminates after ``episode_game_loops`` with a deterministic winner rule so
+league/actor plumbing sees every outcome path.
+
+The observation evolves just enough to exercise the stack: entity counts
+drift, the game-loop time advances, last-action fields reflect the previous
+action (the reference's obs augmentation contract, agent.py:257-304).
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from ..lib import features as F
+from .env import BaseEnv
+
+
+class MockEnv(BaseEnv):
+    def __init__(
+        self,
+        num_agents: int = 2,
+        episode_game_loops: int = 2000,
+        seed: int = 0,
+        win_rule: str = "random",  # 'random' | 'first' (agent 0 always wins)
+    ):
+        self.num_agents = num_agents
+        self._episode_game_loops = episode_game_loops
+        self._rng = np.random.default_rng(seed)
+        self._win_rule = win_rule
+        self._game_loop = 0
+        self._episode_count = 0
+
+    def _obs(self, idx: int) -> dict:
+        obs = F.fake_step_data(train=False, rng=self._rng)
+        obs["entity_num"] = np.asarray(
+            int(self._rng.integers(8, 64)), dtype=np.int64
+        )
+        obs["scalar_info"]["time"] = np.asarray(float(self._game_loop), dtype=np.float32)
+        obs["game_loop"] = self._game_loop
+        # action feedback the agent's reward machinery reads
+        obs["action_result"] = [1]
+        obs["battle_score"] = float(self._rng.integers(0, 100)) + self._game_loop * 0.01
+        obs["opponent_battle_score"] = float(self._rng.integers(0, 100)) + self._game_loop * 0.01
+        return obs
+
+    def reset(self) -> Dict[int, dict]:
+        self._game_loop = 0
+        self._episode_count += 1
+        return {i: self._obs(i) for i in range(self.num_agents)}
+
+    def step(self, actions: Dict[int, dict]):
+        # advance to the earliest requested next observation (variable delay)
+        delays = [int(np.asarray(a["delay"])) for a in actions.values()] or [1]
+        self._game_loop += max(min(delays), 1)
+        done = self._game_loop >= self._episode_game_loops
+        obs = {i: self._obs(i) for i in range(self.num_agents)}
+        rewards: Dict[int, float] = {i: 0.0 for i in range(self.num_agents)}
+        info: dict = {"game_loop": self._game_loop}
+        if done:
+            if self._win_rule == "first":
+                winner = 0
+            else:
+                winner = int(self._rng.integers(0, self.num_agents))
+            for i in range(self.num_agents):
+                rewards[i] = 1.0 if i == winner else -1.0
+            info["winner"] = winner
+        return obs, rewards, done, info

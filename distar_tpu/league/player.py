@@ -272,7 +272,14 @@ class MainExploiterPlayer(ActivePlayer):
     name = "MainExploiterPlayer"
 
     def _main_id(self, active_players) -> str:
-        return f"MP{self.player_id[-1]}"
+        # ME<suffix> pairs with MP<suffix> (multi-digit suffixes included);
+        # fall back to any main when no exact pair exists
+        candidate = f"MP{self.player_id[2:]}"
+        if candidate in active_players:
+            return candidate
+        mains = [pid for pid in active_players if pid.startswith("MP")]
+        assert mains, "MainExploiter needs at least one MainPlayer in the league"
+        return mains[0]
 
     def get_branch_opponent(self, historical_players, active_players, branch_probs, pfsp_train_bot=False):
         main = active_players[self._main_id(active_players)]

@@ -135,21 +135,28 @@ def fake_sl_batch(
     B, T, S = batch_size, unroll_len, F.MAX_SELECTED_UNITS_NUM
     n = B * T
     obs = F.batch_tree([F.fake_step_data(train=False, rng=rng) for _ in range(n)])
+    entity_num = np.maximum(obs["entity_num"], 8)
+    sun = rng.integers(2, 7, (n,))
+    su = np.zeros((n, S), np.int64)
+    for i in range(n):
+        # distinct units then the end token (see fake_rl_batch)
+        su[i, : sun[i] - 1] = rng.permutation(8)[: sun[i] - 1]
+        su[i, sun[i] - 1] = entity_num[i]
     return {
         "spatial_info": obs["spatial_info"],
         "entity_info": obs["entity_info"],
         "scalar_info": obs["scalar_info"],
-        "entity_num": np.maximum(obs["entity_num"], 8),
+        "entity_num": entity_num,
         "action_info": {
             "action_type": rng.integers(0, A.NUM_ACTIONS, (n,)),
             "delay": rng.integers(0, F.MAX_DELAY + 1, (n,)),
             "queued": rng.integers(0, 2, (n,)),
-            "selected_units": rng.integers(0, 8, (n, S)),
+            "selected_units": su,
             "target_unit": rng.integers(0, 8, (n,)),
             "target_location": rng.integers(0, F.SPATIAL_SIZE[0] * F.SPATIAL_SIZE[1], (n,)),
         },
         "action_mask": {k: np.ones((n,), np.float32) for k in F.ACTION_HEADS},
-        "selected_units_num": rng.integers(1, 6, (n,)),
+        "selected_units_num": sun,
         "new_episodes": np.zeros((B,), bool),
         "traj_lens": np.full((B,), T, np.int64),
     }

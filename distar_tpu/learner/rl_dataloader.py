@@ -1,0 +1,102 @@
+"""RL dataloader: trajectory collation + adapter-fed batching.
+
+Role parity with the reference RLDataLoader (reference: distar/agent/default/
+rl_training/rl_dataloader.py:45-167): worker pull-loops fetch trajectories
+over the Adapter, `collate_trajectories` assembles the time-major learner
+batch. Divergence by design: the reference pads entities per-batch to the
+max entity count (:206-245); here every trajectory already carries the fixed
+MAX_ENTITY_NUM padding (XLA static shapes), so collation is pure stacking.
+"""
+from __future__ import annotations
+
+import time
+from typing import Dict, Iterator, List, Optional
+
+import numpy as np
+
+from ..comm import Adapter
+from ..lib import features as F
+
+
+def collate_trajectories(trajs: List[list]) -> Dict:
+    """[B] trajectories (each T steps + 1 bootstrap step) -> learner batch.
+
+    Output layout matches distar_tpu.learner.data (obs [T+1, B, ...],
+    actions/logps/teacher/rewards [T, B, ...], hidden_state per layer [B, H]).
+    """
+    B = len(trajs)
+    T = len(trajs[0]) - 1
+    assert all(len(t) == T + 1 for t in trajs), "trajectories must share T"
+    steps = [t[:T] for t in trajs]
+
+    def stack_obs(key):
+        # [T+1, B, ...]: bootstrap step supplies index T
+        return F.batch_tree(
+            [
+                F.batch_tree([traj[t][key] for traj in trajs])
+                for t in range(T + 1)
+            ]
+        )
+
+    def stack_tb(get):
+        return F.batch_tree([F.batch_tree([get(traj[t]) for traj in trajs]) for t in range(T)])
+
+    batch = {
+        "spatial_info": stack_obs("spatial_info"),
+        "entity_info": stack_obs("entity_info"),
+        "scalar_info": stack_obs("scalar_info"),
+        "entity_num": stack_obs("entity_num"),
+        "hidden_state": tuple(
+            (
+                np.stack([np.asarray(traj[0]["hidden_state"][l][0]) for traj in trajs]),
+                np.stack([np.asarray(traj[0]["hidden_state"][l][1]) for traj in trajs]),
+            )
+            for l in range(len(trajs[0][0]["hidden_state"]))
+        ),
+        "action_info": stack_tb(lambda s: s["action_info"]),
+        "selected_units_num": stack_tb(lambda s: s["selected_units_num"]),
+        "behaviour_logp": stack_tb(lambda s: s["behaviour_logp"]),
+        "teacher_logit": stack_tb(lambda s: s["teacher_logit"]),
+        "reward": stack_tb(lambda s: s["reward"]),
+        "step": stack_tb(lambda s: s["step"]),
+        "model_last_iter": np.asarray(
+            [float(traj[0].get("model_last_iter", 0.0)) for traj in trajs], np.float32
+        ),
+    }
+    sun = batch["selected_units_num"].astype(np.int64)
+    masks = stack_tb(lambda s: s["mask"])
+    masks["selected_units_mask"] = (
+        np.arange(F.MAX_SELECTED_UNITS_NUM)[None, None, :] < sun[..., None]
+    )
+    batch["mask"] = masks
+    return batch
+
+
+class RLDataLoader:
+    """Pulls trajectories for a player token over the Adapter and yields
+    collated [T, B] batches."""
+
+    def __init__(
+        self,
+        adapter: Adapter,
+        player_id: str,
+        batch_size: int,
+        cache_size: int = 64,
+        token_suffix: str = "traj",
+    ):
+        self._adapter = adapter
+        self._token = f"{player_id}{token_suffix}"
+        self._batch_size = batch_size
+        self._cache = adapter.start_pull_loop(self._token, maxlen=cache_size)
+
+    def __iter__(self) -> Iterator[Dict]:
+        return self
+
+    def __next__(self) -> Dict:
+        trajs: List[list] = []
+        while len(trajs) < self._batch_size:
+            if self._cache:
+                trajs.append(self._cache.popleft())
+            else:
+                time.sleep(0.005)
+        return collate_trajectories(trajs)

@@ -134,8 +134,18 @@ class RLLearner(BaseLearner):
         self._dataloader = iter(it)
 
     def _setup_state(self) -> None:
+        import math
+
         lc = self.cfg.learner
         B, T = lc.batch_size, lc.unroll_len
+        if B % self.mesh.shape["dp"] != 0:
+            # shrink dp to the largest divisor of the batch so small debug
+            # batches still run on wide meshes
+            import jax as _jax
+
+            dp = math.gcd(B, len(_jax.devices()))
+            self.mesh = make_mesh(MeshSpec(dp=dp), _jax.devices()[:dp])
+            self.logger.info(f"batch {B} not divisible by mesh dp; using dp={dp}")
         batch = next(self._dataloader)
         self.optimizer = build_optimizer(
             learning_rate=lc.learning_rate,
@@ -185,6 +195,51 @@ class RLLearner(BaseLearner):
         batch["hidden_state"] = hidden
         return out
 
+    # ----------------------------------------------------------------- comm
+    def attach_comm(self, adapter, player_id: str, league=None, send_model_freq: int = 4,
+                    send_train_info_freq: int = 4, model_accept_count: int = 8) -> None:
+        """Wire weight publication + league train-info (roles of the
+        reference LearnerComm: _send_model_loop learner_comm.py:83-99 and
+        send_train_info :101-137 incl. the remote-triggered checkpoint
+        reset)."""
+        from .hooks import LambdaHook
+
+        lc = self.cfg.learner
+        frames_per_iter = lc.batch_size * lc.unroll_len
+
+        def send_model(learner):
+            params_host = jax.tree.map(np.asarray, learner.state["params"])
+            adapter.push(
+                f"{player_id}model",
+                {"params": params_host, "iter": learner.last_iter.val},
+                accept_count=model_accept_count,
+                timeout_ms=120_000,
+            )
+
+        def send_train_info(learner):
+            if league is None:
+                return
+            reply = league.learner_send_train_info(
+                player_id, train_steps=frames_per_iter * send_train_info_freq
+            )
+            reset_path = (reply or {}).get("reset_checkpoint_path")
+            if reset_path:
+                import os
+
+                if os.path.exists(reset_path):
+                    learner.restore(reset_path)
+                    learner.logger.info(f"league reset: restored {reset_path}")
+                else:
+                    learner.logger.info(
+                        f"league reset requested ({reset_path}); checkpoint absent, keeping weights"
+                    )
+
+        self.hooks.add(LambdaHook("send_model", "after_iter", send_model, freq=send_model_freq))
+        self.hooks.add(LambdaHook("send_model_init", "before_run", send_model))
+        self.hooks.add(
+            LambdaHook("send_train_info", "after_iter", send_train_info, freq=send_train_info_freq)
+        )
+
     # ------------------------------------------------------------- training
     def step_value_pretrain(self) -> bool:
         """Value-pretrain gate (reference rl_learner.py:160-180): during the
@@ -196,6 +251,7 @@ class RLLearner(BaseLearner):
 
     def _train(self, data) -> Dict[str, Any]:
         only_value = self.step_value_pretrain()
+        data = dict(data)  # callers may reuse the batch dict
         model_last_iter = np.asarray(data.pop("model_last_iter"))
         staleness = self.last_iter.val - model_last_iter
         data = self.shard_batch(data)

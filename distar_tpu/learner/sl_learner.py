@@ -128,6 +128,7 @@ class SLLearner(BaseLearner):
         )
 
     def _train(self, data) -> Dict[str, Any]:
+        data = dict(data)  # callers may reuse the batch dict
         new_episodes = np.asarray(data.pop("new_episodes"))
         data.pop("traj_lens", None)
         if new_episodes.any():

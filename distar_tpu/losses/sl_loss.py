@@ -86,7 +86,7 @@ def compute_sl_loss(
         elif head == "delay":
             info["delay_distance_L1"] = _masked_mean(jnp.abs(pred - lab), mask)
         elif head == "queued":
-            info["queued_acc"] = _masked_mean(jnp.abs(pred - lab), mask)
+            info["queued_acc"] = _masked_mean((pred == lab).astype(jnp.float32), mask)
         elif head == "target_unit":
             info["target_unit_acc"] = _masked_mean((pred == lab).astype(jnp.float32), mask)
         elif head == "target_location":

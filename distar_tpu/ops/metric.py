@@ -1,0 +1,60 @@
+"""Distance metrics for the Z pseudo-rewards (host-side numpy).
+
+Role parity with the reference metrics (reference: distar/ctools/torch_utils/
+metric.py): levenshtein with a per-match location-cost hook (matching build
+orders still pay for misplaced locations), hamming over cumulative-stat
+bags, and the clamped L2 location cost. These run per env step on the actor
+host, so numpy is the right tool (no device roundtrip for a 20-element DP).
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import numpy as np
+
+
+def l2_distance(a, b, min_val: float = 0.0, max_val: float = 0.8, threshold: float = 5.0,
+                spatial_x: int = 160) -> float:
+    """Clamped L2 between two flat map indices (cost of a matched build-order
+    step placed at the wrong spot)."""
+    a, b = float(a), float(b)
+    x0, y0 = a % spatial_x, a // spatial_x
+    x1, y1 = b % spatial_x, b // spatial_x
+    l2 = np.sqrt((x1 - x0) ** 2 + (y1 - y0) ** 2)
+    return float(np.clip(l2 / threshold, min_val, max_val))
+
+
+def levenshtein_distance(
+    behaviour: np.ndarray,
+    target: np.ndarray,
+    behaviour_extra: Optional[np.ndarray] = None,
+    target_extra: Optional[np.ndarray] = None,
+    extra_fn: Optional[Callable] = None,
+) -> float:
+    """Edit distance; when tokens match, ``extra_fn`` prices the per-step
+    extras (locations) instead of a free match."""
+    behaviour = np.asarray(behaviour)
+    target = np.asarray(target)
+    n1, n2 = len(behaviour), len(target)
+    if n1 == 0 or n2 == 0:
+        return float(max(n1, n2))
+    dp = np.zeros((n1 + 1, n2 + 1), dtype=np.float64)
+    dp[0, :] = np.arange(n2 + 1)
+    dp[:, 0] = np.arange(n1 + 1)
+    for i in range(1, n1 + 1):
+        for j in range(1, n2 + 1):
+            if behaviour[i - 1] == target[j - 1]:
+                cost = (
+                    extra_fn(behaviour_extra[i - 1], target_extra[j - 1]) if extra_fn else 0.0
+                )
+                dp[i, j] = dp[i - 1, j - 1] + cost
+            else:
+                dp[i, j] = min(dp[i - 1, j] + 1, dp[i, j - 1] + 1, dp[i - 1, j - 1] + 1)
+    return float(dp[n1, n2])
+
+
+def hamming_distance(behaviour: np.ndarray, target: np.ndarray) -> float:
+    behaviour = np.asarray(behaviour).astype(bool)
+    target = np.asarray(target).astype(bool)
+    assert behaviour.shape == target.shape
+    return float((behaviour != target).sum(-1))

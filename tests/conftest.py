@@ -20,6 +20,10 @@ if "xla_force_host_platform_device_count" not in flags:
 import jax
 
 jax.config.update("jax_platforms", "cpu")
+# persistent compile cache: identical small-model jits recur across test
+# modules; cached XLA executables cut warm suite time drastically
+jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache_distar_tpu")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
 import numpy as np
 import pytest

@@ -1,0 +1,31 @@
+"""Pseudo-reward metric tests (oracle values computed by hand)."""
+import numpy as np
+
+from distar_tpu.ops.metric import hamming_distance, l2_distance, levenshtein_distance
+
+
+def test_levenshtein_basic():
+    assert levenshtein_distance(np.array([1, 2, 3]), np.array([1, 2, 3])) == 0.0
+    assert levenshtein_distance(np.array([1, 2]), np.array([1, 2, 3])) == 1.0
+    assert levenshtein_distance(np.array([], dtype=int), np.array([1, 2])) == 2.0
+    assert levenshtein_distance(np.array([1, 4, 3]), np.array([1, 2, 3])) == 1.0
+
+
+def test_levenshtein_location_cost():
+    # matching tokens still pay the clamped L2 location cost
+    d = levenshtein_distance(
+        np.array([5]), np.array([5]),
+        np.array([0]), np.array([10]),  # same row, 10 px apart -> 10/5 clamped to 0.8
+        lambda a, b: l2_distance(a, b, spatial_x=160),
+    )
+    assert abs(d - 0.8) < 1e-6
+
+
+def test_hamming():
+    assert hamming_distance(np.array([1, 0, 1]), np.array([1, 1, 0])) == 2.0
+
+
+def test_l2_distance_clamp():
+    assert l2_distance(0, 0) == 0.0
+    assert l2_distance(0, 3) == 0.6  # 3px/5 = 0.6
+    assert l2_distance(0, 159) == 0.8  # clamped

@@ -1,0 +1,121 @@
+"""End-to-end RL pipeline smoke: league -> actor (mock env, batched jitted
+inference) -> adapter data plane -> dataloader -> pjit learner step -> weight
+publication back to the actor. The whole reference rl_train loop
+(SURVEY.md §3.1) in one process on the CPU mesh."""
+import numpy as np
+import pytest
+
+from distar_tpu.actor import Actor
+from distar_tpu.comm import Adapter, Coordinator
+from distar_tpu.envs import MockEnv
+from distar_tpu.league import League
+from distar_tpu.learner import RLLearner
+from distar_tpu.learner.rl_dataloader import RLDataLoader, collate_trajectories
+
+SMALL_MODEL = {
+    "encoder": {
+        "entity": {"layer_num": 1, "hidden_dim": 32, "output_dim": 16, "head_dim": 8},
+        "spatial": {"down_channels": [4, 4, 8], "project_dim": 4, "resblock_num": 1, "fc_dim": 16},
+        "scatter": {"output_dim": 4},
+        "core_lstm": {"hidden_size": 32, "num_layers": 1},
+    },
+    "policy": {
+        "action_type_head": {"res_dim": 16, "res_num": 1, "gate_dim": 32},
+        "delay_head": {"decode_dim": 16},
+        "queued_head": {"decode_dim": 16},
+        "selected_units_head": {"func_dim": 16},
+        "target_unit_head": {"func_dim": 16},
+        "location_head": {"res_dim": 8, "res_num": 1, "upsample_dims": [4, 4, 1], "map_skip_dim": 8},
+    },
+    "value": {"res_dim": 8, "res_num": 1},
+}
+
+LEAGUE_CFG = {
+    "league": {
+        # force pfsp so jobs pit MP0 against history (sp with a single main
+        # would self-match and skip ELO/payoff, which the test asserts on)
+        "branch_probs": {"MainPlayer": {"pfsp": 1.0}},
+        "active_players": {
+            "player_id": ["MP0"],
+            "checkpoint_path": ["mp0.ckpt"],
+            "pipeline": ["default"],
+            "frac_id": [1],
+            "z_path": ["3map.json"],
+            "z_prob": [0.0],
+            "teacher_id": ["T"],
+            "teacher_path": ["t.ckpt"],
+            "one_phase_step": [10 ** 9],
+            "chosen_weight": [1.0],
+        },
+        "historical_players": {
+            "player_id": ["HP0"],
+            "checkpoint_path": ["hp0.ckpt"],
+            "pipeline": ["default"],
+            "frac_id": [1],
+            "z_path": ["3map.json"],
+            "z_prob": [0.0],
+        },
+    }
+}
+
+TRAJ_LEN = 2
+N_ENV = 2
+
+
+@pytest.mark.slow
+def test_full_rl_loop(tmp_path):
+    """Actor rollout -> data plane -> RLDataLoader -> pjit learner -> weight
+    publication + league train-info, all in one process."""
+    league = League(LEAGUE_CFG)
+    co = Coordinator()
+    actor_adapter = Adapter(coordinator=co)
+    learner_adapter = Adapter(coordinator=co)
+
+    actor = Actor(
+        cfg={"actor": {"env_num": N_ENV, "traj_len": TRAJ_LEN, "seed": 3}},
+        league=league,
+        adapter=actor_adapter,
+        model_cfg=SMALL_MODEL,
+        env_fn=lambda: MockEnv(episode_game_loops=300, seed=1),
+    )
+    dataloader = RLDataLoader(learner_adapter, "MP0", batch_size=4)
+    results = actor.run_job(episodes=2)
+    assert len(results) >= 2
+    # league ingested results (pfsp branch guarantees a real opponent)
+    assert league.all_players["MP0"].total_game_count >= 1
+    assert league.elo.game_count >= 1
+
+    # the streaming dataloader collates trajectories from the plane
+    batch = next(iter(dataloader))
+    assert batch["action_info"]["action_type"].shape == (TRAJ_LEN, 4)
+    assert batch["spatial_info"]["height_map"].shape[0] == TRAJ_LEN + 1
+    assert batch["mask"]["selected_units_mask"].shape == (TRAJ_LEN, 4, 64)
+    assert np.isfinite(batch["behaviour_logp"]["action_type"]).all()
+
+    learner = RLLearner(
+        {
+            "common": {"experiment_name": "e2e", "save_path": str(tmp_path)},
+            "learner": {"batch_size": 4, "unroll_len": TRAJ_LEN, "save_freq": 10 ** 9,
+                        "log_freq": 1},
+            "model": SMALL_MODEL,
+        }
+    )
+    learner.attach_comm(
+        learner_adapter, "MP0", league=league, send_model_freq=1, send_train_info_freq=1
+    )
+    learner.set_dataloader(iter(lambda: batch, None))  # replay the collated batch
+    learner.run(max_iterations=2)
+    assert learner.last_iter.val == 2
+    assert np.isfinite(learner.variable_record.get("total_loss").avg)
+    # league saw train info
+    assert league.active_players["MP0"].total_agent_step > 0
+    # published weights are pullable (actor-side refresh path); the plane is
+    # FIFO so drain to the freshest publication
+    latest = -1
+    while True:
+        pub = actor_adapter.pull("MP0model", block=False)
+        if pub is None:
+            break
+        assert "params" in pub
+        latest = max(latest, pub["iter"])
+    assert latest >= 1
