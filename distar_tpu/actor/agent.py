@@ -43,15 +43,23 @@ def time_decay_factor(game_step: float) -> float:
 
 
 def sample_fake_z(rng: Optional[np.random.Generator] = None) -> dict:
-    """A synthetic target strategy with the real Z schema (stand-in for the
-    map/race/born-location-keyed Z json libraries, agent.py:176-243)."""
+    """A synthetic target strategy with the real Z-entry schema (stand-in for
+    the map/race/born-location-keyed Z json libraries, agent.py:176-243;
+    real libraries load via lib.z_library.ZLibrary)."""
     rng = rng or np.random.default_rng(0)
     n_bo = int(rng.integers(5, F.BEGINNING_ORDER_LENGTH))
     bo = rng.integers(1, ACT.NUM_BEGINNING_ORDER_ACTIONS, n_bo).tolist()
     loc = rng.integers(0, F.SPATIAL_SIZE[0] * F.SPATIAL_SIZE[1], n_bo).tolist()
-    cum = np.zeros(ACT.NUM_CUMULATIVE_STAT_ACTIONS, dtype=np.int64)
-    cum[rng.integers(1, ACT.NUM_CUMULATIVE_STAT_ACTIONS, 20)] = 1
-    return {"beginning_order": bo, "bo_location": loc, "cumulative_stat": cum.tolist()}
+    cum_idx = sorted(
+        set(rng.integers(1, ACT.NUM_CUMULATIVE_STAT_ACTIONS, 20).tolist())
+    )
+    return {
+        "beginning_order": bo,
+        "bo_location": loc,
+        "cumulative_stat": cum_idx,  # slot indices (Z-entry convention)
+        "bo_norm": max(len(bo), 1),
+        "cum_norm": max(len(cum_idx), 1),
+    }
 
 
 class Agent:
@@ -81,15 +89,29 @@ class Agent:
     def reset(self, z: Optional[dict] = None) -> None:
         if z is not None:
             self._z = z
-        zl = len(self._z["beginning_order"])
-        pad = F.BEGINNING_ORDER_LENGTH - zl
-        self._target_building_order = list(self._z["beginning_order"])
-        self._target_bo_location = list(self._z["bo_location"])
-        self._target_z_bo = np.asarray(
-            self._z["beginning_order"] + [0] * pad, dtype=np.int64
-        )
-        self._target_z_loc = np.asarray(self._z["bo_location"] + [0] * pad, dtype=np.int64)
-        self._target_cumulative_stat = np.asarray(self._z["cumulative_stat"], dtype=np.int64)
+        bo = list(self._z["beginning_order"])[: F.BEGINNING_ORDER_LENGTH]
+        loc = list(self._z["bo_location"])[: F.BEGINNING_ORDER_LENGTH]
+        pad = F.BEGINNING_ORDER_LENGTH - len(bo)
+        self._target_building_order = bo
+        self._target_bo_location = loc
+        self._target_z_bo = np.asarray(bo + [0] * pad, dtype=np.int64)
+        self._target_z_loc = np.asarray(loc + [0] * pad, dtype=np.int64)
+        # Z entries carry cumulative stats as slot indices; densify
+        cum = np.asarray(self._z["cumulative_stat"], dtype=np.int64)
+        if cum.ndim == 1 and cum.shape[0] == ACT.NUM_CUMULATIVE_STAT_ACTIONS:
+            self._target_cumulative_stat = cum
+        else:
+            dense = np.zeros(ACT.NUM_CUMULATIVE_STAT_ACTIONS, dtype=np.int64)
+            if cum.size:
+                dense[np.clip(cum, 0, ACT.NUM_CUMULATIVE_STAT_ACTIONS - 1)] = 1
+            self._target_cumulative_stat = dense
+        # per-entry reward normalisers + gates (agent.py:238-239,211-221)
+        self._bo_norm = float(self._z.get("bo_norm", BO_NORM))
+        self._cum_norm = float(self._z.get("cum_norm", CUM_NORM))
+        if "use_bo_reward" in self._z:
+            self.use_bo_reward = bool(self._z["use_bo_reward"])
+        if "use_cum_reward" in self._z:
+            self.use_cum_reward = bool(self._z["use_cum_reward"])
 
         self._behaviour_building_order: List[int] = []
         self._behaviour_bo_location: List[int] = []
@@ -98,11 +120,11 @@ class Agent:
         )
         self._old_bo_reward = (
             -levenshtein_distance(np.asarray([]), np.asarray(self._target_building_order))
-            / BO_NORM
+            / self._bo_norm
         )
         self._old_cum_reward = (
             -hamming_distance(self._behaviour_cumulative_stat, self._target_cumulative_stat)
-            / CUM_NORM
+            / self._cum_norm
         )
         self._bo_zergling_count = 0
         self._exceed_flag = True
@@ -112,6 +134,7 @@ class Agent:
         self._game_step = 0
         self._data_buffer: deque = deque()
         self._observation: Optional[dict] = None
+        self._value_feature: Optional[dict] = None
         self._output: Optional[dict] = None
         self._hidden_state_backup = None  # set by actor at traj starts
         self._result = 0
@@ -136,6 +159,13 @@ class Agent:
             "scalar_info": scalar,
             "entity_num": obs["entity_num"],
         }
+        if "value_feature" in obs:
+            # centralized-critic features ride alongside (learner-only; the
+            # model input above stays actor-shaped). The critic also sees
+            # this side's behaviour Z (reference agent.py:563-564).
+            self._value_feature = {**obs["value_feature"], **self.get_behavior_z()}
+        else:
+            self._value_feature = None
         self._raw_obs = obs
         return self._observation
 
@@ -204,7 +234,7 @@ class Agent:
                             np.asarray(tz_lo),
                             partial(l2_distance, spatial_x=F.SPATIAL_SIZE[1]),
                         )
-                        / BO_NORM
+                        / self._bo_norm
                     )
                     bo_reward = new_bo - self._old_bo_reward
                     self._old_bo_reward = new_bo
@@ -218,7 +248,7 @@ class Agent:
         if self.use_cum_reward and cum_flag:
             new_cum = (
                 -hamming_distance(self._behaviour_cumulative_stat, self._target_cumulative_stat)
-                / CUM_NORM
+                / self._cum_norm
             )
             cum_reward = (new_cum - self._old_cum_reward) * time_decay_factor(self._game_step)
             self._old_cum_reward = new_cum
@@ -284,6 +314,8 @@ class Agent:
             "mask": mask,
             "model_last_iter": float(self.model_last_iter),
         }
+        if self._value_feature is not None:
+            step_data["value_feature"] = self._value_feature
         self._data_buffer.append(step_data)
         if len(self._data_buffer) >= self._traj_len or done:
             # fixed-shape contract: an episode ending mid-window pads the
@@ -314,6 +346,8 @@ class Agent:
                 "scalar_info": bootstrap_src["scalar_info"],
                 "entity_num": bootstrap_src["entity_num"],
             }
+            if self._value_feature is not None:
+                last_step["value_feature"] = self._value_feature
             traj = list(self._data_buffer) + [last_step]
             self._data_buffer.clear()
             return traj

@@ -1,0 +1,74 @@
+"""Generate strategy-statistics (Z) libraries from recorded episodes.
+
+Role parity with the reference gen_z (reference: distar/bin/gen_z.py —
+decodes *winning* replays into building-order + cumulative-stat targets
+keyed by map/matchup/born-location). Replay decoding requires the SC2
+client; until that binding lands this tool aggregates episode summary
+records (JSONL, one episode per line, as emitted by the actor's episode
+logger or any external decoder) into the same library format.
+
+Usage:
+  python -m distar_tpu.bin.gen_z --input episodes.jsonl --output my_z.json
+  python -m distar_tpu.bin.gen_z --demo --output demo_z.json   # synthetic
+"""
+from __future__ import annotations
+
+import argparse
+import json
+
+import numpy as np
+
+from ..lib import actions as ACT
+from ..lib.z_library import build_z_library, save_z_library
+
+
+def demo_episodes(n: int = 8, seed: int = 0):
+    rng = np.random.default_rng(seed)
+    eps = []
+    for i in range(n):
+        n_bo = int(rng.integers(5, 20))
+        eps.append(
+            {
+                "map_name": "KairosJunction",
+                "mix_race": "zerg",
+                "born_location": int(rng.choice([22, 38 * 160 + 140])),
+                "winloss": int(rng.choice([-1, 1])),
+                "beginning_order": rng.integers(
+                    1, ACT.NUM_BEGINNING_ORDER_ACTIONS, n_bo
+                ).tolist(),
+                "bo_location": rng.integers(0, 152 * 160, n_bo).tolist(),
+                "cumulative_stat": rng.integers(
+                    1, ACT.NUM_CUMULATIVE_STAT_ACTIONS, 15
+                ).tolist(),
+                "game_loop": int(rng.integers(5000, 30000)),
+            }
+        )
+    return eps
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--input", default="", help="episodes JSONL")
+    p.add_argument("--output", required=True)
+    p.add_argument("--min-winloss", type=int, default=1)
+    p.add_argument("--demo", action="store_true")
+    args = p.parse_args()
+
+    if args.demo:
+        episodes = demo_episodes()
+    else:
+        with open(args.input) as f:
+            episodes = [json.loads(line) for line in f if line.strip()]
+    lib = build_z_library(episodes, min_winloss=args.min_winloss)
+    save_z_library(lib, args.output)
+    n = sum(
+        len(entries)
+        for races in lib.values()
+        for locs in races.values()
+        for entries in locs.values()
+    )
+    print(f"gen_z: wrote {n} entries to {args.output}")
+
+
+if __name__ == "__main__":
+    main()

@@ -28,11 +28,13 @@ class MockEnv(BaseEnv):
         episode_game_loops: int = 2000,
         seed: int = 0,
         win_rule: str = "random",  # 'random' | 'first' (agent 0 always wins)
+        include_value_feature: bool = False,
     ):
         self.num_agents = num_agents
         self._episode_game_loops = episode_game_loops
         self._rng = np.random.default_rng(seed)
         self._win_rule = win_rule
+        self._include_value_feature = include_value_feature
         self._game_loop = 0
         self._episode_count = 0
 
@@ -47,6 +49,8 @@ class MockEnv(BaseEnv):
         obs["action_result"] = [1]
         obs["battle_score"] = float(self._rng.integers(0, 100)) + self._game_loop * 0.01
         obs["opponent_battle_score"] = float(self._rng.integers(0, 100)) + self._game_loop * 0.01
+        if self._include_value_feature:
+            obs["value_feature"] = F.fake_value_feature(self._rng)
         return obs
 
     def reset(self) -> Dict[int, dict]:

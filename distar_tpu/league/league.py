@@ -21,6 +21,7 @@ from typing import Dict, List, Optional
 
 from ..utils import Config, deep_merge_dicts
 from .elo import ELORating
+from .trueskill import TrueSkill
 from .player import (
     ActivePlayer,
     HistoricalPlayer,
@@ -85,6 +86,7 @@ class League:
         self.active_players: Dict[str, ActivePlayer] = {}
         self.historical_players: Dict[str, HistoricalPlayer] = {}
         self.elo = ELORating()
+        self.trueskill = TrueSkill()
         self._lock = threading.RLock()
         self._learners: Dict[str, List[dict]] = {}
         if self.cfg.get("resume_path") and os.path.isfile(self.cfg.resume_path):
@@ -298,8 +300,25 @@ class League:
         return job
 
     def _eval_job(self) -> dict:
+        """Ladder pairing: prefer pairs with fewer recorded games than
+        ladder_min_games so the payoff/rating matrix fills evenly
+        (reference _get_ladder_job_info, league.py:486+)."""
         hist = list(self.historical_players.values())
-        pair = random.sample(hist, 2) if len(hist) >= 2 else hist * 2
+        if len(hist) < 2:
+            pair = hist * 2
+        else:
+            min_games = int(self.cfg.get("ladder_min_games", 100))
+            # .get-based reads: indexing the nested defaultdicts would
+            # materialise zero entries for every pair on every eval job
+            games = self.elo.games
+            under = [
+                (a, b)
+                for a in hist
+                for b in hist
+                if a.player_id != b.player_id
+                and games.get(a.player_id, {}).get(b.player_id, 0) < min_games
+            ]
+            pair = list(random.choice(under)) if under else random.sample(hist, 2)
         job = self._job_template(pair, "ladder")
         job["send_data_players"] = []
         job["update_players"] = []
@@ -329,7 +348,14 @@ class League:
                 player.total_game_count += 1
             first = sides.get("0") or next(iter(sides.values()), None)
             if first is not None and first["player_id"] != first["opponent_id"]:
-                self.elo.update(first["player_id"], first["opponent_id"], int(first["winloss"]))
+                wl = int(first["winloss"])
+                self.elo.update(first["player_id"], first["opponent_id"], wl)
+                if wl > 0:
+                    self.trueskill.update(first["player_id"], first["opponent_id"])
+                elif wl < 0:
+                    self.trueskill.update(first["opponent_id"], first["player_id"])
+                else:
+                    self.trueskill.update(first["player_id"], first["opponent_id"], draw=True)
         return True
 
     # ---------------------------------------------------------------- resume
@@ -341,6 +367,7 @@ class League:
                     "active_players": self.active_players,
                     "historical_players": self.historical_players,
                     "elo": self.elo,
+                    "trueskill": self.trueskill,
                 },
                 f,
             )
@@ -352,4 +379,5 @@ class League:
         self.active_players = data["active_players"]
         self.historical_players = data["historical_players"]
         self.elo = data["elo"]
+        self.trueskill = data.get("trueskill", TrueSkill())
         self._log(f"league resumed from {path}")

@@ -1,0 +1,124 @@
+"""TrueSkill ladder rating (1v1), the ELO alternative.
+
+Role parity with the reference's TrueSkill ladder (reference: distar/ctools/
+worker/ladder/trueskill_algo.py). Standard Herbrich et al. (2006) two-player
+update with a draw margin: mu/sigma per player, Gaussian truncation
+corrections v/w, and a conservative exposed rating mu - 3*sigma.
+"""
+from __future__ import annotations
+
+import math
+from collections import defaultdict
+from functools import partial
+from typing import Dict, Tuple
+
+SQRT2 = math.sqrt(2.0)
+
+
+def _phi(x: float) -> float:  # standard normal pdf
+    return math.exp(-0.5 * x * x) / math.sqrt(2.0 * math.pi)
+
+
+def _cdf(x: float) -> float:
+    return 0.5 * (1.0 + math.erf(x / SQRT2))
+
+
+def _v_win(t: float, eps: float) -> float:
+    denom = _cdf(t - eps)
+    return _phi(t - eps) / max(denom, 1e-12)
+
+
+def _w_win(t: float, eps: float) -> float:
+    v = _v_win(t, eps)
+    return v * (v + t - eps)
+
+
+def _v_draw(t: float, eps: float) -> float:
+    a, b = eps - t, -eps - t
+    denom = _cdf(a) - _cdf(b)
+    return (_phi(b) - _phi(a)) / max(denom, 1e-12)
+
+
+def _w_draw(t: float, eps: float) -> float:
+    a, b = eps - t, -eps - t
+    denom = _cdf(a) - _cdf(b)
+    v = _v_draw(t, eps)
+    return v * v + (a * _phi(a) - b * _phi(b)) / max(denom, 1e-12)
+
+
+class TrueSkill:
+    def __init__(
+        self,
+        mu: float = 25.0,
+        sigma: float = 25.0 / 3.0,
+        beta: float = 25.0 / 6.0,
+        tau: float = 25.0 / 300.0,
+        draw_probability: float = 0.1,
+    ):
+        self.mu0, self.sigma0 = mu, sigma
+        self.beta, self.tau = beta, tau
+        self.draw_probability = draw_probability
+        self.ratings: Dict[str, Tuple[float, float]] = defaultdict(
+            partial(tuple, (mu, sigma))
+        )
+        self.game_count = 0
+
+    def _get(self, pid: str) -> Tuple[float, float]:
+        r = self.ratings[pid]
+        return (r[0], r[1]) if isinstance(r, tuple) and len(r) == 2 else (self.mu0, self.sigma0)
+
+    def update(self, winner: str, loser: str, draw: bool = False) -> None:
+        mu_w, sig_w = self._get(winner)
+        mu_l, sig_l = self._get(loser)
+        sig_w = math.sqrt(sig_w ** 2 + self.tau ** 2)
+        sig_l = math.sqrt(sig_l ** 2 + self.tau ** 2)
+        c2 = 2 * self.beta ** 2 + sig_w ** 2 + sig_l ** 2
+        c = math.sqrt(c2)
+        t = (mu_w - mu_l) / c
+        eps = _draw_margin(self.draw_probability, self.beta) / c
+        if draw:
+            v, w = _v_draw(t, eps), _w_draw(t, eps)
+        else:
+            v, w = _v_win(t, eps), _w_win(t, eps)
+        self.ratings[winner] = (
+            mu_w + (sig_w ** 2 / c) * v,
+            sig_w * math.sqrt(max(1.0 - (sig_w ** 2 / c2) * w, 1e-6)),
+        )
+        self.ratings[loser] = (
+            mu_l - (sig_l ** 2 / c) * v,
+            sig_l * math.sqrt(max(1.0 - (sig_l ** 2 / c2) * w, 1e-6)),
+        )
+        self.game_count += 1
+
+    def exposed(self, pid: str) -> float:
+        mu, sigma = self._get(pid)
+        return mu - 3.0 * sigma
+
+    def leaderboard(self) -> Dict[str, float]:
+        return dict(
+            sorted(
+                ((pid, self.exposed(pid)) for pid in self.ratings),
+                key=lambda kv: -kv[1],
+            )
+        )
+
+    def get_text(self) -> str:
+        return "\n".join(
+            f"{pid:<40s} mu={self._get(pid)[0]:6.2f} sigma={self._get(pid)[1]:5.2f} "
+            f"exposed={score:6.2f}"
+            for pid, score in self.leaderboard().items()
+        )
+
+
+def _draw_margin(draw_probability: float, beta: float, n_players: int = 2) -> float:
+    """Inverse-CDF draw margin for the given draw probability."""
+    # eps = Phi^-1((p_draw + 1) / 2) * sqrt(n) * beta
+    target = (draw_probability + 1.0) / 2.0
+    lo, hi = 0.0, 10.0
+    for _ in range(60):
+        mid = (lo + hi) / 2
+        if _cdf(mid) < target:
+            lo = mid
+        else:
+            hi = mid
+    return lo * math.sqrt(n_players) * beta

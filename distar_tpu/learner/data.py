@@ -36,6 +36,7 @@ def fake_rl_batch(
     rng: Optional[np.random.Generator] = None,
     hidden_size: int = 384,
     hidden_layers: int = 3,
+    use_value_feature: bool = False,
 ) -> Dict:
     """Schema-complete random RL trajectory batch (numpy, host-side)."""
     rng = rng or np.random.default_rng(0)
@@ -102,7 +103,16 @@ def fake_rl_batch(
     rewards = {
         f: rng.integers(-1, 2, (T, B)).astype(np.float32) for f in RL_REWARD_FIELDS
     }
+    extra = {}
+    if use_value_feature:
+        extra["value_feature"] = F.batch_tree(
+            [
+                F.batch_tree([F.fake_value_feature(rng) for _ in range(B)])
+                for _ in range(T + 1)
+            ]
+        )
     return {
+        **extra,
         "spatial_info": obs["spatial_info"],
         "entity_info": obs["entity_info"],
         "scalar_info": obs["scalar_info"],
@@ -166,11 +176,12 @@ class FakeRLDataloader:
     """Infinite iterator of fake RL batches (learner job_type 'train_test')."""
 
     def __init__(self, batch_size: int, unroll_len: int, hidden_size: int = 384,
-                 hidden_layers: int = 3, seed: int = 0):
+                 hidden_layers: int = 3, seed: int = 0, use_value_feature: bool = False):
         self._rng = np.random.default_rng(seed)
         self._kwargs = dict(
             batch_size=batch_size, unroll_len=unroll_len,
             hidden_size=hidden_size, hidden_layers=hidden_layers,
+            use_value_feature=use_value_feature,
         )
 
     def __iter__(self) -> Iterator[Dict]:

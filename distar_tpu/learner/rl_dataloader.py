@@ -63,6 +63,8 @@ def collate_trajectories(trajs: List[list]) -> Dict:
             [float(traj[0].get("model_last_iter", 0.0)) for traj in trajs], np.float32
         ),
     }
+    if "value_feature" in trajs[0][0]:
+        batch["value_feature"] = stack_obs("value_feature")
     sun = batch["selected_units_num"].astype(np.int64)
     masks = stack_tb(lambda s: s["mask"])
     masks["selected_units_mask"] = (

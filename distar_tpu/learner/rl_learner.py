@@ -63,12 +63,15 @@ def make_rl_train_step(model: Model, loss_cfg: ReinforcementLossConfig, optimize
             "scalar_info": _flatten_time(batch["scalar_info"]),
             "entity_num": batch["entity_num"].reshape(-1),
         }
+        value_feature = batch.get("value_feature")
+        if value_feature is not None:
+            value_feature = _flatten_time(value_feature)
         out = model.apply(
             params,
             obs["spatial_info"], obs["entity_info"], obs["scalar_info"], obs["entity_num"],
             batch["hidden_state"], batch["action_info"], batch["selected_units_num"],
             batch_size, unroll_len,
-            value_feature=batch.get("value_feature"),
+            value_feature=value_feature,
             method=model.rl_forward,
         )
         inputs = {
@@ -127,6 +130,7 @@ class RLLearner(BaseLearner):
                 unroll_len=lc.unroll_len,
                 hidden_size=self.model_cfg.encoder.core_lstm.hidden_size,
                 hidden_layers=self.model_cfg.encoder.core_lstm.num_layers,
+                use_value_feature=self.model_cfg.use_value_feature,
             )
         )
 
@@ -134,18 +138,17 @@ class RLLearner(BaseLearner):
         self._dataloader = iter(it)
 
     def _setup_state(self) -> None:
-        import math
-
         lc = self.cfg.learner
         B, T = lc.batch_size, lc.unroll_len
-        if B % self.mesh.shape["dp"] != 0:
-            # shrink dp to the largest divisor of the batch so small debug
-            # batches still run on wide meshes
-            import jax as _jax
+        from ..parallel.mesh import shrink_dp
 
-            dp = math.gcd(B, len(_jax.devices()))
-            self.mesh = make_mesh(MeshSpec(dp=dp), _jax.devices()[:dp])
-            self.logger.info(f"batch {B} not divisible by mesh dp; using dp={dp}")
+        new_mesh = shrink_dp(self.mesh, B)
+        if new_mesh is not self.mesh:
+            self.logger.info(
+                f"batch {B} not divisible by mesh dp={self.mesh.shape['dp']}; "
+                f"shrunk to dp={new_mesh.shape['dp']} (other axes preserved)"
+            )
+            self.mesh = new_mesh
         batch = next(self._dataloader)
         self.optimizer = build_optimizer(
             learning_rate=lc.learning_rate,
@@ -155,13 +158,15 @@ class RLLearner(BaseLearner):
         )
         # jit the init: eager init dispatches thousands of tiny ops, which is
         # painfully slow on a remote/tunneled device
-        def init_fn(rng, spatial, entity, scalar, entity_num, hidden, action, sun):
+        def init_fn(rng, spatial, entity, scalar, entity_num, hidden, action, sun, vf):
             return self.model.init(
                 rng, spatial, entity, scalar, entity_num, hidden, action, sun, B, T,
+                value_feature=vf,
                 method=self.model.rl_forward,
             )
 
         batch = jax.tree.map(jnp.asarray, batch)
+        vf = batch.get("value_feature")
         params = jax.jit(init_fn)(
             jax.random.PRNGKey(0),
             *(_flatten_time(batch[k]) for k in ("spatial_info", "entity_info", "scalar_info")),
@@ -169,6 +174,7 @@ class RLLearner(BaseLearner):
             batch["hidden_state"],
             batch["action_info"],
             batch["selected_units_num"],
+            _flatten_time(vf) if vf is not None else None,
         )
         repl = NamedSharding(self.mesh, P())
         params = jax.device_put(params, repl)

@@ -1,0 +1,149 @@
+"""SL dataloader: disk-backed decoded-replay datasets -> learner batches.
+
+Role parity with the reference SLDataloader (reference: distar/agent/default/
+sl_training/sl_dataloader.py — replay-decode workers feeding trajectory
+windows with carried LSTM state). The SC2 two-pass replay decoder
+(replay_decoder.py) requires the game client; its output contract is frozen
+here: one ``.npz``-saved step list per replay-player, each step carrying the
+feature-schema obs + teacher-forced action labels (see ReplayDataset.save).
+Until the client binding lands, datasets come from any external decoder or
+``make_fake_dataset``.
+
+Windowing matches the reference: each trajectory is cut into unroll_len
+windows; a batch slot advances through one trajectory's windows before
+loading the next (new_episodes flags the learner to zero that slot's hidden
+state, sl_learner.py:31-35).
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import zlib
+from typing import Dict, Iterator, List, Optional
+
+import numpy as np
+
+from ..lib import features as F
+from .data import fake_sl_batch
+
+
+class ReplayDataset:
+    """A directory of decoded trajectories (zlib-pickled step lists)."""
+
+    SUFFIX = ".traj.zpkl"
+
+    def __init__(self, root: str):
+        self.root = root
+        self.paths = sorted(
+            os.path.join(root, f) for f in os.listdir(root) if f.endswith(self.SUFFIX)
+        )
+        if not self.paths:
+            raise FileNotFoundError(f"no {self.SUFFIX} files under {root}")
+
+    @classmethod
+    def save(cls, root: str, name: str, steps: List[dict]) -> str:
+        """Persist one decoded trajectory. Each step dict carries:
+        spatial_info / scalar_info / entity_info / entity_num (feature
+        schema) + action_info + action_mask + selected_units_num."""
+        os.makedirs(root, exist_ok=True)
+        path = os.path.join(root, f"{name}{cls.SUFFIX}")
+        with open(path, "wb") as f:
+            f.write(zlib.compress(pickle.dumps(steps, protocol=5), level=1))
+        return path
+
+    def load(self, idx: int) -> List[dict]:
+        with open(self.paths[idx % len(self.paths)], "rb") as f:
+            return pickle.loads(zlib.decompress(f.read()))
+
+
+class SLDataloader:
+    """Batch slots stream trajectory windows from a ReplayDataset."""
+
+    def __init__(self, dataset: ReplayDataset, batch_size: int, unroll_len: int, seed: int = 0):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.unroll_len = unroll_len
+        self._rng = np.random.default_rng(seed)
+        self._slots: List[List[dict]] = [[] for _ in range(batch_size)]
+        self._fresh = [True] * batch_size
+        self._warned_short: set = set()
+
+    def _refill(self, slot: int) -> None:
+        # trajectories shorter than one window can't fill a fixed-shape batch
+        # slot; skip them (once-per-path warning) rather than emit ragged data
+        for _ in range(len(self.dataset.paths) * 2):
+            idx = int(self._rng.integers(0, len(self.dataset.paths)))
+            traj = self.dataset.load(idx)
+            if len(traj) >= self.unroll_len:
+                self._slots[slot] = list(traj)
+                self._fresh[slot] = True
+                return
+            if idx not in self._warned_short:
+                self._warned_short.add(idx)
+                print(
+                    f"SLDataloader: skipping {self.dataset.paths[idx]} "
+                    f"({len(traj)} steps < unroll_len {self.unroll_len})"
+                )
+        raise RuntimeError(
+            f"no trajectory in {self.dataset.root} has >= unroll_len="
+            f"{self.unroll_len} steps"
+        )
+
+    def __iter__(self) -> Iterator[Dict]:
+        return self
+
+    def __next__(self) -> Dict:
+        T = self.unroll_len
+        windows, new_episodes = [], []
+        for b in range(self.batch_size):
+            if len(self._slots[b]) < T:
+                self._refill(b)
+            new_episodes.append(self._fresh[b])
+            self._fresh[b] = False
+            windows.append(self._slots[b][:T])
+            self._slots[b] = self._slots[b][T:]
+        # flatten batch-major: [B*T] with per-slot contiguous windows
+        flat = [step for win in windows for step in win]
+        batch = {
+            "spatial_info": F.batch_tree([s["spatial_info"] for s in flat]),
+            "entity_info": F.batch_tree([s["entity_info"] for s in flat]),
+            "scalar_info": F.batch_tree([s["scalar_info"] for s in flat]),
+            "entity_num": np.stack([np.asarray(s["entity_num"]) for s in flat]),
+            "action_info": F.batch_tree([s["action_info"] for s in flat]),
+            "action_mask": F.batch_tree([s["action_mask"] for s in flat]),
+            "selected_units_num": np.stack(
+                [np.asarray(s["selected_units_num"]) for s in flat]
+            ),
+            "new_episodes": np.asarray(new_episodes, bool),
+            "traj_lens": np.full((self.batch_size,), T, np.int64),
+        }
+        return batch
+
+
+def make_fake_dataset(root: str, n_trajectories: int = 4, steps_per_traj: int = 16,
+                      seed: int = 0) -> ReplayDataset:
+    """Synthesise a decoded-replay dataset with the frozen contract (test
+    double for the SC2 replay decoder)."""
+    rng = np.random.default_rng(seed)
+    for i in range(n_trajectories):
+        batch = fake_sl_batch(1, steps_per_traj, rng=rng)
+        steps = []
+        for t in range(steps_per_traj):
+            def at(tree):
+                import jax
+
+                return jax.tree.map(lambda x: np.asarray(x)[t], tree)
+
+            steps.append(
+                {
+                    "spatial_info": at(batch["spatial_info"]),
+                    "entity_info": at(batch["entity_info"]),
+                    "scalar_info": at(batch["scalar_info"]),
+                    "entity_num": np.asarray(batch["entity_num"][t]),
+                    "action_info": at(batch["action_info"]),
+                    "action_mask": at(batch["action_mask"]),
+                    "selected_units_num": np.asarray(batch["selected_units_num"][t]),
+                }
+            )
+        ReplayDataset.save(root, f"fake_{i:04d}", steps)
+    return ReplayDataset(root)

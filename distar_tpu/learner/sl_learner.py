@@ -91,6 +91,15 @@ class SLLearner(BaseLearner):
     def _setup_state(self) -> None:
         lc = self.cfg.learner
         B = lc.batch_size
+        from ..parallel.mesh import shrink_dp
+
+        new_mesh = shrink_dp(self.mesh, B)
+        if new_mesh is not self.mesh:
+            self.logger.info(
+                f"batch {B} not divisible by mesh dp={self.mesh.shape['dp']}; "
+                f"shrunk to dp={new_mesh.shape['dp']} (other axes preserved)"
+            )
+            self.mesh = new_mesh
         core = self.model_cfg.encoder.core_lstm
         self._hidden = tuple(
             (jnp.zeros((B, core.hidden_size)), jnp.zeros((B, core.hidden_size)))

@@ -225,6 +225,46 @@ def fake_model_output(hidden_layers: int = 3, hidden_size: int = 384, teacher: b
     return ret
 
 
+# Centralized-critic feature schema (league RL with use_value_feature; role
+# of the reference's value_feature dict built in transform_obs, features.py
+# :691-765 — opponent stats + both sides' unit scatter inputs + behaviour Z).
+VALUE_FEATURE_INFO = {
+    "enemy_unit_counts_bow": (np.uint8, ("NUM_UNIT_TYPES",)),
+    "enemy_unit_type_bool": (np.uint8, ("NUM_UNIT_TYPES",)),
+    "enemy_agent_statistics": (np.float32, (10,)),
+    "enemy_upgrades": (np.int16, ("NUM_UPGRADES",)),
+    "enemy_cumulative_stat": (np.uint8, ("NUM_CUMULATIVE_STAT_ACTIONS",)),
+    "unit_alliance": (np.uint8, ("MAX_ENTITY_NUM",)),
+    "unit_type": (np.int16, ("MAX_ENTITY_NUM",)),
+    "unit_x": (np.uint8, ("MAX_ENTITY_NUM",)),
+    "unit_y": (np.uint8, ("MAX_ENTITY_NUM",)),
+    "total_unit_count": (np.int64, ()),
+    "own_units_spatial": (np.uint8, "SPATIAL"),
+    "enemy_units_spatial": (np.uint8, "SPATIAL"),
+    "beginning_order": (np.int16, (BEGINNING_ORDER_LENGTH,)),
+    "bo_location": (np.int16, (BEGINNING_ORDER_LENGTH,)),
+}
+
+
+def fake_value_feature(rng: Optional[np.random.Generator] = None) -> Dict[str, np.ndarray]:
+    rng = rng or np.random.default_rng(0)
+    dims = {
+        "NUM_UNIT_TYPES": NUM_UNIT_TYPES,
+        "NUM_UPGRADES": NUM_UPGRADES,
+        "NUM_CUMULATIVE_STAT_ACTIONS": NUM_CUMULATIVE_STAT_ACTIONS,
+        "MAX_ENTITY_NUM": MAX_ENTITY_NUM,
+    }
+    out = {}
+    for k, (dtype, shape) in VALUE_FEATURE_INFO.items():
+        if shape == "SPATIAL":
+            out[k] = _zeros(SPATIAL_SIZE, dtype)
+        else:
+            resolved = tuple(dims.get(s, s) for s in shape)
+            out[k] = _zeros(resolved, dtype)
+    out["total_unit_count"] = np.asarray(int(rng.integers(1, MAX_ENTITY_NUM)), np.int64)
+    return out
+
+
 def batch_tree(trees, stack=np.stack):
     """Stack a list of nested dict/tuple/array structures along axis 0."""
     first = trees[0]

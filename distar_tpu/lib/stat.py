@@ -1,0 +1,98 @@
+"""In-game statistics: unit counts, action success rates, per-race legality.
+
+Role parity with the reference Stat module (reference: distar/agent/default/
+lib/stat.py): ``Stat`` tracks built-unit counts and per-action success rates
+during an episode; ``ACTION_RACE_MASK`` gates action-type logits by race in
+play mode (action_type_head.py:53-55); ``cum_dict`` names the cumulative-stat
+slots for TB logging. All data tables come from the extracted contract
+(tools/extract_contract.py).
+"""
+from __future__ import annotations
+
+import json
+import os
+from collections import defaultdict
+from typing import Dict, Optional
+
+import numpy as np
+
+from .actions import ACTIONS, FUNC_ID_TO_ACTION_TYPE
+
+_DATA_PATH = os.path.join(os.path.dirname(__file__), "..", "data", "game_contract.json")
+with open(_DATA_PATH) as _f:
+    _C = json.load(_f)
+
+UNIT_DICT: Dict[str, Dict[int, str]] = {
+    race: {int(k): v for k, v in table.items()} for race, table in _C["unit_dict"].items()
+}
+CUM_DICT = _C["cum_dict"]  # slot index -> human-readable name
+ACTION_RESULT_NAMES = _C["action_result_dict"]
+NUM_ACTION_RESULT = len(ACTION_RESULT_NAMES)
+
+# race -> bool[327] action legality (reference stat.py:533+)
+ACTION_RACE_MASK: Dict[str, np.ndarray] = {
+    race: np.asarray(mask, dtype=bool) for race, mask in _C["action_race_mask"].items()
+}
+
+
+class Stat:
+    """Per-episode unit-count and action-success tracking."""
+
+    def __init__(self, race: str = "zerg"):
+        self._race = race
+        self._unit_num: Dict[str, float] = defaultdict(int)
+        self._unit_num["max_unit_num"] = 0
+        for name in UNIT_DICT.get(race, {}).values():
+            self._unit_num[name] = 0
+        self._success: Dict[str, int] = defaultdict(int)
+
+    def set_race(self, race: str) -> None:
+        self._race = race
+
+    def update(self, last_action_type: int, action_result: int, observation: Optional[dict],
+               game_step: float) -> None:
+        if action_result < 1:
+            return
+        if action_result == 1:
+            self._count_unit(last_action_type)
+        if observation is not None:
+            ent = observation.get("entity_info")
+            n = int(np.asarray(observation.get("entity_num", 0)))
+            if ent is not None and (np.asarray(ent["alliance"])[:n] == 1).sum() > 10:
+                self._success_rate(last_action_type, action_result)
+
+    def _count_unit(self, action_type: int) -> None:
+        func_id = ACTIONS[action_type]["func_id"]
+        name = UNIT_DICT.get(self._race, {}).get(func_id)
+        if not name:
+            return
+        self._unit_num[name] += 1
+        self._unit_num["max_unit_num"] = max(self._unit_num[name], self._unit_num["max_unit_num"])
+
+    def _success_rate(self, action_type: int, action_result: int) -> None:
+        action_name = ACTIONS[action_type]["name"]
+        msg = (
+            ACTION_RESULT_NAMES[action_result]
+            if 0 <= action_result < NUM_ACTION_RESULT
+            else f"code{action_result}"
+        )
+        self._success[f"rate/{action_name}/{msg}"] += 1
+        self._success[f"rate/{action_name}/count"] += 1
+
+    def get_stat_data(self) -> Dict[str, float]:
+        data: Dict[str, float] = {}
+        denom = max(self._unit_num["max_unit_num"], 1)
+        for k, v in self._unit_num.items():
+            if k != "max_unit_num":
+                data[f"units/{k}"] = v / denom
+        for k, v in self._success.items():
+            if k.endswith("/count"):
+                data[k] = v
+            else:
+                action = k.split("rate/")[1].split("/")[0]
+                data[k] = v / (self._success[f"rate/{action}/count"] + 1e-6)
+        return data
+
+    @property
+    def unit_num(self) -> Dict[str, float]:
+        return dict(self._unit_num)

@@ -1,0 +1,107 @@
+"""Strategy-statistics ("Z") libraries.
+
+Role parity with the reference Z machinery (reference: distar/bin/gen_z.py
+and agent.py:176-243): a Z library is a json keyed
+``map_name -> mix_race -> born_location_str -> [entries]`` where each entry is
+``[building_order, cumulative_stat_indices, bo_location, z_loop(, z_type)]``.
+Agents sample an entry at episode start and are rewarded for following it
+(pseudo-rewards) and conditioned on it (scalar encoder Z inputs).
+
+z_type semantics (agent.py:213-217): 1 disables bo reward, 2 disables cum
+reward, 3 disables both.
+"""
+from __future__ import annotations
+
+import json
+import os
+import random
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from . import actions as ACT
+from .features import BEGINNING_ORDER_LENGTH
+
+
+def z_entry_to_target(entry: List, fake_reward_prob: float = 1.0) -> dict:
+    """Normalise one raw library entry into the agent's target dict."""
+    if len(entry) == 5:
+        bo, cum_idx, bo_location, z_loop, z_type = entry
+    else:
+        bo, cum_idx, bo_location, z_loop = entry
+        z_type = None
+    use_cum = not (z_type in (2, 3))
+    use_bo = not (z_type in (1, 3))
+    if random.random() > fake_reward_prob:
+        use_cum = False
+    if random.random() > fake_reward_prob:
+        use_bo = False
+    return {
+        "beginning_order": list(bo),
+        "bo_location": list(bo_location),
+        "cumulative_stat": list(cum_idx),
+        "z_loop": z_loop,
+        "use_bo_reward": use_bo,
+        "use_cum_reward": use_cum,
+        "bo_norm": max(len(bo), 1),
+        "cum_norm": max(len(cum_idx), 1),
+    }
+
+
+class ZLibrary:
+    def __init__(self, path: str):
+        with open(path) as f:
+            self.data = json.load(f)
+
+    def sample(
+        self,
+        map_name: str,
+        mix_race: str,
+        born_location: int,
+        fake_reward_prob: float = 1.0,
+    ) -> dict:
+        entries = self.data[map_name][mix_race][str(born_location)]
+        return z_entry_to_target(random.choice(entries), fake_reward_prob)
+
+    def keys(self):
+        return {
+            m: {r: list(locs.keys()) for r, locs in races.items()}
+            for m, races in self.data.items()
+        }
+
+
+def build_z_library(
+    episodes: List[dict],
+    min_winloss: int = 1,
+) -> Dict:
+    """Aggregate recorded episode summaries into a Z library.
+
+    Role of the reference gen_z result_loop (gen_z.py:49+ — decode *winning*
+    replays into Z entries). ``episodes`` entries carry: map_name, mix_race,
+    born_location, winloss, beginning_order, bo_location, cumulative_stat
+    (dense 0/1 vector or index list), game_loop.
+    """
+    lib: Dict = {}
+    for ep in episodes:
+        if ep.get("winloss", 0) < min_winloss:
+            continue
+        cum = ep["cumulative_stat"]
+        cum = np.asarray(cum)
+        cum_idx = (
+            cum.nonzero()[0].tolist() if cum.ndim and len(cum) == ACT.NUM_CUMULATIVE_STAT_ACTIONS
+            else [int(x) for x in cum]
+        )
+        bo = [int(x) for x in ep["beginning_order"] if x != 0][:BEGINNING_ORDER_LENGTH]
+        loc = [int(x) for x in ep["bo_location"]][: len(bo)]
+        entry = [bo, cum_idx, loc, int(ep.get("game_loop", 0))]
+        lib.setdefault(ep["map_name"], {}).setdefault(ep["mix_race"], {}).setdefault(
+            str(int(ep["born_location"])), []
+        ).append(entry)
+    return lib
+
+
+def save_z_library(lib: Dict, path: str) -> str:
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(lib, f)
+    return path

@@ -266,6 +266,13 @@ class Model(nn.Module):
             critic_input = jax.lax.stop_gradient(critic_input)
             baseline_feature = jax.lax.stop_gradient(baseline_feature)
         if static_cfg(self.cfg).use_value_feature:
+            if value_feature is None:
+                raise ValueError(
+                    "cfg.use_value_feature=True but the batch carries no "
+                    "value_feature — the data source (actor collect_data / "
+                    "fake_rl_batch) must include the centralized-critic "
+                    "features (lib.features.VALUE_FEATURE_INFO)"
+                )
             vf = self.value_encoder(value_feature)
             critic_input = jnp.concatenate([critic_input, vf, baseline_feature], axis=1)
         values = {

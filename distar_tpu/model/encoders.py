@@ -225,6 +225,7 @@ class EntityEncoder(nn.Module):
                 "relu",
                 ent.ln_type,
                 self.dtype,
+                attn_impl=ent.get("attention_impl", "xla"),
             )(h, mask)
         entity_embeddings = FCBlock(width, "relu", dtype=self.dtype, name="entity_fc")(
             jax.nn.relu(h)

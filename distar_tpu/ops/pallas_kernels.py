@@ -1,0 +1,126 @@
+"""Pallas TPU kernels for the framework's hot ops.
+
+The two #1 kernel candidates named in the survey (SURVEY.md §2.3:
+module_utils scatter_connection; §5: entity transformer as a Pallas masked
+attention):
+
+* ``masked_attention``   — fused softmax(QK^T + mask)V over the <=512-entity
+  set. One (batch, head) program: scores, mask, a numerically-stable softmax,
+  and the value matmul all stay in VMEM; both matmuls hit the MXU at
+  (512 x 64/128) tiles. Saves the HBM round-trips XLA's unfused
+  mask->softmax->matmul chain can incur at small batch.
+* ``scatter_add_connection`` — per-batch scatter-add of entity embeddings
+  into the flattened (H*W, D) map via a fori_loop of dynamic row updates
+  (entity count is static at 512; padding rows write via a validity mask to
+  row 0 with zero weight).
+
+Both run under ``interpret=True`` on CPU (tests compare against the jnp
+reference implementations) and lower natively on TPU. Enable via
+``attn_impl='pallas'`` on ops.Transformer (model config key
+``encoder.entity.attention_impl``) and ``impl='pallas'`` on
+ops.scatter_connection.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e9
+
+
+# --------------------------------------------------------------- attention
+def _attention_kernel(q_ref, k_ref, v_ref, mask_ref, out_ref, *, scale: float):
+    q = q_ref[0, 0]  # [N, Dh]
+    k = k_ref[0, 0]  # [N, Dh]
+    v = v_ref[0, 0]  # [N, Dh]
+    mask = mask_ref[0, 0]  # [1, N] key validity
+    score = jax.lax.dot_general(
+        q, k, dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale  # [N, N]
+    score = jnp.where(mask.astype(jnp.bool_), score, NEG_INF)
+    score = score - jnp.max(score, axis=-1, keepdims=True)
+    p = jnp.exp(score)
+    p = p / jnp.sum(p, axis=-1, keepdims=True)
+    out_ref[0, 0] = jnp.dot(p, v, preferred_element_type=jnp.float32)
+
+
+def masked_attention(
+    q: jnp.ndarray,  # [B, H, N, Dh]
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    mask: jnp.ndarray,  # [B, N] bool key validity
+    interpret: bool = False,
+) -> jnp.ndarray:
+    B, H, N, Dh = q.shape
+    scale = 1.0 / (Dh ** 0.5)
+    mask2 = mask[:, None, None, :].astype(jnp.float32)  # [B, 1, 1, N]
+    mask2 = jnp.broadcast_to(mask2, (B, H, 1, N))
+
+    grid = (B, H)
+
+    def idx(b, h):
+        return (b, h, 0, 0)
+
+    return pl.pallas_call(
+        functools.partial(_attention_kernel, scale=scale),
+        out_shape=jax.ShapeDtypeStruct((B, H, N, Dh), jnp.float32),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, N, Dh), idx, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, N, Dh), idx, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, N, Dh), idx, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, 1, N), idx, memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, 1, N, Dh), idx, memory_space=pltpu.VMEM),
+        interpret=interpret,
+    )(q, k, v, mask2)
+
+
+def masked_attention_reference(q, k, v, mask):
+    """jnp oracle with identical semantics."""
+    scale = 1.0 / (q.shape[-1] ** 0.5)
+    score = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    score = jnp.where(mask[:, None, None, :], score, NEG_INF)
+    p = jax.nn.softmax(score, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+
+
+# ----------------------------------------------------------------- scatter
+def _scatter_kernel(emb_ref, idx_ref, out_ref, *, n_entities: int):
+    # zero the output tile, then accumulate entity rows at dynamic offsets
+    out_ref[0] = jnp.zeros_like(out_ref[0])
+
+    def body(i, _):
+        row = idx_ref[0, i]  # flat cell index (already validity-masked)
+        out_ref[0, pl.ds(row, 1), :] += emb_ref[0, pl.ds(i, 1), :]
+        return 0
+
+    jax.lax.fori_loop(0, n_entities, body, 0)
+
+
+def scatter_add_connection(
+    embeddings: jnp.ndarray,  # [B, N, D] (invalid entities must be zeroed)
+    flat_idx: jnp.ndarray,  # [B, N] int32 cell index in [0, H*W)
+    hw: int,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Per-batch scatter-add; returns [B, H*W, D]."""
+    B, N, D = embeddings.shape
+
+    return pl.pallas_call(
+        functools.partial(_scatter_kernel, n_entities=N),
+        out_shape=jax.ShapeDtypeStruct((B, hw, D), embeddings.dtype),
+        grid=(B,),
+        in_specs=[
+            pl.BlockSpec((1, N, D), lambda b: (b, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, N), lambda b: (b, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, hw, D), lambda b: (b, 0, 0), memory_space=pltpu.VMEM),
+        interpret=interpret,
+    )(embeddings, flat_idx.astype(jnp.int32))

@@ -25,6 +25,7 @@ def scatter_connection(
     locations: jnp.ndarray,  # [B, N, 2] as (x, y) int
     spatial_size,  # (H, W)
     mode: str = "add",
+    impl: str = "xla",  # 'xla' | 'pallas' (add mode only)
 ) -> jnp.ndarray:
     """Return [B, H, W, D] map with embeddings scattered at entity cells."""
     B, N, D = embeddings.shape
@@ -32,15 +33,21 @@ def scatter_connection(
     x = jnp.clip(locations[..., 0].astype(jnp.int32), 0, W - 1)
     y = jnp.clip(locations[..., 1].astype(jnp.int32), 0, H - 1)
     flat_idx = y * W + x  # [B, N] in row-major (y, x) order
-    batch_bias = jnp.arange(B, dtype=jnp.int32)[:, None] * (H * W)
-    flat_idx = (flat_idx + batch_bias).reshape(-1)  # [B*N]
 
+    if impl == "pallas":
+        assert mode == "add", "pallas scatter implements add mode"
+        from .pallas_kernels import scatter_add_connection
+
+        return scatter_add_connection(embeddings, flat_idx, H * W).reshape(B, H, W, D)
+
+    batch_bias = jnp.arange(B, dtype=jnp.int32)[:, None] * (H * W)
+    flat = (flat_idx + batch_bias).reshape(-1)  # [B*N]
     buf = jnp.zeros((B * H * W, D), dtype=embeddings.dtype)
     flat_emb = embeddings.reshape(B * N, D)
     if mode == "add":
-        buf = buf.at[flat_idx].add(flat_emb)
+        buf = buf.at[flat].add(flat_emb)
     elif mode == "cover":
-        buf = buf.at[flat_idx].set(flat_emb)
+        buf = buf.at[flat].set(flat_emb)
     else:
         raise NotImplementedError(mode)
     return buf.reshape(B, H, W, D)

@@ -27,12 +27,17 @@ NEG_INF = -1e9
 
 
 class Attention(nn.Module):
-    """Multi-head self-attention over a set, with key-validity masking."""
+    """Multi-head self-attention over a set, with key-validity masking.
+
+    ``impl='pallas'`` routes the fused mask+softmax+PV kernel
+    (ops.pallas_kernels.masked_attention) — TPU only; the default XLA path
+    runs everywhere and fuses well at trainer batch sizes."""
 
     head_dim: int
     head_num: int
     output_dim: int
     dtype: Dtype = jnp.float32
+    impl: str = "xla"  # 'xla' | 'pallas'
 
     @nn.compact
     def __call__(self, x, mask: Optional[jnp.ndarray] = None):
@@ -44,12 +49,19 @@ class Attention(nn.Module):
             return t.reshape(B, N, self.head_num, self.head_dim).transpose(0, 2, 1, 3)
 
         q, k, v = heads(q), heads(k), heads(v)
-        score = jnp.einsum("bhqd,bhkd->bhqk", q, k) / jnp.sqrt(float(self.head_dim))
-        if mask is not None:
-            # mask: [B, N] key validity -> broadcast over heads and queries
-            score = jnp.where(mask[:, None, None, :], score, NEG_INF)
-        score = jax.nn.softmax(score, axis=-1)
-        out = jnp.einsum("bhqk,bhkd->bhqd", score, v)
+        if mask is None:
+            mask_b = jnp.ones((B, N), bool)
+        else:
+            mask_b = mask
+        if self.impl == "pallas":
+            from .pallas_kernels import masked_attention
+
+            out = masked_attention(q, k, v, mask_b)
+        else:
+            score = jnp.einsum("bhqd,bhkd->bhqk", q, k) / jnp.sqrt(float(self.head_dim))
+            score = jnp.where(mask_b[:, None, None, :], score, NEG_INF)
+            score = jax.nn.softmax(score, axis=-1)
+            out = jnp.einsum("bhqk,bhkd->bhqd", score, v)
         out = out.transpose(0, 2, 1, 3).reshape(B, N, self.head_num * self.head_dim)
         return nn.Dense(self.output_dim, dtype=self.dtype)(out)
 
@@ -63,10 +75,13 @@ class TransformerLayer(nn.Module):
     activation: str = "relu"
     ln_type: str = "post"
     dtype: Dtype = jnp.float32
+    attn_impl: str = "xla"
 
     @nn.compact
     def __call__(self, x, mask: Optional[jnp.ndarray] = None):
-        attn = Attention(self.head_dim, self.head_num, self.output_dim, self.dtype)
+        attn = Attention(
+            self.head_dim, self.head_num, self.output_dim, self.dtype, impl=self.attn_impl
+        )
         dims = [self.hidden_dim] * (self.mlp_num - 1) + [self.output_dim]
 
         def mlp(h):
@@ -97,6 +112,7 @@ class Transformer(nn.Module):
     activation: str = "relu"
     ln_type: str = "pre"
     dtype: Dtype = jnp.float32
+    attn_impl: str = "xla"
 
     @nn.compact
     def __call__(self, x, mask: Optional[jnp.ndarray] = None):
@@ -111,6 +127,7 @@ class Transformer(nn.Module):
                 self.activation,
                 self.ln_type,
                 self.dtype,
+                attn_impl=self.attn_impl,
             )(x, mask)
         return x
 

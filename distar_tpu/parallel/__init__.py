@@ -1,4 +1,5 @@
 from .mesh import MeshSpec, make_mesh, batch_sharding, replicated_sharding
+from .ring_attention import ring_attention, ring_self_attention
 from .grad_clip import GradClipConfig, build_grad_clip
 from .optimizer import build_optimizer
 
@@ -10,4 +11,6 @@ __all__ = [
     "GradClipConfig",
     "build_grad_clip",
     "build_optimizer",
+    "ring_attention",
+    "ring_self_attention",
 ]

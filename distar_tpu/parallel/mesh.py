@@ -65,3 +65,20 @@ def time_batch_sharding(mesh: Mesh) -> NamedSharding:
 
 def replicated_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
+
+
+def shrink_dp(mesh: Mesh, batch_size: int) -> Mesh:
+    """Return a mesh whose dp axis divides ``batch_size``, preserving the
+    fsdp/tp/sp axes (small debug batches on wide meshes). No-op when the
+    batch already divides dp."""
+    import math
+
+    dp = mesh.shape["dp"]
+    if batch_size % dp == 0:
+        return mesh
+    new_dp = math.gcd(batch_size, dp)
+    spec = MeshSpec(
+        dp=new_dp, fsdp=mesh.shape["fsdp"], tp=mesh.shape["tp"], sp=mesh.shape["sp"]
+    )
+    devices = mesh.devices.reshape(-1)[: new_dp * spec.fsdp * spec.tp * spec.sp]
+    return make_mesh(spec, devices)

@@ -111,3 +111,22 @@ def test_sl_learner_steps(tmp_path):
     assert learner.last_iter.val == 2
     assert np.isfinite(learner.variable_record.get("total_loss").avg)
     assert np.isfinite(learner.variable_record.get("action_type_acc").avg)
+
+
+@pytest.mark.slow
+def test_rl_learner_with_value_feature(tmp_path):
+    """Centralized-critic path: use_value_feature routes opponent features
+    through the ValueEncoder into every baseline tower."""
+    from distar_tpu.learner import RLLearner
+
+    model = dict(SMALL_MODEL)
+    model = {**model, "use_value_feature": True}
+    cfg = {
+        "common": {"experiment_name": "vf", "save_path": str(tmp_path)},
+        "learner": {"batch_size": 8, "unroll_len": 2, "save_freq": 100000, "log_freq": 1},
+        "model": model,
+    }
+    learner = RLLearner(cfg)
+    learner.run(max_iterations=1)
+    assert learner.last_iter.val == 1
+    assert np.isfinite(learner.variable_record.get("total_loss").avg)

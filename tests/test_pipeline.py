@@ -119,3 +119,41 @@ def test_full_rl_loop(tmp_path):
         assert "params" in pub
         latest = max(latest, pub["iter"])
     assert latest >= 1
+
+
+def test_value_feature_flows_through_trajectory(tmp_path):
+    """Actor-side value_feature (centralized critic) reaches the collated
+    learner batch with [T+1, B, ...] layout."""
+    from distar_tpu.actor.agent import Agent, sample_fake_z
+    from distar_tpu.envs import MockEnv
+    from distar_tpu.lib import features as F
+    import jax
+
+    env = MockEnv(episode_game_loops=50, seed=0, include_value_feature=True)
+    obs = env.reset()
+    ag = Agent("MP0", z=sample_fake_z(), traj_len=2)
+    fake_out = {
+        "action_info": F.fake_action_info(),
+        "action_logp": F.fake_action_logp(),
+        "selected_units_num": np.asarray(1),
+        "logit": F.fake_action_logits(),
+    }
+    hidden = tuple((np.zeros(8, np.float32), np.zeros(8, np.float32)) for _ in range(1))
+    teacher = F.fake_action_logits()
+    trajs = []
+    for _ in range(2):
+        traj = None
+        while traj is None:
+            ag.pre_process(obs[0])
+            ag.post_process(fake_out)
+            next_obs, rewards, done, info = env.step({0: fake_out["action_info"], 1: fake_out["action_info"]})
+            traj = ag.collect_data(next_obs[0], rewards[0], done, teacher, hidden)
+            obs = next_obs
+        trajs.append(traj)
+    batch = collate_trajectories(trajs)
+    assert "value_feature" in batch
+    vf = batch["value_feature"]
+    assert vf["own_units_spatial"].shape == (TRAJ_LEN + 1, 2, 152, 160)
+    assert vf["enemy_agent_statistics"].shape == (TRAJ_LEN + 1, 2, 10)
+    # behaviour Z merged in for the critic
+    assert vf["beginning_order"].shape == (TRAJ_LEN + 1, 2, 20)

@@ -1,0 +1,104 @@
+"""Stat tables, race masks, TrueSkill, and ladder job tests."""
+import numpy as np
+import pytest
+
+from distar_tpu.league import League
+from distar_tpu.league.trueskill import TrueSkill
+from distar_tpu.lib.stat import ACTION_RACE_MASK, CUM_DICT, Stat, UNIT_DICT
+
+
+def test_action_race_mask_shapes():
+    for race in ("zerg", "terran", "protoss"):
+        assert race in ACTION_RACE_MASK
+        assert ACTION_RACE_MASK[race].shape == (327,)
+    # per-race legal action counts from the reference data (NB the reference
+    # masks even no_op=False in play mode — preserved verbatim)
+    assert ACTION_RACE_MASK["zerg"].sum() == 112
+    assert ACTION_RACE_MASK["terran"].sum() == 137
+    assert ACTION_RACE_MASK["protoss"].sum() == 128
+
+
+def test_cum_dict_matches_cumulative_slots():
+    from distar_tpu.lib.actions import NUM_CUMULATIVE_STAT_ACTIONS
+
+    assert len(CUM_DICT) == NUM_CUMULATIVE_STAT_ACTIONS
+
+
+def test_stat_tracks_units_and_success():
+    from distar_tpu.lib.actions import ACTIONS, FUNC_ID_TO_ACTION_TYPE
+
+    stat = Stat("zerg")
+    drone_func = 503  # Train_Drone
+    assert drone_func in UNIT_DICT["zerg"]
+    at = FUNC_ID_TO_ACTION_TYPE[drone_func]
+    obs = {
+        "entity_info": {"alliance": np.ones(64, np.int64)},
+        "entity_num": np.asarray(64),
+    }
+    for _ in range(3):
+        stat.update(at, 1, obs, game_step=100)
+    data = stat.get_stat_data()
+    assert data["units/Drone"] == 1.0  # 3/3 == max
+    name = ACTIONS[at]["name"]
+    assert data[f"rate/{name}/count"] == 3
+
+
+def test_trueskill_winner_rises():
+    ts = TrueSkill()
+    for _ in range(20):
+        ts.update("A", "B")
+    assert ts.exposed("A") > ts.exposed("B")
+    lb = ts.leaderboard()
+    assert list(lb)[0] == "A"
+    # sigma shrinks with games
+    assert ts.ratings["A"][1] < 25.0 / 3.0
+
+
+def test_trueskill_draws_converge_means():
+    ts = TrueSkill()
+    for _ in range(30):
+        ts.update("A", "B", draw=True)
+    mu_a, mu_b = ts.ratings["A"][0], ts.ratings["B"][0]
+    assert abs(mu_a - mu_b) < 1.0
+
+
+def test_ladder_job_prefers_underplayed_pairs():
+    cfg = {
+        "league": {
+            "ladder_min_games": 5,
+            "active_players": {
+                "player_id": ["MP0"],
+                "checkpoint_path": ["a.ckpt"],
+                "pipeline": ["default"],
+                "frac_id": [1],
+                "z_path": ["z.json"],
+                "z_prob": [0.0],
+                "teacher_id": ["T"],
+                "teacher_path": ["t.ckpt"],
+                "one_phase_step": [10 ** 9],
+                "chosen_weight": [1.0],
+            },
+            "historical_players": {
+                "player_id": ["HP0", "HP1"],
+                "checkpoint_path": ["h0.ckpt", "h1.ckpt"],
+                "pipeline": ["default"] * 2,
+                "frac_id": [1] * 2,
+                "z_path": ["z.json"] * 2,
+                "z_prob": [0.0] * 2,
+            },
+        }
+    }
+    lg = League(cfg)
+    job = lg.actor_ask_for_job({"job_type": "eval"})
+    assert job["branch"] == "ladder"
+    assert job["send_data_players"] == []
+    assert len(job["player_ids"]) == 2
+    # trueskill ingests eval results
+    lg.actor_send_result(
+        {
+            "game_steps": 10, "game_iters": 1, "game_duration": 1.0,
+            "0": {"player_id": "HP0", "opponent_id": "HP1", "winloss": 1},
+            "1": {"player_id": "HP1", "opponent_id": "HP0", "winloss": -1},
+        }
+    )
+    assert lg.trueskill.game_count == 1

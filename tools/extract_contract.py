@@ -39,10 +39,34 @@ def literal_assignments(path, names):
     return found
 
 
+def stat_tables(path):
+    """Extract unit_dict / cum_dict / action_result_dict literals and the
+    ACTION_RACE_MASK (a dict of torch.tensor([...bool...]) calls) from the
+    reference stat module."""
+    with open(path) as f:
+        tree = ast.parse(f.read())
+    out = {}
+    for node in tree.body:
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1):
+            continue
+        name = getattr(node.targets[0], "id", None)
+        if name in ("unit_dict", "cum_dict", "action_result_dict"):
+            out[name] = ast.literal_eval(node.value)
+        elif name == "ACTION_RACE_MASK":
+            mask = {}
+            for key_node, val_node in zip(node.value.keys, node.value.values):
+                race = ast.literal_eval(key_node)
+                assert isinstance(val_node, ast.Call)  # torch.tensor([...])
+                mask[race] = [bool(x) for x in ast.literal_eval(val_node.args[0])]
+            out["action_race_mask"] = mask
+    return out
+
+
 def main():
     actions = literal_assignments(
         os.path.join(REF, "distar/agent/default/lib/actions.py"), ["ACTIONS"]
     )["ACTIONS"]
+    stat = stat_tables(os.path.join(REF, "distar/agent/default/lib/stat.py"))
     static = literal_assignments(
         os.path.join(REF, "distar/pysc2/lib/static_data.py"),
         [
@@ -66,6 +90,7 @@ def main():
         },
         "actions": actions,
         **{k.lower(): v for k, v in static.items()},
+        **stat,
     }
     os.makedirs(os.path.dirname(OUT), exist_ok=True)
     with open(OUT, "w") as f:
