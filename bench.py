@@ -29,6 +29,8 @@ def main():
             "save_freq": 10 ** 9,
             "log_freq": 10 ** 9,
         },
+        # bfloat16 matmuls/convs on the MXU (params stay f32)
+        "model": {"dtype": "bfloat16"},
     }
     learner = SLLearner(cfg)
 

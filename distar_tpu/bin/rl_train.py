@@ -44,11 +44,30 @@ SMOKE_MODEL = {
 }
 
 
+def _model_cfg(args) -> dict:
+    user_cfg = read_config(args.config) if args.config else {}
+    return user_cfg.get("model", SMOKE_MODEL if args.smoke_model else {})
+
+
+def _learner_cfg(args, model_cfg: dict, load_path: str = "") -> dict:
+    return {
+        "common": {"experiment_name": args.experiment_name},
+        "learner": {
+            "batch_size": args.batch_size,
+            "unroll_len": args.traj_len,
+            "log_freq": max(args.iters // 4, 1),
+            "save_freq": 10 ** 9,
+            **({"load_path": load_path} if load_path else {}),
+        },
+        "model": model_cfg,
+    }
+
+
 def run_all(args) -> None:
     """Single-process league-RL loop on the mock env (the small-scale config
     path; swaps to the real SC2 env behind the same interfaces)."""
     user_cfg = read_config(args.config) if args.config else {}
-    model_cfg = user_cfg.get("model", SMOKE_MODEL if args.smoke_model else {})
+    model_cfg = _model_cfg(args)
     league = League(user_cfg)
     co = Coordinator()
     actor_adapter = Adapter(coordinator=co)
@@ -73,18 +92,7 @@ def run_all(args) -> None:
     t = threading.Thread(target=actor_loop, daemon=True)
     t.start()
 
-    learner = RLLearner(
-        {
-            "common": {"experiment_name": args.experiment_name},
-            "learner": {
-                "batch_size": args.batch_size,
-                "unroll_len": traj_len,
-                "log_freq": max(args.iters // 4, 1),
-                "save_freq": 10 ** 9,
-            },
-            "model": model_cfg,
-        }
-    )
+    learner = RLLearner(_learner_cfg(args, model_cfg))
     learner.set_dataloader(RLDataLoader(learner_adapter, player_id, args.batch_size))
     learner.attach_comm(learner_adapter, player_id, league=league,
                         send_model_freq=4, send_train_info_freq=4)
@@ -95,6 +103,62 @@ def run_all(args) -> None:
         f"loss={learner.variable_record.get('total_loss').avg:.4f}, "
         f"games={league.all_players[player_id].total_game_count}"
     )
+
+
+def _addr(s: str):
+    host, _, port = s.rpartition(":")
+    return host or "127.0.0.1", int(port)
+
+
+def run_learner(args) -> None:
+    """Standalone learner role connecting to remote league + coordinator
+    (reference rl_train.py:19-53 learner_run)."""
+    import os
+
+    from ..league.remote import RemoteLeague
+    from ..parallel.dist import dist_init
+
+    info = dist_init(
+        method=args.dist_method,
+        coordinator_address=args.dist_coordinator_address or None,
+        num_processes=args.dist_num_processes,
+        process_id=args.dist_process_id,
+    )
+    league = RemoteLeague(*_addr(args.league_addr)) if args.league_addr else None
+    adapter = Adapter(coordinator_addr=_addr(args.coordinator_addr))
+    model_cfg = _model_cfg(args)
+    load_path = ""
+    if league is not None:
+        reply = league.register_learner(args.player_id, rank=info["rank"],
+                                        world_size=info["world_size"])
+        # resume from the league-assigned player checkpoint when it exists
+        # (reference learner_run loads the assigned ckpt)
+        ckpt = reply.get("checkpoint_path", "")
+        if ckpt and os.path.exists(ckpt):
+            load_path = ckpt
+    learner = RLLearner(_learner_cfg(args, model_cfg, load_path=load_path))
+    learner.set_dataloader(RLDataLoader(adapter, args.player_id, args.batch_size))
+    learner.attach_comm(adapter, args.player_id, league=league)
+    learner.run(max_iterations=args.iters)
+    print(f"learner done: {learner.last_iter.val} iters")
+
+
+def run_actor(args) -> None:
+    """Standalone actor role (reference rl_train.py:54-67 actor_run)."""
+    from ..league.remote import RemoteLeague
+
+    league = RemoteLeague(*_addr(args.league_addr))
+    adapter = Adapter(coordinator_addr=_addr(args.coordinator_addr))
+    model_cfg = _model_cfg(args)
+    actor = Actor(
+        cfg={"actor": {"env_num": args.env_num, "traj_len": args.traj_len}},
+        league=league,
+        adapter=adapter,
+        model_cfg=model_cfg,
+        env_fn=lambda: MockEnv(episode_game_loops=args.episode_game_loops),
+    )
+    while True:
+        actor.run_job(episodes=1)
 
 
 def main() -> None:
@@ -111,7 +175,25 @@ def main() -> None:
     p.add_argument("--smoke-model", action="store_true", default=True)
     p.add_argument("--full-model", dest="smoke_model", action="store_false")
     p.add_argument("--port", type=int, default=0)
+    p.add_argument("--league-addr", default="", help="host:port of the league server")
+    p.add_argument("--coordinator-addr", default="", help="host:port of the coordinator")
+    p.add_argument("--player-id", default="MP0")
+    p.add_argument("--dist-method", default="single_node",
+                   choices=["auto", "slurm", "single_node", "explicit"])
+    p.add_argument("--dist-coordinator-address", default="",
+                   help="host:port for jax.distributed (explicit mode)")
+    p.add_argument("--dist-num-processes", type=int, default=None)
+    p.add_argument("--dist-process-id", type=int, default=None)
     args = p.parse_args()
+    if args.dist_method == "explicit" and not (
+        args.dist_coordinator_address
+        and args.dist_num_processes is not None
+        and args.dist_process_id is not None
+    ):
+        raise SystemExit(
+            "--dist-method explicit requires --dist-coordinator-address, "
+            "--dist-num-processes and --dist-process-id"
+        )
 
     if args.type == "all":
         run_all(args)
@@ -119,20 +201,23 @@ def main() -> None:
         server = LeagueAPIServer(League(read_config(args.config) if args.config else {}),
                                  port=args.port)
         server.start()
-        print(f"league serving on {server.host}:{server.port}")
+        print(f"league serving on {server.host}:{server.port}", flush=True)
         while True:
             time.sleep(3600)
     elif args.type == "coordinator":
         server = CoordinatorServer(port=args.port)
         server.start()
-        print(f"coordinator serving on {server.host}:{server.port}")
+        print(f"coordinator serving on {server.host}:{server.port}", flush=True)
         while True:
             time.sleep(3600)
-    else:
-        raise SystemExit(
-            f"--type {args.type} requires --league-addr/--coordinator-addr wiring; "
-            "multi-host role launch lands with the DCN deployment tooling"
-        )
+    elif args.type == "learner":
+        if not args.coordinator_addr:
+            raise SystemExit("--type learner requires --coordinator-addr (and usually --league-addr)")
+        run_learner(args)
+    elif args.type == "actor":
+        if not (args.league_addr and args.coordinator_addr):
+            raise SystemExit("--type actor requires --league-addr and --coordinator-addr")
+        run_actor(args)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,59 @@
+"""Remote league proxy: the League surface over HTTP.
+
+Learners and actors on other hosts construct a RemoteLeague with the league
+server's address and use it exactly like an in-process League (the subset of
+methods the worker roles call). Retries with backoff mirror the reference's
+requests retry adapters (reference: distar/ctools/worker/actor/
+actor_comm.py:59-60, adapter.py:56-63).
+"""
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+from .api import league_request
+
+
+class RemoteLeague:
+    def __init__(self, host: str, port: int, retries: int = 5, backoff_s: float = 0.5,
+                 timeout: float = 30.0):
+        self.host, self.port = host, port
+        self._retries = retries
+        self._backoff_s = backoff_s
+        self._timeout = timeout
+
+    def _call(self, route: str, body: dict):
+        err: Optional[Exception] = None
+        for attempt in range(self._retries):
+            try:
+                out = league_request(self.host, self.port, route, body, timeout=self._timeout)
+                if out.get("code") == 0:
+                    return out["info"]
+                raise RuntimeError(f"league {route} error: {out}")
+            except (OSError, ConnectionError) as e:
+                err = e
+                time.sleep(self._backoff_s * (2 ** attempt))
+        raise ConnectionError(f"league {route} unreachable after {self._retries} tries") from err
+
+    # --- the League surface used by workers ---
+    def register_learner(self, player_id: str, ip: str = "", port: int = 0, rank: int = 0,
+                         world_size: int = 1) -> dict:
+        return self._call(
+            "register_learner",
+            {"player_id": player_id, "ip": ip, "port": port, "rank": rank,
+             "world_size": world_size},
+        )
+
+    def learner_send_train_info(self, player_id: str, train_steps: int,
+                                checkpoint_path: Optional[str] = None) -> dict:
+        return self._call(
+            "learner_send_train_info",
+            {"player_id": player_id, "train_steps": train_steps,
+             **({"checkpoint_path": checkpoint_path} if checkpoint_path else {})},
+        )
+
+    def actor_ask_for_job(self, request: Optional[dict] = None) -> dict:
+        return self._call("actor_ask_for_job", request or {"job_type": "train"})
+
+    def actor_send_result(self, result: dict) -> bool:
+        return bool(self._call("actor_send_result", result))

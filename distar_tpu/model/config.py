@@ -52,6 +52,17 @@ def static_cfg(cfg) -> StaticConfig:
     return cfg if isinstance(cfg, StaticConfig) else StaticConfig(cfg)
 
 
+def cdtype(cfg):
+    """Compute dtype from the model config: 'bfloat16' puts every matmul/conv
+    on the MXU's native precision (params stay float32; flax's Dense/Conv
+    dtype= casts inputs+params for compute only)."""
+    import jax.numpy as jnp
+
+    return {"float32": jnp.float32, "bfloat16": jnp.bfloat16}[
+        static_cfg(cfg).get("dtype", "float32")
+    ]
+
+
 def default_model_config() -> Config:
     bo_encoder = {
         "action_num": A.NUM_BEGINNING_ORDER_ACTIONS,  # 174

@@ -33,7 +33,7 @@ from flax import linen as nn
 
 from ..lib.features import MAX_SELECTED_UNITS_NUM
 from ..ops import FCBlock, StackedLSTM, scatter_connection
-from .config import static_cfg
+from .config import cdtype, static_cfg
 from .encoders import EntityEncoder, ScalarEncoder, SpatialEncoder, ValueEncoder
 from .heads import (
     ActionTypeHead,
@@ -53,7 +53,6 @@ class Encoder(nn.Module):
     the map before the spatial conv stack (reference encoder.py:28-45)."""
 
     cfg: dict
-    dtype = jnp.float32
 
     @nn.compact
     def __call__(self, spatial_info, entity_info, scalar_info, entity_num):
@@ -63,7 +62,7 @@ class Encoder(nn.Module):
         entity_embeddings, embedded_entity, entity_mask = EntityEncoder(
             static_cfg(self.cfg), name="entity_encoder"
         )(entity_info, entity_num)
-        proj = FCBlock(static_cfg(self.cfg).encoder.scatter.output_dim, "relu", dtype=self.dtype)(
+        proj = FCBlock(static_cfg(self.cfg).encoder.scatter.output_dim, "relu", dtype=cdtype(self.cfg))(
             entity_embeddings
         )
         proj = proj * entity_mask[..., None]
@@ -89,7 +88,6 @@ class Policy(nn.Module):
     """The six-head autoregressive chain (reference policy.py)."""
 
     cfg: dict
-    dtype = jnp.float32
 
     def setup(self):
         self.action_type_head = ActionTypeHead(static_cfg(self.cfg))
@@ -158,14 +156,14 @@ class Model(nn.Module):
     """Encoder + LSTM core + Policy + value baselines."""
 
     cfg: dict
-    dtype = jnp.float32
 
     def setup(self):
         self.encoder = Encoder(static_cfg(self.cfg))
         self.policy = Policy(static_cfg(self.cfg))
         core = static_cfg(self.cfg).encoder.core_lstm
         self.core_lstm = StackedLSTM(
-            hidden_size=core.hidden_size, num_layers=core.num_layers, norm="LN"
+            hidden_size=core.hidden_size, num_layers=core.num_layers, norm="LN",
+            dtype=cdtype(self.cfg),
         )
         if static_cfg(self.cfg).use_value_network:
             self.value_networks = {
@@ -174,6 +172,7 @@ class Model(nn.Module):
                     res_num=static_cfg(self.cfg).value.res_num,
                     norm_type=static_cfg(self.cfg).value.norm_type,
                     atan=static_cfg(self.cfg).value.baselines[name].atan,
+                    dtype=cdtype(self.cfg),
                     name=f"value_{name}",
                 )
                 for name in static_cfg(self.cfg).enable_baselines

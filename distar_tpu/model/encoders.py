@@ -21,7 +21,7 @@ import jax
 import jax.numpy as jnp
 from flax import linen as nn
 
-from .config import static_cfg
+from .config import cdtype, static_cfg
 from ..ops import (
     Conv2DBlock,
     FCBlock,
@@ -71,7 +71,7 @@ class BeginningBuildOrderEncoder(nn.Module):
     head_dim: int = 8
     output_dim: int = 64
     spatial_x: int = 160
-    dtype = jnp.float32
+    dtype: object = jnp.float32
 
     @nn.compact
     def __call__(self, bo: jnp.ndarray, bo_location: jnp.ndarray):
@@ -102,7 +102,6 @@ class ScalarEncoder(nn.Module):
     time embedding last."""
 
     cfg: dict  # model config Config
-    dtype = jnp.float32
 
     @nn.compact
     def __call__(self, x: Dict[str, jnp.ndarray]):
@@ -113,9 +112,9 @@ class ScalarEncoder(nn.Module):
                 continue
             if arc == "one_hot":
                 v = jnp.clip(x[key].astype(jnp.int32), 0, n - 1)
-                emb = jax.nn.relu(nn.Embed(n, out_dim, dtype=self.dtype, name=f"embed_{key}")(v))
+                emb = jax.nn.relu(nn.Embed(n, out_dim, dtype=cdtype(self.cfg), name=f"embed_{key}")(v))
             elif arc == "fc":
-                emb = FCBlock(out_dim, "relu", dtype=self.dtype, name=f"fc_{key}")(
+                emb = FCBlock(out_dim, "relu", dtype=cdtype(self.cfg), name=f"fc_{key}")(
                     x[key].astype(jnp.float32)
                 )
             elif arc == "bo_transformer":
@@ -125,6 +124,7 @@ class ScalarEncoder(nn.Module):
                     head_dim=sc.bo.head_dim,
                     output_dim=sc.bo.output_dim,
                     spatial_x=static_cfg(self.cfg).spatial_x,
+                    dtype=cdtype(self.cfg),
                     name="bo_encoder",
                 )(x[key].astype(jnp.float32), x["bo_location"].astype(jnp.int32))
             else:
@@ -159,7 +159,6 @@ class SpatialEncoder(nn.Module):
     """
 
     cfg: dict
-    dtype = jnp.float32
 
     @nn.compact
     def __call__(self, x: Dict[str, jnp.ndarray], scatter_map: jnp.ndarray):
@@ -183,17 +182,17 @@ class SpatialEncoder(nn.Module):
                 raise NotImplementedError(arc)
         planes.append(scatter_map)
         h = jnp.concatenate(planes, axis=-1)
-        h = Conv2DBlock(sp.project_dim, 1, 1, "SAME", "relu", dtype=self.dtype)(h)
+        h = Conv2DBlock(sp.project_dim, 1, 1, "SAME", "relu", dtype=cdtype(self.cfg))(h)
         map_skip: List[jnp.ndarray] = []
         for ch in sp.down_channels:
             map_skip.append(h)
             h = nn.max_pool(h, (2, 2), strides=(2, 2))
-            h = Conv2DBlock(ch, 3, 1, "SAME", "relu", dtype=self.dtype)(h)
+            h = Conv2DBlock(ch, 3, 1, "SAME", "relu", dtype=cdtype(self.cfg))(h)
         for _ in range(sp.resblock_num):
             map_skip.append(h)
-            h = ResBlock(h.shape[-1], "relu", dtype=self.dtype)(h)
+            h = ResBlock(h.shape[-1], "relu", dtype=cdtype(self.cfg))(h)
         h = h.reshape(h.shape[0], -1)
-        h = FCBlock(sp.fc_dim, "relu", dtype=self.dtype)(h)
+        h = FCBlock(sp.fc_dim, "relu", dtype=cdtype(self.cfg))(h)
         return h, map_skip
 
 
@@ -203,14 +202,13 @@ class EntityEncoder(nn.Module):
     (role of reference entity_encoder.py:20-96)."""
 
     cfg: dict
-    dtype = jnp.float32
 
     @nn.compact
     def __call__(self, x: Dict[str, jnp.ndarray], entity_num: jnp.ndarray):
         ent = static_cfg(self.cfg).encoder.entity
         width = ent.output_dim
         # field-sum embedding == reference's concat(one-hots) @ W_embed
-        h = _field_sum_embed("ent", ent.fields, x, width, self.dtype)
+        h = _field_sum_embed("ent", ent.fields, x, width, cdtype(self.cfg))
         bias = self.param("ent_embed_bias", nn.initializers.zeros_init(), (width,))
         h = jax.nn.relu(h + bias)
         mask = sequence_mask(entity_num, h.shape[1])
@@ -224,10 +222,10 @@ class EntityEncoder(nn.Module):
                 ent.mlp_num,
                 "relu",
                 ent.ln_type,
-                self.dtype,
+                cdtype(self.cfg),
                 attn_impl=ent.get("attention_impl", "xla"),
             )(h, mask)
-        entity_embeddings = FCBlock(width, "relu", dtype=self.dtype, name="entity_fc")(
+        entity_embeddings = FCBlock(width, "relu", dtype=cdtype(self.cfg), name="entity_fc")(
             jax.nn.relu(h)
         )
         reduce_type = static_cfg(self.cfg).entity_reduce_type
@@ -237,12 +235,12 @@ class EntityEncoder(nn.Module):
         elif reduce_type == "constant":
             pooled = masked.sum(axis=1) / 512.0
         elif reduce_type == "attention_pool":
-            pooled = AttentionPool(head_num=2, output_dim=width, dtype=self.dtype)(
+            pooled = AttentionPool(head_num=2, output_dim=width, dtype=cdtype(self.cfg))(
                 h, mask=mask[..., None]
             )
         else:
             raise NotImplementedError(reduce_type)
-        embedded_entity = FCBlock(width, "relu", dtype=self.dtype, name="embed_fc")(pooled)
+        embedded_entity = FCBlock(width, "relu", dtype=cdtype(self.cfg), name="embed_fc")(pooled)
         return entity_embeddings, embedded_entity, mask
 
 
@@ -257,22 +255,21 @@ class ValueEncoder(nn.Module):
     """
 
     cfg: dict
-    dtype = jnp.float32
 
     @nn.compact
     def __call__(self, x: Dict[str, jnp.ndarray]):
         vc = static_cfg(self.cfg).value.encoder
         fc_parts = [
-            FCBlock(out, "relu", dtype=self.dtype, name=f"fc_{key}")(x[key].astype(jnp.float32))
+            FCBlock(out, "relu", dtype=cdtype(self.cfg), name=f"fc_{key}")(x[key].astype(jnp.float32))
             for key, _in, out in vc.fc_fields
         ]
         unit_emb = None
         for key, n, dim in vc.unit_fields:
-            e = nn.Embed(n, dim, dtype=self.dtype, name=f"embed_{key}")(
+            e = nn.Embed(n, dim, dtype=cdtype(self.cfg), name=f"embed_{key}")(
                 jnp.clip(x[key].astype(jnp.int32), 0, n - 1)
             )
             unit_emb = e if unit_emb is None else jnp.concatenate([unit_emb, e], axis=-1)
-        proj = FCBlock(vc.scatter_dim, "relu", dtype=self.dtype, name="scatter_project")(unit_emb)
+        proj = FCBlock(vc.scatter_dim, "relu", dtype=cdtype(self.cfg), name="scatter_project")(unit_emb)
         unit_mask = sequence_mask(x["total_unit_count"], proj.shape[1])
         proj = proj * unit_mask[..., None]
         loc = jnp.stack([x["unit_x"].astype(jnp.int32), x["unit_y"].astype(jnp.int32)], axis=-1)
@@ -286,13 +283,13 @@ class ValueEncoder(nn.Module):
             ],
             axis=-1,
         )
-        h = Conv2DBlock(vc.spatial.project_dim, 1, 1, "SAME", "relu", dtype=self.dtype)(spatial)
+        h = Conv2DBlock(vc.spatial.project_dim, 1, 1, "SAME", "relu", dtype=cdtype(self.cfg))(spatial)
         for ch in vc.spatial.down_channels:
             h = nn.max_pool(h, (2, 2), strides=(2, 2))
-            h = Conv2DBlock(ch, 3, 1, "SAME", "relu", dtype=self.dtype)(h)
+            h = Conv2DBlock(ch, 3, 1, "SAME", "relu", dtype=cdtype(self.cfg))(h)
         for _ in range(vc.spatial.resblock_num):
-            h = ResBlock(h.shape[-1], "relu", dtype=self.dtype)(h)
-        h = FCBlock(vc.spatial.fc_dim, "relu", dtype=self.dtype, name="spatial_fc")(
+            h = ResBlock(h.shape[-1], "relu", dtype=cdtype(self.cfg))(h)
+        h = FCBlock(vc.spatial.fc_dim, "relu", dtype=cdtype(self.cfg), name="spatial_fc")(
             h.reshape(h.shape[0], -1)
         )
         bo = BeginningBuildOrderEncoder(
@@ -301,6 +298,7 @@ class ValueEncoder(nn.Module):
             head_dim=vc.bo.head_dim,
             output_dim=vc.bo.output_dim,
             spatial_x=static_cfg(self.cfg).spatial_x,
+            dtype=cdtype(self.cfg),
             name="bo_encoder",
         )(x["beginning_order"].astype(jnp.float32), x["bo_location"].astype(jnp.int32))
         return jnp.concatenate(fc_parts + [h, bo], axis=-1)

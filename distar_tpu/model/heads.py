@@ -24,7 +24,7 @@ import jax
 import jax.numpy as jnp
 from flax import linen as nn
 
-from .config import static_cfg
+from .config import cdtype, static_cfg
 from ..lib.features import MAX_ENTITY_NUM, MAX_SELECTED_UNITS_NUM
 from ..ops import GLU, Conv2DBlock, FCBlock, GatedResBlock, ResBlock, ResFCBlock, sequence_mask
 from ..ops.blocks import build_activation
@@ -38,7 +38,6 @@ class ActionTypeHead(nn.Module):
     autoregressive embedding (role of reference action_type_head.py:18-67)."""
 
     cfg: dict
-    dtype = jnp.float32
 
     @nn.compact
     def __call__(
@@ -50,20 +49,22 @@ class ActionTypeHead(nn.Module):
         legal_mask: Optional[jnp.ndarray] = None,
     ):
         hc = static_cfg(self.cfg).policy.action_type_head
-        x = FCBlock(hc.res_dim, "relu", dtype=self.dtype)(lstm_output)
+        x = FCBlock(hc.res_dim, "relu", dtype=cdtype(self.cfg))(lstm_output)
         for _ in range(hc.res_num):
-            x = ResFCBlock(hc.res_dim, "relu", hc.norm_type, dtype=self.dtype)(x)
-        logits = GLU(hc.action_num, dtype=self.dtype, name="action_glu")(x, scalar_context)
-        logits = logits / static_cfg(self.cfg).temperature
+            x = ResFCBlock(hc.res_dim, "relu", hc.norm_type, dtype=cdtype(self.cfg))(x)
+        logits = GLU(hc.action_num, dtype=cdtype(self.cfg), name="action_glu")(x, scalar_context)
+        # logits leave every head in f32: log-prob differences (CE, vtrace
+        # rhos) are too quantized in bf16
+        logits = logits.astype(jnp.float32) / static_cfg(self.cfg).temperature
         if legal_mask is not None:
             logits = jnp.where(legal_mask.astype(bool), logits, NEG_INF)
         if action_type is None:
             action_type = jax.random.categorical(rng, logits, axis=-1)
         one_hot_action = jax.nn.one_hot(action_type, hc.action_num, dtype=jnp.float32)
-        e1 = FCBlock(hc.action_map_dim, "relu", dtype=self.dtype)(one_hot_action)
-        e1 = FCBlock(hc.action_map_dim, None, dtype=self.dtype)(e1)
-        e1 = GLU(hc.gate_dim, dtype=self.dtype, name="glu1")(e1, scalar_context)
-        e2 = GLU(hc.gate_dim, dtype=self.dtype, name="glu2")(lstm_output, scalar_context)
+        e1 = FCBlock(hc.action_map_dim, "relu", dtype=cdtype(self.cfg))(one_hot_action)
+        e1 = FCBlock(hc.action_map_dim, None, dtype=cdtype(self.cfg))(e1)
+        e1 = GLU(hc.gate_dim, dtype=cdtype(self.cfg), name="glu1")(e1, scalar_context)
+        e2 = GLU(hc.gate_dim, dtype=cdtype(self.cfg), name="glu2")(lstm_output, scalar_context)
         return logits, action_type, e1 + e2
 
 
@@ -71,19 +72,18 @@ class DelayHead(nn.Module):
     """128-way delay logits; no temperature (reference action_arg_head.py:27-53)."""
 
     cfg: dict
-    dtype = jnp.float32
 
     @nn.compact
     def __call__(self, embedding, delay=None, rng=None):
         hc = static_cfg(self.cfg).policy.delay_head
-        x = FCBlock(hc.decode_dim, "relu", dtype=self.dtype)(embedding)
-        x = FCBlock(hc.decode_dim, "relu", dtype=self.dtype)(x)
-        logits = FCBlock(hc.delay_dim, None, dtype=self.dtype)(x)
+        x = FCBlock(hc.decode_dim, "relu", dtype=cdtype(self.cfg))(embedding)
+        x = FCBlock(hc.decode_dim, "relu", dtype=cdtype(self.cfg))(x)
+        logits = FCBlock(hc.delay_dim, None, dtype=cdtype(self.cfg))(x).astype(jnp.float32)
         if delay is None:
             delay = jax.random.categorical(rng, logits, axis=-1)
         dh = jax.nn.one_hot(delay, hc.delay_dim, dtype=jnp.float32)
-        e = FCBlock(hc.delay_map_dim, "relu", dtype=self.dtype)(dh)
-        e = FCBlock(embedding.shape[-1], None, dtype=self.dtype)(e)
+        e = FCBlock(hc.delay_map_dim, "relu", dtype=cdtype(self.cfg))(dh)
+        e = FCBlock(embedding.shape[-1], None, dtype=cdtype(self.cfg))(e)
         return logits, delay, embedding + e
 
 
@@ -91,19 +91,20 @@ class QueuedHead(nn.Module):
     """Binary queued flag (reference action_arg_head.py:56-86)."""
 
     cfg: dict
-    dtype = jnp.float32
 
     @nn.compact
     def __call__(self, embedding, queued=None, rng=None):
         hc = static_cfg(self.cfg).policy.queued_head
-        x = FCBlock(hc.decode_dim, "relu", dtype=self.dtype)(embedding)
-        x = FCBlock(hc.decode_dim, "relu", dtype=self.dtype)(x)
-        logits = FCBlock(hc.queued_dim, None, dtype=self.dtype)(x) / static_cfg(self.cfg).temperature
+        x = FCBlock(hc.decode_dim, "relu", dtype=cdtype(self.cfg))(embedding)
+        x = FCBlock(hc.decode_dim, "relu", dtype=cdtype(self.cfg))(x)
+        logits = FCBlock(hc.queued_dim, None, dtype=cdtype(self.cfg))(x).astype(
+            jnp.float32
+        ) / static_cfg(self.cfg).temperature
         if queued is None:
             queued = jax.random.categorical(rng, logits, axis=-1)
         qh = jax.nn.one_hot(queued, hc.queued_dim, dtype=jnp.float32)
-        e = FCBlock(hc.queued_map_dim, "relu", dtype=self.dtype)(qh)
-        e = FCBlock(embedding.shape[-1], None, dtype=self.dtype)(e)
+        e = FCBlock(hc.queued_map_dim, "relu", dtype=cdtype(self.cfg))(qh)
+        e = FCBlock(embedding.shape[-1], None, dtype=cdtype(self.cfg))(e)
         return logits, queued, embedding + e
 
 
@@ -118,20 +119,19 @@ class SelectedUnitsHead(nn.Module):
     """
 
     cfg: dict
-    dtype = jnp.float32
 
     def setup(self):
         hc = static_cfg(self.cfg).policy.selected_units_head
         # the query LSTM's output dots against the keys, so widths must match
         assert hc.hidden_dim == hc.key_dim, "selected_units_head: hidden_dim must equal key_dim"
-        self.key_fc = FCBlock(hc.key_dim, None, dtype=self.dtype, name="key_fc")
-        self.query_fc1 = FCBlock(hc.func_dim, "relu", dtype=self.dtype, name="query_fc1")
-        self.query_fc2 = FCBlock(hc.key_dim, None, dtype=self.dtype, name="query_fc2")
-        self.embed_fc1 = FCBlock(hc.func_dim, "relu", dtype=self.dtype, name="embed_fc1")
+        self.key_fc = FCBlock(hc.key_dim, None, dtype=cdtype(self.cfg), name="key_fc")
+        self.query_fc1 = FCBlock(hc.func_dim, "relu", dtype=cdtype(self.cfg), name="query_fc1")
+        self.query_fc2 = FCBlock(hc.key_dim, None, dtype=cdtype(self.cfg), name="query_fc2")
+        self.embed_fc1 = FCBlock(hc.func_dim, "relu", dtype=cdtype(self.cfg), name="embed_fc1")
         self.embed_fc2 = FCBlock(
-            static_cfg(self.cfg).policy.action_type_head.gate_dim, None, dtype=self.dtype, name="embed_fc2"
+            static_cfg(self.cfg).policy.action_type_head.gate_dim, None, dtype=cdtype(self.cfg), name="embed_fc2"
         )
-        self.lstm = PlainLSTMCell(hc.hidden_dim, dtype=self.dtype, name="lstm")
+        self.lstm = PlainLSTMCell(hc.hidden_dim, dtype=cdtype(self.cfg), name="lstm")
         self.end_embedding = self.param(
             "end_embedding", nn.initializers.uniform(scale=2.0 / (32 ** 0.5)), (hc.key_dim,)
         )
@@ -160,7 +160,7 @@ class SelectedUnitsHead(nn.Module):
         N1 = key.shape[1]
         q = self.query_fc2(self.query_fc1(carry["ae"]))
         out, lstm_state = self.lstm(q, carry["lstm_state"])
-        logits = (out[:, None, :] * key).sum(-1)  # B, N+1
+        logits = (out[:, None, :] * key).sum(-1).astype(jnp.float32)  # B, N+1
         logits = jnp.where(carry["logit_mask"], logits, NEG_INF) / temperature
         result = result_fn(logits)
         picked_end = result == entity_num
@@ -216,7 +216,7 @@ class SelectedUnitsHead(nn.Module):
         S = MAX_SELECTED_UNITS_NUM
         key, valid = self._keys(entity_embedding, entity_num)
         base_ae = embedding
-        h0 = jnp.zeros((B, hc.hidden_dim), self.dtype)
+        h0 = jnp.zeros((B, hc.hidden_dim), jnp.float32)  # carry stays f32
         init_mask = valid & (jnp.arange(N + 1)[None, :] != entity_num[:, None])  # end off at step 0
 
         train = selected_units is not None
@@ -285,15 +285,14 @@ class TargetUnitHead(nn.Module):
     """Key-query attention over entities (reference action_arg_head.py:331-363)."""
 
     cfg: dict
-    dtype = jnp.float32
 
     @nn.compact
     def __call__(self, embedding, entity_embedding, entity_num, target_unit=None, rng=None):
         hc = static_cfg(self.cfg).policy.target_unit_head
-        key = FCBlock(hc.key_dim, None, dtype=self.dtype)(entity_embedding)
-        q = FCBlock(hc.key_dim, "relu", dtype=self.dtype)(embedding)
-        q = FCBlock(hc.key_dim, None, dtype=self.dtype)(q)
-        logits = (q[:, None, :] * key).sum(-1)
+        key = FCBlock(hc.key_dim, None, dtype=cdtype(self.cfg))(entity_embedding)
+        q = FCBlock(hc.key_dim, "relu", dtype=cdtype(self.cfg))(embedding)
+        q = FCBlock(hc.key_dim, None, dtype=cdtype(self.cfg))(q)
+        logits = (q[:, None, :] * key).sum(-1).astype(jnp.float32)
         mask = sequence_mask(entity_num, entity_embedding.shape[1])
         logits = jnp.where(mask, logits, NEG_INF) / static_cfg(self.cfg).temperature
         if target_unit is None:
@@ -306,29 +305,28 @@ class LocationHead(nn.Module):
     (reference action_arg_head.py:366-450; gate=True, film/unet off)."""
 
     cfg: dict
-    dtype = jnp.float32
 
     @nn.compact
     def __call__(self, embedding, map_skip: List[jnp.ndarray], location=None, rng=None):
         hc = static_cfg(self.cfg).policy.location_head
         H8, W8 = static_cfg(self.cfg).spatial_y // 8, static_cfg(self.cfg).spatial_x // 8
-        proj = FCBlock(H8 * W8 * hc.reshape_channel, "relu", dtype=self.dtype)(embedding)
+        proj = FCBlock(H8 * W8 * hc.reshape_channel, "relu", dtype=cdtype(self.cfg))(embedding)
         proj = proj.reshape(-1, H8, W8, hc.reshape_channel)
         x = jnp.concatenate([proj, map_skip[-1]], axis=-1)
         x = jax.nn.relu(x)
-        x = Conv2DBlock(hc.res_dim, 1, 1, "SAME", "relu", dtype=self.dtype)(x)
+        x = Conv2DBlock(hc.res_dim, 1, 1, "SAME", "relu", dtype=cdtype(self.cfg))(x)
         for i in range(hc.res_num):
             x = x + map_skip[len(map_skip) - i - 1]
             if hc.gate:
-                x = GatedResBlock(hc.res_dim, "relu", dtype=self.dtype)(x, x)
+                x = GatedResBlock(hc.res_dim, "relu", dtype=cdtype(self.cfg))(x, x)
             else:
-                x = ResBlock(hc.res_dim, "relu", dtype=self.dtype)(x)
+                x = ResBlock(hc.res_dim, "relu", dtype=cdtype(self.cfg))(x)
         for i, ch in enumerate(hc.upsample_dims):
             B, h, w, c = x.shape
             x = jax.image.resize(x, (B, h * 2, w * 2, c), "bilinear")
             act = "relu" if i < len(hc.upsample_dims) - 1 else None
-            x = Conv2DBlock(ch, 3, 1, "SAME", act, dtype=self.dtype)(x)
-        logits = x.reshape(x.shape[0], -1) / static_cfg(self.cfg).temperature
+            x = Conv2DBlock(ch, 3, 1, "SAME", act, dtype=cdtype(self.cfg))(x)
+        logits = x.reshape(x.shape[0], -1).astype(jnp.float32) / static_cfg(self.cfg).temperature
         if location is None:
             location = jax.random.categorical(rng, logits, axis=-1)
         return logits, location

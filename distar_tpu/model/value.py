@@ -16,7 +16,7 @@ class ValueBaseline(nn.Module):
     res_num: int = 16
     norm_type: str = "LN"
     atan: bool = False
-    dtype = jnp.float32
+    dtype: object = jnp.float32
 
     @nn.compact
     def __call__(self, x):
@@ -28,7 +28,7 @@ class ValueBaseline(nn.Module):
             dtype=self.dtype,
             kernel_init=nn.initializers.variance_scaling(0.01, "fan_in", "truncated_normal"),
         )(x)
-        v = v[..., 0]
+        v = v[..., 0].astype(jnp.float32)
         if self.atan:
             v = (2.0 / PI) * jnp.arctan((PI / 2.0) * v)
         return v

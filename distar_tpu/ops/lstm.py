@@ -35,6 +35,10 @@ class PlainLSTMCell(nn.Module):
         i, f, g, o = jnp.split(gates, 4, axis=-1)
         c_new = jax.nn.sigmoid(f) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
         h_new = jax.nn.sigmoid(o) * jnp.tanh(c_new)
+        # recurrent state stays in the carry's dtype (f32 under mixed
+        # precision) so scan carries type-check and accumulation is stable
+        h_new = h_new.astype(h.dtype)
+        c_new = c_new.astype(c.dtype)
         return h_new, (h_new, c_new)
 
 
@@ -61,6 +65,8 @@ class LayerNormLSTMCell(nn.Module):
             jax.nn.sigmoid(f) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
         )
         h_new = jax.nn.sigmoid(o) * jnp.tanh(c_new)
+        h_new = h_new.astype(h.dtype)
+        c_new = c_new.astype(c.dtype)
         return h_new, (h_new, c_new)
 
 
@@ -84,7 +90,8 @@ class StackedLSTM(nn.Module):
         ]
 
     def init_state(self, batch_size: int) -> Tuple[LSTMState, ...]:
-        z = jnp.zeros((batch_size, self.hidden_size), dtype=self.dtype)
+        # carry in f32 regardless of compute dtype (accumulation stability)
+        z = jnp.zeros((batch_size, self.hidden_size), dtype=jnp.float32)
         return tuple((z, z) for _ in range(self.num_layers))
 
     def _step(self, states, x):

@@ -1,0 +1,55 @@
+"""Multi-host runtime initialisation.
+
+Role of the reference dist_init (reference: distar/ctools/utils/
+dist_helper.py:321-344 — NCCL process-group setup with SLURM / single-node /
+torch env discovery): on TPU pods the analogue is jax.distributed.initialize,
+after which every host sees the global device set and pjit programs run SPMD
+with gradient collectives over ICI/DCN scheduled by XLA. Env discovery covers
+SLURM (SLURM_PROCID/SLURM_NTASKS, dist_helper.py:329-334), TPU-VM metadata
+(jax's own autodetection), and explicit addresses.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+
+def dist_init(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+    method: str = "auto",  # auto | slurm | single_node | explicit
+) -> dict:
+    """Initialise the multi-host jax runtime; returns rank/world_size info.
+
+    single_node is a no-op (one process owns all local devices). On Cloud
+    TPU VMs 'auto' lets jax autodetect the pod topology from metadata.
+    """
+    import jax
+
+    if method == "single_node":
+        return {"rank": 0, "world_size": 1}
+    if method == "slurm" or (method == "auto" and "SLURM_PROCID" in os.environ):
+        process_id = int(os.environ["SLURM_PROCID"])
+        num_processes = int(os.environ["SLURM_NTASKS"])
+        if coordinator_address is None:
+            nodelist = os.environ.get("SLURM_STEP_NODELIST", "localhost")
+            head = nodelist.split(",")[0].split("[")[0]
+            coordinator_address = f"{head}:12355"
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    elif method == "explicit":
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    else:  # auto on TPU VMs: jax reads the pod metadata itself
+        try:
+            jax.distributed.initialize()
+        except Exception:
+            return {"rank": 0, "world_size": 1}
+    return {"rank": jax.process_index(), "world_size": jax.process_count()}

@@ -185,3 +185,23 @@ def test_teacher_and_sl_forward(small_cfg, model_and_params):
     )
     assert logits["action_type"].shape == (T, 327)
     assert len(state) == small_cfg.encoder.core_lstm.num_layers
+
+
+def test_bfloat16_compute_dtype(small_cfg, model_and_params):
+    """cfg.dtype='bfloat16' must produce finite float32 outputs (params stay
+    f32; matmuls/convs compute in bf16 on the MXU)."""
+    model, params = model_and_params
+    from distar_tpu.utils import deep_merge_dicts
+
+    bf_cfg = deep_merge_dicts(small_cfg, {"dtype": "bfloat16"})
+    bf_model = Model(bf_cfg)
+    data = _batch_obs(B)
+    out = bf_model.apply(
+        params, data["spatial_info"], data["entity_info"], data["scalar_info"],
+        data["entity_num"], _hidden(small_cfg, B), jax.random.PRNGKey(5),
+        method=bf_model.sample_action,
+    )
+    for k, v in out["logit"].items():
+        assert np.isfinite(np.asarray(v, dtype=np.float32)).all(), k
+    # params remain float32
+    assert jax.tree.leaves(params)[0].dtype == jnp.float32

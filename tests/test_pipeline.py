@@ -157,3 +157,39 @@ def test_value_feature_flows_through_trajectory(tmp_path):
     assert vf["enemy_agent_statistics"].shape == (TRAJ_LEN + 1, 2, 10)
     # behaviour Z merged in for the critic
     assert vf["beginning_order"].shape == (TRAJ_LEN + 1, 2, 20)
+
+
+@pytest.mark.slow
+def test_remote_roles_over_http(tmp_path):
+    """League + coordinator as HTTP servers; actor and learner connect via
+    RemoteLeague/Adapter addresses (the multi-host role path)."""
+    from distar_tpu.comm import CoordinatorServer
+    from distar_tpu.league import LeagueAPIServer
+    from distar_tpu.league.remote import RemoteLeague
+
+    league_server = LeagueAPIServer(League(LEAGUE_CFG))
+    league_server.start()
+    co_server = CoordinatorServer()
+    co_server.start()
+    try:
+        remote = RemoteLeague(league_server.host, league_server.port)
+        info = remote.register_learner("MP0", rank=0, world_size=1)
+        assert info["checkpoint_path"] == "mp0.ckpt"
+
+        actor = Actor(
+            cfg={"actor": {"env_num": 1, "traj_len": TRAJ_LEN, "seed": 9}},
+            league=remote,
+            adapter=Adapter(coordinator_addr=(co_server.host, co_server.port)),
+            model_cfg=SMALL_MODEL,
+            env_fn=lambda: MockEnv(episode_game_loops=120, seed=4),
+        )
+        actor.run_job(episodes=1)
+
+        learner_adapter = Adapter(coordinator_addr=(co_server.host, co_server.port))
+        traj = learner_adapter.pull("MP0traj", timeout=30)
+        assert len(traj) == TRAJ_LEN + 1
+        reply = remote.learner_send_train_info("MP0", train_steps=10)
+        assert isinstance(reply, dict)
+    finally:
+        league_server.stop()
+        co_server.stop()
