@@ -20,7 +20,10 @@ def main():
 
     BASELINE_FRAMES_PER_SEC_PER_CHIP = 384.0  # A100, reference large-scale SL
 
-    batch_size, unroll_len = 4, 16
+    import os
+
+    batch_size = int(os.environ.get("BENCH_BATCH", 4))
+    unroll_len = int(os.environ.get("BENCH_UNROLL", 16))
     cfg = {
         "common": {"experiment_name": "bench_sl"},
         "learner": {

@@ -94,11 +94,45 @@ class Actor:
     def _load_player_params(self, player_id: str):
         """Fresh weights from the learner when published, else initial."""
         if self.adapter is not None:
-            data = self.adapter.pull(f"{player_id}model", block=False)
+            data = self._pull_latest_model(player_id)
             if data is not None:
                 self._model_iters[player_id] = data.get("iter", 0)
                 return jax.tree.map(np.asarray, data["params"])
         return self._initial_params()
+
+    def _pull_latest_model(self, player_id: str):
+        """Drain the FIFO plane to the freshest publication (non-blocking).
+        reset_flag ORs across everything drained — exactly one publication
+        carries it and it must not be lost to a newer one."""
+        latest, reset_seen = None, False
+        while True:
+            data = self.adapter.pull(f"{player_id}model", block=False)
+            if data is None:
+                if latest is not None and reset_seen:
+                    latest = dict(latest, reset_flag=True)
+                return latest
+            reset_seen = reset_seen or bool(data.get("reset_flag", False))
+            if latest is None or data.get("iter", 0) >= latest.get("iter", 0):
+                latest = data
+
+    def _refresh_models(self, job, player_ids, infer, params) -> bool:
+        """Periodic weight hot-reload for update_players (the
+        freshness-critical path, reference actor_comm.py:172-216: actors pull
+        every ~10s; a learner-sent reset_flag additionally restarts
+        episodes). Returns True when a reset was requested."""
+        reset = False
+        for side, pid in enumerate(infer):
+            player = player_ids[side]
+            if player not in job.get("update_players", []):
+                continue
+            data = self._pull_latest_model(player)
+            if data is not None and data.get("iter", -1) > self._model_iters.get(player, -1):
+                new_params = jax.tree.map(np.asarray, data["params"])
+                params[player] = new_params
+                infer[side].params = new_params
+                self._model_iters[player] = data.get("iter", 0)
+                reset = reset or bool(data.get("reset_flag", False))
+        return reset
 
     # ------------------------------------------------------------------- run
     def run_job(self, episodes: Optional[int] = None) -> List[dict]:
@@ -144,9 +178,34 @@ class Actor:
             (e, side): infer[side].hidden_for_slot(e) for e in range(n_env) for side in (0, 1)
         }
 
+        def reset_slot(e: int) -> dict:
+            """Restart env slot e: fresh episode, fresh Z, zeroed policy and
+            teacher LSTM carries (shared by episode-end and league-reset)."""
+            new_obs = envs[e].reset()
+            for side in (0, 1):
+                agents[(e, side)].reset(z=sample_fake_z(self._rng))
+                infer[side].reset_slot(e)
+                teacher_hidden[side] = tuple(
+                    (h.at[e].set(0.0), c.at[e].set(0.0))
+                    for h, c in teacher_hidden[side]
+                )
+                hidden_backup[(e, side)] = infer[side].hidden_for_slot(e)
+            return new_obs
+
         obs = {e: envs[e].reset() for e in range(n_env)}
         episodes_done, results = 0, []
+        last_model_refresh = time.time()
         while episodes_done < episodes:
+            if time.time() - last_model_refresh > self.cfg.model_update_interval_s:
+                last_model_refresh = time.time()
+                refreshed = self._refresh_models(job, player_ids, infer, params)
+                for ag in agents.values():
+                    ag.model_last_iter = self._model_iters.get(ag.player_id, 0)
+                if refreshed:
+                    # league-triggered reset: restart every episode with the
+                    # fresh checkpoint (reference actor.py:321-323)
+                    for e in range(n_env):
+                        obs[e] = reset_slot(e)
             env_actions: Dict[int, dict] = {e: {} for e in range(n_env)}
             prepared_by_side: Dict[int, list] = {}
             outputs_by_side: Dict[int, list] = {}
@@ -188,30 +247,24 @@ class Actor:
                         "game_steps": info.get("game_loop", 0),
                         "game_iters": 0,
                         "game_duration": 0.0,
-                        "0": {
-                            "player_id": player_ids[0],
-                            "opponent_id": player_ids[1],
-                            "winloss": int(rewards[0]),
-                        },
-                        "1": {
-                            "player_id": player_ids[1],
-                            "opponent_id": player_ids[0],
-                            "winloss": int(rewards[1]),
-                        },
                     }
+                    from ..league.player import FRAC_ID
+
+                    frac_ids = job.get("frac_ids", [1, 1])
+                    for side in (0, 1):
+                        ag = agents[(e, side)]
+                        frac = frac_ids[side] if side < len(frac_ids) else 1
+                        result[str(side)] = {
+                            "player_id": player_ids[side],
+                            "opponent_id": player_ids[1 - side],
+                            "winloss": int(rewards[side]),
+                            "race": FRAC_ID.get(frac, ["zerg"])[0],
+                            **ag.episode_stats(),
+                        }
                     results.append(result)
                     if self.league is not None:
                         self.league.actor_send_result(result)
-                    obs[e] = envs[e].reset()
-                    for side in (0, 1):
-                        agents[(e, side)].reset(z=sample_fake_z(self._rng))
-                        infer[side].reset_slot(e)
-                        # the teacher's LSTM carry is per-episode too
-                        teacher_hidden[side] = tuple(
-                            (h.at[e].set(0.0), c.at[e].set(0.0))
-                            for h, c in teacher_hidden[side]
-                        )
-                        hidden_backup[(e, side)] = infer[side].hidden_for_slot(e)
+                    obs[e] = reset_slot(e)
                 else:
                     obs[e] = next_obs
         for env in envs:

@@ -127,11 +127,17 @@ class Agent:
             / self._cum_norm
         )
         self._bo_zergling_count = 0
+        self._total_bo_reward = 0.0
+        self._total_cum_reward = 0.0
+        self._total_battle_reward = 0.0
         self._exceed_flag = True
         self._last_action = {k: 0 for k in F.ACTION_HEADS}
         self._battle_score = 0.0
         self._opponent_battle_score = 0.0
         self._game_step = 0
+        from ..lib.stat import Stat
+
+        self._stat = Stat(self._z.get("race", "zerg"))
         self._data_buffer: deque = deque()
         self._observation: Optional[dict] = None
         self._value_feature: Optional[dict] = None
@@ -252,7 +258,30 @@ class Agent:
             )
             cum_reward = (new_cum - self._old_cum_reward) * time_decay_factor(self._game_step)
             self._old_cum_reward = new_cum
+        self._total_bo_reward += bo_reward
+        self._total_cum_reward += cum_reward
+        self._total_battle_reward += battle_reward
         return {"build_order": bo_reward, "built_unit": cum_reward, "battle": battle_reward}
+
+    def episode_stats(self) -> dict:
+        """Per-episode summary for league stat meters (reference result_info:
+        distances + reward totals + behaviour cum stats)."""
+        from ..ops.metric import hamming_distance as _hd, levenshtein_distance as _ld
+
+        return {
+            "bo_distance": _ld(
+                np.asarray(self._behaviour_building_order),
+                np.asarray(self._target_building_order),
+            ),
+            "cum_distance": _hd(
+                self._behaviour_cumulative_stat, self._target_cumulative_stat
+            ),
+            "bo_reward_total": self._total_bo_reward,
+            "cum_reward_total": self._total_cum_reward,
+            "battle_reward_total": self._total_battle_reward,
+            "cumulative_stat": (self._behaviour_cumulative_stat > 0).astype(int).tolist(),
+            "unit_num": self._stat.unit_num,
+        }
 
     def get_behavior_z(self) -> dict:
         pad = F.BEGINNING_ORDER_LENGTH - len(self._behaviour_building_order)
@@ -277,6 +306,12 @@ class Agent:
         pseudo = self.update_fake_reward(next_obs or {})
         a = self._output["action_info"]
         action_type = int(np.asarray(a["action_type"]).reshape(-1)[0])
+        self._stat.update(
+            action_type,
+            1 if (next_obs or {}).get("action_result", [1])[0] == 1 else 0,
+            self._observation,
+            self._game_step,
+        )
         spec = ACT.ACTIONS[action_type]
         mask = {
             "actions_mask": {

@@ -98,6 +98,9 @@ def run_all(args) -> None:
                         send_model_freq=4, send_train_info_freq=4)
     learner.run(max_iterations=args.iters)
     stop.set()
+    # let the actor finish its in-flight job: a daemon thread killed inside a
+    # jitted computation aborts the interpreter teardown
+    t.join(timeout=120)
     print(
         f"rl_train done: {learner.last_iter.val} iters, "
         f"loss={learner.variable_record.get('total_loss').avg:.4f}, "

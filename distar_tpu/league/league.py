@@ -346,6 +346,12 @@ class League:
                         {"winrate": (1 + side["winloss"]) / 2, **game_stats},
                     )
                 player.total_game_count += 1
+                race = side.get("race", "unknown")
+                if isinstance(player, ActivePlayer) and race != "unknown":
+                    stats = {**side, "game_steps": game_stats["game_steps"]}
+                    player.dist_stat.update_from_result(race, stats)
+                    player.cum_stat.update_from_result(race, stats)
+                    player.unit_num_stat.update_from_result(race, stats)
             first = sides.get("0") or next(iter(sides.values()), None)
             if first is not None and first["player_id"] != first["opponent_id"]:
                 wl = int(first["winloss"])
@@ -380,4 +386,13 @@ class League:
         self.historical_players = data["historical_players"]
         self.elo = data["elo"]
         self.trueskill = data.get("trueskill", TrueSkill())
+        # backfill attributes absent from older resume pickles (unpickling
+        # skips __init__)
+        from .stat_meters import CumStat, DistStat, UnitNumStat
+
+        for player in self.active_players.values():
+            if not hasattr(player, "dist_stat"):
+                player.dist_stat = DistStat(player.decay, player.warm_up_size)
+                player.cum_stat = CumStat(player.decay, player.warm_up_size)
+                player.unit_num_stat = UnitNumStat(player.decay, player.warm_up_size)
         self._log(f"league resumed from {path}")

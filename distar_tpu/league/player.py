@@ -110,6 +110,11 @@ class ActivePlayer(Player):
         self.last_successive_step = last_enough_step
         self.teammate_payoff = Payoff(self.decay, self.warm_up_size, self.min_win_rate_games)
         self.opponent_payoff = Payoff(self.decay, self.warm_up_size, self.min_win_rate_games)
+        from .stat_meters import CumStat, DistStat, UnitNumStat
+
+        self.dist_stat = DistStat(self.decay, self.warm_up_size)
+        self.cum_stat = CumStat(self.decay, self.warm_up_size)
+        self.unit_num_stat = UnitNumStat(self.decay, self.warm_up_size)
 
     # ------------------------------------------------------------- helpers
     def _non_bot_history(self, historical: Dict[str, HistoricalPlayer], include_bots: bool):

@@ -1,0 +1,94 @@
+"""Per-race strategy/distance/unit-count EMA meters for league TB logging.
+
+Role parity with the reference's league stat trio (reference: distar/ctools/
+worker/league/cum_stat.py, dist_stat.py, unit_num_stat.py — per-race EMA
+grids updated from each game result and dumped to TensorBoard):
+
+* DistStat     — pseudo-reward distances (bo/cum distance, battle totals)
+* CumStat      — cumulative-stat slot frequencies (what the agent built)
+* UnitNumStat  — built-unit-count averages
+
+All keyed race -> metric; fed from the per-side result dicts the actor sends
+(league.actor_send_result), rendered via get_text()/stat_info_dict.
+"""
+from __future__ import annotations
+
+from collections import defaultdict
+from functools import partial
+from typing import Dict
+
+from .stats import EmaMeter
+
+
+def _meter_dict(decay: float, warm_up_size: int):
+    return defaultdict(partial(EmaMeter, decay, warm_up_size))
+
+
+class RaceMeterGrid:
+    """race -> metric-name -> EmaMeter."""
+
+    def __init__(self, decay: float = 0.995, warm_up_size: int = 1000):
+        self._decay = decay
+        self._warm_up = warm_up_size
+        self._grid: Dict[str, Dict[str, EmaMeter]] = defaultdict(
+            partial(_meter_dict, decay, warm_up_size)
+        )
+        self.game_count: Dict[str, int] = defaultdict(int)
+
+    def update(self, race: str, info: Dict[str, float]) -> None:
+        self.game_count[race] += 1
+        for k, v in info.items():
+            try:
+                self._grid[race][k].update(float(v))
+            except (TypeError, ValueError):
+                continue
+
+    @property
+    def stat_info_dict(self) -> Dict[str, Dict[str, float]]:
+        return {
+            race: {k: m.val for k, m in metrics.items()}
+            for race, metrics in self._grid.items()
+        }
+
+    def get_text(self) -> str:
+        rows = []
+        for race, metrics in sorted(self._grid.items()):
+            for k, m in sorted(metrics.items()):
+                rows.append(f"{race:<10s} {k:<40s} {m.val:>10.4f} ({m.count})")
+        return "\n".join(rows) if rows else "(empty)"
+
+
+class DistStat(RaceMeterGrid):
+    """Consumes keys: bo_distance, cum_distance, battle_reward_total,
+    bo_reward_total, cum_reward_total (when present in the result info)."""
+
+    KEYS = ("bo_distance", "cum_distance", "battle_reward_total",
+            "bo_reward_total", "cum_reward_total", "game_steps")
+
+    def update_from_result(self, race: str, side_info: Dict) -> None:
+        self.update(race, {k: side_info[k] for k in self.KEYS if k in side_info})
+
+
+class CumStat(RaceMeterGrid):
+    """Cumulative-stat slot frequencies, keyed by slot name (lib.stat.CUM_DICT)."""
+
+    def update_from_result(self, race: str, side_info: Dict) -> None:
+        cum = side_info.get("cumulative_stat")
+        if cum is None:
+            return
+        from ..lib.stat import CUM_DICT
+
+        info = {}
+        for slot, active in enumerate(cum):
+            if active and slot < len(CUM_DICT):
+                info[str(CUM_DICT[slot])] = 1.0
+        self.update(race, info)
+
+
+class UnitNumStat(RaceMeterGrid):
+    """Built-unit-count averages, keyed by unit name."""
+
+    def update_from_result(self, race: str, side_info: Dict) -> None:
+        units = side_info.get("unit_num")
+        if units:
+            self.update(race, {f"unit_num/{k}": v for k, v in units.items()})

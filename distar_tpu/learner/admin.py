@@ -1,0 +1,79 @@
+"""Live learner admin API.
+
+Role parity with the reference RL learner's runtime HTTP endpoints
+(reference: distar/agent/default/rl_learner.py:203-287 — re-read user config,
+reset value networks, rebuild comm, all applied between train iterations):
+the server only sets flags/payloads; the learner applies them at the next
+iteration boundary (jit caches and donated buffers make mid-step mutation
+unsafe, so the boundary is the only correct application point).
+
+POST /learner/<update_config|reset_value|save_ckpt|status>
+"""
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+
+class LearnerAdminServer:
+    def __init__(self, learner, host: str = "127.0.0.1", port: int = 0):
+        self.learner = learner
+
+        def routes(name: str, body: dict):
+            if name == "update_config":
+                learner.request_update_config(body.get("config", {}))
+                return "queued"
+            if name == "reset_value":
+                learner.request_value_reset()
+                return "queued"
+            if name == "save_ckpt":
+                # deferred like the rest: saving mid-iteration races the
+                # donated train-step buffers
+                learner.request_save()
+                return "queued"
+            if name == "status":
+                return {
+                    "last_iter": learner.last_iter.val,
+                    "meters": {
+                        k: m.avg for k, m in learner.variable_record.vars().items()
+                    },
+                }
+            return None
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):
+                pass
+
+            def do_POST(self):
+                name = self.path.strip("/").split("/")[-1]
+                length = int(self.headers.get("Content-Length", 0))
+                try:
+                    body = json.loads(self.rfile.read(length) or b"{}")
+                    info = routes(name, body)
+                    payload = (
+                        {"code": 404, "info": f"no route {name}"}
+                        if info is None
+                        else {"code": 0, "info": info}
+                    )
+                except Exception as e:
+                    payload = {"code": 1, "info": repr(e)}
+                data = json.dumps(payload, default=str).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self.host, self.port = self._server.server_address
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self._server.serve_forever, daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        self._server.shutdown()
+        self._server.server_close()

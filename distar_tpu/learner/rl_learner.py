@@ -167,8 +167,7 @@ class RLLearner(BaseLearner):
 
         batch = jax.tree.map(jnp.asarray, batch)
         vf = batch.get("value_feature")
-        params = jax.jit(init_fn)(
-            jax.random.PRNGKey(0),
+        init_args = (
             *(_flatten_time(batch[k]) for k in ("spatial_info", "entity_info", "scalar_info")),
             batch["entity_num"].reshape(-1),
             batch["hidden_state"],
@@ -176,6 +175,20 @@ class RLLearner(BaseLearner):
             batch["selected_units_num"],
             _flatten_time(vf) if vf is not None else None,
         )
+        jitted_init = jax.jit(init_fn)
+        # for admin-triggered value resets: keep only shape/dtype specs (not
+        # the batch itself — that would pin it in HBM for the whole run)
+        init_specs = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), init_args
+        )
+
+        def _reinit(rng):
+            dummy = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), init_specs)
+            return jitted_init(rng, *dummy)
+
+        self._init_params = _reinit
+        params = jitted_init(jax.random.PRNGKey(0), *init_args)
+        del init_args
         repl = NamedSharding(self.mesh, P())
         params = jax.device_put(params, repl)
         self._state = {
@@ -213,14 +226,23 @@ class RLLearner(BaseLearner):
         lc = self.cfg.learner
         frames_per_iter = lc.batch_size * lc.unroll_len
 
+        self._pending_reset_flag = False
+
         def send_model(learner):
             params_host = jax.tree.map(np.asarray, learner.state["params"])
             adapter.push(
                 f"{player_id}model",
-                {"params": params_host, "iter": learner.last_iter.val},
+                {
+                    "params": params_host,
+                    "iter": learner.last_iter.val,
+                    # actors restart episodes when a league reset swapped the
+                    # checkpoint (reference actor_comm.py:191-196)
+                    "reset_flag": learner._pending_reset_flag,
+                },
                 accept_count=model_accept_count,
                 timeout_ms=120_000,
             )
+            learner._pending_reset_flag = False
 
         def send_train_info(learner):
             if league is None:
@@ -234,6 +256,8 @@ class RLLearner(BaseLearner):
 
                 if os.path.exists(reset_path):
                     learner.restore(reset_path)
+                    # only a real checkpoint swap makes actors restart
+                    learner._pending_reset_flag = True
                     learner.logger.info(f"league reset: restored {reset_path}")
                 else:
                     learner.logger.info(
@@ -245,6 +269,71 @@ class RLLearner(BaseLearner):
         self.hooks.add(
             LambdaHook("send_train_info", "after_iter", send_train_info, freq=send_train_info_freq)
         )
+
+    # ----------------------------------------------------------------- admin
+    def start_admin(self, port: int = 0):
+        """Serve the live admin API (update_config / reset_value / save_ckpt /
+        status); requests apply at iteration boundaries."""
+        from .admin import LearnerAdminServer
+
+        self._admin = LearnerAdminServer(self, port=port)
+        self._admin.start()
+        self.logger.info(f"admin API on {self._admin.host}:{self._admin.port}")
+        return self._admin
+
+    def request_update_config(self, cfg_patch: dict) -> None:
+        self._pending_config_patch = cfg_patch
+
+    def request_value_reset(self) -> None:
+        self._pending_value_reset = True
+
+    def request_save(self) -> None:
+        self._pending_save = True
+
+    def _apply_admin_requests(self) -> None:
+        patch = getattr(self, "_pending_config_patch", None)
+        if patch:
+            self._pending_config_patch = None
+            self.cfg = deep_merge_dicts(self.cfg, patch)
+            lc = self.cfg.learner
+            # hyperparameter changes rebuild the optax chain; opt state resets
+            # (the reference rebuilds the optimizer on update_config too)
+            self.optimizer = build_optimizer(
+                learning_rate=lc.learning_rate,
+                betas=tuple(lc.betas),
+                eps=lc.eps,
+                clip=GradClipConfig(**lc.grad_clip),
+            )
+            self._state["opt_state"] = jax.device_put(
+                self.optimizer.init(self._state["params"]), self._shardings["repl"]
+            )
+            self._train_step = jax.jit(
+                make_rl_train_step(
+                    self.model, self.loss_cfg, self.optimizer,
+                    lc.batch_size, lc.unroll_len,
+                ),
+                donate_argnums=(0, 1),
+            )
+            self.logger.info(f"applied config patch: {patch}")
+        if getattr(self, "_pending_save", False):
+            self._pending_save = False
+            path = self.checkpoint_path()
+            self.save(path)
+            self.logger.info(f"admin checkpoint saved: {path}")
+        if getattr(self, "_pending_value_reset", False):
+            self._pending_value_reset = False
+            # re-init ONLY the value towers (reference reset_value,
+            # rl_learner.py:233-247)
+            fresh = self._init_params(jax.random.PRNGKey(self.last_iter.val + 1))
+            params = self._state["params"]
+            new_params = {"params": dict(params["params"])}
+            for k in params["params"]:
+                if k.startswith("value_") or k == "value_encoder":
+                    new_params["params"][k] = fresh["params"][k]
+            self._state["params"] = jax.device_put(
+                new_params, self._shardings["repl"]
+            )
+            self.logger.info("value networks reset")
 
     # ------------------------------------------------------------- training
     def step_value_pretrain(self) -> bool:
@@ -270,4 +359,5 @@ class RLLearner(BaseLearner):
         log["staleness/mean"] = float(staleness.mean())
         log["staleness/max"] = float(staleness.max())
         log["staleness/std"] = float(staleness.std())
+        self._apply_admin_requests()
         return log

@@ -130,3 +130,40 @@ def test_rl_learner_with_value_feature(tmp_path):
     learner.run(max_iterations=1)
     assert learner.last_iter.val == 1
     assert np.isfinite(learner.variable_record.get("total_loss").avg)
+
+
+@pytest.mark.slow
+def test_learner_admin_api(rl_learner):
+    """Live admin surface: status, value reset, config patch between iters."""
+    import urllib.request, json as _json
+
+    learner = rl_learner
+    learner.run(max_iterations=max(learner.last_iter.val + 1, 1))
+    admin = learner.start_admin()
+
+    def post(route, body=None):
+        req = urllib.request.Request(
+            f"http://{admin.host}:{admin.port}/learner/{route}",
+            data=_json.dumps(body or {}).encode(), method="POST",
+            headers={"Content-Type": "application/json"},
+        )
+        return _json.loads(urllib.request.urlopen(req, timeout=10).read())
+
+    try:
+        status = post("status")
+        assert status["code"] == 0 and status["info"]["last_iter"] >= 1
+        # queue a value reset + lr patch; both apply on the next iteration
+        w_before = np.asarray(
+            jax.tree.leaves(learner.state["params"]["params"]["value_winloss"])[0]
+        ).copy()
+        assert post("reset_value")["code"] == 0
+        assert post("update_config", {"config": {"learner": {"learning_rate": 5e-6}}})["code"] == 0
+        learner.run(max_iterations=learner.last_iter.val + 1)
+        w_after = np.asarray(
+            jax.tree.leaves(learner.state["params"]["params"]["value_winloss"])[0]
+        )
+        assert not np.allclose(w_before, w_after)
+        assert float(learner.cfg.learner.learning_rate) == 5e-6
+        assert post("bogus")["code"] == 404
+    finally:
+        admin.stop()

@@ -102,3 +102,43 @@ def test_ladder_job_prefers_underplayed_pairs():
         }
     )
     assert lg.trueskill.game_count == 1
+
+
+def test_league_race_meters_from_results():
+    """Active players accumulate per-race dist/cum/unit meters from results."""
+    cfg = {
+        "league": {
+            "active_players": {
+                "player_id": ["MP0"], "checkpoint_path": ["a.ckpt"],
+                "pipeline": ["default"], "frac_id": [1], "z_path": ["z.json"],
+                "z_prob": [0.0], "teacher_id": ["T"], "teacher_path": ["t.ckpt"],
+                "one_phase_step": [10 ** 9], "chosen_weight": [1.0],
+            },
+            "historical_players": {
+                "player_id": ["HP0"], "checkpoint_path": ["h.ckpt"],
+                "pipeline": ["default"], "frac_id": [1], "z_path": ["z.json"],
+                "z_prob": [0.0],
+            },
+        }
+    }
+    lg = League(cfg)
+    cum = [0] * 167
+    cum[5] = 1
+    lg.actor_send_result(
+        {
+            "game_steps": 100, "game_iters": 1, "game_duration": 5.0,
+            "0": {"player_id": "MP0", "opponent_id": "HP0", "winloss": 1,
+                   "race": "zerg", "bo_distance": 3.0, "cum_distance": 7.0,
+                   "bo_reward_total": -0.2, "cum_reward_total": 0.1,
+                   "battle_reward_total": 0.4, "cumulative_stat": cum,
+                   "unit_num": {"Drone": 12}},
+            "1": {"player_id": "HP0", "opponent_id": "MP0", "winloss": -1},
+        }
+    )
+    mp0 = lg.active_players["MP0"]
+    assert mp0.dist_stat.stat_info_dict["zerg"]["bo_distance"] == 3.0
+    assert mp0.unit_num_stat.stat_info_dict["zerg"]["unit_num/Drone"] == 12
+    from distar_tpu.lib.stat import CUM_DICT
+
+    assert str(CUM_DICT[5]) in mp0.cum_stat.stat_info_dict["zerg"]
+    assert "zerg" in mp0.dist_stat.get_text()
