@@ -200,6 +200,9 @@ def default_model_config() -> Config:
                     "hidden_dim": 32,
                     "num_layers": 1,
                     "extra_units": True,
+                    # teacher-forced decode: 'parallel' (batched, default) or
+                    # 'scan' (step-by-step, the sampling path's structure)
+                    "train_impl": "parallel",
                 },
                 "target_unit_head": {"key_dim": 32, "func_dim": 256},
                 "location_head": {

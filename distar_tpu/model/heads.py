@@ -187,6 +187,79 @@ class SelectedUnitsHead(nn.Module):
         )
         return new_carry, (logits, result)
 
+    def _train_forward_parallel(
+        self, base_ae, key, valid, entity_num, labels, selected_units_num, h0
+    ):
+        """Teacher-forced decode with everything except the tiny query LSTM
+        batched over the 64 steps.
+
+        Under teacher forcing the per-step state (selected one-hots, masks,
+        autoregressive embeddings) is a pure function of the *labels*, so the
+        reference's step-by-step recomputation (and the scan path's 64
+        sequential 1024-wide matmuls) collapses into cumulative ops + three
+        big MXU matmuls; only the 32-dim pointer LSTM stays sequential.
+        Produces logits identical to the scan path (equivalence-tested)."""
+        B, N1, K = key.shape
+        S = MAX_SELECTED_UNITS_NUM
+        slot = jax.nn.one_hot(labels, N1, dtype=jnp.float32)  # [B, S, N+1]
+        picked_end = labels == entity_num[:, None]  # [B, S]
+        end_before = jnp.concatenate(
+            [jnp.zeros((B, 1), bool), jnp.cumsum(picked_end, axis=1)[:, :-1] > 0], axis=1
+        )
+        # selection accumulated AFTER each step i (ended lanes stop adding)
+        add = slot * (~(end_before | picked_end))[..., None]
+        sel_after = jnp.minimum(jnp.cumsum(add, axis=1), 1.0)  # [B, S, N+1]
+        # ae at step i uses selections from steps < i
+        sel_before = jnp.concatenate(
+            [jnp.zeros((B, 1, N1), jnp.float32), sel_after[:, :-1]], axis=1
+        )
+        count_before = sel_before.sum(-1)  # [B, S]
+        pooled = jnp.einsum("bsn,bnk->bsk", sel_before, key) / jnp.maximum(
+            count_before, 1.0
+        )[..., None]
+        emb = self.embed_fc2(self.embed_fc1(pooled))  # [B, S, 1024] one batched matmul
+        ae_all = base_ae[:, None, :] + jnp.where(
+            (count_before > 0)[..., None], emb, 0.0
+        )
+        # per-step logits mask: end slot off at step 0, on after; previously
+        # selected units off (the end pick itself stays maskable)
+        picked_slots_before = jnp.concatenate(
+            [
+                jnp.zeros((B, 1, N1), jnp.float32),
+                jnp.cumsum(slot * (~picked_end)[..., None], axis=1)[:, :-1],
+            ],
+            axis=1,
+        )
+        is_end_slot = (jnp.arange(N1)[None, :] == entity_num[:, None])[:, None, :]
+        step_idx = jnp.arange(S)[None, :, None]
+        mask_all = (
+            valid[:, None, :]
+            & ((step_idx > 0) | ~is_end_slot)  # init_mask semantics at step 0
+            & (picked_slots_before == 0)
+        )
+        # tiny pointer LSTM over the precomputed query inputs
+        q_in = self.query_fc2(self.query_fc1(ae_all))  # [B, S, K]
+        (_, _), lstm_out = nn.transforms.scan(
+            lambda mdl, carry, x: tuple(reversed(mdl.lstm(x, carry))),
+            variable_broadcast="params",
+            split_rngs={"params": False},
+        )(self, (h0, h0), q_in.transpose(1, 0, 2))
+        lstm_out = lstm_out.transpose(1, 0, 2)  # [B, S, K]
+        logits = jnp.einsum("bsk,bnk->bsn", lstm_out, key).astype(jnp.float32)
+        logits = jnp.where(mask_all, logits, NEG_INF)
+        # final ae (feeds target_unit/location heads) = ae after step S-1
+        count_after = sel_after[:, -1].sum(-1)
+        pooled_final = jnp.einsum(
+            "bn,bnk->bk", sel_after[:, -1], key
+        ) / jnp.maximum(count_after, 1.0)[:, None]
+        emb_final = self.embed_fc2(self.embed_fc1(pooled_final))
+        ae_final = base_ae + jnp.where((count_after > 0)[:, None], emb_final, 0.0)
+        end_flag = end_before[:, -1] | picked_end[:, -1]
+        last_logits = logits[:, -1, :]
+        end_logit = jnp.take_along_axis(last_logits, entity_num[:, None], axis=1)
+        extra_units = ((last_logits > end_logit) & ~end_flag[:, None]).astype(jnp.float32)
+        return logits, labels, ae_final, selected_units_num, extra_units
+
     def _su_step_train(self, carry, label):
         return self._su_step(carry, lambda logits: label)
 
@@ -224,6 +297,13 @@ class SelectedUnitsHead(nn.Module):
             labels = selected_units[:, :S].astype(jnp.int32)
             if labels.shape[1] < S:
                 labels = jnp.pad(labels, ((0, 0), (0, S - labels.shape[1])))
+            if (
+                hc.get("train_impl", "parallel") != "scan"
+                and not self.is_initializing()
+            ):
+                return self._train_forward_parallel(
+                    base_ae, key, valid, entity_num, labels, selected_units_num, h0
+                )
             xs = labels.T  # [S, B]
         else:
             xs = jax.random.split(rng, S)

@@ -205,3 +205,52 @@ def test_bfloat16_compute_dtype(small_cfg, model_and_params):
         assert np.isfinite(np.asarray(v, dtype=np.float32)).all(), k
     # params remain float32
     assert jax.tree.leaves(params)[0].dtype == jnp.float32
+
+
+def test_su_head_parallel_matches_scan(small_cfg, model_and_params):
+    """The batched teacher-forced SelectedUnits path must equal the scan path
+    bit-for-bit in semantics: same logits on real steps, same downstream
+    embeddings (checked via target_unit/location logits)."""
+    from distar_tpu.utils import deep_merge_dicts
+
+    model, params = model_and_params
+    scan_cfg = deep_merge_dicts(
+        small_cfg, {"policy": {"selected_units_head": {"train_impl": "scan"}}}
+    )
+    scan_model = Model(scan_cfg)
+    data = _batch_obs(B)
+    rng = np.random.default_rng(7)
+    labels = np.zeros((B, F.MAX_SELECTED_UNITS_NUM), np.int64)
+    sun = np.array([3, 5])
+    for b in range(B):
+        labels[b, : sun[b] - 1] = rng.permutation(6)[: sun[b] - 1]
+        labels[b, sun[b] - 1] = int(data["entity_num"][b])  # end token
+    action_info = {
+        "action_type": jnp.zeros((B,), jnp.int32),
+        "delay": jnp.zeros((B,), jnp.int32),
+        "queued": jnp.zeros((B,), jnp.int32),
+        "selected_units": jnp.asarray(labels),
+        "target_unit": jnp.zeros((B,), jnp.int32),
+        "target_location": jnp.zeros((B,), jnp.int32),
+    }
+    outs = {}
+    for name, m in (("parallel", model), ("scan", scan_model)):
+        outs[name] = m.apply(
+            params, data["spatial_info"], data["entity_info"], data["scalar_info"],
+            data["entity_num"], _hidden(small_cfg, B), action_info, jnp.asarray(sun),
+            method=m.teacher_logits,
+        )
+    su_p = np.asarray(outs["parallel"]["logit"]["selected_units"])
+    su_s = np.asarray(outs["scan"]["logit"]["selected_units"])
+    # compare real steps only (post-end steps diverge in masking, loss-masked)
+    for b in range(B):
+        np.testing.assert_allclose(
+            su_p[b, : sun[b]], su_s[b, : sun[b]], rtol=2e-4, atol=2e-4
+        )
+    # downstream heads see the same autoregressive embedding
+    for head in ("target_unit", "target_location"):
+        np.testing.assert_allclose(
+            np.asarray(outs["parallel"]["logit"][head]),
+            np.asarray(outs["scan"]["logit"][head]),
+            rtol=2e-4, atol=2e-4,
+        )
