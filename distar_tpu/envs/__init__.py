@@ -1,4 +1,14 @@
+from .dummy_obs import build_dummy_game_info, build_dummy_obs
 from .env import BaseEnv
+from .features import ProtoFeatures, compute_battle_score, unpack_feature_layer
 from .mock_env import MockEnv
 
-__all__ = ["BaseEnv", "MockEnv"]
+__all__ = [
+    "BaseEnv",
+    "MockEnv",
+    "ProtoFeatures",
+    "compute_battle_score",
+    "unpack_feature_layer",
+    "build_dummy_game_info",
+    "build_dummy_obs",
+]

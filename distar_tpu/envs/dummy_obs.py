@@ -1,0 +1,137 @@
+"""Synthetic raw-observation builders (duck-typed protos).
+
+Role parity with the reference's proto fixtures (reference: distar/pysc2/
+tests/dummy_observation.py:15-50 — "build a dummy ResponseObservation ...
+passed to features.transform_obs"): SimpleNamespace trees with the same
+attribute surface as s2clientprotocol messages, so ProtoFeatures runs and is
+tested without the game or even the proto package.
+"""
+from __future__ import annotations
+
+from types import SimpleNamespace as NS
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+
+def pos(x, y):
+    return NS(x=x, y=y)
+
+
+def make_unit(
+    tag: int,
+    unit_type: int,
+    alliance: int = 1,
+    x: float = 10.0,
+    y: float = 20.0,
+    health: float = 50.0,
+    health_max: float = 100.0,
+    orders: Sequence[int] = (),
+    buff_ids: Sequence[int] = (),
+    passengers: Sequence = (),
+    **kwargs,
+):
+    defaults = dict(
+        cargo_space_taken=0, build_progress=1.0, shield_max=0.0, energy_max=0.0,
+        display_type=1, owner=1 if alliance == 1 else 2, cloak=3, is_blip=False,
+        is_powered=True, mineral_contents=0, vespene_contents=0, cargo_space_max=0,
+        assigned_harvesters=0, weapon_cooldown=0, is_hallucination=False,
+        add_on_tag=0, is_active=True, attack_upgrade_level=0, armor_upgrade_level=0,
+        shield_upgrade_level=0, shield=0.0, energy=0.0,
+    )
+    defaults.update(kwargs)
+    return NS(
+        tag=tag, unit_type=unit_type, alliance=alliance, pos=pos(x, y),
+        health=health, health_max=health_max,
+        orders=[NS(ability_id=a, progress=0.5) for a in orders],
+        buff_ids=list(buff_ids), passengers=list(passengers), **defaults,
+    )
+
+
+def make_passenger(tag: int, unit_type: int, health: float = 30.0):
+    return NS(tag=tag, unit_type=unit_type, health=health, health_max=50.0,
+              shield=0.0, shield_max=0.0, energy=0.0, energy_max=0.0)
+
+
+def _packed_plane(arr: np.ndarray, bits: int):
+    if bits == 1:
+        data = np.packbits(arr.astype(bool).reshape(-1)).tobytes()
+    else:
+        data = arr.astype({8: np.uint8, 16: np.uint16, 32: np.int32}[bits]).tobytes()
+    return NS(size=NS(y=arr.shape[0], x=arr.shape[1]), bits_per_pixel=bits, data=data)
+
+
+def make_minimap(map_y: int = 120, map_x: int = 120, rng: Optional[np.random.Generator] = None):
+    rng = rng or np.random.default_rng(0)
+    layers = {
+        "height_map": _packed_plane(rng.integers(0, 255, (map_y, map_x)), 8),
+        "visibility_map": _packed_plane(rng.integers(0, 4, (map_y, map_x)), 8),
+        "creep": _packed_plane(rng.integers(0, 2, (map_y, map_x)), 1),
+        "player_relative": _packed_plane(rng.integers(0, 5, (map_y, map_x)), 8),
+        "alerts": _packed_plane(rng.integers(0, 2, (map_y, map_x)), 1),
+        "pathable": _packed_plane(rng.integers(0, 2, (map_y, map_x)), 1),
+        "buildable": _packed_plane(rng.integers(0, 2, (map_y, map_x)), 1),
+    }
+    return NS(**layers)
+
+
+def build_dummy_obs(
+    units: Optional[List] = None,
+    game_loop: int = 100,
+    player_id: int = 1,
+    upgrade_ids: Sequence[int] = (),
+    effects: Sequence = (),
+    map_y: int = 120,
+    map_x: int = 120,
+    minerals: int = 500,
+    killed_minerals: float = 0.0,
+    killed_vespene: float = 0.0,
+    action_results: Sequence[int] = (1,),
+    rng: Optional[np.random.Generator] = None,
+):
+    cat = NS(none=0.0, army=killed_minerals, economy=0.0, technology=0.0, upgrade=0.0)
+    catv = NS(none=0.0, army=killed_vespene, economy=0.0, technology=0.0, upgrade=0.0)
+    return NS(
+        observation=NS(
+            game_loop=game_loop,
+            raw_data=NS(
+                units=units or [],
+                effects=list(effects),
+                player=NS(upgrade_ids=list(upgrade_ids)),
+            ),
+            player_common=NS(
+                player_id=player_id, minerals=minerals, vespene=100, food_used=20,
+                food_cap=30, food_army=10, food_workers=10, idle_worker_count=1,
+                army_count=5, warp_gate_count=0, larva_count=3,
+            ),
+            feature_layer_data=NS(minimap_renders=make_minimap(map_y, map_x, rng)),
+            score=NS(score_details=NS(killed_minerals=cat, killed_vespene=catv)),
+        ),
+        action_errors=[NS(result=r) for r in action_results],
+    )
+
+
+def build_dummy_game_info(map_y: int = 120, map_x: int = 120, map_name: str = "DummyMap"):
+    return NS(
+        start_raw=NS(map_size=NS(x=map_x, y=map_y), start_locations=[pos(20, 30)]),
+        map_name=map_name,
+        player_info=[
+            NS(player_id=1, race_requested=2, type=1),
+            NS(player_id=2, race_requested=2, type=1),
+        ],
+    )
+
+
+def make_effect(effect_id: int, positions: Sequence, owner: int = 2):
+    return NS(effect_id=effect_id, owner=owner, pos=[pos(x, y) for x, y in positions])
+
+
+def make_raw_action(ability_id: int, unit_tags: Sequence[int] = (),
+                    target_unit_tag: Optional[int] = None,
+                    target_pos=None, queue_command: bool = False):
+    uc = NS(ability_id=ability_id, unit_tags=list(unit_tags), queue_command=queue_command)
+    if target_unit_tag is not None:
+        uc.target_unit_tag = target_unit_tag
+    if target_pos is not None:
+        uc.target_world_space_pos = pos(*target_pos)
+    return NS(unit_command=uc)

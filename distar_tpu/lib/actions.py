@@ -147,6 +147,35 @@ for _idx, _ab in enumerate(UNIT_MIX_ABILITIES):
     if _ab in GENERAL_ABILITY_IDS:
         UNIT_ABILITY_TO_ACTION[_idx] = GENERAL_ABILITY_IDS.index(_ab)
 
+# --- replay-decode ability canonicalisation (reference features.py:862-871) -
+# cancel-slot and unload-unit ability families collapse onto their general
+# actions; Dance/Cheer are dropped.
+CANCEL_SLOT_ABILITIES = {313, 1039, 305, 307, 309, 1832, 1834, 3672}
+UNLOAD_UNIT_ABILITIES = {410, 415, 397, 1440, 2373, 1409, 914, 3670}
+FRIVOLOUS_ABILITIES = {6, 7}  # Dance, Cheer
+CANCEL_SLOT_TARGET = 3671  # Cancel_Last/cancel_quick general
+UNLOAD_ALL_TARGET = 3664
+
+
+def action_kind(a: dict) -> str:
+    """Which raw-command form an action takes: 'unit' (targets a unit), 'pt'
+    (targets a location), 'autocast', or 'quick' (no target) — the cmd_type
+    disambiguation of reference reverse_raw_action (:875-878)."""
+    if a["target_unit"]:
+        return "unit"
+    if a["target_location"]:
+        return "pt"
+    if a["name"].endswith("_autocast"):
+        return "autocast"
+    return "quick"
+
+
+# (general_ability_id, kind) -> action index; verified collision-free
+GAB_KIND_TO_ACTION: Dict[tuple, int] = {}
+for _idx, _a in enumerate(ACTIONS):
+    if _a["general_ability_id"] is not None:
+        GAB_KIND_TO_ACTION.setdefault((_a["general_ability_id"], action_kind(_a)), _idx)
+
 # game unit-type / upgrade id -> cumulative-stat slot (-1 when untracked)
 UNIT_TO_CUM: Dict[int, int] = {}
 UPGRADE_TO_CUM: Dict[int, int] = {}

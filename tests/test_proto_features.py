@@ -1,0 +1,195 @@
+"""ProtoFeatures tests over dummy-proto fixtures (the reference's
+dummy_observation test strategy, pysc2/tests/dummy_observation_test.py)."""
+import numpy as np
+import pytest
+
+from distar_tpu.envs.dummy_obs import (
+    build_dummy_game_info,
+    build_dummy_obs,
+    make_effect,
+    make_passenger,
+    make_raw_action,
+    make_unit,
+)
+from distar_tpu.envs.features import Effects, ProtoFeatures, compute_battle_score
+from distar_tpu.lib import actions as ACT
+from distar_tpu.lib import features as F
+
+
+@pytest.fixture
+def feat():
+    return ProtoFeatures(build_dummy_game_info())
+
+
+DRONE = ACT.UNIT_TYPES[10]  # some real game unit id from the vocabulary
+
+
+def test_transform_obs_shapes_and_schema(feat):
+    units = [make_unit(100 + i, DRONE, x=5 + i, y=7) for i in range(5)]
+    obs = build_dummy_obs(units=units)
+    out = feat.transform_obs(obs)
+    assert int(out["entity_num"]) == 5
+    for k, dtype in F.SPATIAL_INFO.items():
+        if k.startswith("effect_"):
+            assert out["spatial_info"][k].shape == (F.EFFECT_LENGTH,)
+        else:
+            assert out["spatial_info"][k].shape == F.SPATIAL_SIZE, k
+    for k in F.ENTITY_INFO:
+        assert out["entity_info"][k].shape == (F.MAX_ENTITY_NUM,), k
+    for k in F.SCALAR_INFO:
+        assert k in out["scalar_info"], k
+
+
+def test_unit_type_remap_and_y_flip(feat):
+    u = make_unit(1, DRONE, x=3, y=10)
+    out = feat.transform_obs(build_dummy_obs(units=[u]))
+    # unit_type remapped into the dense vocabulary (DRONE is index 10)
+    assert int(out["entity_info"]["unit_type"][0]) == 10
+    # y flipped: map_y(120) - 10 = 110
+    assert int(out["entity_info"]["y"][0]) == 110
+    assert int(out["entity_info"]["x"][0]) == 3
+    # health ratio
+    assert out["entity_info"]["health_ratio"][0] == pytest.approx(0.5, abs=1e-3)
+
+
+def test_bow_vectors_and_upgrades(feat):
+    units = [make_unit(i, DRONE) for i in range(3)] + [
+        make_unit(50, ACT.UNIT_TYPES[20], alliance=4)
+    ]
+    up_id = ACT.UPGRADES[5]
+    out = feat.transform_obs(build_dummy_obs(units=units, upgrade_ids=[up_id]))
+    assert int(out["scalar_info"]["unit_counts_bow"][10]) == 3
+    assert int(out["scalar_info"]["unit_type_bool"][10]) == 1
+    assert int(out["scalar_info"]["enemy_unit_type_bool"][20]) == 1
+    assert int(out["scalar_info"]["upgrades"][5]) == 1
+    # log1p stats
+    assert out["scalar_info"]["agent_statistics"][0] == pytest.approx(np.log1p(500))
+
+
+def test_cargo_passengers_become_entities(feat):
+    carrier = make_unit(
+        1, DRONE, passengers=[make_passenger(2, ACT.UNIT_TYPES[11])]
+    )
+    out = feat.transform_obs(build_dummy_obs(units=[carrier]))
+    assert int(out["entity_num"]) == 2
+    assert int(out["entity_info"]["is_in_cargo"][1]) == 1
+    assert out["game_info"]["tags"] == [1, 2]
+
+
+def test_effect_coordinates_flat_flipped(feat):
+    eff = make_effect(Effects.PsiStorm, [(4, 20)])
+    out = feat.transform_obs(build_dummy_obs(effects=[eff]))
+    expected = 4 + (120 - 20) * F.SPATIAL_SIZE[1]
+    assert int(out["spatial_info"]["effect_PsiStorm"][0]) == expected
+    # own liberator zones are skipped
+    own_zone = make_effect(Effects.LiberatorDefenderZone, [(1, 1)], owner=1)
+    out2 = feat.transform_obs(build_dummy_obs(effects=[own_zone]))
+    assert int(out2["spatial_info"]["effect_LiberatorDefenderZone"][0]) == 0
+
+
+def test_battle_score(feat):
+    obs = build_dummy_obs(killed_minerals=100.0, killed_vespene=40.0)
+    assert compute_battle_score(obs) == pytest.approx(100 + 1.5 * 40)
+
+
+def test_value_feature_from_opponent(feat):
+    my_units = [make_unit(1, DRONE, alliance=1)]
+    opp_units = [make_unit(9, ACT.UNIT_TYPES[30], alliance=1, x=50, y=60)]
+    obs = build_dummy_obs(units=my_units)
+    opp = build_dummy_obs(units=opp_units, player_id=2)
+    out = feat.transform_obs(obs, opponent_obs=opp)
+    vf = out["value_feature"]
+    assert int(vf["total_unit_count"]) == 2  # 1 enemy + 1 own
+    assert int(vf["enemy_unit_counts_bow"][30]) == 1
+    assert vf["own_units_spatial"].shape == F.SPATIAL_SIZE
+    assert int(vf["unit_alliance"][0]) == 1 and int(vf["unit_alliance"][1]) == 0
+
+
+def test_transform_action_roundtrip(feat):
+    tags = [111, 222, 333]
+    attack_pt = ACT.FUNC_ID_TO_ACTION_TYPE[2]  # Attack_pt: selects + location
+    action = {
+        "action_type": np.asarray(attack_pt),
+        "delay": np.asarray(3),
+        "queued": np.asarray(1),
+        "selected_units": np.asarray([0, 2] + [3] * 62),  # 3 == entity_num end
+        "target_unit": np.asarray(0),
+        "target_location": np.asarray(5 + 10 * F.SPATIAL_SIZE[1]),
+    }
+    cmd = feat.transform_action(action, tags)
+    assert cmd["ability_id"] == ACT.ACTIONS[attack_pt]["general_ability_id"]
+    assert cmd["unit_tags"] == [111, 333]
+    # post-end-token garbage must not produce commands: fill tail with a
+    # valid-looking index
+    garbage = dict(action, selected_units=np.asarray([0, 3] + [1] * 62))
+    assert feat.transform_action(garbage, tags)["unit_tags"] == [111]
+    # explicit selected_units_num wins
+    assert feat.transform_action(action, tags, selected_units_num=1)["unit_tags"] == [111]
+    assert cmd["queue_command"] is True
+    x, y = cmd["target_world_space_pos"]
+    assert (x, y) == (5.0, 120.0 - 10.0)
+
+
+def test_reverse_raw_action(feat):
+    tags = [111, 222, 333]
+    attack_gab = ACT.ACTIONS[ACT.FUNC_ID_TO_ACTION_TYPE[2]]["general_ability_id"]  # 3674
+    raw = make_raw_action(attack_gab, unit_tags=[222, 111], target_pos=(5, 110),
+                          queue_command=True)
+    out = feat.reverse_raw_action(raw, tags)
+    a = out["action"]
+    assert int(a["action_type"]) == ACT.FUNC_ID_TO_ACTION_TYPE[2]  # Attack_pt
+    # selected: indices then end flag (== entity_num == 3)
+    assert a["selected_units"][:3].tolist() == [1, 0, 3]
+    assert int(out["selected_units_num"]) == 3
+    assert int(a["queued"]) == 1
+    assert int(a["target_location"]) == (120 - 110) * F.SPATIAL_SIZE[1] + 5
+    assert out["mask"]["target_location"] == 1.0 and out["mask"]["target_unit"] == 0.0
+    assert not out["invalid"]
+
+
+def test_reverse_raw_action_unit_variant(feat):
+    """Same general ability with a target unit must decode to the _unit
+    variant (cmd-kind disambiguation)."""
+    tags = [111, 222, 333]
+    attack_gab = 3674
+    raw = make_raw_action(attack_gab, unit_tags=[111], target_unit_tag=333)
+    out = feat.reverse_raw_action(raw, tags)
+    assert int(out["action"]["action_type"]) == ACT.FUNC_ID_TO_ACTION_TYPE[3]  # Attack_unit
+    assert int(out["action"]["target_unit"]) == 2
+    assert out["mask"]["target_unit"] == 1.0 and out["mask"]["target_location"] == 0.0
+
+
+def test_reverse_raw_action_cancel_slot_and_clamp(feat):
+    tags = [111]
+    # cancel-slot ability family remaps to the cancel general (3671)
+    out = feat.reverse_raw_action(make_raw_action(313, unit_tags=[111]), tags)
+    cancel_action = ACT.GAB_KIND_TO_ACTION[(3671, "quick")]
+    assert int(out["action"]["action_type"]) == cancel_action
+    assert not out["invalid"]
+    # y=0 flips past the map edge; label clamps inside
+    attack_gab = 3674
+    out2 = feat.reverse_raw_action(
+        make_raw_action(attack_gab, unit_tags=[111], target_pos=(5, 0)), tags
+    )
+    assert int(out2["action"]["target_location"]) == (120 - 1) * F.SPATIAL_SIZE[1] + 5
+
+
+def test_reverse_raw_action_invalid(feat):
+    tags = [111]
+    # unknown ability -> masked no_op
+    unk = feat.reverse_raw_action(make_raw_action(999999, unit_tags=[111]), tags)
+    assert int(unk["action"]["action_type"]) == 0
+    assert unk["invalid"] and unk["mask"]["action_type"] == 0.0
+    # frivolous (Dance) dropped
+    assert feat.reverse_raw_action(make_raw_action(6, unit_tags=[111]), tags)["invalid"]
+
+
+def test_agent_consumes_proto_obs(feat):
+    """The proto transform's output feeds Agent.pre_process unchanged."""
+    from distar_tpu.actor.agent import Agent
+
+    out = feat.transform_obs(build_dummy_obs(units=[make_unit(1, DRONE)]))
+    ag = Agent("MP0", traj_len=4)
+    model_in = ag.pre_process(out)
+    assert model_in["scalar_info"]["beginning_order"].shape == (20,)
+    assert model_in["entity_num"] == out["entity_num"]
