@@ -195,6 +195,8 @@ class Actor:
         obs = {e: envs[e].reset() for e in range(n_env)}
         episodes_done, results = 0, []
         last_model_refresh = time.time()
+        pending_teacher: Dict = {}
+        last_prepared: Dict = {}
         while episodes_done < episodes:
             if time.time() - last_model_refresh > self.cfg.model_update_interval_s:
                 last_model_refresh = time.time()
@@ -204,44 +206,63 @@ class Actor:
                 if refreshed:
                     # league-triggered reset: restart every episode with the
                     # fresh checkpoint (reference actor.py:321-323)
+                    pending_teacher.clear()
                     for e in range(n_env):
                         obs[e] = reset_slot(e)
+            # obs[e] holds only the sides DUE this cycle (variable per-agent
+            # delays, SC2Env contract); a fresh obs first closes out that
+            # agent's previous action (collect-on-receipt, the reference's
+            # per-env loop order), then the agent acts on it. Non-due slots
+            # ride the batch as inactive filler (hidden state preserved).
             env_actions: Dict[int, dict] = {e: {} for e in range(n_env)}
-            prepared_by_side: Dict[int, list] = {}
-            outputs_by_side: Dict[int, list] = {}
             for side, pid in enumerate(player_ids):
-                prepared = [agents[(e, side)].pre_process(obs[e][side]) for e in range(n_env)]
-                prepared_by_side[side] = prepared
-                outs = infer[side].sample(prepared)
-                outputs_by_side[side] = outs
+                prepared, active = [], []
                 for e in range(n_env):
-                    env_actions[e][side] = agents[(e, side)].post_process(outs[e])
-            # teacher logits for the sampled actions (teacher == own params
-            # here until distinct teacher ckpts are wired)
-            teacher_by_side = {}
-            for side in infer:
+                    if side in obs[e]:
+                        ag = agents[(e, side)]
+                        if ag._output is not None and (e, side) in pending_teacher:
+                            traj = ag.collect_data(
+                                obs[e][side], 0.0, False,
+                                pending_teacher.pop((e, side)),
+                                hidden_backup[(e, side)],
+                            )
+                            self._maybe_push(job, ag, traj, infer, hidden_backup, e, side)
+                        prepared.append(ag.pre_process(obs[e][side]))
+                        last_prepared[(e, side)] = prepared[-1]
+                        active.append(True)
+                    else:
+                        prepared.append(last_prepared[(e, side)])
+                        active.append(False)
+                outs = infer[side].sample(prepared, active)
+                # teacher logits at act time, stored until the next obs
+                # arrives (teacher == own params until distinct teacher
+                # checkpoints are wired)
                 t_logits, teacher_hidden[side] = infer[side].teacher_logits(
-                    params[player_ids[side]], prepared_by_side[side], teacher_hidden[side],
-                    outputs_by_side[side],
+                    params[pid], prepared, teacher_hidden[side], outs, active
                 )
-                teacher_by_side[side] = t_logits
+                for e in range(n_env):
+                    if active[e]:
+                        act = agents[(e, side)].post_process(outs[e])
+                        act["selected_units_num"] = outs[e]["selected_units_num"]
+                        env_actions[e][side] = act
+                        pending_teacher[(e, side)] = t_logits[e]
 
             for e in range(n_env):
+                if not env_actions[e]:
+                    continue
                 next_obs, rewards, done, info = envs[e].step(env_actions[e])
-                for side in (0, 1):
-                    ag = agents[(e, side)]
-                    traj = ag.collect_data(
-                        next_obs[side],
-                        rewards[side],
-                        done,
-                        teacher_by_side[side][e],
-                        hidden_backup[(e, side)],
-                    )
-                    if traj is not None:
-                        hidden_backup[(e, side)] = infer[side].hidden_for_slot(e)
-                        if self.adapter is not None and ag.player_id in job["send_data_players"]:
-                            self.adapter.push(f"{ag.player_id}traj", traj, timeout_ms=120_000)
                 if done:
+                    # episode end returns every side: close out all pending
+                    # actions with the terminal reward
+                    for side in (0, 1):
+                        ag = agents[(e, side)]
+                        if ag._output is not None and (e, side) in pending_teacher:
+                            traj = ag.collect_data(
+                                next_obs.get(side), rewards[side], True,
+                                pending_teacher.pop((e, side)),
+                                hidden_backup[(e, side)],
+                            )
+                            self._maybe_push(job, ag, traj, infer, hidden_backup, e, side)
                     episodes_done += 1
                     result = {
                         "game_steps": info.get("game_loop", 0),
@@ -271,3 +292,12 @@ class Actor:
             env.close()
         self.results.extend(results)
         return results
+
+    def _maybe_push(self, job, ag, traj, infer, hidden_backup, e, side) -> None:
+        if traj is None:
+            return
+        # next trajectory starts from the CURRENT carry (before this cycle's
+        # forward)
+        hidden_backup[(e, side)] = infer[side].hidden_for_slot(e)
+        if self.adapter is not None and ag.player_id in job["send_data_players"]:
+            self.adapter.push(f"{ag.player_id}traj", traj, timeout_ms=120_000)

@@ -66,30 +66,47 @@ class BatchedInference:
             (np.asarray(h[idx]), np.asarray(c[idx])) for h, c in self.hidden
         )
 
-    def sample(self, prepared: List[dict]) -> List[dict]:
-        """One batched forward over all slots; returns per-slot outputs."""
+    def sample(self, prepared: List[dict], active: Optional[List[bool]] = None) -> List[dict]:
+        """One batched forward over all slots; returns per-slot outputs.
+
+        ``active`` marks slots that are actually acting this cycle (variable
+        per-agent delays mean some slots carry stale observations as batch
+        filler): inactive slots keep their previous hidden state and their
+        outputs must be ignored by the caller. The batch shape stays static —
+        inactive-lane compute is the price of one compiled program.
+        """
         assert len(prepared) == self.num_slots
         batch = jax.tree.map(jnp.asarray, F.batch_tree(prepared))
         self._rng, key = jax.random.split(self._rng)
+        old_hidden = self.hidden
         out = self._sample(self.params, batch, self.hidden, key)
-        self.hidden = out["hidden_state"]
+        self.hidden = self._merge_hidden(out["hidden_state"], old_hidden, active)
         outs = []
         host = jax.tree.map(np.asarray, {k: v for k, v in out.items() if k != "hidden_state"})
         for i in range(self.num_slots):
             outs.append(jax.tree.map(lambda x: x[i], host))
         return outs
 
+    def _merge_hidden(self, new, old, active: Optional[List[bool]]):
+        if active is None or all(active):
+            return new
+        mask = jnp.asarray(np.asarray(active, bool))[:, None]
+        return jax.tree.map(lambda n, o: jnp.where(mask, n, o), new, old)
+
     def teacher_logits(
-        self, teacher_params, prepared: List[dict], teacher_hidden, outputs: List[dict]
+        self, teacher_params, prepared: List[dict], teacher_hidden, outputs: List[dict],
+        active: Optional[List[bool]] = None,
     ):
         """Teacher-forced logits for the freshly sampled actions; returns
-        (per-slot logit dicts, new teacher hidden)."""
+        (per-slot logit dicts, new teacher hidden — inactive slots keep the
+        old carry)."""
         batch = jax.tree.map(jnp.asarray, F.batch_tree(prepared))
         action_info = jax.tree.map(
             jnp.asarray, F.batch_tree([o["action_info"] for o in outputs])
         )
         sun = jnp.asarray(np.stack([np.asarray(o["selected_units_num"]) for o in outputs]))
         out = self._teacher(teacher_params, batch, teacher_hidden, action_info, sun)
+        merged = self._merge_hidden(out["hidden_state"], teacher_hidden, active)
         host_logit = jax.tree.map(np.asarray, out["logit"])
         per_slot = [jax.tree.map(lambda x: x[i], host_logit) for i in range(self.num_slots)]
-        return per_slot, out["hidden_state"]
+        return per_slot, merged

@@ -2,10 +2,13 @@ from .dummy_obs import build_dummy_game_info, build_dummy_obs
 from .env import BaseEnv
 from .features import ProtoFeatures, compute_battle_score, unpack_feature_layer
 from .mock_env import MockEnv
+from .sc2_env import FakeController, SC2Env
 
 __all__ = [
     "BaseEnv",
     "MockEnv",
+    "SC2Env",
+    "FakeController",
     "ProtoFeatures",
     "compute_battle_score",
     "unpack_feature_layer",

@@ -1,0 +1,233 @@
+"""SC2 environment orchestration over abstract game controllers.
+
+Role parity with the reference SC2Env (reference: distar/envs/env.py:96-455):
+per-agent variable ``skip_steps`` delays (the AlphaStar delay-action model —
+each agent names the game loop of its next observation, the env advances to
+the earliest one, :333-375), simulated inference-latency noise
+(`random_delay_weights`, :350-354), win/loss extraction from player_result
+(:411-424), per-agent {obs, opponent_obs, action_result} returns (:443-455),
+and episode-length cutoffs.
+
+The controller is abstract (`GameController`): the reference's
+RemoteController (websocket+protobuf to the SC2 binary,
+pysc2/lib/remote_controller.py) slots in unchanged once the proto package is
+available; `FakeController` (dummy protos) makes the whole orchestration
+testable without the game — the reference's mock_sc2_env strategy applied
+one layer lower.
+"""
+from __future__ import annotations
+
+import random
+from typing import Dict, List, Optional, Protocol, Sequence
+
+import numpy as np
+
+from .env import BaseEnv
+from .features import ProtoFeatures
+
+# sc_pb.Result: Victory=1, Defeat=2, Tie=3, Undecided=4
+POSSIBLE_RESULTS = {1: 1, 2: -1, 3: 0, 4: 0}
+MAX_STEP_COUNT = 524_000  # SC2 hard limit 2^19, minus margin (reference :427)
+
+
+class GameController(Protocol):
+    """Subset of the reference RemoteController the env drives."""
+
+    status_ended: bool
+
+    def step(self, loops: int) -> None: ...
+
+    def acts(self, raw_cmds: List[dict]): ...  # returns result-like or None
+
+    def observe(self, target_game_loop: int = 0): ...  # raw proto obs
+
+
+class SC2Env(BaseEnv):
+    def __init__(
+        self,
+        controllers: Sequence[GameController],
+        features: Sequence[ProtoFeatures],
+        episode_length: int = 100_000,
+        random_delay_weights: Optional[Sequence[float]] = None,
+        realtime: bool = False,
+        both_obs: bool = True,
+        seed: int = 0,
+    ):
+        assert len(controllers) == len(features)
+        self._controllers = list(controllers)
+        self._features = list(features)
+        self.num_agents = len(controllers)
+        self._episode_length = min(episode_length, MAX_STEP_COUNT)
+        self._random_delay_weights = list(random_delay_weights or [])
+        self._realtime = realtime
+        self._both_obs = both_obs and self.num_agents == 2
+        self._rng = random.Random(seed)
+        self._episode_steps = 0
+        self._episode_count = 0
+        self._next_obs_step = [0] * self.num_agents
+        self._action_result: List[List[int]] = [[1] for _ in range(self.num_agents)]
+        self._last_tags: List[list] = [[] for _ in range(self.num_agents)]
+        self._done = True
+
+    # ------------------------------------------------------------------ api
+    def reset(self) -> Dict[int, dict]:
+        self._episode_steps = 0
+        self._episode_count += 1
+        self._next_obs_step = [0] * self.num_agents
+        self._action_result = [[1] for _ in range(self.num_agents)]
+        self._done = False
+        # restart the underlying game (reference restarts via the
+        # controller's restart_game / create+join, env.py:298-330)
+        for c in self._controllers:
+            if hasattr(c, "reset"):
+                c.reset()
+        obs, _, _, _ = self._observe(0)
+        return obs
+
+    def step(self, actions: Dict[int, dict]):
+        assert not self._done, "step() after episode end; call reset()"
+        # issue raw commands + register each agent's requested delay
+        for idx, action in actions.items():
+            delay = max(int(np.asarray(action["delay"]).reshape(-1)[0]), 1)
+            self._next_obs_step[idx] = self._episode_steps + delay
+            cmd = self._features[idx].transform_action(
+                action, self._last_tags[idx],
+                selected_units_num=action.get("selected_units_num"),
+            )
+            c = self._controllers[idx]
+            if not c.status_ended:
+                result = c.acts([cmd])
+                if result is not None:
+                    self._action_result[idx] = (
+                        list(result) if isinstance(result, (list, tuple)) else [result]
+                    )
+
+        # simulated inference/network latency for short delays (reference
+        # :350-354, fires only when EVERY acting agent requested a short
+        # delay): the game runs on while the "agents think"
+        if not self._realtime and self._random_delay_weights and actions:
+            max_delay = max(
+                self._next_obs_step[i] - self._episode_steps for i in actions
+            )
+            if max_delay < 4:
+                lag = self._rng.choices(
+                    range(len(self._random_delay_weights)),
+                    weights=self._random_delay_weights,
+                )[0]
+                self._advance(lag)
+                self._episode_steps += lag
+
+        target = min(self._next_obs_step)
+        step_mul = max(target - self._episode_steps, 0)
+        self._advance(step_mul)
+        # dueness is judged inside _observe against the ACTUAL game loop —
+        # a latency lag may have overshot some agents' schedules
+        return self._observe(max(target, self._episode_steps))
+
+    def close(self) -> None:
+        for c in self._controllers:
+            if hasattr(c, "close"):
+                c.close()
+
+    # ------------------------------------------------------------- internals
+    def _advance(self, loops: int) -> None:
+        if loops <= 0:
+            return
+        for c in self._controllers:
+            if not c.status_ended:
+                c.step(loops)
+
+    def _observe(self, target_game_loop: int):
+        raw = [c.observe(target_game_loop=target_game_loop) for c in self._controllers]
+        game_loop = int(raw[0].observation.game_loop)
+        self._episode_steps = game_loop
+        due = [i for i in range(self.num_agents) if self._next_obs_step[i] <= game_loop]
+
+        outcome = [0] * self.num_agents
+        episode_complete = any(
+            getattr(o, "player_result", None) for o in raw if o is not None
+        )
+        if episode_complete:
+            for i, o in enumerate(raw):
+                if o is None:
+                    continue
+                pid = o.observation.player_common.player_id
+                for result in o.player_result:
+                    if result.player_id == pid:
+                        outcome[i] = POSSIBLE_RESULTS.get(result.result, 0)
+                    elif self.num_agents == 2:
+                        outcome[1 - i] = POSSIBLE_RESULTS.get(result.result, 0)
+        if game_loop >= self._episode_length:
+            episode_complete = True
+        self._done = episode_complete
+
+        obs: Dict[int, dict] = {}
+        indices = range(self.num_agents) if episode_complete else due
+        for i in indices:
+            opponent = raw[1 - i] if self._both_obs else None
+            f_obs = self._features[i].transform_obs(raw[i], opponent_obs=opponent)
+            f_obs["action_result"] = self._action_result[i]
+            self._last_tags[i] = f_obs["game_info"]["tags"]
+            obs[i] = f_obs
+        rewards = {i: float(outcome[i]) for i in range(self.num_agents)}
+        info = {"game_loop": game_loop, "outcome": outcome}
+        return obs, rewards, episode_complete, info
+
+
+class FakeController:
+    """Dummy-proto controller: advances a loop counter, serves synthetic
+    observations, ends with a victory/defeat pair after ``end_at`` loops."""
+
+    def __init__(self, player_id: int = 1, end_at: int = 1000, n_units: int = 8,
+                 map_y: int = 120, map_x: int = 120, seed: int = 0,
+                 winner_player: int = 1):
+        from .dummy_obs import build_dummy_obs, make_unit
+        from ..lib import actions as ACT
+
+        self._build = build_dummy_obs
+        self._make_unit = make_unit
+        self._unit_type = ACT.UNIT_TYPES[10]
+        self.player_id = player_id
+        self._end_at = end_at
+        self._n_units = n_units
+        self._map = (map_y, map_x)
+        self._rng = np.random.default_rng(seed)
+        self._winner = winner_player
+        self.game_loop = 0
+        self.status_ended = False
+        self.acts_log: List[list] = []
+
+    def reset(self) -> None:
+        """Restart the fake game (role of restart_game in the real client)."""
+        self.game_loop = 0
+        self.status_ended = False
+
+    def step(self, loops: int) -> None:
+        self.game_loop += loops
+
+    def acts(self, raw_cmds: List[dict]):
+        self.acts_log.append(raw_cmds)
+        return [1]
+
+    def observe(self, target_game_loop: int = 0):
+        if target_game_loop > self.game_loop:
+            self.game_loop = target_game_loop
+        units = [
+            self._make_unit(100 + i, self._unit_type, x=5 + i, y=10)
+            for i in range(self._n_units)
+        ]
+        obs = self._build(
+            units=units, game_loop=self.game_loop, player_id=self.player_id,
+            map_y=self._map[0], map_x=self._map[1], rng=self._rng,
+        )
+        if self.game_loop >= self._end_at:
+            self.status_ended = True
+            from types import SimpleNamespace as NS
+
+            obs.player_result = [
+                NS(player_id=1, result=1 if self._winner == 1 else 2),
+                NS(player_id=2, result=1 if self._winner == 2 else 2),
+            ]
+        else:
+            obs.player_result = []
+        return obs
