@@ -1,29 +1,58 @@
 """Benchmark: SL learner throughput on the real chip.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
 
 Metric: supervised-learning replay-frames/sec on a single chip with the FULL
 flagship model (the reference's headline SL number is ~384 frames/s per A100
 — 56xA100, total batch 336 x traj 64 at ~1s/iter; see BASELINE.md). A frame
 is one (obs, action) trajectory step through forward+loss+backward+adam.
+
+Robustness (round-1 postmortem: BENCH_r01 died in TPU backend init with no
+number at all): the measurement runs in a child process; the parent retries
+with backoff on init failures (the single tunneled chip admits one client at
+a time and a previous holder may linger) and ALWAYS prints a parseable JSON
+line — a diagnostic one with value 0 if every attempt fails.
+
+The child sweeps batch sizes at trajectory length 64 (the regime the
+baseline numbers live in) up to a time budget and reports the best
+operating point, plus an MFU estimate from XLA's own cost analysis.
 """
 from __future__ import annotations
 
 import json
+import os
+import subprocess
+import sys
 import time
 
+BASELINE_FRAMES_PER_SEC_PER_CHIP = 384.0  # A100, reference large-scale SL
 
-def main():
+# peak bf16 matmul throughput per chip, for the MFU estimate
+_PEAK_FLOPS = {
+    "v4": 275e12,
+    "v5 lite": 197e12,
+    "v5e": 197e12,
+    "v5p": 459e12,
+    "v5": 459e12,
+    "v6 lite": 918e12,
+    "v6e": 918e12,
+}
+
+
+def _peak_flops(device_kind: str):
+    kind = device_kind.lower()
+    best = None
+    for name, peak in _PEAK_FLOPS.items():
+        if name in kind and (best is None or len(name) > best[0]):
+            best = (len(name), peak)
+    return best[1] if best else None
+
+
+def _bench_config(batch_size: int, unroll_len: int, iters: int = 4):
     import jax
 
     from distar_tpu.learner import SLLearner
 
-    BASELINE_FRAMES_PER_SEC_PER_CHIP = 384.0  # A100, reference large-scale SL
-
-    import os
-
-    batch_size = int(os.environ.get("BENCH_BATCH", 4))
-    unroll_len = int(os.environ.get("BENCH_UNROLL", 16))
     cfg = {
         "common": {"experiment_name": "bench_sl"},
         "learner": {
@@ -37,30 +66,164 @@ def main():
     }
     learner = SLLearner(cfg)
 
-    # warmup (compile)
     data = next(learner._dataloader)
-    learner._train(dict(data))
+    learner._train(dict(data))  # warmup (compile)
     jax.block_until_ready(learner.state["params"])
 
-    iters = 4
     start = time.perf_counter()
     for _ in range(iters):
         learner._train(dict(data))
     jax.block_until_ready(learner.state["params"])
     elapsed = time.perf_counter() - start
-
     frames_per_sec = batch_size * unroll_len * iters / elapsed
+
+    flops_per_step = None
+    try:
+        batch = {k: v for k, v in dict(data).items() if k not in ("new_episodes", "traj_lens")}
+        batch = jax.tree.map(jax.numpy.asarray, batch)
+        lowered = learner._train_step.lower(
+            learner.state["params"], learner.state["opt_state"], batch, learner._hidden
+        )
+        # unoptimized-HLO flops straight off the Lowered — adequate for an
+        # MFU estimate and avoids a second multi-minute XLA compile
+        cost = lowered.cost_analysis()
+        if cost:
+            flops_per_step = float(cost.get("flops", 0.0)) or None
+    except Exception:
+        pass
+
+    del learner
+    return frames_per_sec, elapsed / iters, flops_per_step
+
+
+def run_child():
+    import jax
+
+    # persistent compile cache: the flagship train step costs minutes to
+    # compile through the tunneled chip; retries and later rounds must not
+    # pay it again
+    jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache_distar_tpu_bench")
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+    devices = jax.devices()
+    device_kind = devices[0].device_kind
+    peak = _peak_flops(device_kind)
+
+    if "BENCH_BATCH" in os.environ or "BENCH_UNROLL" in os.environ:
+        configs = [(int(os.environ.get("BENCH_BATCH", 6)), int(os.environ.get("BENCH_UNROLL", 64)))]
+    else:
+        # sweep toward the HBM-limited batch; baseline regime is traj 64
+        # (reference per-A100 slice: batch 6 x traj 64)
+        configs = [(6, 64), (16, 64), (32, 64)]
+    budget = float(os.environ.get("BENCH_TIME_BUDGET", 420.0))
+
+    t0 = time.perf_counter()
+    best = None
+    sweep = []
+
+    def emit(b):
+        # one full result line per completed config: if the parent kills us
+        # mid-sweep, the best-so-far measurement still reaches stdout
+        out = {
+            "metric": "SL replay-frames/sec/chip (full model, fwd+loss+bwd+adam)",
+            "value": b["frames_per_sec"],
+            "unit": "frames/s",
+            "vs_baseline": round(b["frames_per_sec"] / BASELINE_FRAMES_PER_SEC_PER_CHIP, 3),
+            "device": device_kind,
+            "batch": b["batch"],
+            "unroll": b["unroll"],
+            "sweep": list(sweep),
+        }
+        if "mfu" in b:
+            out["mfu"] = b["mfu"]
+        print(json.dumps(out), flush=True)
+
+    for batch_size, unroll_len in configs:
+        if best is not None and time.perf_counter() - t0 > budget:
+            break
+        try:
+            fps, step_time, flops = _bench_config(batch_size, unroll_len)
+        except Exception as e:  # OOM at the top of the sweep is expected
+            sweep.append({"batch": batch_size, "unroll": unroll_len, "error": repr(e)[:200]})
+            break
+        point = {
+            "batch": batch_size,
+            "unroll": unroll_len,
+            "frames_per_sec": round(fps, 2),
+            "step_time_s": round(step_time, 4),
+        }
+        if flops and peak:
+            point["mfu"] = round(flops / step_time / peak, 4)
+        sweep.append(point)
+        if best is None or fps > best["frames_per_sec"]:
+            best = point
+        emit(best)
+
+    if best is None:
+        raise RuntimeError(f"no config completed: {sweep}")
+
+
+def main():
+    deadline = time.monotonic() + float(os.environ.get("BENCH_DEADLINE", 1500.0))
+    attempt_timeout = float(os.environ.get("BENCH_ATTEMPT_TIMEOUT", 900.0))
+    backoff = 20.0
+    last_err = ""
+
+    def scan_for_result(stdout) -> bool:
+        if isinstance(stdout, bytes):
+            stdout = stdout.decode("utf-8", "replace")
+        for line in reversed((stdout or "").strip().splitlines()):
+            try:
+                parsed = json.loads(line)
+            except (json.JSONDecodeError, ValueError):
+                continue
+            if isinstance(parsed, dict) and "metric" in parsed:
+                print(line)
+                return True
+        return False
+
+    for attempt in range(4):
+        remaining = deadline - time.monotonic()
+        if remaining <= 60:
+            break
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--run"],
+                capture_output=True,
+                text=True,
+                timeout=min(attempt_timeout, remaining),
+            )
+        except subprocess.TimeoutExpired as e:
+            # the child emits a result line per completed config — salvage
+            # the best-so-far even when the sweep hung partway
+            if scan_for_result(e.stdout):
+                return
+            last_err = f"attempt {attempt}: timeout after {e.timeout}s"
+            continue
+        if scan_for_result(proc.stdout):
+            return
+        last_err = (
+            f"attempt {attempt}: rc={proc.returncode} "
+            f"stderr_tail={proc.stderr[-1500:]!r} stdout_tail={proc.stdout[-300:]!r}"
+        )
+        if attempt < 3:
+            time.sleep(min(backoff, max(0.0, deadline - time.monotonic())))
+            backoff *= 2
     print(
         json.dumps(
             {
                 "metric": "SL replay-frames/sec/chip (full model, fwd+loss+bwd+adam)",
-                "value": round(frames_per_sec, 2),
+                "value": 0.0,
                 "unit": "frames/s",
-                "vs_baseline": round(frames_per_sec / BASELINE_FRAMES_PER_SEC_PER_CHIP, 3),
+                "vs_baseline": 0.0,
+                "error": last_err[-2000:],
             }
         )
     )
 
 
 if __name__ == "__main__":
-    main()
+    if "--run" in sys.argv:
+        run_child()
+    else:
+        main()

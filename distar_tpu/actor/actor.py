@@ -16,6 +16,8 @@ pool behind the same interface.
 """
 from __future__ import annotations
 
+import logging
+import os
 import time
 from typing import Callable, Dict, List, Optional
 
@@ -100,6 +102,33 @@ class Actor:
                 return jax.tree.map(np.asarray, data["params"])
         return self._initial_params()
 
+    def _load_teacher_params(self, side: int, job: dict, own_params):
+        """Frozen teacher weights for the human-prior KL (reference
+        actor_comm.py:114-118: teacher = separate SL checkpoint with value
+        nets stripped). Falls back to a frozen snapshot of the player's own
+        initial weights — logged loudly, since a self-teacher makes the
+        kl/action_type_kl terms near-vacuous."""
+        tids = job.get("teacher_player_ids", [])
+        tpaths = job.get("teacher_checkpoint_paths", [])
+        tid = tids[side] if side < len(tids) else "none"
+        tpath = str(tpaths[side]) if side < len(tpaths) else "none"
+        if tid != "none" and tpath not in ("none", "") and os.path.exists(tpath):
+            try:
+                from ..utils.checkpoint import load_checkpoint
+
+                state = load_checkpoint(tpath, target={"params": own_params})["state"]
+                return state["params"]
+            except Exception as e:
+                logging.warning(
+                    f"actor: failed to load teacher checkpoint {tpath} for side {side}: {e!r}"
+                )
+        logging.warning(
+            f"actor: no teacher checkpoint for side {side} "
+            f"(teacher_id={tid!r}, path={tpath!r}); freezing the player's initial "
+            "weights as teacher — KL terms will be weak until a real SL teacher is wired"
+        )
+        return own_params
+
     def _pull_latest_model(self, player_id: str):
         """Drain the FIFO plane to the freshest publication (non-blocking).
         reset_flag ORs across everything drained — exactly one publication
@@ -162,6 +191,10 @@ class Actor:
             for side, pid in enumerate(player_ids)
         }
         teacher_hidden = {side: infer[side]._zero_hidden() for side in infer}
+        teacher_params = {
+            side: self._load_teacher_params(side, job, params[pid])
+            for side, pid in enumerate(player_ids)
+        }
         agents = {
             (e, side): Agent(
                 pid,
@@ -234,11 +267,10 @@ class Actor:
                         prepared.append(last_prepared[(e, side)])
                         active.append(False)
                 outs = infer[side].sample(prepared, active)
-                # teacher logits at act time, stored until the next obs
-                # arrives (teacher == own params until distinct teacher
-                # checkpoints are wired)
+                # teacher logits at act time with the FROZEN teacher weights,
+                # stored until the next obs arrives
                 t_logits, teacher_hidden[side] = infer[side].teacher_logits(
-                    params[pid], prepared, teacher_hidden[side], outs, active
+                    teacher_params[side], prepared, teacher_hidden[side], outs, active
                 )
                 for e in range(n_env):
                     if active[e]:

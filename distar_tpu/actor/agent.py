@@ -246,12 +246,27 @@ class Agent:
                     self._old_bo_reward = new_bo
 
         cum_flag = False
-        if action_type in ACT.CUMULATIVE_STAT_ACTIONS and success:
+        # cancelled builds lose their cumulative-stat credit (reference
+        # agent.py:682-697, cum_type 'action'): resolve the cancelled order
+        # from the selected unit's order fields and decrement its slot
+        if ACT.ACTIONS[action_type]["name"] in ("Cancel_quick", "Cancel_Last_quick"):
+            cancelled = self._resolve_cancelled_action()
+            # 0 = unresolved (and the no-op slot of CUMULATIVE_STAT_ACTIONS)
+            if cancelled > 0 and cancelled in ACT.CUMULATIVE_STAT_ACTIONS:
+                cum_flag = True
+                ci = ACT.CUMULATIVE_STAT_ACTIONS.index(cancelled)
+                self._behaviour_cumulative_stat[ci] = max(
+                    0, self._behaviour_cumulative_stat[ci] - 1
+                )
+        if action_type in ACT.CUMULATIVE_STAT_ACTIONS:
             cum_flag = True
             self._behaviour_cumulative_stat[
                 ACT.CUMULATIVE_STAT_ACTIONS.index(action_type)
             ] += 1
-        if self.use_cum_reward and cum_flag:
+        # stat updates above are unconditional, the reward recompute gates on
+        # the action having succeeded (reference agent.py:699-705)
+        if self.use_cum_reward and cum_flag and success:
+            # hamming_distance binarizes internally (reference casts to bool)
             new_cum = (
                 -hamming_distance(self._behaviour_cumulative_stat, self._target_cumulative_stat)
                 / self._cum_norm
@@ -262,6 +277,29 @@ class Agent:
         self._total_cum_reward += cum_reward
         self._total_battle_reward += battle_reward
         return {"build_order": bo_reward, "built_unit": cum_reward, "battle": battle_reward}
+
+    def _resolve_cancelled_action(self) -> int:
+        """Which action a Cancel_quick/Cancel_Last_quick undoes: the selected
+        unit's current order (order_id_0, a mix-ability index) when it has one
+        order, else the LAST queued order (order_id_{n-1}, a queue-action id)
+        (reference agent.py:682-692)."""
+        if self._output is None or self._observation is None:
+            return 0
+        su = np.asarray(self._output["action_info"]["selected_units"]).reshape(-1)
+        if su.size == 0:
+            return 0
+        unit_index = int(su[0])
+        ent = self._observation["entity_info"]
+        order_len = int(np.asarray(ent["order_length"]).reshape(-1)[unit_index])
+        if order_len == 1:
+            ability = int(np.asarray(ent["order_id_0"]).reshape(-1)[unit_index])
+            return ACT.UNIT_ABILITY_TO_ACTION.get(ability, 0)
+        if order_len > 1:
+            key = f"order_id_{min(order_len - 1, 3)}"
+            q = int(np.asarray(ent[key]).reshape(-1)[unit_index])
+            if 1 <= q <= len(ACT.QUEUE_ACTIONS):
+                return ACT.QUEUE_ACTIONS[q - 1]
+        return 0
 
     def episode_stats(self) -> dict:
         """Per-episode summary for league stat meters (reference result_info:
@@ -326,6 +364,7 @@ class Agent:
             "build_order_mask": float(self.use_bo_reward),
             "built_unit_mask": float(self.use_cum_reward),
             "effect_mask": 1.0,
+            "step_mask": 1.0,
         }
         step_data = {
             "spatial_info": self._observation["spatial_info"],
@@ -347,6 +386,7 @@ class Agent:
             },
             "step": float(self._game_step),
             "mask": mask,
+            "done": float(done),
             "model_last_iter": float(self.model_last_iter),
         }
         if self._value_feature is not None:
@@ -356,7 +396,9 @@ class Agent:
             # fixed-shape contract: an episode ending mid-window pads the
             # trajectory to traj_len by repeating the final step with masks,
             # rewards, and logps zeroed — padded steps contribute nothing to
-            # any loss term but keep T static for XLA
+            # any loss term but keep T static for XLA. step_mask=0 + done=1
+            # let the loss zero post-terminal values and mask the always-on
+            # heads on pads (the terminal +-1 reward stays at its real step).
             while done and len(self._data_buffer) < self._traj_len:
                 pad = copy.deepcopy(self._data_buffer[-1])
                 pad["mask"] = {
@@ -365,7 +407,9 @@ class Agent:
                     "build_order_mask": 0.0,
                     "built_unit_mask": 0.0,
                     "effect_mask": 0.0,
+                    "step_mask": 0.0,
                 }
+                pad["done"] = 1.0
                 pad["reward"] = {k: 0.0 for k in pad["reward"]}
                 pad["behaviour_logp"] = {
                     k: np.zeros_like(v) for k, v in pad["behaviour_logp"].items()

@@ -11,6 +11,7 @@ The RL trajectory batch layout (time-major, mirroring the reference's
   teacher_logit[head]       [T, B, ...]
   reward[field]             [T, B]
   step                      [T, B]
+  done                      [T, B]  (1 from the terminal step onward)
   mask                      dict (see losses.rl_loss)
   model_last_iter           [B]
 
@@ -99,6 +100,7 @@ def fake_rl_batch(
         "built_unit_mask": np.ones((T, B), np.float32),
         "effect_mask": np.ones((T, B), np.float32),
         "cum_action_mask": np.ones((T, B), np.float32),
+        "step_mask": np.ones((T, B), np.float32),
     }
     rewards = {
         f: rng.integers(-1, 2, (T, B)).astype(np.float32) for f in RL_REWARD_FIELDS
@@ -130,6 +132,7 @@ def fake_rl_batch(
         "teacher_logit": teacher_logit,
         "reward": rewards,
         "step": rng.integers(0, 10000, (T, B)).astype(np.float32),
+        "done": np.zeros((T, B), np.float32),
         "mask": masks,
         "model_last_iter": np.zeros((B,), np.float32),
     }

@@ -59,6 +59,7 @@ def collate_trajectories(trajs: List[list]) -> Dict:
         "teacher_logit": stack_tb(lambda s: s["teacher_logit"]),
         "reward": stack_tb(lambda s: s["reward"]),
         "step": stack_tb(lambda s: s["step"]),
+        "done": stack_tb(lambda s: s.get("done", 0.0)),
         "model_last_iter": np.asarray(
             [float(traj[0].get("model_last_iter", 0.0)) for traj in trajs], np.float32
         ),

@@ -82,6 +82,7 @@ def make_rl_train_step(model: Model, loss_cfg: ReinforcementLossConfig, optimize
             "action": batch["action_info"],
             "reward": batch["reward"],
             "step": batch["step"],
+            "done": batch.get("done"),
             "mask": batch["mask"],
             "entity_num": batch["entity_num"].reshape(-1, batch_size)[:unroll_len],
             "selected_units_num": batch["selected_units_num"],

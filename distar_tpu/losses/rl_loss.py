@@ -18,7 +18,9 @@ Input layout (time-major):
   mask:
     actions_mask[head]    [T, B]   per-step head applicability
     selected_units_mask   [T, B, S]
+    step_mask             [T, B]   1 real step / 0 pad step (optional)
     build_order_mask, built_unit_mask, effect_mask, cum_action_mask  [T, B]
+  done                    [T, B]   1 from the terminal step onward (optional)
   entity_num              [T, B]   for entropy normalisation
   selected_units_num      [T, B]
 """
@@ -101,11 +103,30 @@ def compute_rl_loss(
 
     info: Dict[str, jnp.ndarray] = {}
 
-    # if the trajectory's final step didn't end the game (winloss reward 0),
-    # keep the bootstrap value; else zero it (reference rl_loss.py:47-49)
-    not_done = (rewards["winloss"][-1] == 0).astype(values[next(iter(values))].dtype)
+    vdtype = values[next(iter(values))].dtype
+    # step_mask: 1 on real steps, 0 on the pad steps that fill a trajectory
+    # window after a mid-window episode end. Padded steps must contribute to
+    # NO loss term (incl. the always-on action_type/delay heads) and their
+    # post-terminal values are 0 by definition.
+    step_mask = masks.get("step_mask")
+    if step_mask is None:
+        step_mask = jnp.ones_like(rewards["winloss"], dtype=vdtype)
+    else:
+        step_mask = step_mask.astype(vdtype)
+    # explicit done flag [T, B] (1 from the terminal step onward): zero the
+    # bootstrap value when the episode ended anywhere in this window — the
+    # reference zeroes it on done (rl_loss.py:47-49); inferring done from
+    # reward[-1]==0 breaks when the terminal +-1 sits mid-window before pads.
+    done = inputs.get("done")
+    if done is None:
+        not_done = (rewards["winloss"][-1] == 0).astype(vdtype)
+    else:
+        not_done = 1.0 - done[-1].astype(vdtype)
     for field in values:
-        values[field] = values[field].at[-1].multiply(not_done)
+        v = values[field]
+        v = v.at[:-1].multiply(step_mask)  # post-terminal states have value 0
+        v = v.at[-1].multiply(not_done)
+        values[field] = v
 
     # per-head distribution prep
     target_logp_full: Dict[str, jnp.ndarray] = {}
@@ -146,7 +167,7 @@ def compute_rl_loss(
                     gammas=cfg.pg_gamma, lambda_=cfg.vtrace_lambda,
                 )
             )
-            pg = -adv * target_action_logp[head]
+            pg = -adv * target_action_logp[head] * step_mask
             if head not in ALWAYS_ON:
                 pg = pg * masks["actions_mask"][head]
             if field in FIELD_MASKS:
@@ -165,7 +186,7 @@ def compute_rl_loss(
     )
     for head in HEADS:
         adv = clipped_rhos[head] * upgo_adv_base
-        ug = -adv * target_action_logp[head]
+        ug = -adv * target_action_logp[head] * step_mask
         if head not in ALWAYS_ON:
             ug = ug * masks["actions_mask"][head]
         ug = ug.mean()
@@ -184,7 +205,7 @@ def compute_rl_loss(
         returns = jax.lax.stop_gradient(
             generalized_lambda_returns(reward, gammas[field], baseline, cfg.td_lambda)
         )
-        td = 0.5 * jnp.square(returns - baseline[:-1])
+        td = 0.5 * jnp.square(returns - baseline[:-1]) * step_mask
         if field in FIELD_MASKS:
             td = td * masks[FIELD_MASKS[field]]
         td = td.mean()
@@ -204,9 +225,12 @@ def compute_rl_loss(
             ent = ent.sum(-1) / norm
             ent = (ent * su_mask).sum(-1) / (su_mask.sum(-1) + 1e-9)
         elif head == "target_unit":
-            ent = ent.sum(-1) / (jnp.log(entity_num.astype(jnp.float32) + 1e-9))
+            # log(num_valid_targets + 1) (reference as_rl_utils.py:59-61);
+            # the +1 inside the log also guards entity_num == 1
+            ent = ent.sum(-1) / (jnp.log(entity_num.astype(jnp.float32) + 1.0) + 1e-9)
         else:
             ent = ent.sum(-1) / jnp.log(float(ent.shape[-1]))
+        ent = ent * step_mask
         if head not in ALWAYS_ON:
             ent = ent * masks["actions_mask"][head]
         ent_mean = ent.mean()
@@ -223,6 +247,7 @@ def compute_rl_loss(
             kl = (jnp.exp(ref_logp) * (ref_logp - target_logp_full[head])).sum(-1)
             if head == "selected_units":
                 kl = (kl * su_mask).sum(-1)
+            kl = kl * step_mask
             if head not in ALWAYS_ON:
                 kl = kl * masks["actions_mask"][head]
             out[head] = kl

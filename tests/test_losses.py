@@ -111,6 +111,45 @@ def test_rl_loss_dapo(rng):
     assert abs(float(info["dapo/total"])) < 1e-4
 
 
+def test_rl_loss_done_padding_semantics(rng):
+    """A mid-window episode end (terminal step + pads): the bootstrap value
+    and all padded-step values are ignored, and padded steps contribute no
+    gradient — including the always-on action_type/delay heads."""
+    inputs = _rl_inputs(rng)
+    t_star = 1  # terminal step; steps t_star+1.. are pads
+    step_mask = np.ones((T, B), np.float32)
+    step_mask[t_star + 1:] = 0.0
+    done = np.zeros((T, B), np.float32)
+    done[t_star:] = 1.0
+    inputs["mask"] = dict(inputs["mask"], step_mask=jnp.asarray(step_mask))
+    inputs["done"] = jnp.asarray(done)
+    # terminal reward at its real position, pads zeroed
+    inputs["reward"] = {
+        f: r * jnp.asarray(step_mask) for f, r in inputs["reward"].items()
+    }
+
+    total, _ = compute_rl_loss(inputs)
+
+    # value estimates past the terminal step must not matter
+    for rows in ([T], list(range(t_star + 1, T + 1))):
+        poisoned = dict(inputs)
+        poisoned["value"] = {
+            f: v.at[jnp.asarray(rows)].set(1e3) for f, v in inputs["value"].items()
+        }
+        total_p, _ = compute_rl_loss(poisoned)
+        assert jnp.allclose(total, total_p, atol=1e-5), rows
+
+    # padded steps give zero gradient to every head's logits
+    def loss_fn(target_logit):
+        return compute_rl_loss(dict(inputs, target_logit=target_logit))[0]
+
+    g = jax.grad(loss_fn)(inputs["target_logit"])
+    for head, gh in g.items():
+        pad_grad = float(jnp.abs(gh[t_star + 1:]).sum())
+        assert pad_grad == 0.0, head
+        assert float(jnp.abs(gh[: t_star + 1]).sum()) > 0.0, head
+
+
 def _sl_inputs(rng):
     logits = {
         "action_type": jnp.asarray(rng.standard_normal((B, 327)).astype(np.float32)),
