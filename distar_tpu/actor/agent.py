@@ -289,6 +289,9 @@ class Agent:
         if su.size == 0:
             return 0
         unit_index = int(su[0])
+        # su[0] may be the end-of-selection token (== entity_num): no unit
+        if unit_index >= int(np.asarray(self._observation["entity_num"]).reshape(-1)[0]):
+            return 0
         ent = self._observation["entity_info"]
         order_len = int(np.asarray(ent["order_length"]).reshape(-1)[unit_index])
         if order_len == 1:

@@ -95,12 +95,71 @@ def _lut(lut: np.ndarray, ids) -> np.ndarray:
     return np.where((ids >= 0) & (ids < len(lut)) & (out >= 0), out, 0)
 
 
+def extract_z(
+    action_infos: Sequence[Dict],
+    home_born_location: Optional[int] = None,
+    away_born_location: Optional[int] = None,
+    filter_spine: bool = True,
+    bo_zergling_num: int = 8,
+):
+    """Strategy-statistics ("Z") extraction from a decoded action stream
+    (reference get_z, features.py:419-460): beginning-order indices +
+    locations (zergling spam capped at ``bo_zergling_num``, spine crawlers
+    nearer our base than the enemy's dropped) and the dense cumulative-stat
+    vector.
+
+    Returns (beginning_order[20], cumulative_stat[dense], bo_len,
+    bo_location[20]).
+    """
+    sx = F.SPATIAL_SIZE[1]
+    own = (home_born_location % sx, home_born_location // sx) if home_born_location is not None else None
+    away = (away_born_location % sx, away_born_location // sx) if away_born_location is not None else None
+
+    zergling_count = 0
+    beginning_order: List[int] = []
+    bo_location: List[int] = []
+    cumulative_stat = np.zeros(ACT.NUM_CUMULATIVE_STAT_ACTIONS, np.int8)
+    for step in action_infos:
+        action_type = int(np.asarray(step["action_info"]["action_type"]).reshape(-1)[0])
+        if action_type == 322:  # Train_Zergling_quick
+            zergling_count += 1
+            if zergling_count > bo_zergling_num:
+                continue
+        if action_type in ACT.BEGINNING_ORDER_ACTIONS:
+            location = int(np.asarray(step["action_info"]["target_location"]).reshape(-1)[0])
+            if filter_spine and action_type == 54 and own and away:  # Build_SpineCrawler_pt
+                x, y = location % sx, location // sx
+                own_d = (own[0] - x) ** 2 + (own[1] - y) ** 2
+                away_d = (away[0] - x) ** 2 + (away[1] - y) ** 2
+                if own_d < away_d:
+                    continue
+            beginning_order.append(ACT.BEGINNING_ORDER_ACTIONS.index(action_type))
+            bo_location.append(location)
+        if action_type in ACT.CUMULATIVE_STAT_ACTIONS:
+            cumulative_stat[ACT.CUMULATIVE_STAT_ACTIONS.index(action_type)] = 1
+
+    bo_len = len(beginning_order)
+    L = F.BEGINNING_ORDER_LENGTH
+    beginning_order = (beginning_order + [0] * L)[:L]
+    bo_location = (bo_location + [0] * L)[:L]
+    return (
+        np.asarray(beginning_order, np.int16),
+        cumulative_stat,
+        bo_len,
+        np.asarray(bo_location, np.int16),
+    )
+
+
 class ProtoFeatures:
     """Per-game feature transformer bound to game_info (map size, races)."""
 
     def __init__(self, game_info, cfg: Optional[dict] = None):
         self.map_size = game_info.start_raw.map_size  # .x, .y
         self.map_name = getattr(game_info, "map_name", "unknown")
+        self.start_locations = [
+            (float(p.x), float(p.y))
+            for p in getattr(game_info.start_raw, "start_locations", [])
+        ]
         # 3 = observer type in sc_pb; duck-typed: anything with player_id +
         # race_requested and type != observer
         self.requested_races = {
@@ -108,6 +167,34 @@ class ProtoFeatures:
             for info in game_info.player_info
             if getattr(info, "type", 1) != 3
         }
+
+    def flat_location(self, x: float, y: float) -> int:
+        """World (x, y) -> flat spatial index after the y flip."""
+        xi = min(int(x), int(self.map_size.x) - 1)
+        yi = min(int(self.map_size.y - y), int(self.map_size.y) - 1)
+        return max(yi, 0) * F.SPATIAL_SIZE[1] + max(xi, 0)
+
+    def born_locations(self, first_obs) -> (int, int):
+        """(home, away) flat born locations from the initial observation:
+        home = our first base structure, away = the farthest start location
+        (reference Features keeps home/away_born_location for the Z spine
+        filter, features.py:431-446)."""
+        home_xy = None
+        for u in first_obs.observation.raw_data.units:
+            if u.alliance == 1 and u.unit_type in (59, 18, 86):  # nexus/cc/hatchery
+                home_xy = (u.pos.x, u.pos.y)
+                break
+        if home_xy is None:
+            return 0, 0
+        away_xy = None
+        best = -1.0
+        for sx, sy in self.start_locations:
+            d = (sx - home_xy[0]) ** 2 + (sy - home_xy[1]) ** 2
+            if d > best:
+                best, away_xy = d, (sx, sy)
+        home = self.flat_location(*home_xy)
+        away = self.flat_location(*away_xy) if away_xy else home
+        return home, away
 
     # ------------------------------------------------------------------ obs
     def transform_obs(self, obs, padding_spatial: bool = True, opponent_obs=None) -> Dict:
@@ -406,7 +493,7 @@ class ProtoFeatures:
             sel = np.asarray(action["selected_units"]).reshape(-1)
             n_tags = len(tags)
             if selected_units_num is not None:
-                sel = sel[: int(np.asarray(selected_units_num))]
+                sel = sel[: int(np.asarray(selected_units_num).reshape(-1)[0])]
             else:
                 end = np.nonzero(sel == n_tags)[0]
                 if end.size:
@@ -457,8 +544,14 @@ class ProtoFeatures:
 
         target_unit = 0
         location = 0
-        pos = getattr(uc, "target_world_space_pos", None)
-        target_tag = getattr(uc, "target_unit_tag", None)
+        if hasattr(uc, "HasField"):
+            # real protos: unset oneof members read as defaults, so presence
+            # must come from HasField (duck-typed fixtures use None-absence)
+            pos = uc.target_world_space_pos if uc.HasField("target_world_space_pos") else None
+            target_tag = uc.target_unit_tag if uc.HasField("target_unit_tag") else None
+        else:
+            pos = getattr(uc, "target_world_space_pos", None)
+            target_tag = getattr(uc, "target_unit_tag", None)
         if target_tag is not None:
             kind = "unit"
             if target_tag in tag_index:

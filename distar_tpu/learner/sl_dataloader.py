@@ -2,12 +2,10 @@
 
 Role parity with the reference SLDataloader (reference: distar/agent/default/
 sl_training/sl_dataloader.py — replay-decode workers feeding trajectory
-windows with carried LSTM state). The SC2 two-pass replay decoder
-(replay_decoder.py) requires the game client; its output contract is frozen
-here: one ``.npz``-saved step list per replay-player, each step carrying the
-feature-schema obs + teacher-forced action labels (see ReplayDataset.save).
-Until the client binding lands, datasets come from any external decoder or
-``make_fake_dataset``.
+windows with carried LSTM state). Datasets are produced by the two-pass SC2
+replay decoder (envs/replay_decoder.py) over the client layer (envs/sc2), or
+by ``make_fake_dataset`` for tests; the step contract is frozen in
+ReplayDataset.save.
 
 Windowing matches the reference: each trajectory is cut into unroll_len
 windows; a batch slot advances through one trajectory's windows before
@@ -66,28 +64,25 @@ class SLDataloader:
         self._rng = np.random.default_rng(seed)
         self._slots: List[List[dict]] = [[] for _ in range(batch_size)]
         self._fresh = [True] * batch_size
-        self._warned_short: set = set()
 
     def _refill(self, slot: int) -> None:
-        # trajectories shorter than one window can't fill a fixed-shape batch
-        # slot; skip them (once-per-path warning) rather than emit ragged data
-        for _ in range(len(self.dataset.paths) * 2):
-            idx = int(self._rng.integers(0, len(self.dataset.paths)))
-            traj = self.dataset.load(idx)
-            if len(traj) >= self.unroll_len:
-                self._slots[slot] = list(traj)
-                self._fresh[slot] = True
-                return
-            if idx not in self._warned_short:
-                self._warned_short.add(idx)
-                print(
-                    f"SLDataloader: skipping {self.dataset.paths[idx]} "
-                    f"({len(traj)} steps < unroll_len {self.unroll_len})"
-                )
-        raise RuntimeError(
-            f"no trajectory in {self.dataset.root} has >= unroll_len="
-            f"{self.unroll_len} steps"
-        )
+        idx = int(self._rng.integers(0, len(self.dataset.paths)))
+        traj = self.dataset.load(idx)
+        if not traj:
+            raise RuntimeError(f"empty trajectory: {self.dataset.paths[idx]}")
+        self._slots[slot] = list(traj)
+        self._fresh[slot] = True
+
+    @staticmethod
+    def _pad_window(window: List[dict], length: int) -> List[dict]:
+        """Pad a short window (short replay, or a trajectory tail) to the
+        fixed unroll by repeating the final step with every action_mask head
+        zeroed — padded steps contribute to no SL loss term. The reference
+        pads short trajectories rather than dropping them; skipping would
+        discard short-game replays wholesale at unroll 32-64."""
+        pad_src = dict(window[-1])
+        pad_src["action_mask"] = {k: 0.0 for k in pad_src["action_mask"]}
+        return window + [pad_src] * (length - len(window))
 
     def __iter__(self) -> Iterator[Dict]:
         return self
@@ -96,12 +91,15 @@ class SLDataloader:
         T = self.unroll_len
         windows, new_episodes = [], []
         for b in range(self.batch_size):
-            if len(self._slots[b]) < T:
+            if not self._slots[b]:
                 self._refill(b)
             new_episodes.append(self._fresh[b])
             self._fresh[b] = False
-            windows.append(self._slots[b][:T])
+            window = self._slots[b][:T]
             self._slots[b] = self._slots[b][T:]
+            if len(window) < T:
+                window = self._pad_window(window, T)
+            windows.append(window)
         # flatten batch-major: [B*T] with per-slot contiguous windows
         flat = [step for win in windows for step in win]
         batch = {

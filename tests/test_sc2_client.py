@@ -1,0 +1,332 @@
+"""SC2 client layer tests: websocket protocol + RemoteController status
+machine against the in-process fake SC2 server (real websocket handshake,
+real proto wire format), multiplayer create/join port plumbing through the
+launcher, process launch/teardown, version routing, map registry.
+
+Strategy per VERDICT round-1 #2: where the retail binary is absent, the
+client stack runs byte-identically against a recorded-protocol fake
+(fake_sc2.FakeSC2Server) — only the simulation behind /sc2api differs.
+"""
+import os
+import stat
+import sys
+
+import numpy as np
+import pytest
+
+from distar_tpu.envs.sc2 import maps as map_registry
+from distar_tpu.envs.sc2 import run_configs
+from distar_tpu.envs.sc2.fake_sc2 import FakeGameCore, FakeSC2Server
+from distar_tpu.envs.sc2.launcher import (
+    Bot,
+    Player,
+    RealSC2Env,
+    SC2GameLauncher,
+    crop_and_deduplicate_names,
+)
+from distar_tpu.envs.sc2.proto import Status, sc_pb
+from distar_tpu.envs.sc2.protocol import ProtocolError
+from distar_tpu.envs.sc2.remote_controller import RemoteController
+from distar_tpu.lib import actions as ACT
+from distar_tpu.lib import features as F
+
+
+@pytest.fixture
+def server():
+    s = FakeSC2Server(game=FakeGameCore(end_at=300))
+    yield s
+    s.stop()
+
+
+def connect(server):
+    return RemoteController("127.0.0.1", server.port, timeout_seconds=5)
+
+
+# ------------------------------------------------------------------ protocol
+def test_controller_ping_and_status(server):
+    c = connect(server)
+    res = c.ping()
+    assert res.base_build == server.game.base_build
+    assert c.status == Status.launched
+    c.quit()
+    assert c.status == Status.quit
+
+
+def test_valid_status_gating(server):
+    c = connect(server)
+    with pytest.raises(ProtocolError):
+        c.observe()  # only legal in_game/in_replay/ended
+    with pytest.raises(ProtocolError):
+        c.step()
+    c.quit()
+
+
+def test_create_join_observe_step_act(server):
+    c = connect(server)
+    create = sc_pb.RequestCreateGame()
+    create.local_map.map_path = "FakeMap.SC2Map"
+    create.player_setup.add(type=sc_pb.Participant)
+    create.player_setup.add(type=sc_pb.Computer, race=2, difficulty=7)
+    c.create_game(create)
+    assert c.status == Status.init_game
+
+    join = sc_pb.RequestJoinGame(options=sc_pb.InterfaceOptions(raw=True, score=True))
+    join.race = 2
+    res = c.join_game(join)
+    assert res.player_id == 1
+    assert c.status == Status.in_game
+
+    gi = c.game_info()
+    assert gi.start_raw.map_size.x > 0
+
+    obs = c.observe(target_game_loop=0)
+    assert obs.observation.game_loop == 0
+    assert len(obs.observation.raw_data.units) > 0
+
+    c.step(10)
+    obs = c.observe(target_game_loop=10)
+    assert obs.observation.game_loop == 10
+
+    # batched acts with the ProtoFeatures raw-command dict contract
+    result = c.acts([
+        {"ability_id": 3674, "queue_command": False, "unit_tags": [10000, 10001]}
+    ])
+    assert result == [1]
+    assert server.game.action_log
+
+    # run to the scripted end: player_result appears, status -> ended
+    c.step(400)
+    obs = c.observe(target_game_loop=400)
+    assert list(obs.player_result)
+    assert c.status == Status.ended
+    assert c.status_ended
+
+    c.restart()
+    assert c.status == Status.in_game
+    c.quit()
+
+
+def test_observe_regurgitates_stub_observation(server):
+    """The 2^32-1 stub obs is replaced by the previous obs + new results
+    (reference remote_controller.py:247-264)."""
+    c = connect(server)
+    create = sc_pb.RequestCreateGame()
+    create.player_setup.add(type=sc_pb.Participant)
+    c.create_game(create)
+    c.join_game(sc_pb.RequestJoinGame(options=sc_pb.InterfaceOptions(raw=True)))
+    first = c.observe()
+    assert first.observation.game_loop == 0
+    # craft a stub observation response through the controller's own path
+    stub = sc_pb.ResponseObservation()
+    stub.observation.game_loop = 2 ** 32 - 1
+    pr = stub.player_result.add()
+    pr.player_id = 1
+    pr.result = sc_pb.Victory
+
+    orig_send = c._client.send
+
+    def fake_send(**kwargs):
+        if "observation" in kwargs:
+            return stub
+        return orig_send(**kwargs)
+
+    c._client.send = fake_send
+    obs = c.observe()
+    assert obs.observation.game_loop == first.observation.game_loop
+    assert obs.player_result[0].result == sc_pb.Victory
+    c._client.send = orig_send
+    c.quit()
+
+
+# ------------------------------------------------------- multiplayer launcher
+def two_player_env(server, **env_kwargs):
+    launcher = SC2GameLauncher(
+        map_name="KairosJunction",
+        players=[Player("zerg"), Player("zerg")],
+        controller_factory=lambda i: connect(server),
+        relaunch_every_episodes=0,
+    )
+    return RealSC2Env(launcher, **env_kwargs)
+
+
+def act_dict(action_type: int, delay: int = 4, n_tags: int = 16):
+    sel = np.zeros(F.MAX_SELECTED_UNITS_NUM, np.int64)
+    sel[0] = 0
+    sel[1] = n_tags  # end token
+    return {
+        "action_type": np.asarray([action_type]),
+        "delay": np.asarray([delay]),
+        "queued": np.asarray([0]),
+        "selected_units": sel,
+        "target_unit": np.asarray([0]),
+        "target_location": np.asarray([500]),
+        "selected_units_num": np.asarray([2]),
+    }
+
+
+def test_multiplayer_create_join_and_episode(server):
+    env = two_player_env(server)
+    obs = env.reset()
+    assert set(obs.keys()) == {0, 1}
+    for i in (0, 1):
+        assert obs[i]["entity_num"] > 0
+        assert obs[i]["spatial_info"]["height_map"].shape == tuple(F.SPATIAL_SIZE)
+        assert "value_feature" in obs[i]  # both_obs default
+
+    # an action with selected_units, stepping until the scripted end
+    at = next(
+        i for i, a in enumerate(ACT.ACTIONS)
+        if a["selected_units"] and not a["target_unit"] and not a["target_location"]
+    )
+    done = False
+    for _ in range(100):
+        actions = {i: act_dict(at) for i in obs}
+        obs, rewards, done, info = env.step(actions)
+        if done:
+            break
+    assert done
+    # fake scripts player 1 as the winner
+    assert rewards[0] == 1.0 and rewards[1] == -1.0
+    # both fake connections saw create/join from the plumbing
+    assert server.game.started
+    env.close()
+
+
+def test_launcher_bot_game_single_agent(server):
+    launcher = SC2GameLauncher(
+        map_name="KairosJunction",
+        players=[Player("zerg"), Bot("zerg", 7)],
+        controller_factory=lambda i: connect(server),
+    )
+    env = RealSC2Env(launcher)
+    assert launcher.num_agents == 1
+    obs = env.reset()
+    assert set(obs.keys()) == {0}
+    env.close()
+
+
+def test_crop_and_deduplicate_names():
+    names = crop_and_deduplicate_names(["a" * 40, "a" * 40, "short"])
+    assert len(set(names)) == 3
+    assert all(len(n) <= 32 for n in names)
+
+
+# ------------------------------------------------------------ process launch
+def test_sc_process_launch_and_connect(tmp_path):
+    """StarcraftProcess launches the fake binary, retries the websocket until
+    it serves, pings, and tears down (reference sc_process.py:49-234)."""
+    script = tmp_path / "SC2_fake"
+    script.write_text(
+        "#!/bin/sh\n"
+        f'exec {sys.executable} -m distar_tpu.envs.sc2.fake_sc2 "$@"\n'
+    )
+    script.chmod(script.stat().st_mode | stat.S_IEXEC)
+
+    class StubRunConfig:
+        data_dir = str(tmp_path)
+        tmp_dir = str(tmp_path)
+        cwd = None
+        env = {**os.environ, "PYTHONPATH": os.path.dirname(os.path.dirname(__file__))}
+
+    from distar_tpu.envs.sc2.sc_process import StarcraftProcess
+
+    proc = StarcraftProcess(
+        StubRunConfig(), exec_path=str(script), version=None, timeout_seconds=30
+    )
+    try:
+        assert proc.running
+        assert proc.controller.ping().game_version
+    finally:
+        proc.close()
+    assert not proc.running
+
+
+# ------------------------------------------------------------ version routing
+def test_version_routing():
+    v = run_configs.VERSIONS["4.10.0"]
+    assert v.build_version == 75689
+    # decoder pins (reference replay_decoder.py:37-41)
+    assert run_configs.BUILD2VERSION[81009] == "5.0.0"
+    assert run_configs.BUILD2VERSION[80188] == "4.12.1"
+    assert run_configs.version_for_build(75689).game_version == "4.10.0"
+    # unknown build falls back to closest at-or-below
+    assert run_configs.version_for_build(75690).game_version == "4.10.0"
+
+    rc = run_configs.RunConfig(
+        replay_dir="/tmp", data_dir="/tmp", tmp_dir=None, version="4.10"
+    )
+    assert rc.version.game_version == "4.10.0"
+    with pytest.raises(ValueError):
+        run_configs.RunConfig(
+            replay_dir="/tmp", data_dir="/tmp", tmp_dir=None, version="9.9.9"
+        )
+
+
+# -------------------------------------------------------------------- maps
+def test_map_registry():
+    assert map_registry.get_map_size("KairosJunction") == (120, 140)
+    assert map_registry.get_map_size("KairosJunction", cropped=False) == (152, 168)
+    # localized / battle.net spellings route to the canonical name
+    assert map_registry.LOCALIZED_BNET_NAME_TO_NAME_LUT["Kairos Junction LE"] == "KairosJunction"
+    m = map_registry.get("Kairos Junction LE")
+    assert m.name == "KairosJunction"
+    assert m.filename.endswith("KairosJunctionLE.SC2Map")
+    with pytest.raises(KeyError):
+        map_registry.get("NoSuchMap")
+
+
+# ------------------------------------------------------------------ replays
+def make_fake_replay(base_build=75689, loops=200):
+    return {
+        "base_build": base_build,
+        "game_version": "4.10.0",
+        "data_version": "FAKE",
+        "map_name": "KairosJunction",
+        "game_duration_loops": loops,
+        "players": [
+            {"player_id": 1, "race": 2, "mmr": 4800, "apm": 160, "result": 1},
+            {"player_id": 2, "race": 2, "mmr": 4600, "apm": 140, "result": 2},
+        ],
+        "actions": [
+            (10, 3674, [10000], None),
+            (60, 1183, [10001], (20.0, 30.0)),
+            (120, 3674, [10002], 20000),
+        ],
+    }
+
+
+def test_replay_info_and_action_stream(server):
+    import pickle
+
+    rep = make_fake_replay()
+    server.game.replay_library["test.SC2Replay"] = rep
+
+    c = connect(server)
+    info = c.replay_info(replay_path="test.SC2Replay")
+    assert info.base_build == 75689
+    assert info.player_info[0].player_mmr == 4800
+    assert info.game_duration_loops == 200
+
+    req = sc_pb.RequestStartReplay(replay_path="test.SC2Replay", observed_player_id=1)
+    req.options.raw = True
+    c.start_replay(req)
+    assert c.status == Status.in_replay
+
+    # harvest the action stream at 50-loop strides (the decoder's pass 1)
+    harvested = []
+    while not c.status_ended:
+        c.step(50)
+        obs = c.observe()
+        harvested.extend(obs.actions)
+        if obs.player_result:
+            break
+    assert [a.action_raw.unit_command.ability_id for a in harvested] == [3674, 1183, 3674]
+    assert harvested[1].action_raw.unit_command.target_world_space_pos.x == 20.0
+    assert harvested[2].action_raw.unit_command.target_unit_tag == 20000
+
+    # replay_data path (bytes) works too
+    c2 = connect(server)
+    info2 = c2.replay_info(replay_data=pickle.dumps(rep))
+    assert info2.base_build == 75689
+    c2.quit()
+    c.quit()

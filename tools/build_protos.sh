@@ -1,0 +1,13 @@
+#!/usr/bin/env bash
+# Regenerate the vendored SC2 proto subset (distar_tpu/envs/sc2/_proto_gen)
+# from distar_tpu/envs/sc2/protos/*.proto using the system protoc.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+SRC=distar_tpu/envs/sc2/protos
+OUT=distar_tpu/envs/sc2/_proto_gen
+mkdir -p "$OUT"
+protoc --proto_path="$SRC" --python_out="$OUT" "$SRC"/*.proto
+# protoc emits absolute sibling imports; make them package-relative
+sed -i -E 's/^import ([a-z0-9_]+_pb2) as/from . import \1 as/' "$OUT"/*_pb2.py
+touch "$OUT/__init__.py"
+echo "generated: $(ls "$OUT")"
