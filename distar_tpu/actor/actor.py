@@ -40,6 +40,9 @@ ACTOR_DEFAULTS = Config(
             "episodes_per_job": 1,
             "model_update_interval_s": 10.0,
             "seed": 0,
+            # directories searched for the job's z_path libraries
+            "z_dirs": ["", "data/z_libraries"],
+            "fake_reward_prob": 1.0,
         }
     }
 )
@@ -101,6 +104,53 @@ class Actor:
                 self._model_iters[player_id] = data.get("iter", 0)
                 return jax.tree.map(np.asarray, data["params"])
         return self._initial_params()
+
+    def _sample_z(self, side: int, job: dict) -> dict:
+        """Target strategy for one side: the job's z_path library keyed by
+        map/matchup (reference agent.py:176-243), synthetic fallback when no
+        library resolves (e.g. before gen_z has produced one)."""
+        z_paths = job.get("z_path", [])
+        path = z_paths[side] if side < len(z_paths) else ""
+        lib = None
+        if path and path != "none":
+            if not hasattr(self, "_z_libs"):
+                self._z_libs = {}
+            if path not in self._z_libs:
+                from ..lib.z_library import ZLibrary
+
+                resolved = None
+                for d in self.cfg.get("z_dirs", [""]):
+                    cand = os.path.join(d, path) if d else path
+                    if os.path.exists(cand):
+                        resolved = cand
+                        break
+                try:
+                    self._z_libs[path] = ZLibrary(resolved) if resolved else None
+                except Exception as e:
+                    logging.warning(f"actor: failed to load z library {path}: {e!r}")
+                    self._z_libs[path] = None
+            lib = self._z_libs[path]
+        if lib is not None:
+            from ..league.player import FRAC_ID
+
+            frac_ids = job.get("frac_ids", [1, 1])
+
+            def race_of(s):
+                frac = frac_ids[s] if s < len(frac_ids) else 1
+                return FRAC_ID.get(frac, ["zerg"])[0]
+
+            race, opp_race = race_of(side), race_of(1 - side)
+            # library keys follow the decoder's matchup convention: own race
+            # for mirrors, race+opponent otherwise (gen_z, decode_z)
+            mix_race = race if race == opp_race else race + opp_race
+            target = lib.sample_any(
+                job.get("env_info", {}).get("map_name", ""),
+                mix_race=mix_race,
+                fake_reward_prob=float(self.cfg.get("fake_reward_prob", 1.0)),
+            )
+            if target is not None:
+                return target
+        return sample_fake_z(self._rng)
 
     def _load_teacher_params(self, side: int, job: dict, own_params):
         """Frozen teacher weights for the human-prior KL (reference
@@ -182,7 +232,11 @@ class Actor:
         self._model_iters: Dict[str, int] = {}
         player_ids = job["player_ids"][:2]
         n_env = self.cfg.env_num
-        envs = [self._env_fn() for _ in range(n_env)]
+        # each env steps in its own worker thread (real SC2 steps are slow
+        # and high-variance); inference batches over the ready set
+        from .env_pool import RESET, EnvWorkerPool
+
+        pool = EnvWorkerPool([self._env_fn] * n_env)
 
         # slots: (env, side); one BatchedInference per side (player)
         params = {pid: self._load_player_params(pid) for pid in set(player_ids)}
@@ -198,7 +252,7 @@ class Actor:
         agents = {
             (e, side): Agent(
                 pid,
-                z=sample_fake_z(self._rng),
+                z=self._sample_z(side, job),
                 traj_len=self.cfg.traj_len,
                 seed=self.cfg.seed + e * 2 + side,
             )
@@ -211,117 +265,147 @@ class Actor:
             (e, side): infer[side].hidden_for_slot(e) for e in range(n_env) for side in (0, 1)
         }
 
-        def reset_slot(e: int) -> dict:
+        def reset_slot(e: int) -> None:
             """Restart env slot e: fresh episode, fresh Z, zeroed policy and
-            teacher LSTM carries (shared by episode-end and league-reset)."""
-            new_obs = envs[e].reset()
+            teacher LSTM carries (shared by episode-end and league-reset).
+            The fresh obs arrives asynchronously via the pool."""
             for side in (0, 1):
-                agents[(e, side)].reset(z=sample_fake_z(self._rng))
+                agents[(e, side)].reset(z=self._sample_z(side, job))
                 infer[side].reset_slot(e)
                 teacher_hidden[side] = tuple(
                     (h.at[e].set(0.0), c.at[e].set(0.0))
                     for h, c in teacher_hidden[side]
                 )
                 hidden_backup[(e, side)] = infer[side].hidden_for_slot(e)
-            return new_obs
+            pool.reset(e)
 
-        obs = {e: envs[e].reset() for e in range(n_env)}
+        def handle_episode_end(e: int, next_obs, rewards, info) -> None:
+            """Close out every side's pending action with the terminal
+            reward, report the result, restart the slot."""
+            nonlocal episodes_done
+            for side in (0, 1):
+                ag = agents[(e, side)]
+                if ag._output is not None and (e, side) in pending_teacher:
+                    traj = ag.collect_data(
+                        next_obs.get(side), rewards[side], True,
+                        pending_teacher.pop((e, side)),
+                        hidden_backup[(e, side)],
+                    )
+                    self._maybe_push(job, ag, traj, infer, hidden_backup, e, side)
+            episodes_done += 1
+            result = {
+                "game_steps": info.get("game_loop", 0),
+                "game_iters": 0,
+                "game_duration": 0.0,
+            }
+            from ..league.player import FRAC_ID
+
+            frac_ids = job.get("frac_ids", [1, 1])
+            for side in (0, 1):
+                ag = agents[(e, side)]
+                frac = frac_ids[side] if side < len(frac_ids) else 1
+                result[str(side)] = {
+                    "player_id": player_ids[side],
+                    "opponent_id": player_ids[1 - side],
+                    "winloss": int(rewards[side]),
+                    "race": FRAC_ID.get(frac, ["zerg"])[0],
+                    **ag.episode_stats(),
+                }
+            results.append(result)
+            if self.league is not None:
+                self.league.actor_send_result(result)
+            reset_slot(e)
+
+        for e in range(n_env):
+            pool.reset(e)
+        # neutral schema-complete filler for slots that haven't produced an
+        # observation yet (inactive batch positions are never consumed)
+        from ..lib import features as F
+
+        filler = F.fake_step_data(train=False, rng=self._rng)
+        obs: Dict[int, dict] = {}
         episodes_done, results = 0, []
         last_model_refresh = time.time()
         pending_teacher: Dict = {}
         last_prepared: Dict = {}
-        while episodes_done < episodes:
-            if time.time() - last_model_refresh > self.cfg.model_update_interval_s:
-                last_model_refresh = time.time()
-                refreshed = self._refresh_models(job, player_ids, infer, params)
-                for ag in agents.values():
-                    ag.model_last_iter = self._model_iters.get(ag.player_id, 0)
-                if refreshed:
-                    # league-triggered reset: restart every episode with the
-                    # fresh checkpoint (reference actor.py:321-323)
-                    pending_teacher.clear()
-                    for e in range(n_env):
-                        obs[e] = reset_slot(e)
-            # obs[e] holds only the sides DUE this cycle (variable per-agent
-            # delays, SC2Env contract); a fresh obs first closes out that
-            # agent's previous action (collect-on-receipt, the reference's
-            # per-env loop order), then the agent acts on it. Non-due slots
-            # ride the batch as inactive filler (hidden state preserved).
-            env_actions: Dict[int, dict] = {e: {} for e in range(n_env)}
-            for side, pid in enumerate(player_ids):
-                prepared, active = [], []
-                for e in range(n_env):
-                    if side in obs[e]:
-                        ag = agents[(e, side)]
-                        if ag._output is not None and (e, side) in pending_teacher:
-                            traj = ag.collect_data(
-                                obs[e][side], 0.0, False,
-                                pending_teacher.pop((e, side)),
-                                hidden_backup[(e, side)],
-                            )
-                            self._maybe_push(job, ag, traj, infer, hidden_backup, e, side)
-                        prepared.append(ag.pre_process(obs[e][side]))
-                        last_prepared[(e, side)] = prepared[-1]
-                        active.append(True)
+        try:
+            while episodes_done < episodes:
+                if time.time() - last_model_refresh > self.cfg.model_update_interval_s:
+                    last_model_refresh = time.time()
+                    refreshed = self._refresh_models(job, player_ids, infer, params)
+                    for ag in agents.values():
+                        ag.model_last_iter = self._model_iters.get(ag.player_id, 0)
+                    if refreshed:
+                        # league-triggered reset: restart every episode with
+                        # the fresh checkpoint (reference actor.py:321-323);
+                        # in-flight steps are dropped by the epoch tags
+                        pending_teacher.clear()
+                        obs.clear()
+                        for e in range(n_env):
+                            reset_slot(e)
+                # collect whatever envs finished stepping (>=1, with a cap so
+                # the model-refresh clock keeps ticking)
+                for e, kind, payload in pool.ready(timeout=1.0):
+                    if kind == RESET:
+                        obs[e] = payload
                     else:
-                        prepared.append(last_prepared[(e, side)])
-                        active.append(False)
-                outs = infer[side].sample(prepared, active)
-                # teacher logits at act time with the FROZEN teacher weights,
-                # stored until the next obs arrives
-                t_logits, teacher_hidden[side] = infer[side].teacher_logits(
-                    teacher_params[side], prepared, teacher_hidden[side], outs, active
-                )
-                for e in range(n_env):
-                    if active[e]:
-                        act = agents[(e, side)].post_process(outs[e])
-                        act["selected_units_num"] = outs[e]["selected_units_num"]
-                        env_actions[e][side] = act
-                        pending_teacher[(e, side)] = t_logits[e]
-
-            for e in range(n_env):
-                if not env_actions[e]:
+                        next_obs, rewards, done, info = payload
+                        if done:
+                            handle_episode_end(e, next_obs, rewards, info)
+                        else:
+                            obs[e] = next_obs
+                if not obs:
                     continue
-                next_obs, rewards, done, info = envs[e].step(env_actions[e])
-                if done:
-                    # episode end returns every side: close out all pending
-                    # actions with the terminal reward
-                    for side in (0, 1):
-                        ag = agents[(e, side)]
-                        if ag._output is not None and (e, side) in pending_teacher:
-                            traj = ag.collect_data(
-                                next_obs.get(side), rewards[side], True,
-                                pending_teacher.pop((e, side)),
-                                hidden_backup[(e, side)],
-                            )
-                            self._maybe_push(job, ag, traj, infer, hidden_backup, e, side)
-                    episodes_done += 1
-                    result = {
-                        "game_steps": info.get("game_loop", 0),
-                        "game_iters": 0,
-                        "game_duration": 0.0,
-                    }
-                    from ..league.player import FRAC_ID
 
-                    frac_ids = job.get("frac_ids", [1, 1])
-                    for side in (0, 1):
-                        ag = agents[(e, side)]
-                        frac = frac_ids[side] if side < len(frac_ids) else 1
-                        result[str(side)] = {
-                            "player_id": player_ids[side],
-                            "opponent_id": player_ids[1 - side],
-                            "winloss": int(rewards[side]),
-                            "race": FRAC_ID.get(frac, ["zerg"])[0],
-                            **ag.episode_stats(),
-                        }
-                    results.append(result)
-                    if self.league is not None:
-                        self.league.actor_send_result(result)
-                    obs[e] = reset_slot(e)
-                else:
-                    obs[e] = next_obs
-        for env in envs:
-            env.close()
+                # obs[e] holds only the sides DUE this cycle (variable
+                # per-agent delays, SC2Env contract); a fresh obs first
+                # closes out that agent's previous action (collect-on-
+                # receipt, the reference's per-env loop order), then the
+                # agent acts on it. Non-ready slots ride the batch as
+                # inactive filler (hidden state preserved).
+                env_actions: Dict[int, dict] = {e: {} for e in range(n_env)}
+                for side, pid in enumerate(player_ids):
+                    prepared, active = [], []
+                    for e in range(n_env):
+                        if e in obs and side in obs[e]:
+                            ag = agents[(e, side)]
+                            if ag._output is not None and (e, side) in pending_teacher:
+                                traj = ag.collect_data(
+                                    obs[e][side], 0.0, False,
+                                    pending_teacher.pop((e, side)),
+                                    hidden_backup[(e, side)],
+                                )
+                                self._maybe_push(job, ag, traj, infer, hidden_backup, e, side)
+                            prepared.append(ag.pre_process(obs[e][side]))
+                            last_prepared[(e, side)] = prepared[-1]
+                            active.append(True)
+                        else:
+                            prepared.append(last_prepared.get((e, side), filler))
+                            active.append(False)
+                    if not any(active):
+                        # no lane of this side is due: skip both forwards
+                        # (hidden state untouched for inactive lanes anyway)
+                        continue
+                    outs = infer[side].sample(prepared, active)
+                    # teacher logits at act time with the FROZEN teacher
+                    # weights, stored until the next obs arrives
+                    t_logits, teacher_hidden[side] = infer[side].teacher_logits(
+                        teacher_params[side], prepared, teacher_hidden[side], outs, active
+                    )
+                    for e in range(n_env):
+                        if active[e]:
+                            act = agents[(e, side)].post_process(outs[e])
+                            act["selected_units_num"] = outs[e]["selected_units_num"]
+                            env_actions[e][side] = act
+                            pending_teacher[(e, side)] = t_logits[e]
+
+                # hand the acted-on envs back to their workers
+                for e in list(obs.keys()):
+                    if env_actions[e]:
+                        pool.submit(e, env_actions[e])
+                        del obs[e]
+        finally:
+            pool.close()
         self.results.extend(results)
         return results
 

@@ -1,0 +1,108 @@
+"""Async env worker pool: each environment steps in its own thread.
+
+Role of the reference's process-per-env fan-out (reference: distar/actor/
+actor.py:301-319 forks one process per env; the GPU batch-inference loop
+:268-299 serves whichever envs have filled their shared-memory slots). Real
+SC2 steps are slow (~0.25s) with high variance — a lockstep fleet stalls the
+whole batch on the slowest env. Here each env blocks in its own thread and
+the actor batches inference over the READY set (active-mask partial batches,
+which inference.BatchedInference already supports).
+
+Results are epoch-tagged: `reset(e)` bumps the env's epoch so in-flight step
+results from the abandoned episode are dropped instead of corrupting the new
+one (the league-reset path restarts every episode mid-flight).
+"""
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable, List, Optional, Tuple
+
+RESET = "reset"
+STEP = "step"
+CLOSE = "close"
+
+
+class EnvWorkerPool:
+    def __init__(self, env_fns: List[Callable]):
+        self.num = len(env_fns)
+        self._in: List[queue.Queue] = [queue.Queue() for _ in range(self.num)]
+        self._out: queue.Queue = queue.Queue()
+        self._epoch = [0] * self.num
+        self._threads = []
+        for e, fn in enumerate(env_fns):
+            t = threading.Thread(
+                target=self._worker, args=(e, fn), daemon=True, name=f"env-worker-{e}"
+            )
+            t.start()
+            self._threads.append(t)
+
+    # ---------------------------------------------------------------- worker
+    def _worker(self, e: int, env_fn: Callable) -> None:
+        env = env_fn()
+        try:
+            while True:
+                cmd, epoch, payload = self._in[e].get()
+                if cmd == CLOSE:
+                    return
+                try:
+                    if cmd == RESET:
+                        obs = env.reset()
+                        self._out.put((e, epoch, RESET, obs))
+                    else:
+                        result = env.step(payload)
+                        self._out.put((e, epoch, STEP, result))
+                except Exception as err:
+                    self._out.put((e, epoch, "error", err))
+        finally:
+            try:
+                env.close()
+            except Exception:
+                pass
+
+    # ------------------------------------------------------------------ api
+    def reset(self, e: int) -> None:
+        """Start a fresh episode on env ``e``; stale in-flight results from
+        the previous epoch will be dropped."""
+        self._epoch[e] += 1
+        self._in[e].put((RESET, self._epoch[e], None))
+
+    def submit(self, e: int, actions: dict) -> None:
+        self._in[e].put((STEP, self._epoch[e], actions))
+
+    def ready(self, timeout: Optional[float] = None) -> List[Tuple[int, str, object]]:
+        """Block until at least one result is available (up to ``timeout``),
+        then drain everything currently ready. Stale-epoch results are
+        dropped; worker errors re-raise here."""
+        out = []
+        while not out:
+            try:
+                item = self._out.get(timeout=timeout)
+            except queue.Empty:
+                return out
+            out.extend(self._accept(item))
+            if timeout is not None and not out:
+                continue
+            break
+        while True:
+            try:
+                item = self._out.get_nowait()
+            except queue.Empty:
+                break
+            out.extend(self._accept(item))
+        return out
+
+    def _accept(self, item):
+        e, epoch, kind, payload = item
+        if epoch != self._epoch[e]:
+            return []  # abandoned episode
+        if kind == "error":
+            raise RuntimeError(f"env worker {e} failed") from payload
+        return [(e, kind, payload)]
+
+    def close(self) -> None:
+        for e in range(self.num):
+            self._epoch[e] += 1  # drop anything still in flight
+            self._in[e].put((CLOSE, self._epoch[e], None))
+        for t in self._threads:
+            t.join(timeout=5.0)

@@ -371,10 +371,7 @@ class ReplayDecoder:
             race = info["race"][player_index]
             opp_race = info["race"][1 - player_index] if len(info["race"]) > 1 else race
             mix_race = race if race == opp_race else race + opp_race
-            filtered_infos = [
-                {"action_info": feature.reverse_raw_action(a.action_raw, [])["action"]}
-                for a in filtered
-            ]
+            filtered_infos = _z_action_infos(feature, filtered)
             bo, cum, _, bo_loc = extract_z(filtered_infos, home_loc, away_loc)
             return {
                 "map_name": info["map_name"],
@@ -487,10 +484,7 @@ class ReplayDecoder:
             traj_data.append(step_data)
 
         # ---------------- Z targets from the FILTERED stream (:341-351)
-        filtered_infos = []
-        for a in filtered_actions:
-            rev = feature.reverse_raw_action(a.action_raw, [])
-            filtered_infos.append({"action_info": rev["action"]})
+        filtered_infos = _z_action_infos(feature, filtered_actions)
         beginning_order, cumulative_stat, _, bo_location = extract_z(
             filtered_infos, home_loc, away_loc
         )
@@ -499,6 +493,20 @@ class ReplayDecoder:
             step_data["scalar_info"]["cumulative_stat"] = cumulative_stat.astype(np.uint8)
             step_data["scalar_info"]["bo_location"] = bo_location
         return traj_data
+
+
+def _z_action_infos(feature: ProtoFeatures, actions) -> List[dict]:
+    """Action stream -> action_info dicts for extract_z. Out-of-set abilities
+    decode to action_type 0 == BEGINNING_ORDER_ACTIONS[0]; letting them
+    through would misalign beginning_order/bo_location in the Z targets
+    (unresolvable selections are fine here — Z only reads type+location)."""
+    infos = []
+    for a in actions:
+        rev = feature.reverse_raw_action(a.action_raw, [])
+        if int(np.asarray(rev["action"]["action_type"])) == 0:
+            continue
+        infos.append({"action_info": rev["action"]})
+    return infos
 
 
 class _SC2ProcessProvider:

@@ -216,7 +216,6 @@ class SC2GameLauncher:
         game_infos = [c.game_info() for c in self.controllers]
         self.features = [ProtoFeatures(gi) for gi in game_infos]
         self._launched = True
-        self._episodes_since_launch = 0
 
     # ------------------------------------------------------------ lifecycle
     def ensure_game(self) -> None:
@@ -226,6 +225,7 @@ class SC2GameLauncher:
         if not self._launched:
             self._launch_game()
             self._create_join()
+            self._episodes_since_launch = 0
             return
         self._episodes_since_launch += 1
         if (
@@ -237,6 +237,7 @@ class SC2GameLauncher:
             self.close()
             self._launch_game()
             self._create_join()
+            self._episodes_since_launch = 0
             return
         single = self.num_agents == 1 and len(self._map_names) == 1
         if single:

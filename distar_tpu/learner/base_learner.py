@@ -88,9 +88,27 @@ class BaseLearner:
         """One optimisation step; returns the log dict."""
         raise NotImplementedError
 
+    # -------------------------------------------------------------- prefetch
+    def _place_batch(self, batch):  # overridden by learners that prefetch
+        return batch
+
+    def _maybe_enable_prefetch(self) -> None:
+        """Wrap the dataloader in a device prefetcher (the reference's async
+        copy process, rl_dataloader.py:113-127): the next batch lands in HBM
+        while the current step trains. Disable with learner.prefetch_depth=0."""
+        from .prefetch import DevicePrefetcher
+
+        depth = int(self.cfg.learner.get("prefetch_depth", 2))
+        if depth <= 0 or isinstance(self._dataloader, DevicePrefetcher):
+            return
+        if type(self)._place_batch is BaseLearner._place_batch:
+            return  # learner doesn't define placement
+        self._dataloader = DevicePrefetcher(self._dataloader, self._place_batch, depth)
+
     # ------------------------------------------------------------------ run
     def run(self, max_iterations: Optional[int] = None) -> None:
         max_iterations = max_iterations or self.cfg.learner.max_iterations
+        self._maybe_enable_prefetch()
 
         @auto_checkpoint(lambda: self.save(self.checkpoint_path()))
         def _run():

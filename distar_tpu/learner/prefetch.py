@@ -1,0 +1,72 @@
+"""Host->device prefetch: overlap batch assembly + transfer with compute.
+
+Role of the reference's async CUDA-copy process (reference: distar/agent/
+default/rl_training/rl_dataloader.py:113-127 — a worker that copies the next
+collated batch to the GPU while the current step trains). TPU-first shape:
+``jax.device_put`` is asynchronous (it returns device buffers immediately and
+streams over PCIe/ICI in the background), so a single thread that PULLS the
+next host batch and ISSUES its placement is enough — the XLA runtime
+overlaps the copy with the in-flight train step, and the bounded queue
+double-buffers without pinning more than ``depth`` batches in HBM.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable, Iterator
+
+
+class DevicePrefetcher:
+    """Wraps a host-batch iterator; yields device-placed batches."""
+
+    def __init__(self, dataloader, place_fn: Callable, depth: int = 2):
+        assert depth >= 1
+        self._it = iter(dataloader)
+        self._place = place_fn
+        self._q: queue.Queue = queue.Queue(maxsize=depth)
+        self._err = None
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="device-prefetch"
+        )
+        self._thread.start()
+
+    def _loop(self) -> None:
+        try:
+            for batch in self._it:
+                if self._stop.is_set():
+                    return
+                placed = self._place(batch)
+                while not self._stop.is_set():
+                    try:
+                        self._q.put(placed, timeout=0.2)
+                        break
+                    except queue.Full:
+                        continue
+        except BaseException as e:  # surfaced on the consumer side
+            self._err = e
+        finally:
+            self._q.put(_SENTINEL)
+
+    def __iter__(self) -> Iterator:
+        return self
+
+    def __next__(self):
+        item = self._q.get()
+        if item is _SENTINEL:
+            if self._err is not None:
+                raise self._err
+            raise StopIteration
+        return item
+
+    def close(self) -> None:
+        self._stop.set()
+        # drain so the producer unblocks
+        try:
+            while True:
+                self._q.get_nowait()
+        except queue.Empty:
+            pass
+
+
+_SENTINEL = object()

@@ -215,6 +215,16 @@ class RLLearner(BaseLearner):
         batch["hidden_state"] = hidden
         return out
 
+    def _place_batch(self, batch):
+        """Prefetch placement: everything device-put ahead of time except the
+        host-side staleness field."""
+        batch = dict(batch)
+        model_last_iter = np.asarray(batch.pop("model_last_iter"))
+        out = self.shard_batch(batch)
+        out["model_last_iter"] = model_last_iter
+        out["_on_device"] = True
+        return out
+
     # ----------------------------------------------------------------- comm
     def attach_comm(self, adapter, player_id: str, league=None, send_model_freq: int = 4,
                     send_train_info_freq: int = 4, model_accept_count: int = 8) -> None:
@@ -348,9 +358,11 @@ class RLLearner(BaseLearner):
     def _train(self, data) -> Dict[str, Any]:
         only_value = self.step_value_pretrain()
         data = dict(data)  # callers may reuse the batch dict
+        on_device = data.pop("_on_device", False)
         model_last_iter = np.asarray(data.pop("model_last_iter"))
         staleness = self.last_iter.val - model_last_iter
-        data = self.shard_batch(data)
+        if not on_device:
+            data = self.shard_batch(data)
         params, opt_state, info = self._train_step(
             self._state["params"], self._state["opt_state"], data,
             jnp.asarray(only_value),

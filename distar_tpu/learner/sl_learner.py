@@ -136,8 +136,20 @@ class SLLearner(BaseLearner):
             donate_argnums=(0, 1),
         )
 
+    def _place_batch(self, data):
+        """Prefetch placement: device-put ahead of time, host fields kept."""
+        data = dict(data)
+        host = {k: np.asarray(data.pop(k)) for k in ("new_episodes", "traj_lens") if k in data}
+        out = jax.tree.map(
+            lambda x: jax.device_put(jnp.asarray(x), self._shardings["flat"]), data
+        )
+        out.update(host)
+        out["_on_device"] = True
+        return out
+
     def _train(self, data) -> Dict[str, Any]:
         data = dict(data)  # callers may reuse the batch dict
+        on_device = data.pop("_on_device", False)
         new_episodes = np.asarray(data.pop("new_episodes"))
         data.pop("traj_lens", None)
         if new_episodes.any():
@@ -145,9 +157,10 @@ class SLLearner(BaseLearner):
             # sl_learner.py:31-35)
             keep = jnp.asarray(~new_episodes, jnp.float32)[:, None]
             self._hidden = tuple((h * keep, c * keep) for h, c in self._hidden)
-        data = jax.tree.map(
-            lambda x: jax.device_put(jnp.asarray(x), self._shardings["flat"]), data
-        )
+        if not on_device:
+            data = jax.tree.map(
+                lambda x: jax.device_put(jnp.asarray(x), self._shardings["flat"]), data
+            )
         params, opt_state, out_state, info = self._train_step(
             self._state["params"], self._state["opt_state"], data, self._hidden
         )

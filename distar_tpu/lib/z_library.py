@@ -69,6 +69,27 @@ class ZLibrary:
             for m, races in self.data.items()
         }
 
+    def sample_any(
+        self,
+        map_name: str,
+        mix_race: Optional[str] = None,
+        fake_reward_prob: float = 1.0,
+    ) -> Optional[dict]:
+        """Sample with graceful key fallback: unknown map/race/location keys
+        fall back to a random available one (the reference tolerates partial
+        libraries via its own fallbacks, agent.py:189-206); None when the
+        library is empty."""
+        if not self.data:
+            return None
+        races = self.data.get(map_name) or self.data[random.choice(list(self.data))]
+        locs = races.get(mix_race) if mix_race else None
+        if not locs:
+            locs = races[random.choice(list(races))]
+        entries = locs[random.choice(list(locs))]
+        if not entries:
+            return None
+        return z_entry_to_target(random.choice(entries), fake_reward_prob)
+
 
 def build_z_library(
     episodes: List[dict],

@@ -225,9 +225,12 @@ class EntityEncoder(nn.Module):
                 cdtype(self.cfg),
                 attn_impl=ent.get("attention_impl", "xla"),
             )(h, mask)
-        entity_embeddings = FCBlock(width, "relu", dtype=cdtype(self.cfg), name="entity_fc")(
-            jax.nn.relu(h)
-        )
+        # the reference's build_activation returns an INPLACE ReLU, so its
+        # `entity_fc(act(x))` also rewrites x before the pooling branch
+        # (entity_encoder.py:82-96 + activation.py:85) — the pooled embedding
+        # therefore reduces relu(x), and so do we (golden-parity verified)
+        h = jax.nn.relu(h)
+        entity_embeddings = FCBlock(width, "relu", dtype=cdtype(self.cfg), name="entity_fc")(h)
         reduce_type = static_cfg(self.cfg).entity_reduce_type
         masked = h * mask[..., None]
         if reduce_type in ("entity_num", "selected_units_num"):

@@ -28,7 +28,7 @@ from .config import cdtype, static_cfg
 from ..lib.features import MAX_ENTITY_NUM, MAX_SELECTED_UNITS_NUM
 from ..ops import GLU, Conv2DBlock, FCBlock, GatedResBlock, ResBlock, ResFCBlock, sequence_mask
 from ..ops.blocks import build_activation
-from ..ops.lstm import PlainLSTMCell
+from ..ops.lstm import LayerNormLSTMCell
 
 NEG_INF = -1e9
 
@@ -131,7 +131,12 @@ class SelectedUnitsHead(nn.Module):
         self.embed_fc2 = FCBlock(
             static_cfg(self.cfg).policy.action_type_head.gate_dim, None, dtype=cdtype(self.cfg), name="embed_fc2"
         )
-        self.lstm = PlainLSTMCell(hc.hidden_dim, dtype=cdtype(self.cfg), name="lstm")
+        # the reference hardcodes script_lnlstm for the pointer decoder
+        # (action_arg_head.py:108), overriding its own lstm_type config
+        self.lstm_cells = [
+            LayerNormLSTMCell(hc.hidden_dim, dtype=cdtype(self.cfg), name=f"lstm{i}")
+            for i in range(hc.get("num_layers", 1))
+        ]
         self.end_embedding = self.param(
             "end_embedding", nn.initializers.uniform(scale=2.0 / (32 ** 0.5)), (hc.key_dim,)
         )
@@ -147,19 +152,29 @@ class SelectedUnitsHead(nn.Module):
         mask = sequence_mask(entity_num + 1, N + 1)
         return key, mask
 
+    def _lstm(self, x, states):
+        """Stacked LN-LSTM step; ``states`` is a tuple of (h, c) per layer."""
+        new_states = []
+        for cell, st in zip(self.lstm_cells, states):
+            x, st = cell(x, st)
+            new_states.append(st)
+        return x, tuple(new_states)
+
     def _ae_update(self, base_ae, key, sel_onehot, count):
-        """ae = base + embed(mean of selected keys); zero-selection lanes keep base."""
+        """ae = base + embed(mean of selected keys). The MLP applies even to
+        a zero selection (the reference feeds the raw zero sum through
+        embed_fc1/2, whose biases contribute — action_arg_head.py:193-200);
+        only step 0 uses the raw base ae (handled by callers)."""
         s = (key * sel_onehot[..., None]).sum(axis=1)
         denom = jnp.maximum(count, 1.0)[:, None]
-        emb = self.embed_fc2(self.embed_fc1(s / denom))
-        return base_ae + jnp.where((count > 0)[:, None], emb, 0.0)
+        return base_ae + self.embed_fc2(self.embed_fc1(s / denom))
 
     def _su_step(self, carry, result_fn, temperature: float = 1.0):
         """One pointer-decode step; ``result_fn(logits)`` picks the unit."""
         key, valid, entity_num = carry["key"], carry["valid"], carry["entity_num"]
         N1 = key.shape[1]
         q = self.query_fc2(self.query_fc1(carry["ae"]))
-        out, lstm_state = self.lstm(q, carry["lstm_state"])
+        out, lstm_state = self._lstm(q, carry["lstm_state"])
         logits = (out[:, None, :] * key).sum(-1).astype(jnp.float32)  # B, N+1
         logits = jnp.where(carry["logit_mask"], logits, NEG_INF) / temperature
         result = result_fn(logits)
@@ -188,7 +203,7 @@ class SelectedUnitsHead(nn.Module):
         return new_carry, (logits, result)
 
     def _train_forward_parallel(
-        self, base_ae, key, valid, entity_num, labels, selected_units_num, h0
+        self, base_ae, key, valid, entity_num, labels, selected_units_num, states0
     ):
         """Teacher-forced decode with everything except the tiny query LSTM
         batched over the 64 steps.
@@ -218,8 +233,10 @@ class SelectedUnitsHead(nn.Module):
             count_before, 1.0
         )[..., None]
         emb = self.embed_fc2(self.embed_fc1(pooled))  # [B, S, 1024] one batched matmul
+        # step 0 queries the raw base ae; every later step adds the selection
+        # MLP (incl. its bias for empty selections — see _ae_update)
         ae_all = base_ae[:, None, :] + jnp.where(
-            (count_before > 0)[..., None], emb, 0.0
+            (jnp.arange(S) > 0)[None, :, None], emb, 0.0
         )
         # per-step logits mask: end slot off at step 0, on after; previously
         # selected units off (the end pick itself stays maskable)
@@ -239,11 +256,11 @@ class SelectedUnitsHead(nn.Module):
         )
         # tiny pointer LSTM over the precomputed query inputs
         q_in = self.query_fc2(self.query_fc1(ae_all))  # [B, S, K]
-        (_, _), lstm_out = nn.transforms.scan(
-            lambda mdl, carry, x: tuple(reversed(mdl.lstm(x, carry))),
+        _, lstm_out = nn.transforms.scan(
+            lambda mdl, carry, x: tuple(reversed(mdl._lstm(x, carry))),
             variable_broadcast="params",
             split_rngs={"params": False},
-        )(self, (h0, h0), q_in.transpose(1, 0, 2))
+        )(self, states0, q_in.transpose(1, 0, 2))
         lstm_out = lstm_out.transpose(1, 0, 2)  # [B, S, K]
         logits = jnp.einsum("bsk,bnk->bsn", lstm_out, key).astype(jnp.float32)
         logits = jnp.where(mask_all, logits, NEG_INF)
@@ -253,7 +270,7 @@ class SelectedUnitsHead(nn.Module):
             "bn,bnk->bk", sel_after[:, -1], key
         ) / jnp.maximum(count_after, 1.0)[:, None]
         emb_final = self.embed_fc2(self.embed_fc1(pooled_final))
-        ae_final = base_ae + jnp.where((count_after > 0)[:, None], emb_final, 0.0)
+        ae_final = base_ae + emb_final
         end_flag = end_before[:, -1] | picked_end[:, -1]
         last_logits = logits[:, -1, :]
         end_logit = jnp.take_along_axis(last_logits, entity_num[:, None], axis=1)
@@ -290,6 +307,7 @@ class SelectedUnitsHead(nn.Module):
         key, valid = self._keys(entity_embedding, entity_num)
         base_ae = embedding
         h0 = jnp.zeros((B, hc.hidden_dim), jnp.float32)  # carry stays f32
+        states0 = tuple((h0, h0) for _ in self.lstm_cells)
         init_mask = valid & (jnp.arange(N + 1)[None, :] != entity_num[:, None])  # end off at step 0
 
         train = selected_units is not None
@@ -302,7 +320,7 @@ class SelectedUnitsHead(nn.Module):
                 and not self.is_initializing()
             ):
                 return self._train_forward_parallel(
-                    base_ae, key, valid, entity_num, labels, selected_units_num, h0
+                    base_ae, key, valid, entity_num, labels, selected_units_num, states0
                 )
             xs = labels.T  # [S, B]
         else:
@@ -314,10 +332,10 @@ class SelectedUnitsHead(nn.Module):
             end0 = ~su_mask.astype(bool)
             num0 = jnp.where(su_mask.astype(bool), num0, 0)
         carry0 = dict(
-            lstm_state=(h0, h0),
-            ae=self._ae_update(
-                base_ae, key, jnp.zeros((B, N + 1), jnp.float32), jnp.zeros((B,))
-            ),
+            lstm_state=states0,
+            # step 0 queries the RAW base ae (the selection MLP only joins
+            # from step 1, reference :188-200)
+            ae=base_ae,
             logit_mask=init_mask,
             sel_onehot=jnp.zeros((B, N + 1), jnp.float32),
             end_flag=end0,

@@ -1,0 +1,396 @@
+"""Reference (torch) state_dict -> Flax params conversion.
+
+Maps the reference model's recorded weights onto this framework's modules so
+(a) golden parity tests can pin our numerics to the reference's
+(tests/test_golden_parity.py; VERDICT round-1 weak #7) and (b) reference
+pretrained checkpoints can seed training here.
+
+Layout rules:
+  torch Linear weight [out, in]      -> flax Dense kernel [in, out] (transpose)
+  torch Conv2d weight [O, I, kh, kw] -> flax Conv kernel [kh, kw, I, O]
+  torch LayerNorm weight/bias        -> flax LayerNorm scale/bias
+  torch NCHW flatten (view(B, -1))   -> our NHWC flatten: fc kernels over
+                                        flattened conv maps are re-ordered
+                                        (C,H,W) -> (H,W,C) row-wise
+  reference one-hot-concat @ W       -> our per-field Embed/Dense params are
+                                        ROW SLICES of W^T at each field's
+                                        column offset (entity encoder)
+"""
+from __future__ import annotations
+
+from typing import Dict
+
+import numpy as np
+
+__all__ = [
+    "convert_lnlstm",
+    "convert_entity_encoder",
+    "convert_scalar_encoder",
+    "convert_spatial_encoder",
+    "convert_action_type_head",
+    "convert_delay_head",
+    "convert_queued_head",
+    "convert_selected_units_head",
+    "convert_target_unit_head",
+    "convert_location_head",
+    "convert_value_baseline",
+]
+
+
+def _t(w) -> np.ndarray:
+    return np.ascontiguousarray(np.asarray(w).T)
+
+
+def _ln(sd: Dict, prefix: str) -> Dict:
+    return {"scale": np.asarray(sd[f"{prefix}.weight"]), "bias": np.asarray(sd[f"{prefix}.bias"])}
+
+
+def _fc(sd: Dict, prefix: str) -> Dict:
+    """reference fc_block -> {FCBlock}/Dense_0 params."""
+    return {
+        "Dense_0": {
+            "kernel": _t(sd[f"{prefix}.0.weight"]),
+            "bias": np.asarray(sd[f"{prefix}.0.bias"]),
+        }
+    }
+
+
+def _dense(sd: Dict, prefix: str) -> Dict:
+    return {"kernel": _t(sd[f"{prefix}.0.weight"]), "bias": np.asarray(sd[f"{prefix}.0.bias"])}
+
+
+def _conv(sd: Dict, prefix: str) -> Dict:
+    """reference conv2d_block -> flax Conv params (inside Conv2DBlock)."""
+    w = np.asarray(sd[f"{prefix}.0.weight"])  # [O, I, kh, kw]
+    return {
+        "Conv_0": {
+            "kernel": np.ascontiguousarray(w.transpose(2, 3, 1, 0)),
+            "bias": np.asarray(sd[f"{prefix}.0.bias"]),
+        }
+    }
+
+
+def _nchw_fc_kernel(w: np.ndarray, c: int, h: int, wdt: int) -> np.ndarray:
+    """torch fc over an NCHW flatten -> kernel for our NHWC flatten."""
+    out_dim = w.shape[0]
+    k = w.reshape(out_dim, c, h, wdt).transpose(2, 3, 1, 0)  # H, W, C, out
+    return np.ascontiguousarray(k.reshape(h * wdt * c, out_dim))
+
+
+def _transformer_layer(sd: Dict, prefix: str, mlp_num: int = 2) -> Dict:
+    out = {
+        "Attention_0": {
+            "Dense_0": {
+                "kernel": _t(sd[f"{prefix}.attention.attention_pre.0.weight"]),
+                "bias": np.asarray(sd[f"{prefix}.attention.attention_pre.0.bias"]),
+            },
+            "Dense_1": {
+                "kernel": _t(sd[f"{prefix}.attention.project.0.weight"]),
+                "bias": np.asarray(sd[f"{prefix}.attention.project.0.bias"]),
+            },
+        },
+        "LayerNorm_0": _ln(sd, f"{prefix}.layernorm1"),
+        "LayerNorm_1": _ln(sd, f"{prefix}.layernorm2"),
+    }
+    for i in range(mlp_num):
+        out[f"FCBlock_{i}"] = _fc(sd, f"{prefix}.mlp.{i}")
+    return out
+
+
+def _transformer(sd: Dict, prefix: str, layer_num: int = 3, mlp_num: int = 2) -> Dict:
+    """reference module_utils.Transformer (embedding fc + layers) -> our
+    ops.Transformer params (FCBlock_0 embedding + TransformerLayer_i)."""
+    out = {"FCBlock_0": _fc(sd, f"{prefix}.embedding")}
+    for i in range(layer_num):
+        out[f"TransformerLayer_{i}"] = _transformer_layer(sd, f"{prefix}.layers.{i}", mlp_num)
+    return out
+
+
+def _fc_ln(sd: Dict, prefix: str) -> Dict:
+    """reference fc_block with norm -> FCBlock{Dense_0, LayerNorm_0}."""
+    out = _fc(sd, prefix)
+    out["LayerNorm_0"] = _ln(sd, f"{prefix}.1")
+    return out
+
+
+def _res_fc(sd: Dict, prefix: str) -> Dict:
+    """reference ResFCBlock (norm per fc) -> ops.ResFCBlock."""
+    return {"FCBlock_0": _fc_ln(sd, f"{prefix}.fc1"), "FCBlock_1": _fc_ln(sd, f"{prefix}.fc2")}
+
+
+def _glu(sd: Dict, prefix: str) -> Dict:
+    """reference GLU (layer1 = context gate, layer2 = output) -> ops.GLU."""
+    return {
+        "Dense_0": {"kernel": _t(sd[f"{prefix}.layer1.0.weight"]), "bias": np.asarray(sd[f"{prefix}.layer1.0.bias"])},
+        "Dense_1": {"kernel": _t(sd[f"{prefix}.layer2.0.weight"]), "bias": np.asarray(sd[f"{prefix}.layer2.0.bias"])},
+    }
+
+
+def convert_lnlstm(sd: Dict, num_layers: int) -> Dict:
+    """reference script_lnlstm state_dict -> ops.lstm.StackedLSTM params."""
+    params = {}
+    for i in range(num_layers):
+        p = f"layers.{i}.cell"
+        params[f"layer{i}"] = {
+            "ih": {"kernel": _t(sd[f"{p}.weight_ih"])},
+            "hh": {"kernel": _t(sd[f"{p}.weight_hh"])},
+            "ln_ih": _ln(sd, f"{p}.layernorm_i"),
+            "ln_hh": _ln(sd, f"{p}.layernorm_h"),
+            "ln_c": _ln(sd, f"{p}.layernorm_c"),
+        }
+    return {"params": params}
+
+
+def convert_entity_encoder(sd: Dict, cfg) -> Dict:
+    """reference EntityEncoder state_dict -> model.encoders.EntityEncoder.
+
+    The reference materialises each entity as a 997-wide one-hot/binary/raw
+    concat and projects with transformer.embedding (fc 997->256,
+    entity_encoder.py:59-80); our per-field embedding-sum is the same map
+    with W^T split row-wise at each field's column offset."""
+    ent = cfg.encoder.entity
+    W = np.asarray(sd["transformer.embedding.0.weight"])  # [width, total]
+    bias = np.asarray(sd["transformer.embedding.0.bias"])
+    params = {"ent_embed_bias": bias}
+    off = 0
+    for key, arc, n in ent.fields:
+        span = {"one_hot": n, "binary": n, "float": 1}[arc]
+        block = _t(W[:, off : off + span])  # [span, width]
+        if arc == "one_hot":
+            params[f"ent_{key}"] = {"embedding": block}
+        else:
+            params[f"ent_{key}"] = {"kernel": block}
+        off += span
+    assert off == W.shape[1], f"field widths {off} != embedding input {W.shape[1]}"
+
+    for i in range(ent.layer_num):
+        params[f"TransformerLayer_{i}"] = _transformer_layer(
+            sd, f"transformer.layers.{i}", ent.mlp_num
+        )
+    params["entity_fc"] = _fc(sd, "entity_fc")
+    params["embed_fc"] = _fc(sd, "embed_fc")
+    return {"params": params}
+
+
+def _bo_encoder(sd: Dict, prefix: str) -> Dict:
+    return {
+        "Transformer_0": _transformer(sd, f"{prefix}.transformer"),
+        "FCBlock_0": _fc(sd, f"{prefix}.embedd_fc"),
+    }
+
+
+def convert_scalar_encoder(sd: Dict, cfg) -> Dict:
+    """reference ScalarEncoder state_dict -> model.encoders.ScalarEncoder."""
+    params = {}
+    for key, arc, _n, _out, _ctx, _base in cfg.encoder.scalar.fields:
+        if arc == "one_hot":
+            params[f"embed_{key}"] = {
+                "embedding": np.asarray(sd[f"encode_modules.{key}.weight"])
+            }
+        elif arc == "fc":
+            params[f"fc_{key}"] = _fc(sd, f"encode_modules.{key}")
+        elif arc == "bo_transformer":
+            params["bo_encoder"] = _bo_encoder(sd, f"encode_modules.{key}")
+    return {"params": params}
+
+
+def convert_spatial_encoder(sd: Dict, cfg) -> Dict:
+    """reference SpatialEncoder state_dict -> model.encoders.SpatialEncoder.
+
+    Ours auto-names blocks in call order: Conv2DBlock_0 (project), then one
+    Conv2DBlock per downsample, then ResBlock_i, then FCBlock_0 (head). The
+    fc head's kernel is re-ordered for the NHWC flatten."""
+    sp = cfg.encoder.spatial
+    params = {"Conv2DBlock_0": _conv(sd, "project")}
+    for i in range(len(sp.down_channels)):
+        params[f"Conv2DBlock_{i + 1}"] = _conv(sd, f"downsample.{i}")
+    for i in range(sp.resblock_num):
+        params[f"ResBlock_{i}"] = {
+            "Conv2DBlock_0": _conv(sd, f"res.{i}.conv1"),
+            "Conv2DBlock_1": _conv(sd, f"res.{i}.conv2"),
+        }
+    c = sp.down_channels[-1]
+    h = cfg.static.spatial_y // (2 ** len(sp.down_channels)) if hasattr(cfg, "static") else None
+    # head fc: torch flattens NCHW, ours NHWC
+    w = np.asarray(sd["fc.0.weight"])
+    hw = w.shape[1] // c
+    # infer H from the known aspect (H/W ratio preserved through /8 pooling)
+    from ..lib.features import SPATIAL_SIZE
+
+    H = SPATIAL_SIZE[0] // (2 ** len(sp.down_channels))
+    W_ = SPATIAL_SIZE[1] // (2 ** len(sp.down_channels))
+    assert H * W_ == hw, (H, W_, hw)
+    params["FCBlock_0"] = {
+        "Dense_0": {"kernel": _nchw_fc_kernel(w, c, H, W_), "bias": np.asarray(sd["fc.0.bias"])}
+    }
+    return {"params": params}
+
+
+def convert_action_type_head(sd: Dict, cfg) -> Dict:
+    """reference ActionTypeHead -> model.heads.ActionTypeHead."""
+    hc = cfg.policy.action_type_head
+    params = {"FCBlock_0": _fc(sd, "project")}
+    for i in range(hc.res_num):
+        params[f"ResFCBlock_{i}"] = _res_fc(sd, f"res.{i}")
+    params["action_glu"] = _glu(sd, "action_fc")
+    params["FCBlock_1"] = _fc(sd, "action_map_fc1")
+    params["FCBlock_2"] = _fc(sd, "action_map_fc2")
+    params["glu1"] = _glu(sd, "glu1")
+    params["glu2"] = _glu(sd, "glu2")
+    return {"params": params}
+
+
+def _fc_chain(sd: Dict, names) -> Dict:
+    return {f"FCBlock_{i}": _fc(sd, name) for i, name in enumerate(names)}
+
+
+def convert_delay_head(sd: Dict, cfg) -> Dict:
+    return {"params": _fc_chain(sd, ["fc1", "fc2", "fc3", "embed_fc1", "embed_fc2"])}
+
+
+def convert_queued_head(sd: Dict, cfg) -> Dict:
+    return {"params": _fc_chain(sd, ["fc1", "fc2", "fc3", "embed_fc1", "embed_fc2"])}
+
+
+def convert_selected_units_head(sd: Dict, cfg) -> Dict:
+    hc = cfg.policy.selected_units_head
+    params = {
+        "key_fc": _fc(sd, "key_fc"),
+        "query_fc1": _fc(sd, "query_fc1"),
+        "query_fc2": _fc(sd, "query_fc2"),
+        "embed_fc1": _fc(sd, "embed_fc1"),
+        "embed_fc2": _fc(sd, "embed_fc2"),
+        "end_embedding": np.asarray(sd["end_embedding"]).reshape(-1),
+    }
+    for i in range(hc.get("num_layers", 1)):
+        p = f"lstm.layers.{i}.cell"
+        params[f"lstm{i}"] = {
+            "ih": {"kernel": _t(sd[f"{p}.weight_ih"])},
+            "hh": {"kernel": _t(sd[f"{p}.weight_hh"])},
+            "ln_ih": _ln(sd, f"{p}.layernorm_i"),
+            "ln_hh": _ln(sd, f"{p}.layernorm_h"),
+            "ln_c": _ln(sd, f"{p}.layernorm_c"),
+        }
+    return {"params": params}
+
+
+def convert_target_unit_head(sd: Dict, cfg) -> Dict:
+    return {"params": _fc_chain(sd, ["key_fc", "query_fc1", "query_fc2"])}
+
+
+def convert_location_head(sd: Dict, cfg) -> Dict:
+    """reference LocationHead (gate=True, bilinear upsample) ->
+    model.heads.LocationHead. project_embed's output feeds a channel-FIRST
+    reshape in the reference and channel-LAST in ours, so its rows are
+    re-ordered (C,H,W) -> (H,W,C)."""
+    hc = cfg.policy.location_head
+    from ..lib.features import SPATIAL_SIZE
+
+    H8, W8 = SPATIAL_SIZE[0] // 8, SPATIAL_SIZE[1] // 8
+    c = hc.reshape_channel
+    w = np.asarray(sd["project_embed.0.weight"])  # [C*H8*W8, in]
+    b = np.asarray(sd["project_embed.0.bias"])
+    w = w.reshape(c, H8, W8, -1).transpose(1, 2, 0, 3).reshape(c * H8 * W8, -1)
+    b = b.reshape(c, H8, W8).transpose(1, 2, 0).reshape(-1)
+    params = {
+        "FCBlock_0": {"Dense_0": {"kernel": _t(w), "bias": b}},
+        "Conv2DBlock_0": _conv(sd, "conv1"),
+    }
+    for i in range(hc.res_num):
+        block = {
+            "Conv2DBlock_0": _conv(sd, f"res.{i}.conv1"),
+            "Conv2DBlock_1": _conv(sd, f"res.{i}.conv2"),
+            "update_sp": np.asarray(sd[f"res.{i}.UpdateSP"]),
+        }
+        for g in range(4):
+            block[f"Conv2DBlock_{g + 2}"] = _conv(sd, f"res.{i}.GateWeightG.{g}")
+        params[f"GatedResBlock_{i}"] = block
+    for i in range(len(hc.upsample_dims)):
+        params[f"Conv2DBlock_{i + 1}"] = _conv(sd, f"upsample.{i}")
+    return {"params": params}
+
+
+def convert_value_baseline(sd: Dict, res_num: int) -> Dict:
+    params = {"FCBlock_0": _fc(sd, "project")}
+    for i in range(res_num):
+        params[f"ResFCBlock2_{i}"] = {
+            "FCBlock_0": _fc(sd, f"res.{i}.fc1"),
+            "FCBlock_1": _fc(sd, f"res.{i}.fc2"),
+            "LayerNorm_0": _ln(sd, f"res.{i}.norm"),
+        }
+    params["Dense_0"] = {
+        "kernel": _t(sd["value_fc.0.weight"]),
+        "bias": np.asarray(sd["value_fc.0.bias"]),
+    }
+    return {"params": params}
+
+
+def _subdict(sd: Dict, prefix: str) -> Dict:
+    p = prefix + "."
+    return {k[len(p):]: v for k, v in sd.items() if k.startswith(p)}
+
+
+def convert_model(sd: Dict, cfg) -> Dict:
+    """Full reference Model state_dict -> our Model params.
+
+    Accepts raw reference checkpoints: 'model.'/'module.' prefixes are
+    stripped. Value towers present in the state dict are converted under
+    their value_<name> modules; the value encoder is not yet mapped."""
+    for strip in ("model.", "module."):
+        if any(k.startswith(strip) for k in sd):
+            sd = {k[len(strip):] if k.startswith(strip) else k: v for k, v in sd.items()}
+
+    params = {
+        "encoder": {
+            "scalar_encoder": convert_scalar_encoder(_subdict(sd, "encoder.scalar_encoder"), cfg)["params"],
+            "entity_encoder": convert_entity_encoder(_subdict(sd, "encoder.entity_encoder"), cfg)["params"],
+            "spatial_encoder": convert_spatial_encoder(_subdict(sd, "encoder.spatial_encoder"), cfg)["params"],
+            "FCBlock_0": _fc(_subdict(sd, "encoder"), "scatter_project"),
+        },
+        "core_lstm": convert_lnlstm(_subdict(sd, "core_lstm"), cfg.encoder.core_lstm.num_layers)["params"],
+        "policy": {
+            "action_type_head": convert_action_type_head(_subdict(sd, "policy.action_type_head"), cfg)["params"],
+            "delay_head": convert_delay_head(_subdict(sd, "policy.delay_head"), cfg)["params"],
+            "queued_head": convert_queued_head(_subdict(sd, "policy.queued_head"), cfg)["params"],
+            "selected_units_head": convert_selected_units_head(_subdict(sd, "policy.selected_units_head"), cfg)["params"],
+            "target_unit_head": convert_target_unit_head(_subdict(sd, "policy.target_unit_head"), cfg)["params"],
+            "location_head": convert_location_head(_subdict(sd, "policy.location_head"), cfg)["params"],
+        },
+    }
+    for name in cfg.enable_baselines:
+        sub = _subdict(sd, f"value_networks.{name}")
+        if sub:
+            params[f"value_{name}"] = convert_value_baseline(sub, cfg.value.res_num)["params"]
+    return {"params": params}
+
+
+def convert_value_encoder(sd: Dict, cfg) -> Dict:
+    """reference ValueEncoder state_dict -> model.encoders.ValueEncoder."""
+    vc = cfg.value.encoder
+    params = {}
+    for key, _in, _out in vc.fc_fields:
+        ref_key = "cumulative_stat" if key == "enemy_cumulative_stat" else key
+        params[f"fc_{key}"] = _fc(sd, f"encode_modules.{ref_key}")
+    for key, _n, _dim in vc.unit_fields:
+        params[f"embed_{key}"] = {"embedding": np.asarray(sd[f"encode_modules.{key}.weight"])}
+    params["bo_encoder"] = _bo_encoder(sd, "encode_modules.beginning_order")
+    params["scatter_project"] = _fc(sd, "scatter_project")
+    params["Conv2DBlock_0"] = _conv(sd, "project")
+    # downsample Sequential alternates MaxPool2d (no params) and conv blocks
+    for i in range(len(vc.spatial.down_channels)):
+        params[f"Conv2DBlock_{i + 1}"] = _conv(sd, f"downsample.{2 * i + 1}")
+    for i in range(vc.spatial.resblock_num):
+        params[f"ResBlock_{i}"] = {
+            "Conv2DBlock_0": _conv(sd, f"res.{i}.conv1"),
+            "Conv2DBlock_1": _conv(sd, f"res.{i}.conv2"),
+        }
+    c = vc.spatial.down_channels[-1]
+    from ..lib.features import SPATIAL_SIZE
+
+    H = SPATIAL_SIZE[0] // (2 ** len(vc.spatial.down_channels))
+    W_ = SPATIAL_SIZE[1] // (2 ** len(vc.spatial.down_channels))
+    w = np.asarray(sd["spatial_fc.0.weight"])
+    params["spatial_fc"] = {
+        "Dense_0": {"kernel": _nchw_fc_kernel(w, c, H, W_), "bias": np.asarray(sd["spatial_fc.0.bias"])}
+    }
+    return {"params": params}

@@ -4,13 +4,16 @@ from __future__ import annotations
 import jax.numpy as jnp
 from flax import linen as nn
 
-from ..ops import FCBlock, ResFCBlock
+from ..ops import FCBlock
+from ..ops.blocks import ResFCBlock2
 
 PI = 3.141592653589793
 
 
 class ValueBaseline(nn.Module):
-    """fc -> res_num x ResFC -> scalar; optional atan squash into (-1, 1)."""
+    """fc -> res_num x post-norm ResFC2 -> scalar; optional atan squash into
+    (-1, 1). The tower uses the reference's ResFCBlock2 topology
+    (LN(x + fc(fc_relu(x))), res_block.py:110-139)."""
 
     res_dim: int = 256
     res_num: int = 16
@@ -22,7 +25,7 @@ class ValueBaseline(nn.Module):
     def __call__(self, x):
         x = FCBlock(self.res_dim, "relu", dtype=self.dtype)(x)
         for _ in range(self.res_num):
-            x = ResFCBlock(self.res_dim, "relu", self.norm_type, dtype=self.dtype)(x)
+            x = ResFCBlock2(self.res_dim, "relu", dtype=self.dtype)(x)
         v = nn.Dense(
             1,
             dtype=self.dtype,

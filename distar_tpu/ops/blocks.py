@@ -71,7 +71,7 @@ class FCBlock(nn.Module):
             self.features, dtype=self.dtype, kernel_init=self.kernel_init, bias_init=self.bias_init
         )(x)
         if self.norm == "LN":
-            x = nn.LayerNorm(dtype=self.dtype)(x)
+            x = nn.LayerNorm(epsilon=1e-5, dtype=self.dtype)(x)
         return build_activation(self.activation)(x)
 
 
@@ -99,7 +99,7 @@ class Conv2DBlock(nn.Module):
             dtype=self.dtype,
         )(x)
         if self.norm == "LN":
-            x = nn.LayerNorm(dtype=self.dtype)(x)
+            x = nn.LayerNorm(epsilon=1e-5, dtype=self.dtype)(x)
         return build_activation(self.activation)(x)
 
 
@@ -133,6 +133,21 @@ class ResFCBlock(nn.Module):
         y = FCBlock(self.features, self.activation, self.norm, self.dtype)(x)
         y = FCBlock(self.features, None, self.norm, self.dtype)(y)
         return act(x + y)
+
+
+class ResFCBlock2(nn.Module):
+    """Post-norm residual fc block: LN(x + fc(fc_act(x))), no outer
+    activation (the reference's value-tower block, res_block.py:110-139)."""
+
+    features: int
+    activation: str = "relu"
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        y = FCBlock(self.features, self.activation, None, self.dtype)(x)
+        y = FCBlock(self.features, None, None, self.dtype)(y)
+        return nn.LayerNorm(epsilon=1e-5, dtype=self.dtype)(x + y)
 
 
 class GLU(nn.Module):

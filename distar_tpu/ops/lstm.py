@@ -53,15 +53,15 @@ class LayerNormLSTMCell(nn.Module):
     @nn.compact
     def __call__(self, x, state: LSTMState) -> Tuple[jnp.ndarray, LSTMState]:
         h, c = state
-        ih = nn.LayerNorm(dtype=self.dtype, name="ln_ih")(
+        ih = nn.LayerNorm(epsilon=1e-5, dtype=self.dtype, name="ln_ih")(
             nn.Dense(4 * self.hidden_size, use_bias=False, dtype=self.dtype, name="ih")(x)
         )
-        hh = nn.LayerNorm(dtype=self.dtype, name="ln_hh")(
+        hh = nn.LayerNorm(epsilon=1e-5, dtype=self.dtype, name="ln_hh")(
             nn.Dense(4 * self.hidden_size, use_bias=False, dtype=self.dtype, name="hh")(h)
         )
         gates = ih + hh
         i, f, g, o = jnp.split(gates, 4, axis=-1)
-        c_new = nn.LayerNorm(dtype=self.dtype, name="ln_c")(
+        c_new = nn.LayerNorm(epsilon=1e-5, dtype=self.dtype, name="ln_c")(
             jax.nn.sigmoid(f) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
         )
         h_new = jax.nn.sigmoid(o) * jnp.tanh(c_new)

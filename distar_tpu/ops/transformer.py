@@ -90,11 +90,11 @@ class TransformerLayer(nn.Module):
             return h
 
         if self.ln_type == "post":
-            x = nn.LayerNorm(dtype=self.dtype)(x + attn(x, mask))
-            x = nn.LayerNorm(dtype=self.dtype)(x + mlp(x))
+            x = nn.LayerNorm(epsilon=1e-5, dtype=self.dtype)(x + attn(x, mask))
+            x = nn.LayerNorm(epsilon=1e-5, dtype=self.dtype)(x + mlp(x))
         elif self.ln_type == "pre":
-            x = x + attn(nn.LayerNorm(dtype=self.dtype)(x), mask)
-            x = x + mlp(nn.LayerNorm(dtype=self.dtype)(x))
+            x = x + attn(nn.LayerNorm(epsilon=1e-5, dtype=self.dtype)(x), mask)
+            x = x + mlp(nn.LayerNorm(epsilon=1e-5, dtype=self.dtype)(x))
         else:
             raise NotImplementedError(self.ln_type)
         return x

@@ -1,0 +1,114 @@
+"""Two-process jax.distributed smoke: the real multi-host init path.
+
+Exercises parallel.dist.dist_init beyond single-process mesh shrinking
+(VERDICT round-1 #10): two CPU processes x 4 virtual devices each form one
+8-device global mesh; each process feeds its local shard of a dp-sharded
+batch through a pjit train-ish step whose gradient psum rides the
+cross-process collective layer.
+
+Run directly (spawns both workers):   python tests/multihost_smoke.py
+Run one worker (spawned internally):  python tests/multihost_smoke.py --rank N --port P
+Wrapped by tests/test_multihost.py for CI.
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+
+def worker(rank: int, port: int) -> None:
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=4"
+    ).strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    from distar_tpu.parallel.dist import dist_init
+
+    info = dist_init(
+        coordinator_address=f"127.0.0.1:{port}",
+        num_processes=2,
+        process_id=rank,
+        method="explicit",
+    )
+    assert info["world_size"] == 2, info
+
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from distar_tpu.parallel import MeshSpec, make_mesh
+
+    mesh = make_mesh(MeshSpec(dp=8))
+    assert mesh.devices.size == 8
+
+    batch_sharding = NamedSharding(mesh, P("dp"))
+    repl = NamedSharding(mesh, P())
+
+    # one data-parallel "train step": per-shard loss grads psum over dp
+    def step(w, x, y):
+        def loss(w):
+            pred = x @ w
+            return jnp.mean((pred - y) ** 2)
+
+        g = jax.grad(loss)(w)
+        return w - 0.1 * g, loss(w)
+
+    step = jax.jit(
+        step,
+        in_shardings=(repl, batch_sharding, batch_sharding),
+        out_shardings=(repl, repl),
+    )
+
+    rng = np.random.default_rng(0)  # same on both ranks
+    w = jnp.asarray(rng.standard_normal((16, 4)).astype(np.float32))
+    x_global = rng.standard_normal((32, 16)).astype(np.float32)
+    y_global = (x_global @ np.asarray(w) * 0.5).astype(np.float32)
+
+    # each process supplies ITS addressable shards of the global batch
+    def make_global(arr):
+        sharding = NamedSharding(mesh, P("dp"))
+        return jax.make_array_from_callback(
+            arr.shape, sharding, lambda idx: arr[idx]
+        )
+
+    x = make_global(x_global)
+    y = make_global(y_global)
+    losses = []
+    for _ in range(3):
+        w, l = step(w, x, y)
+        losses.append(float(l))
+    assert losses[-1] < losses[0], losses
+    if rank == 0:
+        print(f"multihost smoke ok: world={info['world_size']} losses={losses}")
+
+
+def main() -> int:
+    import portpicker
+
+    port = portpicker.pick_unused_port()
+    env = dict(os.environ)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), "--rank", str(r), "--port", str(port)],
+            env=env,
+        )
+        for r in range(2)
+    ]
+    rcs = [p.wait(timeout=300) for p in procs]
+    if any(rcs):
+        print(f"multihost smoke FAILED: rcs={rcs}")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    if "--rank" in sys.argv:
+        rank = int(sys.argv[sys.argv.index("--rank") + 1])
+        port = int(sys.argv[sys.argv.index("--port") + 1])
+        worker(rank, port)
+    else:
+        sys.exit(main())

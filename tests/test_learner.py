@@ -167,3 +167,24 @@ def test_learner_admin_api(rl_learner):
         assert post("bogus")["code"] == 404
     finally:
         admin.stop()
+
+
+def test_device_prefetcher_order_and_errors():
+    from distar_tpu.learner.prefetch import DevicePrefetcher
+
+    batches = [{"i": i} for i in range(5)]
+    pf = DevicePrefetcher(iter(batches), lambda b: {**b, "placed": True}, depth=2)
+    out = list(pf)
+    assert [b["i"] for b in out] == list(range(5))
+    assert all(b["placed"] for b in out)
+
+    def boom():
+        yield {"i": 0}
+        raise RuntimeError("producer failed")
+
+    pf = DevicePrefetcher(boom(), lambda b: b, depth=2)
+    assert next(pf)["i"] == 0
+    import pytest as _pytest
+
+    with _pytest.raises(RuntimeError, match="producer failed"):
+        next(pf)

@@ -1,0 +1,16 @@
+"""CI wrapper for the two-process jax.distributed smoke (multihost_smoke.py)."""
+import os
+import subprocess
+import sys
+
+import pytest
+
+
+@pytest.mark.slow
+def test_two_process_distributed_smoke():
+    script = os.path.join(os.path.dirname(__file__), "multihost_smoke.py")
+    proc = subprocess.run(
+        [sys.executable, script], capture_output=True, text=True, timeout=420,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:] + proc.stdout[-500:]
+    assert "multihost smoke ok" in proc.stdout
