@@ -57,6 +57,7 @@ class Actor:
         model_cfg: Optional[dict] = None,
         env_fn: Optional[Callable[[], BaseEnv]] = None,
         init_params: Optional[dict] = None,
+        player_params: Optional[Dict[str, dict]] = None,
     ):
         whole = deep_merge_dicts(ACTOR_DEFAULTS, cfg or {})
         self.cfg = whole.actor
@@ -67,6 +68,7 @@ class Actor:
         self.model = Model(self.model_cfg)
         self._env_fn = env_fn or (lambda: MockEnv(seed=self.cfg.seed))
         self._init_params = init_params
+        self._player_params = dict(player_params or {})
         self._rng = np.random.default_rng(self.cfg.seed)
         self.results: List[dict] = []
 
@@ -98,6 +100,8 @@ class Actor:
 
     def _load_player_params(self, player_id: str):
         """Fresh weights from the learner when published, else initial."""
+        if player_id in self._player_params:
+            return self._player_params[player_id]
         if self.adapter is not None:
             data = self._pull_latest_model(player_id)
             if data is not None:
@@ -105,10 +109,18 @@ class Actor:
                 return jax.tree.map(np.asarray, data["params"])
         return self._initial_params()
 
-    def _sample_z(self, side: int, job: dict) -> dict:
+    def _sample_z(
+        self,
+        side: int,
+        job: dict,
+        born_location: Optional[int] = None,
+        map_name: Optional[str] = None,
+    ) -> dict:
         """Target strategy for one side: the job's z_path library keyed by
-        map/matchup (reference agent.py:176-243), synthetic fallback when no
-        library resolves (e.g. before gen_z has produced one)."""
+        map/matchup/born-location (reference agent.py:176-243), synthetic
+        fallback when no library resolves (e.g. before gen_z has produced
+        one). With a ``born_location`` (known once the episode's first obs
+        arrives) the exact library key is used; otherwise a random one."""
         z_paths = job.get("z_path", [])
         path = z_paths[side] if side < len(z_paths) else ""
         lib = None
@@ -119,7 +131,11 @@ class Actor:
                 from ..lib.z_library import ZLibrary
 
                 resolved = None
-                for d in self.cfg.get("z_dirs", [""]):
+                pkg_z_dir = os.path.join(
+                    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                    "data", "z_libraries",
+                )
+                for d in list(self.cfg.get("z_dirs", [""])) + [pkg_z_dir]:
                     cand = os.path.join(d, path) if d else path
                     if os.path.exists(cand):
                         resolved = cand
@@ -143,10 +159,15 @@ class Actor:
             # library keys follow the decoder's matchup convention: own race
             # for mirrors, race+opponent otherwise (gen_z, decode_z)
             mix_race = race if race == opp_race else race + opp_race
+            fr_prob = float(self.cfg.get("fake_reward_prob", 1.0))
+            resolved_map = map_name or job.get("env_info", {}).get("map_name", "")
+            if born_location is not None:
+                try:
+                    return lib.sample(resolved_map, mix_race, int(born_location), fr_prob)
+                except (KeyError, TypeError, IndexError):
+                    pass  # library has no entries for this exact spawn
             target = lib.sample_any(
-                job.get("env_info", {}).get("map_name", ""),
-                mix_race=mix_race,
-                fake_reward_prob=float(self.cfg.get("fake_reward_prob", 1.0)),
+                resolved_map, mix_race=mix_race, fake_reward_prob=fr_prob,
             )
             if target is not None:
                 return target
@@ -214,21 +235,27 @@ class Actor:
         return reset
 
     # ------------------------------------------------------------------- run
-    def run_job(self, episodes: Optional[int] = None) -> List[dict]:
-        """Ask for one job and play it out; returns per-episode results."""
+    def run_job(
+        self, episodes: Optional[int] = None, job: Optional[dict] = None
+    ) -> List[dict]:
+        """Ask for one job and play it out; returns per-episode results.
+
+        An explicit ``job`` dict overrides asking the league — play/eval use
+        this to pin matchups (reference job_type eval_test, play.py)."""
         episodes = episodes or self.cfg.episodes_per_job
-        job = (
-            self.league.actor_ask_for_job({"job_type": "train"})
-            if self.league is not None
-            else {
-                "player_ids": ["MP0", "HP0"],
-                "send_data_players": ["MP0"],
-                "update_players": ["MP0"],
-                "teacher_player_ids": ["T", "none"],
-                "branch": "standalone",
-                "env_info": {"map_name": "mock"},
-            }
-        )
+        if job is None:
+            job = (
+                self.league.actor_ask_for_job({"job_type": "train"})
+                if self.league is not None
+                else {
+                    "player_ids": ["MP0", "HP0"],
+                    "send_data_players": ["MP0"],
+                    "update_players": ["MP0"],
+                    "teacher_player_ids": ["T", "none"],
+                    "branch": "standalone",
+                    "env_info": {"map_name": "mock"},
+                }
+            )
         self._model_iters: Dict[str, int] = {}
         player_ids = job["player_ids"][:2]
         n_env = self.cfg.env_num
@@ -261,15 +288,17 @@ class Actor:
         }
         for (e, side), ag in agents.items():
             ag.model_last_iter = self._model_iters.get(ag.player_id, 0)
+            ag.collect_trajectories = ag.player_id in job.get("send_data_players", [])
+        sides = list(range(len(player_ids)))
         hidden_backup = {
-            (e, side): infer[side].hidden_for_slot(e) for e in range(n_env) for side in (0, 1)
+            (e, side): infer[side].hidden_for_slot(e) for e in range(n_env) for side in sides
         }
 
         def reset_slot(e: int) -> None:
             """Restart env slot e: fresh episode, fresh Z, zeroed policy and
             teacher LSTM carries (shared by episode-end and league-reset).
             The fresh obs arrives asynchronously via the pool."""
-            for side in (0, 1):
+            for side in sides:
                 agents[(e, side)].reset(z=self._sample_z(side, job))
                 infer[side].reset_slot(e)
                 teacher_hidden[side] = tuple(
@@ -283,7 +312,7 @@ class Actor:
             """Close out every side's pending action with the terminal
             reward, report the result, restart the slot."""
             nonlocal episodes_done
-            for side in (0, 1):
+            for side in sides:
                 ag = agents[(e, side)]
                 if ag._output is not None and (e, side) in pending_teacher:
                     traj = ag.collect_data(
@@ -301,12 +330,16 @@ class Actor:
             from ..league.player import FRAC_ID
 
             frac_ids = job.get("frac_ids", [1, 1])
-            for side in (0, 1):
+            for side in sides:
                 ag = agents[(e, side)]
                 frac = frac_ids[side] if side < len(frac_ids) else 1
+                opponent = (
+                    player_ids[1 - side] if 1 - side < len(player_ids) else
+                    job.get("opponent_id", "bot")
+                )
                 result[str(side)] = {
                     "player_id": player_ids[side],
-                    "opponent_id": player_ids[1 - side],
+                    "opponent_id": opponent,
                     "winloss": int(rewards[side]),
                     "race": FRAC_ID.get(frac, ["zerg"])[0],
                     **ag.episode_stats(),
@@ -348,6 +381,17 @@ class Actor:
                 for e, kind, payload in pool.ready(timeout=1.0):
                     if kind == RESET:
                         obs[e] = payload
+                        # the first obs reveals the spawn: re-key Z to the
+                        # exact map/matchup/born-location library entry
+                        # (reference agent.reset, agent.py:176-243)
+                        for side in sides:
+                            gi = (payload.get(side) or {}).get("game_info", {})
+                            born = gi.get("born_location")
+                            if born is not None:
+                                agents[(e, side)].reset(z=self._sample_z(
+                                    side, job, born_location=born,
+                                    map_name=gi.get("map_name"),
+                                ))
                     else:
                         next_obs, rewards, done, info = payload
                         if done:

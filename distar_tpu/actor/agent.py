@@ -83,6 +83,9 @@ class Agent:
         self._rng = np.random.default_rng(seed)
         self._z = z or sample_fake_z(self._rng)
         self.model_last_iter = 0
+        # eval agents keep stats/pseudo-rewards but assemble no trajectories
+        # (the reference's eval job_type skips the data buffer entirely)
+        self.collect_trajectories = True
         self.reset()
 
     # ----------------------------------------------------------------- reset
@@ -354,6 +357,10 @@ class Agent:
             self._game_step,
         )
         spec = ACT.ACTIONS[action_type]
+        if not self.collect_trajectories:
+            # eval agents keep the stat/pseudo-reward updates above but
+            # skip the per-step trajectory assembly entirely
+            return None
         mask = {
             "actions_mask": {
                 "action_type": 1.0,

@@ -1,51 +1,220 @@
-"""Play/eval launcher: agent-vs-agent matches, winrate report.
+"""Play/eval launcher: agent-vs-agent / agent-vs-bot / human-vs-agent
+matches on a real SC2 install, plus a game-free mock mode.
 
-Role parity with the reference (reference: distar/bin/play.py:27-120 —
-human/agent/bot matchups over the realtime env). The mock env stands in for
-SC2; checkpoints load into either side. Human mode and the realtime SC2
-window land with the env binding.
+Role parity with the reference (reference: distar/bin/play.py:27-120):
+resolves the SC2 install (SC2PATH), installs bundled maps, pins the matchup
+by game_type, loads a checkpoint per side (native checkpoints or reference
+torch .pth via ref_convert), runs realtime games, and reports winrates.
+Human mode gives the human their own full-screen client (env.py:191-197);
+the realtime clock is SC2's own.
 """
 from __future__ import annotations
 
 import argparse
+import os
 from collections import Counter
 
 from ..actor import Actor
 from ..envs import MockEnv
 from ..utils.checkpoint import load_checkpoint
 
+GAME_TYPES = ("agent_vs_agent", "agent_vs_bot", "human_vs_agent", "mock")
+
+
+def find_sc2() -> str:
+    """Locate the SC2 install via the platform run config (single source of
+    truth for discovery, envs/sc2/run_configs.py)."""
+    from ..envs.sc2 import run_configs
+
+    data_dir = run_configs.get().data_dir
+    if not os.path.isdir(data_dir):
+        raise SystemExit(
+            f"StarCraft II install not found at '{data_dir}': set the SC2PATH "
+            "environment variable (or use --game_type mock for a game-free "
+            "smoke run)."
+        )
+    return data_dir
+
+
+def load_params(path: str, model_cfg):
+    """Checkpoint -> Flax params; reference torch .pth checkpoints convert
+    on the fly (model/ref_convert.convert_model)."""
+    if path.endswith((".pth", ".pt")):
+        import torch
+
+        sd = torch.load(path, map_location="cpu")
+        sd = sd.get("model", sd)
+        from ..model.ref_convert import convert_model
+
+        return convert_model(sd, model_cfg)
+    return load_checkpoint(path)["state"].get("params")
+
+
+def side_name(path: str, default: str) -> str:
+    if not path:
+        return default
+    return os.path.basename(path).rsplit(".", 1)[0] or default
+
 
 def main() -> None:
     p = argparse.ArgumentParser()
-    p.add_argument("--game-count", type=int, default=4)
-    p.add_argument("--model1", default="", help="checkpoint for side 0 (optional)")
-    p.add_argument("--model2", default="", help="checkpoint for side 1 (optional)")
-    p.add_argument("--env-num", type=int, default=2)
-    p.add_argument("--episode-game-loops", type=int, default=300)
-    p.add_argument("--smoke-model", action="store_true", default=True)
+    p.add_argument("--model1", default="", help="checkpoint for side 0")
+    p.add_argument("--model2", default="", help="checkpoint for side 1 (or botN)")
+    p.add_argument("--game_type", default="human_vs_agent", choices=GAME_TYPES)
+    p.add_argument("--map", dest="map_name", default="KairosJunction")
+    p.add_argument("--race1", default="zerg")
+    p.add_argument("--race2", default="zerg")
+    p.add_argument("--game-count", type=int, default=1)
+    p.add_argument("--maps-dir", default="", help="bundled .SC2Map dir to auto-install")
+    p.add_argument("--z-path", default="", help="Z strategy library for both sides")
+    p.add_argument("--save-replay-episodes", type=int, default=0)
+    p.add_argument("--replay-dir", default="replays")
+    p.add_argument("--no-realtime", action="store_true",
+                   help="lockstep stepping instead of wall-clock (agent games only)")
+    p.add_argument("--episode-game-loops", type=int, default=300, help="mock mode only")
+    p.add_argument("--env-num", type=int, default=1)
+    p.add_argument("--smoke-model", action="store_true", default=None,
+                   help="tiny model dims for fast smoke runs (default for "
+                        "checkpoint-less mock games)")
+    p.add_argument("--full-model", dest="smoke_model", action="store_false",
+                   help="force full-scale model dims")
+    p.add_argument("--platform", default="auto", choices=("auto", "cpu", "tpu"),
+                   help="inference device; cpu works anywhere (the reference's "
+                        "--cpu flag), auto uses the default jax backend")
     args = p.parse_args()
+
+    if args.platform == "cpu" or (args.platform == "auto" and args.game_type == "mock"):
+        # pin before any backend init; the image's sitecustomize pins the
+        # platform via jax.config, so an env var alone is too late
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache_distar_tpu")
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
     from .rl_train import SMOKE_MODEL
 
-    init_params = None
+    if args.smoke_model is None:
+        # checkpoints require the full-scale dims; a checkpoint-less mock
+        # smoke shouldn't compile the full model
+        args.smoke_model = args.game_type == "mock" and not args.model1
+    model_cfg = SMOKE_MODEL if args.smoke_model else {}
+
+    if args.game_type == "mock":
+        from ..model.config import default_model_config
+        from ..utils.config import deep_merge_dicts
+
+        cfg = deep_merge_dicts(default_model_config(), model_cfg)
+        player_params = {}
+        if args.model1:
+            player_params["model1"] = load_params(args.model1, cfg)
+        if args.model2:
+            player_params["model2"] = load_params(args.model2, cfg)
+        actor = Actor(
+            cfg={"actor": {"env_num": args.env_num, "traj_len": 10 ** 9}},
+            model_cfg=model_cfg,
+            env_fn=lambda: MockEnv(episode_game_loops=args.episode_game_loops),
+            player_params=player_params,
+        )
+        job = {
+            "player_ids": ["model1", "model2"],
+            "send_data_players": [],
+            "update_players": [],
+            "teacher_player_ids": ["none", "none"],
+            "branch": "eval_test",
+            "env_info": {"map_name": "mock"},
+        }
+        results = actor.run_job(episodes=args.game_count, job=job)
+        report(results)
+        return
+
+    sc2_dir = find_sc2()
+    if args.maps_dir:
+        from ..envs.sc2 import maps as map_registry
+
+        map_registry.install_maps(args.maps_dir, sc2_dir)
+
+    from ..model.config import default_model_config
+    from ..utils.config import deep_merge_dicts
+
+    full_model_cfg = deep_merge_dicts(default_model_config(), model_cfg)
+
+    # matchup -> env player ids + the model-driven sides (reference
+    # play.py:101-112)
+    name1 = side_name(args.model1, "model1")
+    realtime = not args.no_realtime
+    player_params = {}
+    if args.game_type == "agent_vs_agent":
+        name2 = side_name(args.model2, "model2")
+        if name2 == name1:
+            name2 = name1 + "(1)"
+        env_player_ids = [name1, name2]
+        agent_ids = [name1, name2]
+        if args.model2:
+            player_params[name2] = load_params(args.model2, full_model_cfg)
+    elif args.game_type == "agent_vs_bot":
+        import re
+
+        if args.model2 and not re.fullmatch(r"bot\d+", args.model2):
+            raise SystemExit(
+                f"agent_vs_bot expects --model2 botN (built-in bot level), "
+                f"got {args.model2!r}; use --game_type agent_vs_agent for a "
+                "checkpoint opponent"
+            )
+        bot = args.model2 or "bot10"
+        env_player_ids = [name1, bot]
+        agent_ids = [name1]
+    else:  # human_vs_agent
+        env_player_ids = [name1, "human"]
+        agent_ids = [name1]
+        realtime = True  # the human plays in wall-clock time
     if args.model1:
-        init_params = load_checkpoint(args.model1)["state"].get("params")
+        player_params[name1] = load_params(args.model1, full_model_cfg)
+
+    env_cfg = {
+        "env": {
+            "map_name": args.map_name,
+            "player_ids": env_player_ids,
+            "races": [args.race1, args.race2],
+            "realtime": realtime,
+            "save_replay_episodes": args.save_replay_episodes,
+            "replay_dir": args.replay_dir,
+        }
+    }
+
+    from ..envs.sc2.launcher import make_sc2_env
+
+    z_paths = [args.z_path, args.z_path] if args.z_path else []
+    job = {
+        "player_ids": agent_ids,
+        "send_data_players": [],
+        "update_players": [],
+        "teacher_player_ids": ["none"] * len(agent_ids),
+        "branch": "eval_test",
+        "env_info": {"map_name": args.map_name},
+        "z_path": z_paths,
+        "opponent_id": env_player_ids[-1],
+    }
     actor = Actor(
-        cfg={"actor": {"env_num": args.env_num, "traj_len": 10 ** 9}},  # no traj push
-        league=None,
-        adapter=None,
-        model_cfg=SMOKE_MODEL if args.smoke_model else {},
-        env_fn=lambda: MockEnv(episode_game_loops=args.episode_game_loops),
-        init_params=init_params,
+        cfg={"actor": {"env_num": args.env_num, "traj_len": 10 ** 9}},
+        model_cfg=model_cfg,
+        env_fn=lambda: make_sc2_env(env_cfg),
+        player_params=player_params,
     )
-    results = actor.run_job(episodes=args.game_count)
+    results = actor.run_job(episodes=args.game_count, job=job)
+    report(results)
+
+
+def report(results) -> None:
     outcomes = Counter(
-        "side0" if r["0"]["winloss"] > 0 else "side1" for r in results
+        "side0" if r["0"]["winloss"] > 0 else
+        ("side1" if r["0"]["winloss"] < 0 else "tie")
+        for r in results
     )
     n = max(len(results), 1)
     print(
         f"games={len(results)} side0_winrate={outcomes['side0'] / n:.2f} "
-        f"side1_winrate={outcomes['side1'] / n:.2f}"
+        f"side1_winrate={outcomes['side1'] / n:.2f} ties={outcomes['tie']}"
     )
 
 

@@ -1,9 +1,16 @@
 """Supervised-learning launcher.
 
 Role parity with the reference (reference: distar/bin/sl_train.py:28-50):
-learner / replay-actor roles. Until the SC2 replay decoder lands, --fake-data
-drives the learner with schema-complete batches (the reference's
-FakeDataloader path) — same model, loss, and meters as real training.
+three roles —
+  learner        train on decoded-replay data: a local ReplayDataset dir
+                 (--data), trajectories pulled off the Adapter data plane
+                 from remote replay actors (--remote), or — with neither —
+                 schema-complete fake batches (the reference FakeDataloader
+                 path);
+  replay_actor   shard a replay list over SLURM tasks × workers, decode via
+                 the two-pass SC2 decoder, push to the learner
+                 (reference replay_actor.py);
+  coordinator    the metadata broker both sides register with.
 """
 from __future__ import annotations
 
@@ -11,20 +18,10 @@ import argparse
 
 from ..learner import SLLearner
 from ..utils import read_config
+from .rl_train import _addr
 
 
-def main() -> None:
-    p = argparse.ArgumentParser()
-    p.add_argument("--config", default="")
-    p.add_argument("--iters", type=int, default=4)
-    p.add_argument("--batch-size", type=int, default=2)
-    p.add_argument("--traj-len", type=int, default=8)
-    p.add_argument("--experiment-name", default="sl_train")
-    p.add_argument("--fake-data", action="store_true", default=True)
-    p.add_argument("--smoke-model", action="store_true", default=True)
-    p.add_argument("--full-model", dest="smoke_model", action="store_false")
-    args = p.parse_args()
-
+def _learner(args) -> None:
     from .rl_train import SMOKE_MODEL
 
     user_cfg = read_config(args.config) if args.config else {}
@@ -41,12 +38,86 @@ def main() -> None:
             "model": model_cfg,
         }
     )
+    if args.data:
+        from ..learner.sl_dataloader import ReplayDataset, SLDataloader
+
+        learner.set_dataloader(
+            SLDataloader(ReplayDataset(args.data), args.batch_size, args.traj_len)
+        )
+    elif args.remote:
+        from ..comm import Adapter
+        from ..learner.replay_actor import RemoteSLDataloader
+
+        adapter = Adapter(coordinator_addr=_addr(args.coordinator_addr))
+        learner.set_dataloader(
+            RemoteSLDataloader(adapter, args.batch_size, args.traj_len)
+        )
+    # else: the built-in fake dataloader (schema-complete random batches)
     learner.run(max_iterations=args.iters)
     print(
         f"sl_train done: {learner.last_iter.val} iters, "
         f"loss={learner.variable_record.get('total_loss').avg:.4f}, "
         f"action_type_acc={learner.variable_record.get('action_type_acc').avg:.4f}"
     )
+
+
+def _replay_actor(args) -> None:
+    from ..comm import Adapter
+    from ..envs.replay_decoder import ReplayDecoder
+    from ..learner.replay_actor import ReplayActor
+
+    coordinator = _addr(args.coordinator_addr)
+    ReplayActor(
+        replays=args.replays,
+        adapter_factory=lambda: Adapter(coordinator_addr=coordinator),
+        decoder_factory=lambda: ReplayDecoder(),
+        num_workers=args.num_workers,
+        epochs=args.epochs,
+    ).run()
+
+
+def _coordinator(args) -> None:
+    import time
+
+    from ..comm import CoordinatorServer
+
+    server = CoordinatorServer(port=_addr(args.coordinator_addr)[1])
+    server.start()
+    print(f"coordinator serving on {server.host}:{server.port}")
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        server.stop()
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--type", default="learner",
+                   choices=("learner", "replay_actor", "coordinator"))
+    p.add_argument("--config", default="")
+    p.add_argument("--iters", type=int, default=4)
+    p.add_argument("--batch-size", type=int, default=2)
+    p.add_argument("--traj-len", type=int, default=8)
+    p.add_argument("--experiment-name", default="sl_train")
+    p.add_argument("--data", default="",
+                   help="local ReplayDataset directory (decoded trajectories)")
+    p.add_argument("--remote", action="store_true",
+                   help="pull trajectories from replay actors via the coordinator")
+    p.add_argument("--smoke-model", action="store_true", default=True)
+    p.add_argument("--full-model", dest="smoke_model", action="store_false")
+    p.add_argument("--coordinator-addr", default="127.0.0.1:8422")
+    p.add_argument("--replays", default="", help="replay list file or directory")
+    p.add_argument("--num-workers", type=int, default=1)
+    p.add_argument("--epochs", type=int, default=1)
+    args = p.parse_args()
+
+    if args.type == "learner":
+        _learner(args)
+    elif args.type == "replay_actor":
+        _replay_actor(args)
+    else:
+        _coordinator(args)
 
 
 if __name__ == "__main__":

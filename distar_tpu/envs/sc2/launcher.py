@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import logging
 import random
+import re
 import threading
 import time
 from typing import Callable, List, Optional, Sequence
@@ -55,6 +56,15 @@ class Bot(Player):
         super().__init__(race, name=f"bot{difficulty}")
         self.difficulty = difficulty
         self.ai_build = ai_build
+
+
+class Human(Player):
+    """A human participant: gets their own (full-screen) SC2 client to play
+    in; the env never observes or acts their controller (reference
+    env.py:191-197, :315-316)."""
+
+    def __init__(self, race: str, name: str = "human"):
+        super().__init__(race, name=name)
 
 
 class SC2GameLauncher:
@@ -110,9 +120,16 @@ class SC2GameLauncher:
                         self._controller_factory(i) for i in range(self.num_agents)
                     ]
                 else:
+                    agent_players = [
+                        p for p in self.players if not isinstance(p, Bot)
+                    ]
+                    # the human's client launches full screen (reference
+                    # env.py:191-197)
                     self._procs = [
-                        self._run_config.start(want_rgb=False)
-                        for _ in range(self.num_agents)
+                        self._run_config.start(
+                            want_rgb=False, full_screen=isinstance(p, Human)
+                        )
+                        for p in agent_players
                     ]
                     self.controllers = [p.controller for p in self._procs]
                 return
@@ -160,6 +177,7 @@ class SC2GameLauncher:
         # interface options: raw + score + map-sized minimap feature layers
         # (reference _setup_interface :150-177)
         agent_players = [p for p in self.players if not isinstance(p, Bot)]
+        has_human = any(isinstance(p, Human) for p in agent_players)
         names = crop_and_deduplicate_names([p.name for p in agent_players])
         join_reqs = []
         for p, name in zip(agent_players, names):
@@ -169,7 +187,9 @@ class SC2GameLauncher:
                 show_cloaked=False,
                 show_burrowed_shadows=False,
                 show_placeholders=False,
-                raw_affects_selection=False,
+                # a human drives the UI, so raw commands must respect their
+                # selection (reference _setup_interface env.py:153-156)
+                raw_affects_selection=has_human,
                 raw_crop_to_playable_area=True,
             )
             interface.feature_layer.width = 24
@@ -216,6 +236,21 @@ class SC2GameLauncher:
         game_infos = [c.game_info() for c in self.controllers]
         self.features = [ProtoFeatures(gi) for gi in game_infos]
         self._launched = True
+
+    @property
+    def human_indices(self) -> List[int]:
+        agent_players = [p for p in self.players if not isinstance(p, Bot)]
+        return [i for i, p in enumerate(agent_players) if isinstance(p, Human)]
+
+    def save_replay(self, replay_dir: str, prefix: Optional[str] = None) -> Optional[str]:
+        """Pull the replay from the first controller and write it via the run
+        config (reference env.py:485-496)."""
+        if not self.controllers or self._run_config is None:
+            return None
+        data = self.controllers[0].save_replay()
+        if not data:
+            return None
+        return self._run_config.save_replay(data, replay_dir, prefix)
 
     # ------------------------------------------------------------ lifecycle
     def ensure_game(self) -> None:
@@ -274,14 +309,26 @@ class RealSC2Env(SC2Env):
     """SC2Env over a launcher's real controllers (the complete L2+L1 stack:
     orchestration from envs.sc2_env + the client layer underneath)."""
 
-    def __init__(self, launcher: SC2GameLauncher, **env_kwargs):
+    def __init__(
+        self,
+        launcher: SC2GameLauncher,
+        save_replay_episodes: int = 0,
+        replay_dir: str = ".",
+        **env_kwargs,
+    ):
         self._launcher = launcher
         launcher.ensure_game()
+        replay_saver = None
+        if save_replay_episodes > 0:
+            replay_saver = lambda prefix: launcher.save_replay(replay_dir, prefix)
         super().__init__(
             controllers=launcher.controllers,
             features=launcher.features,
             episode_length=launcher.game_steps_per_episode,
             realtime=env_kwargs.pop("realtime", launcher._realtime),
+            human_indices=launcher.human_indices,
+            save_replay_episodes=save_replay_episodes,
+            replay_saver=replay_saver,
             **env_kwargs,
         )
         self._first_reset_done = False
@@ -318,14 +365,21 @@ def make_sc2_env(cfg: Optional[dict] = None, controller_factory=None) -> RealSC2
             "version": None,
             "random_seed": None,
             "relaunch_every_episodes": 10,
+            "save_replay_episodes": 0,
+            "replay_dir": ".",
         }
     }
     whole = deep_merge_dicts(Config(defaults), cfg or {})
     ec = whole.env
     players = []
     for pid, race in zip(ec.player_ids, ec.races):
-        if isinstance(pid, str) and "bot" in pid:
-            players.append(Bot(race, int(pid.split("bot")[1])))
+        # exact forms only — agent ids derive from checkpoint basenames,
+        # which may legitimately contain 'bot'/'human' as substrings
+        bot_m = re.fullmatch(r"bot(\d+)", str(pid))
+        if bot_m:
+            players.append(Bot(race, int(bot_m.group(1))))
+        elif str(pid) == "human":
+            players.append(Human(race))
         else:
             players.append(Player(race, name=str(pid)))
     launcher = SC2GameLauncher(
@@ -342,4 +396,6 @@ def make_sc2_env(cfg: Optional[dict] = None, controller_factory=None) -> RealSC2
         launcher,
         random_delay_weights=list(ec.get("random_delay_weights") or []),
         both_obs=bool(ec.get("update_both_obs", True)),
+        save_replay_episodes=int(ec.get("save_replay_episodes", 0) or 0),
+        replay_dir=str(ec.get("replay_dir", ".")),
     )

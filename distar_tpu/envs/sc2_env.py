@@ -52,6 +52,9 @@ class SC2Env(BaseEnv):
         realtime: bool = False,
         both_obs: bool = True,
         seed: int = 0,
+        human_indices: Optional[Sequence[int]] = None,
+        save_replay_episodes: int = 0,
+        replay_saver=None,
     ):
         assert len(controllers) == len(features)
         self._controllers = list(controllers)
@@ -60,21 +63,33 @@ class SC2Env(BaseEnv):
         self._episode_length = min(episode_length, MAX_STEP_COUNT)
         self._random_delay_weights = list(random_delay_weights or [])
         self._realtime = realtime
-        self._both_obs = both_obs and self.num_agents == 2
+        # a human plays through their own full-screen client: the env never
+        # observes or acts their controller (reference env.py:315-316,384-385)
+        self._human = set(human_indices or [])
+        self._both_obs = both_obs and self.num_agents == 2 and not self._human
+        self._save_replay_episodes = save_replay_episodes
+        self._replay_saver = replay_saver
         self._rng = random.Random(seed)
         self._episode_steps = 0
         self._episode_count = 0
         self._next_obs_step = [0] * self.num_agents
         self._action_result: List[List[int]] = [[1] for _ in range(self.num_agents)]
         self._last_tags: List[list] = [[] for _ in range(self.num_agents)]
+        self._raw_obs: List = [None] * self.num_agents
+        self._born_locations: List = [None] * self.num_agents
         self._done = True
 
     # ------------------------------------------------------------------ api
     def reset(self) -> Dict[int, dict]:
         self._episode_steps = 0
         self._episode_count += 1
-        self._next_obs_step = [0] * self.num_agents
+        self._next_obs_step = [
+            MAX_STEP_COUNT + 1 if i in self._human else 0
+            for i in range(self.num_agents)
+        ]
         self._action_result = [[1] for _ in range(self.num_agents)]
+        self._raw_obs = [None] * self.num_agents
+        self._born_locations: List = [None] * self.num_agents
         self._done = False
         # restart the underlying game (reference restarts via the
         # controller's restart_game / create+join, env.py:298-330)
@@ -131,24 +146,44 @@ class SC2Env(BaseEnv):
 
     # ------------------------------------------------------------- internals
     def _advance(self, loops: int) -> None:
-        if loops <= 0:
+        # realtime games advance on SC2's own clock — no step requests
+        # (upstream pysc2 sc2_env gates exactly this way); observe() blocks
+        # until the target game loop instead
+        if loops <= 0 or self._realtime:
             return
         for c in self._controllers:
             if not c.status_ended:
                 c.step(loops)
 
     def _observe(self, target_game_loop: int):
-        raw = [c.observe(target_game_loop=target_game_loop) for c in self._controllers]
-        game_loop = int(raw[0].observation.game_loop)
+        # observe only the agents that are due (or every non-human agent in
+        # both-obs critic mode) — the reference's selective parallel observe
+        # (env.py:377-390); a human's controller is never queried
+        due = [
+            i for i in range(self.num_agents)
+            if self._next_obs_step[i] <= target_game_loop and i not in self._human
+        ]
+        query = [
+            i for i in range(self.num_agents)
+            if i not in self._human and (self._both_obs or i in due)
+        ] or due
+        for i in query:
+            self._raw_obs[i] = self._controllers[i].observe(
+                target_game_loop=target_game_loop
+            )
+        game_loop = int(self._raw_obs[query[0]].observation.game_loop)
         self._episode_steps = game_loop
-        due = [i for i in range(self.num_agents) if self._next_obs_step[i] <= game_loop]
+        due = [
+            i for i in range(self.num_agents)
+            if self._next_obs_step[i] <= game_loop and i not in self._human
+        ]
 
         outcome = [0] * self.num_agents
         episode_complete = any(
-            getattr(o, "player_result", None) for o in raw if o is not None
+            getattr(o, "player_result", None) for o in self._raw_obs if o is not None
         )
         if episode_complete:
-            for i, o in enumerate(raw):
+            for i, o in enumerate(self._raw_obs):
                 if o is None:
                     continue
                 pid = o.observation.player_common.player_id
@@ -160,18 +195,60 @@ class SC2Env(BaseEnv):
         if game_loop >= self._episode_length:
             episode_complete = True
         self._done = episode_complete
+        if episode_complete:
+            self._maybe_save_replay(outcome)
 
         obs: Dict[int, dict] = {}
-        indices = range(self.num_agents) if episode_complete else due
+        if episode_complete:
+            indices = [i for i in range(self.num_agents) if i not in self._human]
+        else:
+            indices = due
         for i in indices:
-            opponent = raw[1 - i] if self._both_obs else None
-            f_obs = self._features[i].transform_obs(raw[i], opponent_obs=opponent)
+            # a non-due agent's cached obs may be stale (or absent) — e.g.
+            # the terminal frame, or a realtime overshoot making an
+            # unqueried agent due; serve it the current frame
+            cached = self._raw_obs[i]
+            if cached is None or int(cached.observation.game_loop) < game_loop:
+                self._raw_obs[i] = self._controllers[i].observe(
+                    target_game_loop=target_game_loop
+                )
+            opponent = self._raw_obs[1 - i] if self._both_obs else None
+            f_obs = self._features[i].transform_obs(
+                self._raw_obs[i], opponent_obs=opponent
+            )
             f_obs["action_result"] = self._action_result[i]
             self._last_tags[i] = f_obs["game_info"]["tags"]
+            # born locations key the Z-library sampling (reference
+            # agent.py:183-187 reads them off the first observation)
+            if self._born_locations[i] is None:
+                try:
+                    self._born_locations[i] = self._features[i].born_locations(
+                        self._raw_obs[i]
+                    )
+                except Exception:
+                    self._born_locations[i] = (0, 0)
+            f_obs["game_info"]["born_location"] = self._born_locations[i][0]
+            f_obs["game_info"]["away_born_location"] = self._born_locations[i][1]
             obs[i] = f_obs
         rewards = {i: float(outcome[i]) for i in range(self.num_agents)}
         info = {"game_loop": game_loop, "outcome": outcome}
         return obs, rewards, episode_complete, info
+
+    def _maybe_save_replay(self, outcome) -> None:
+        """Save the finished game's replay every N episodes (reference
+        env.py:435-438)."""
+        if (
+            self._replay_saver is None
+            or self._save_replay_episodes <= 0
+            or self._episode_count % self._save_replay_episodes != 0
+        ):
+            return
+        try:
+            self._replay_saver(f"outcome_{outcome}")
+        except Exception:  # replay saving must never kill training
+            import logging
+
+            logging.exception("save_replay failed")
 
 
 class FakeController:

@@ -51,7 +51,9 @@ def z_entry_to_target(entry: List, fake_reward_prob: float = 1.0) -> dict:
 class ZLibrary:
     def __init__(self, path: str):
         with open(path) as f:
-            self.data = json.load(f)
+            raw = json.load(f)
+        # dunder keys hold metadata (e.g. the extraction provenance block)
+        self.data = {k: v for k, v in raw.items() if not k.startswith("__")}
 
     def sample(
         self,

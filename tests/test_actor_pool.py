@@ -150,3 +150,36 @@ def test_actor_samples_z_from_library(tmp_path):
     # unknown map falls back to an available key, not a crash
     job2 = dict(job, env_info={"map_name": "NoSuchMap"})
     assert actor._sample_z(0, job2)["beginning_order"] == [5, 9, 12]
+
+    # a known born location pins the exact entry; an unknown one falls back
+    z_exact = actor._sample_z(0, job, born_location=22)
+    assert z_exact["beginning_order"] == [5, 9, 12]
+    assert actor._sample_z(0, job, born_location=999)["beginning_order"] == [5, 9, 12]
+
+
+def test_extracted_z_libraries_load_and_sample():
+    """The shipped Z data (extracted reference strategy statistics,
+    tools/extract_z_data.py) loads through ZLibrary and samples exact
+    map/matchup/born-location keys."""
+    import os
+
+    from distar_tpu.lib import features as F
+    from distar_tpu.lib.z_library import ZLibrary
+
+    z_dir = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "distar_tpu", "data", "z_libraries",
+    )
+    lib = ZLibrary(os.path.join(z_dir, "3map.json"))
+    assert "__provenance__" not in lib.data
+    maps = lib.keys()
+    assert "KingsCove" in maps and "zerg" in maps["KingsCove"]
+    born = maps["KingsCove"]["zerg"][0]
+    z = lib.sample("KingsCove", "zerg", int(born))
+    assert len(z["beginning_order"]) == F.BEGINNING_ORDER_LENGTH
+    assert z["z_loop"] > 0
+    assert all(isinstance(x, int) for x in z["cumulative_stat"])
+    # every shipped library parses and yields a sample
+    for fname in os.listdir(z_dir):
+        l = ZLibrary(os.path.join(z_dir, fname))
+        assert l.sample_any("KingsCove", mix_race="zerg") is not None, fname

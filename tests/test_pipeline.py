@@ -160,6 +160,45 @@ def test_value_feature_flows_through_trajectory(tmp_path):
 
 
 @pytest.mark.slow
+def test_one_sided_eval_vs_bot():
+    """play.py's agent_vs_bot shape: a single model-driven side over a
+    1-agent env (the built-in bot lives inside the game), pinned matchup via
+    the explicit job override — no league, no data push."""
+    from distar_tpu.envs.dummy_obs import build_dummy_game_info
+    from distar_tpu.envs.features import ProtoFeatures
+    from distar_tpu.envs.sc2_env import FakeController, SC2Env
+
+    gi = build_dummy_game_info()
+
+    def env_fn():
+        return SC2Env(
+            [FakeController(player_id=1, end_at=40, winner_player=1)],
+            [ProtoFeatures(gi)],
+        )
+
+    actor = Actor(
+        cfg={"actor": {"env_num": 1, "traj_len": 10 ** 9, "seed": 5}},
+        model_cfg=SMALL_MODEL,
+        env_fn=env_fn,
+    )
+    job = {
+        "player_ids": ["model1"],
+        "send_data_players": [],
+        "update_players": [],
+        "teacher_player_ids": ["none"],
+        "branch": "eval_test",
+        "env_info": {"map_name": "fake"},
+        "opponent_id": "bot10",
+    }
+    results = actor.run_job(episodes=2, job=job)
+    assert len(results) >= 2
+    for r in results:
+        assert r["0"]["winloss"] == 1  # the fake game declares player 1 winner
+        assert r["0"]["opponent_id"] == "bot10"
+        assert "1" not in r
+
+
+@pytest.mark.slow
 def test_remote_roles_over_http(tmp_path):
     """League + coordinator as HTTP servers; actor and learner connect via
     RemoteLeague/Adapter addresses (the multi-host role path)."""

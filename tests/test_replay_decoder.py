@@ -268,3 +268,52 @@ def test_extract_z_spine_and_zergling_rules():
     assert names[0] == hatch
     assert bo_loc[0] == 50
     assert cum[ACT.CUMULATIVE_STAT_ACTIONS.index(hatch)] == 1
+
+
+def test_replay_actor_shards_and_feeds_remote_dataloader(server):
+    """ReplayActor decodes a sharded replay list through the fake SC2 server
+    and pushes trajectories over the Adapter; RemoteSLDataloader assembles
+    learner batches from them (reference replay_actor.py + remote SL mode)."""
+    from distar_tpu.comm import Adapter, Coordinator
+    from distar_tpu.learner.replay_actor import (
+        ReplayActor, RemoteSLDataloader, expand_replay_list,
+    )
+
+    # sharding math: 2 tasks x epochs over 4 replays
+    paths = [f"r{i}.SC2Replay" for i in range(4)]
+    shard0 = expand_replay_list(paths, epochs=2, ntasks=2, proc_id=0)
+    shard1 = expand_replay_list(paths, epochs=2, ntasks=2, proc_id=1)
+    assert len(shard0) == len(shard1) == 4
+    assert sorted(shard0 + shard1) == sorted(paths * 2)
+
+    for p in paths[:2]:
+        server.game.replay_library[p] = make_replay()
+
+    co = Coordinator()
+    push_adapter = Adapter(coordinator=co)
+    pull_adapter = Adapter(coordinator=co)
+
+    def decoder_factory():
+        return ReplayDecoder(
+            cfg={"minimum_action_length": 2, "parse_race": "Z"},
+            controller_provider=lambda v: RemoteController(
+                "127.0.0.1", server.port, timeout_seconds=5
+            ),
+        )
+
+    actor = ReplayActor(
+        replays=paths[:2],
+        adapter_factory=lambda: push_adapter,
+        decoder_factory=decoder_factory,
+        num_workers=1,
+        ntasks=1, proc_id=0,
+    )
+    actor.run()
+    assert actor.pushed >= 2  # both players of both replays that decoded
+
+    loader = RemoteSLDataloader(pull_adapter, batch_size=2, unroll_len=4,
+                                pull_timeout=30.0)
+    batch = next(loader)
+    assert batch["entity_num"].shape == (2 * 4,)
+    assert batch["new_episodes"].tolist() == [True, True]
+    assert np.isfinite(batch["entity_num"]).all()

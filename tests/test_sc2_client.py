@@ -185,8 +185,13 @@ def test_multiplayer_create_join_and_episode(server):
         if done:
             break
     assert done
-    # fake scripts player 1 as the winner
-    assert rewards[0] == 1.0 and rewards[1] == -1.0
+    # fake scripts player 1 as the winner; which env index IS player 1
+    # depends on join order (parallel joins race, as with real SC2), so map
+    # the outcome through the reported player id
+    assert sorted(rewards.values()) == [-1.0, 1.0]
+    win_idx = max(rewards, key=rewards.get)
+    pid = env._raw_obs[win_idx].observation.player_common.player_id
+    assert pid == 1
     # both fake connections saw create/join from the plumbing
     assert server.game.started
     env.close()

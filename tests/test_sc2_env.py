@@ -90,3 +90,45 @@ def test_episode_length_cutoff():
     _, rewards, done, _ = env.step({0: _action(delay=16), 1: _action(delay=16)})
     assert done  # cut at episode_length, no winner
     assert rewards == {0: 0.0, 1: 0.0}
+
+
+class _CountingController(FakeController):
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self.observe_calls = 0
+
+    def observe(self, target_game_loop=0):
+        self.observe_calls += 1
+        return super().observe(target_game_loop=target_game_loop)
+
+
+def test_human_mode_never_touches_human_controller():
+    """A human's controller is never observed or acted; the agent side still
+    plays and the human side's outcome comes from the agent's player_result
+    (reference env.py:315-316, :384-385)."""
+    gi = build_dummy_game_info()
+    controllers = [
+        _CountingController(player_id=1, end_at=20, winner_player=2),
+        _CountingController(player_id=2, end_at=20, winner_player=2),
+    ]
+    feats = [ProtoFeatures(gi), ProtoFeatures(gi)]
+    env = SC2Env(controllers, feats, human_indices=[1])
+    obs = env.reset()
+    assert set(obs) == {0}
+    assert "value_feature" not in obs[0]  # both_obs forced off in human mode
+    done = False
+    while not done:
+        obs, rewards, done, info = env.step({0: _action(delay=8)})
+    assert controllers[1].observe_calls == 0
+    assert controllers[1].acts_log == []
+    assert rewards[0] == -1.0 and rewards[1] == 1.0  # human won
+    assert 1 not in obs  # no terminal obs built for the human side
+
+
+def test_save_replay_hook_fires_on_episode_end():
+    saved = []
+    env, _ = _env(end_at=6, save_replay_episodes=1,
+                  replay_saver=lambda prefix: saved.append(prefix))
+    env.reset()
+    env.step({0: _action(delay=8), 1: _action(delay=8)})
+    assert len(saved) == 1 and "outcome" in saved[0]

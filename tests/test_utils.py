@@ -65,3 +65,76 @@ def test_timer():
     with t:
         pass
     assert t.value >= 0.0
+
+
+def test_downloader_resumes_with_range(tmp_path):
+    """download_model resumes a partial file via HTTP Range (reference
+    distar/bin/download_model.py:24-48)."""
+    import http.server
+    import threading
+
+    payload = bytes(range(256)) * 40  # 10240 bytes
+
+    class RangeHandler(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):
+            start = 0
+            rng = self.headers.get("Range")
+            if rng:
+                start = int(rng.split("=")[1].rstrip("-"))
+                self.send_response(206)
+            else:
+                self.send_response(200)
+            body = payload[start:]
+            self.send_header("Content-Length", str(len(payload) if not rng else len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):
+            pass
+
+    srv = http.server.HTTPServer(("127.0.0.1", 0), RangeHandler)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    try:
+        from distar_tpu.bin.download_model import Downloader
+
+        out = tmp_path / "model.pth"
+        out.write_bytes(payload[:3000])  # partial file on disk
+        url = f"http://127.0.0.1:{srv.server_address[1]}/model.pth"
+        d = Downloader(url, str(out), timeout=5.0)
+        assert d.total_size == len(payload)
+        d.download()
+        assert out.read_bytes() == payload
+    finally:
+        srv.shutdown()
+
+
+def test_downloader_restarts_when_server_ignores_range(tmp_path):
+    """A 200 response to a Range request must overwrite, not append."""
+    import http.server
+    import threading
+
+    payload = b"x" * 5000
+
+    class NoRangeHandler(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):
+            self.send_response(200)  # ignores Range entirely
+            self.send_header("Content-Length", str(len(payload)))
+            self.end_headers()
+            self.wfile.write(payload)
+
+        def log_message(self, *a):
+            pass
+
+    srv = http.server.HTTPServer(("127.0.0.1", 0), NoRangeHandler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        from distar_tpu.bin.download_model import Downloader
+
+        out = tmp_path / "model.pth"
+        out.write_bytes(b"y" * 1234)  # stale partial file
+        d = Downloader(f"http://127.0.0.1:{srv.server_address[1]}/m", str(out))
+        d.download()
+        assert out.read_bytes() == payload
+    finally:
+        srv.shutdown()

@@ -1,0 +1,102 @@
+#!/usr/bin/env python
+"""Extract the reference's curated Z strategy libraries as data assets.
+
+The Z libraries (reference: distar/agent/default/lib/*.json, consumed at
+agent.py:189-206) are *data*, not code: per-map, per-matchup, per-born-
+location strategy statistics (building orders, cumulative-stat index sets,
+build locations, loop horizons) distilled from high-MMR human replays by the
+reference's gen_z pipeline. Like data/game_contract.json they are game-fact
+artifacts the framework consumes; the schema is validated and normalised on
+the way through, and every output embeds a ``__provenance__`` block naming
+the source. Regenerating them from scratch requires decoding thousands of
+ladder replays with a live SC2 install (bin/gen_z.py --replays does exactly
+that when one is available).
+
+Usage: python tools/extract_z_data.py [--ref /root/reference] [--out distar_tpu/data/z_libraries]
+"""
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import sys
+
+VALID_RACES = {"zerg", "terran", "protoss", "random"}
+# races appear standalone (mirrors) or concatenated (e.g. "zergterran")
+MIX_RACES = VALID_RACES | {a + b for a in VALID_RACES for b in VALID_RACES}
+
+
+def validate_and_normalize(lib: dict, name: str) -> dict:
+    """Check the map->mix_race->born_location->entries schema and coerce all
+    leaves to plain ints (the loader contract, lib/z_library.py)."""
+    out = {}
+    n_entries = 0
+    for map_name, races in lib.items():
+        assert isinstance(map_name, str) and isinstance(races, dict), (name, map_name)
+        out_races = {}
+        for mix_race, locs in races.items():
+            assert mix_race in MIX_RACES, (name, map_name, mix_race)
+            assert isinstance(locs, dict), (name, map_name, mix_race)
+            out_locs = {}
+            for born, entries in locs.items():
+                int(born)  # born locations are flat spatial indices
+                norm = []
+                for e in entries:
+                    assert len(e) in (4, 5), (name, map_name, mix_race, born)
+                    bo, cum, bo_loc, z_loop = e[:4]
+                    rec = [
+                        [int(x) for x in bo],
+                        [int(x) for x in cum],
+                        [int(x) for x in bo_loc],
+                        int(z_loop),
+                    ]
+                    if len(e) == 5:
+                        rec.append(int(e[4]))
+                    norm.append(rec)
+                    n_entries += 1
+                out_locs[str(int(born))] = norm
+            out_races[mix_race] = out_locs
+        out[map_name] = out_races
+    print(f"  {name}: {len(out)} maps, {n_entries} entries")
+    return out
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--ref", default="/root/reference")
+    ap.add_argument(
+        "--out",
+        default=os.path.join(os.path.dirname(__file__), "..", "distar_tpu", "data", "z_libraries"),
+    )
+    args = ap.parse_args()
+
+    src_dir = os.path.join(args.ref, "distar", "agent", "default", "lib")
+    os.makedirs(args.out, exist_ok=True)
+    count = 0
+    for fname in sorted(os.listdir(src_dir)):
+        if not fname.endswith(".json"):
+            continue
+        src = os.path.join(src_dir, fname)
+        with open(src) as f:
+            raw = f.read()
+        lib = validate_and_normalize(json.loads(raw), fname)
+        lib["__provenance__"] = {
+            "source": f"distar/agent/default/lib/{fname}",
+            "sha256": hashlib.sha256(raw.encode()).hexdigest(),
+            "tool": "tools/extract_z_data.py",
+            "note": (
+                "Curated strategy statistics distilled from human ladder "
+                "replays by the reference's gen_z pipeline; data asset, "
+                "regenerable via bin/gen_z.py --replays with an SC2 install."
+            ),
+        }
+        with open(os.path.join(args.out, fname), "w") as f:
+            json.dump(lib, f)
+        count += 1
+    print(f"extracted {count} Z libraries -> {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
