@@ -96,7 +96,15 @@ def _bench_config(batch_size: int, unroll_len: int, iters: int = 4):
     return frames_per_sec, elapsed / iters, flops_per_step
 
 
+def _stage(name: str) -> None:
+    # breadcrumbs on stderr: when an attempt times out, the parent reports
+    # the LAST stage reached so the diagnostic says where it stalled
+    # (round-1 postmortem: "rc=1" with no location)
+    print(f"BENCH-STAGE {name} t={time.time():.0f}", file=sys.stderr, flush=True)
+
+
 def run_child():
+    _stage("import-jax")
     import jax
 
     # persistent compile cache: the flagship train step costs minutes to
@@ -105,8 +113,10 @@ def run_child():
     jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache_distar_tpu_bench")
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
+    _stage("backend-init")
     devices = jax.devices()
     device_kind = devices[0].device_kind
+    _stage(f"devices-ok {device_kind}")
     peak = _peak_flops(device_kind)
 
     if "BENCH_BATCH" in os.environ or "BENCH_UNROLL" in os.environ:

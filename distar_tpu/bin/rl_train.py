@@ -49,6 +49,34 @@ def _model_cfg(args) -> dict:
     return user_cfg.get("model", SMOKE_MODEL if args.smoke_model else {})
 
 
+def _resolve_args(args) -> None:
+    """Fill CLI sentinels from the user config's learner/actor blocks —
+    explicit CLI flags win, then config, then defaults (the reference's
+    deep-merge cascade applied to the launcher surface)."""
+    user_cfg = read_config(args.config) if args.config else {}
+    learner_cfg = user_cfg.get("learner", {})
+    actor_cfg = user_cfg.get("actor", {})
+    if args.batch_size is None:
+        args.batch_size = int(learner_cfg.get("batch_size", 4))
+    if args.traj_len is None:
+        args.traj_len = int(learner_cfg.get("unroll_len", actor_cfg.get("traj_len", 4)))
+    if args.env_num is None:
+        args.env_num = int(actor_cfg.get("env_num", 2))
+
+
+def _env_fn(args):
+    """Env factory from the user config's env block: ``env.type: sc2``
+    launches real games through the client layer (reference actors always
+    do); the default mock env keeps game-free smoke loops working."""
+    user_cfg = read_config(args.config) if args.config else {}
+    env_cfg = dict(user_cfg.get("env", {}))
+    if env_cfg.pop("type", "mock") == "sc2":
+        from ..envs.sc2.launcher import make_sc2_env
+
+        return lambda: make_sc2_env({"env": env_cfg})
+    return lambda: MockEnv(episode_game_loops=args.episode_game_loops)
+
+
 def _learner_cfg(args, model_cfg: dict, load_path: str = "") -> dict:
     return {
         "common": {"experiment_name": args.experiment_name},
@@ -80,7 +108,7 @@ def run_all(args) -> None:
         league=league,
         adapter=actor_adapter,
         model_cfg=model_cfg,
-        env_fn=lambda: MockEnv(episode_game_loops=args.episode_game_loops),
+        env_fn=_env_fn(args),
     )
 
     stop = threading.Event()
@@ -158,7 +186,7 @@ def run_actor(args) -> None:
         league=league,
         adapter=adapter,
         model_cfg=model_cfg,
-        env_fn=lambda: MockEnv(episode_game_loops=args.episode_game_loops),
+        env_fn=_env_fn(args),
     )
     while True:
         actor.run_job(episodes=1)
@@ -170,9 +198,9 @@ def main() -> None:
                    choices=["all", "league", "coordinator", "learner", "actor"])
     p.add_argument("--config", default="")
     p.add_argument("--iters", type=int, default=4)
-    p.add_argument("--batch-size", type=int, default=4)
-    p.add_argument("--traj-len", type=int, default=4)
-    p.add_argument("--env-num", type=int, default=2)
+    p.add_argument("--batch-size", type=int, default=None)
+    p.add_argument("--traj-len", type=int, default=None)
+    p.add_argument("--env-num", type=int, default=None)
     p.add_argument("--episode-game-loops", type=int, default=300)
     p.add_argument("--experiment-name", default="rl_train")
     p.add_argument("--smoke-model", action="store_true", default=True)
@@ -187,7 +215,18 @@ def main() -> None:
                    help="host:port for jax.distributed (explicit mode)")
     p.add_argument("--dist-num-processes", type=int, default=None)
     p.add_argument("--dist-process-id", type=int, default=None)
+    p.add_argument("--platform", default="auto", choices=("auto", "cpu", "tpu"),
+                   help="jax backend; cpu must be pinned via jax.config "
+                        "(this image selects the TPU at interpreter start, "
+                        "so JAX_PLATFORMS=cpu alone is too late)")
     args = p.parse_args()
+    if args.platform != "auto":
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
+        jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache_distar_tpu")
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    _resolve_args(args)
     if args.dist_method == "explicit" and not (
         args.dist_coordinator_address
         and args.dist_num_processes is not None

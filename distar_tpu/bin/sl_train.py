@@ -97,8 +97,8 @@ def main() -> None:
                    choices=("learner", "replay_actor", "coordinator"))
     p.add_argument("--config", default="")
     p.add_argument("--iters", type=int, default=4)
-    p.add_argument("--batch-size", type=int, default=2)
-    p.add_argument("--traj-len", type=int, default=8)
+    p.add_argument("--batch-size", type=int, default=None)
+    p.add_argument("--traj-len", type=int, default=None)
     p.add_argument("--experiment-name", default="sl_train")
     p.add_argument("--data", default="",
                    help="local ReplayDataset directory (decoded trajectories)")
@@ -110,7 +110,23 @@ def main() -> None:
     p.add_argument("--replays", default="", help="replay list file or directory")
     p.add_argument("--num-workers", type=int, default=1)
     p.add_argument("--epochs", type=int, default=1)
+    p.add_argument("--platform", default="auto", choices=("auto", "cpu", "tpu"),
+                   help="jax backend; cpu must be pinned via jax.config "
+                        "(this image selects the TPU at interpreter start, "
+                        "so JAX_PLATFORMS=cpu alone is too late)")
     args = p.parse_args()
+    if args.platform != "auto":
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
+        jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache_distar_tpu")
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    user_cfg = read_config(args.config) if args.config else {}
+    learner_cfg = user_cfg.get("learner", {})
+    if args.batch_size is None:
+        args.batch_size = int(learner_cfg.get("batch_size", 2))
+    if args.traj_len is None:
+        args.traj_len = int(learner_cfg.get("unroll_len", 8))
 
     if args.type == "learner":
         _learner(args)

@@ -368,7 +368,9 @@ class RLLearner(BaseLearner):
             jnp.asarray(only_value),
         )
         self._state = {"params": params, "opt_state": opt_state}
-        log = {k: float(v) for k, v in info.items()}
+        # one batched D2H transfer — per-scalar float() would round-trip
+        # once per metric across the ~60-entry loss grid every iteration
+        log = {k: float(v) for k, v in jax.device_get(info).items()}
         log["staleness/mean"] = float(staleness.mean())
         log["staleness/max"] = float(staleness.max())
         log["staleness/std"] = float(staleness.std())

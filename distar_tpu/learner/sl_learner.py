@@ -166,4 +166,5 @@ class SLLearner(BaseLearner):
         )
         self._state = {"params": params, "opt_state": opt_state}
         self._hidden = jax.tree.map(jax.lax.stop_gradient, out_state)
-        return {k: float(v) for k, v in info.items()}
+        # one batched D2H transfer instead of a round-trip per metric
+        return {k: float(v) for k, v in jax.device_get(info).items()}

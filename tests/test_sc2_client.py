@@ -335,3 +335,42 @@ def test_replay_info_and_action_stream(server):
     assert info2.base_build == 75689
     c2.quit()
     c.quit()
+
+
+def test_sc2_tools_cli_over_fake_server(server, capsys):
+    """The developer-tool subcommands (replay-info / map-list /
+    benchmark-observe / benchmark-replay) drive the production client stack
+    against the fake server (reference pysc2/bin tool scripts)."""
+    import sys
+
+    from distar_tpu.bin.sc2_tools import main as tools_main
+    from tests.test_replay_decoder import make_replay
+
+    server.game.replay_library["bench.SC2Replay"] = make_replay()
+    ep = f"127.0.0.1:{server.port}"
+
+    argv = sys.argv
+    try:
+        sys.argv = ["sc2_tools", "replay-info", "bench.SC2Replay", "--endpoint", ep]
+        tools_main()
+        out = capsys.readouterr().out
+        assert "KairosJunction" in out and "build 75689" in out
+
+        sys.argv = ["sc2_tools", "map-list"]
+        tools_main()
+        out = capsys.readouterr().out
+        assert "KairosJunction" in out
+
+        sys.argv = ["sc2_tools", "benchmark-observe", "--steps", "5",
+                    "--endpoint", ep]
+        tools_main()
+        out = capsys.readouterr().out
+        assert "obs/s" in out
+
+        sys.argv = ["sc2_tools", "benchmark-replay", "bench.SC2Replay",
+                    "--endpoint", ep]
+        tools_main()
+        out = capsys.readouterr().out
+        assert "steps/s" in out
+    finally:
+        sys.argv = argv
