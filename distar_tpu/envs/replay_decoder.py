@@ -275,15 +275,28 @@ class ReplayDecoder:
             return None
 
     def _replay_info(self, replay_path: str) -> Optional[dict]:
-        """Replay metadata + version routing. Version comes from the
-        client's replay_info base_build (the reference reads it from the MPQ
-        archive, replay_decoder.py:366-377; querying the client avoids the
-        mpyq dependency — any running version can serve replay_info)."""
+        """Replay metadata + version routing. The version is routed from the
+        replay's own MPQ header (``sc2.replay_header`` — same source the
+        reference reads via mpyq, replay_decoder.py:366-377) so the FIRST
+        client launch is already the right binary; the running client then
+        serves the player/race/map metadata."""
         from .sc2.run_configs import VERSIONS, version_for_build
 
-        self._ensure_client(self._version)  # any version serves replay_info
+        base_build = None
+        try:
+            from .sc2.replay_header import parse_replay_header
+
+            base_build = parse_replay_header(replay_path)["base_build"]
+        except (OSError, ValueError) as e:
+            # unreadable header: fall back to asking whatever client is up
+            # (any version serves replay_info)
+            logging.warning("replay header parse failed for %s: %r", replay_path, e)
+        if base_build is not None:
+            self._ensure_client(version_for_build(base_build).game_version)
+        else:
+            self._ensure_client(self._version)
         info = self._controller.replay_info(replay_path=replay_path)
-        version = version_for_build(info.base_build).game_version
+        version = version_for_build(base_build if base_build is not None else info.base_build).game_version
         if version not in VERSIONS:
             logging.warning("no game version for build %s; using current", info.base_build)
             version = self._version

@@ -190,19 +190,33 @@ class RLLearner(BaseLearner):
         self._init_params = _reinit
         params = jitted_init(jax.random.PRNGKey(0), *init_args)
         del init_args
+        from ..parallel.mesh import batch_sharding, fsdp_param_sharding, time_batch_sharding
+
         repl = NamedSharding(self.mesh, P())
-        params = jax.device_put(params, repl)
+        # params sharded over the fsdp axis (replicated when fsdp == 1);
+        # Adam moments follow the same shardings, so optimizer state is
+        # 1/fsdp-sized per device
+        param_sh = fsdp_param_sharding(self.mesh, params)
+        params = jax.device_put(params, param_sh)
+        opt_sh = fsdp_param_sharding(self.mesh, jax.eval_shape(self.optimizer.init, params))
         self._state = {
             "params": params,
-            "opt_state": jax.device_put(self.optimizer.init(params), repl),
+            "opt_state": jax.jit(self.optimizer.init, out_shardings=opt_sh)(params),
         }
         step_fn = make_rl_train_step(self.model, self.loss_cfg, self.optimizer, B, T)
         self._shardings = dict(
             repl=repl,
-            batch=NamedSharding(self.mesh, P(None, "dp")),  # [T(,+1), B, ...]
-            flat=NamedSharding(self.mesh, P("dp")),  # [B]-leading leaves
+            param=param_sh,
+            batch=time_batch_sharding(self.mesh),  # [T(,+1), B, ...]
+            flat=batch_sharding(self.mesh),  # [B]-leading leaves
         )
-        self._train_step = jax.jit(step_fn, donate_argnums=(0, 1))
+        self._train_step = jax.jit(
+            step_fn,
+            donate_argnums=(0, 1),
+            # pin params/opt outputs to their fsdp shardings; the loss-info
+            # scalars replicate
+            out_shardings=(param_sh, opt_sh, repl),
+        )
 
     def shard_batch(self, batch):
         """Place a host batch onto the mesh: B sharded over dp everywhere
@@ -315,8 +329,13 @@ class RLLearner(BaseLearner):
                 eps=lc.eps,
                 clip=GradClipConfig(**lc.grad_clip),
             )
-            self._state["opt_state"] = jax.device_put(
-                self.optimizer.init(self._state["params"]), self._shardings["repl"]
+            from ..parallel.mesh import fsdp_param_sharding
+
+            opt_sh = fsdp_param_sharding(
+                self.mesh, jax.eval_shape(self.optimizer.init, self._state["params"])
+            )
+            self._state["opt_state"] = jax.jit(self.optimizer.init, out_shardings=opt_sh)(
+                self._state["params"]
             )
             self._train_step = jax.jit(
                 make_rl_train_step(
@@ -324,6 +343,7 @@ class RLLearner(BaseLearner):
                     lc.batch_size, lc.unroll_len,
                 ),
                 donate_argnums=(0, 1),
+                out_shardings=(self._shardings["param"], opt_sh, self._shardings["repl"]),
             )
             self.logger.info(f"applied config patch: {patch}")
         if getattr(self, "_pending_save", False):
@@ -342,7 +362,7 @@ class RLLearner(BaseLearner):
                 if k.startswith("value_") or k == "value_encoder":
                     new_params["params"][k] = fresh["params"][k]
             self._state["params"] = jax.device_put(
-                new_params, self._shardings["repl"]
+                new_params, self._shardings["param"]
             )
             self.logger.info("value networks reset")
 

@@ -127,13 +127,25 @@ class SLLearner(BaseLearner):
             batch["entity_num"], batch["action_info"], batch["selected_units_num"],
             self._hidden,
         )
+        from ..parallel.mesh import batch_sharding, fsdp_param_sharding
+
         repl = NamedSharding(self.mesh, P())
-        params = jax.device_put(params, repl)
-        self._state = {"params": params, "opt_state": jax.device_put(self.optimizer.init(params), repl)}
-        self._shardings = dict(repl=repl, flat=NamedSharding(self.mesh, P("dp")))
+        param_sh = fsdp_param_sharding(self.mesh, params)
+        params = jax.device_put(params, param_sh)
+        opt_sh = fsdp_param_sharding(self.mesh, jax.eval_shape(self.optimizer.init, params))
+        self._state = {
+            "params": params,
+            "opt_state": jax.jit(self.optimizer.init, out_shardings=opt_sh)(params),
+        }
+        flat_sh = batch_sharding(self.mesh)
+        self._shardings = dict(repl=repl, param=param_sh, flat=flat_sh)
         self._train_step = jax.jit(
             make_sl_train_step(self.model, self.loss_cfg, self.optimizer, B),
             donate_argnums=(0, 1),
+            # params/opt keep their fsdp shardings; the carried hidden state
+            # shards over batch; the info scalars replicate (prefix leaves
+            # broadcast over their subtrees)
+            out_shardings=(param_sh, opt_sh, flat_sh, repl),
         )
 
     def _place_batch(self, data):

@@ -48,10 +48,17 @@ def make_mesh(spec: MeshSpec = MeshSpec(), devices: Optional[Sequence] = None) -
     return Mesh(arr, AXES)
 
 
+def dp_axes(mesh: Mesh):
+    """The mesh axes the batch dimension shards over. When fsdp > 1 the
+    fsdp axis doubles as extra data parallelism (ZeRO semantics: every
+    device holds a distinct batch shard AND a distinct parameter shard)."""
+    return ("dp", "fsdp") if mesh.shape["fsdp"] > 1 else "dp"
+
+
 def batch_sharding(mesh: Mesh, batch_axis: int = 0) -> NamedSharding:
     """Shard the batch dimension over dp (and fsdp if >1), replicate the rest."""
     spec = [None] * (batch_axis + 1)
-    spec[batch_axis] = ("dp", "fsdp") if mesh.shape["fsdp"] > 1 else "dp"
+    spec[batch_axis] = dp_axes(mesh)
     return NamedSharding(mesh, P(*spec))
 
 
@@ -59,26 +66,62 @@ def time_batch_sharding(mesh: Mesh) -> NamedSharding:
     """[T, B, ...] arrays: shard B (axis 1) over dp; T stays whole (or moves
     to sp when a sequence-parallel mesh is configured)."""
     if mesh.shape["sp"] > 1:
-        return NamedSharding(mesh, P("sp", "dp"))
-    return NamedSharding(mesh, P(None, "dp"))
+        return NamedSharding(mesh, P("sp", dp_axes(mesh)))
+    return NamedSharding(mesh, P(None, dp_axes(mesh)))
 
 
 def replicated_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
+def fsdp_param_sharding(mesh: Mesh, tree):
+    """Parameter shardings for the fsdp axis: every large-enough leaf is
+    sharded on its largest fsdp-divisible dimension; small or indivisible
+    leaves stay replicated.
+
+    This is ZeRO-3-style parameter sharding done the XLA way: params (and,
+    via ``jnp.zeros_like`` inheritance, Adam moments) live sharded over the
+    fsdp axis, and GSPMD inserts the all-gather before use and the
+    reduce-scatter after the backward — the role the reference fills with
+    manual per-param NCCL allreduce (dist_helper.py:369-431), except the
+    optimizer state is also 1/fsdp-sized per device.
+
+    ``tree`` may hold arrays or ShapeDtypeStructs; returns a matching tree
+    of NamedShardings.
+    """
+    n = mesh.shape["fsdp"]
+
+    def spec_for(x) -> NamedSharding:
+        if n <= 1 or not getattr(x, "shape", ()):  # scalars replicate
+            return NamedSharding(mesh, P())
+        shape = x.shape
+        best = None
+        for i, d in enumerate(shape):
+            if d % n == 0 and d >= 2 * n and (best is None or d > shape[best]):
+                best = i
+        if best is None:
+            return NamedSharding(mesh, P())
+        spec = [None] * len(shape)
+        spec[best] = "fsdp"
+        return NamedSharding(mesh, P(*spec))
+
+    return jax.tree.map(spec_for, tree)
+
+
 def shrink_dp(mesh: Mesh, batch_size: int) -> Mesh:
-    """Return a mesh whose dp axis divides ``batch_size``, preserving the
-    fsdp/tp/sp axes (small debug batches on wide meshes). No-op when the
-    batch already divides dp."""
+    """Return a mesh whose batch-sharding axes (dp, and fsdp when > 1 —
+    see ``dp_axes``) divide ``batch_size``, preserving tp/sp (small debug
+    batches on wide meshes). No-op when the batch already fits."""
     import math
 
-    dp = mesh.shape["dp"]
-    if batch_size % dp == 0:
+    dp, fsdp = mesh.shape["dp"], mesh.shape["fsdp"]
+    if batch_size % (dp * fsdp) == 0:
         return mesh
-    new_dp = math.gcd(batch_size, dp)
+    # shrink fsdp first only as far as divisibility demands, then dp
+    new_fsdp = math.gcd(batch_size, fsdp)
+    new_dp = math.gcd(batch_size // new_fsdp, dp)
     spec = MeshSpec(
-        dp=new_dp, fsdp=mesh.shape["fsdp"], tp=mesh.shape["tp"], sp=mesh.shape["sp"]
+        dp=new_dp, fsdp=new_fsdp, tp=mesh.shape["tp"], sp=mesh.shape["sp"]
     )
     devices = mesh.devices.reshape(-1)[: new_dp * spec.fsdp * spec.tp * spec.sp]
     return make_mesh(spec, devices)

@@ -37,6 +37,19 @@ def test_mesh_axes():
     assert mesh2.shape["dp"] == 4 and mesh2.shape["sp"] == 2
 
 
+def test_shrink_dp_respects_fsdp():
+    """shrink_dp must leave a mesh whose dp x fsdp divides the batch — the
+    batch shards over BOTH axes when fsdp > 1 (mesh.dp_axes)."""
+    from distar_tpu.parallel.mesh import shrink_dp
+
+    mesh = make_mesh(MeshSpec(dp=2, fsdp=2), jax.devices()[:4])
+    assert shrink_dp(mesh, 8) is mesh  # 8 % (2*2) == 0: no-op
+    m6 = shrink_dp(mesh, 6)  # 6 % 4 != 0 -> must shrink
+    assert 6 % (m6.shape["dp"] * m6.shape["fsdp"]) == 0
+    m3 = shrink_dp(mesh, 3)
+    assert 3 % (m3.shape["dp"] * m3.shape["fsdp"]) == 0
+
+
 def test_grad_clip_modes():
     params = {"w": jnp.ones((3,)), "b": jnp.ones((2,))}
     grads = {"w": jnp.full((3,), 10.0), "b": jnp.full((2,), 10.0)}
@@ -95,6 +108,41 @@ def test_rl_learner_steps_and_checkpoint(rl_learner, tmp_path):
     w2 = jax.tree.leaves(learner.state["params"])[0]
     np.testing.assert_allclose(w0, np.asarray(w2))
     assert learner.last_iter.val == 2
+
+
+@pytest.mark.slow
+def test_rl_learner_fsdp_mesh(tmp_path):
+    """A mesh with a REAL second axis: params + Adam moments sharded over
+    fsdp (ZeRO-style), batch sharded over dp x fsdp. Verifies the train step
+    compiles and executes with non-replicated parameter shardings and that
+    a checkpoint still round-trips (device_get gathers the shards)."""
+    from distar_tpu.learner import RLLearner
+
+    mesh = make_mesh(MeshSpec(dp=2, fsdp=2), jax.devices()[:4])
+    cfg = {
+        "common": {"experiment_name": "fsdp", "save_path": str(tmp_path)},
+        "learner": {"batch_size": 8, "unroll_len": 2, "save_freq": 100000, "log_freq": 1},
+        "model": SMALL_MODEL,
+    }
+    learner = RLLearner(cfg, mesh=mesh)
+    # at least one large param leaf must actually shard over fsdp
+    specs = [
+        x.sharding.spec
+        for x in jax.tree.leaves(learner.state["params"])
+        if hasattr(x, "sharding")
+    ]
+    assert any("fsdp" in str(s) for s in specs), specs
+    # and the Adam moments follow (1/fsdp-sized opt state per device)
+    mom_specs = [x.sharding.spec for x in jax.tree.leaves(learner.state["opt_state"])]
+    assert any("fsdp" in str(s) for s in mom_specs), mom_specs
+    learner.run(max_iterations=2)
+    assert learner.last_iter.val == 2
+    assert np.isfinite(learner.variable_record.get("total_loss").avg)
+    p = str(tmp_path / "fsdp.ckpt")
+    learner.save(p)
+    w0 = np.asarray(jax.tree.leaves(learner.state["params"])[0]).copy()
+    learner.restore(p)
+    np.testing.assert_allclose(w0, np.asarray(jax.tree.leaves(learner.state["params"])[0]))
 
 
 @pytest.mark.slow

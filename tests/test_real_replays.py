@@ -1,0 +1,117 @@
+"""Version routing + header parsing against the reference's 16 REAL replays.
+
+The reference validates its decode path on recorded games
+(distar/pysc2/tests/replay_obs_test.py); without a game binary in this image
+we validate what is game-free: the MPQ header parse and the
+build->version routing the decoder uses to pick a binary
+(distar/agent/default/replay_decoder.py:37-41, :366-377). A full two-pass
+decode of one real replay runs when an SC2 install is present (SC2PATH),
+and is skip-marked otherwise.
+"""
+import glob
+import os
+
+import pytest
+
+from distar_tpu.envs.sc2.replay_header import (
+    CorruptReplayError,
+    parse_replay_header,
+)
+from distar_tpu.envs.sc2.run_configs import BUILD2VERSION, VERSIONS, version_for_build
+
+REPLAY_DIR = "/root/reference/data/replays"
+REPLAYS = sorted(glob.glob(os.path.join(REPLAY_DIR, "*.SC2Replay")))
+
+pytestmark = pytest.mark.skipif(
+    not REPLAYS, reason="reference replay bundle not present"
+)
+
+# filename-embedded version -> expected routed version. Identity everywhere
+# except 5.0.1: the reference pins build 81009 -> "5.0.0"
+# (replay_decoder.py:37-41), because 5.0.0 and 5.0.1 share data compatibility.
+EXPECTED_ROUTE_OVERRIDES = {"5.0.1": "5.0.0"}
+
+
+def _filename_version(path):
+    # "replay_4.10.0.SC2Replay" -> "4.10.0"
+    return os.path.basename(path)[len("replay_"):-len(".SC2Replay")]
+
+
+def test_all_16_headers_parse():
+    assert len(REPLAYS) == 16
+    for path in REPLAYS:
+        h = parse_replay_header(path)
+        assert h["signature"].startswith("StarCraft II replay")
+        assert h["base_build"] > 70000
+        assert h["elapsed_game_loops"] > 0
+        assert h["duration_seconds"] > 60
+
+
+def test_base_build_matches_filename_version():
+    """The header's base_build must be the build the filename's version
+    names in the public VERSIONS table (the replays are named by the game
+    version that recorded them)."""
+    for path in REPLAYS:
+        h = parse_replay_header(path)
+        fname_ver = _filename_version(path)
+        assert fname_ver in VERSIONS, f"{fname_ver} missing from VERSIONS"
+        assert h["base_build"] == VERSIONS[fname_ver].build_version, (
+            f"{os.path.basename(path)}: header base_build {h['base_build']} "
+            f"!= VERSIONS[{fname_ver}].build_version "
+            f"{VERSIONS[fname_ver].build_version}"
+        )
+
+
+def test_version_routing_on_real_builds():
+    """version_for_build must route every real replay's base_build to a
+    launchable version — the filename's own version, modulo the reference's
+    explicit compatibility pins."""
+    for path in REPLAYS:
+        h = parse_replay_header(path)
+        fname_ver = _filename_version(path)
+        expected = EXPECTED_ROUTE_OVERRIDES.get(fname_ver, fname_ver)
+        routed = version_for_build(h["base_build"])
+        assert routed.game_version == expected, (
+            f"{os.path.basename(path)}: build {h['base_build']} routed to "
+            f"{routed.game_version}, expected {expected}"
+        )
+        # the routed version must be fully launchable: a known build dir +
+        # data version
+        assert routed.build_version in BUILD2VERSION or routed.game_version in VERSIONS
+        assert len(routed.data_version) == 32
+
+
+def test_reference_pins_present():
+    """The decoder's three explicit pins (reference replay_decoder.py:37-41)."""
+    assert BUILD2VERSION[80188] == "4.12.1"
+    assert BUILD2VERSION[81009] == "5.0.0"
+    assert BUILD2VERSION[81433] == "5.0.3"
+
+
+def test_corrupt_input_raises():
+    with pytest.raises(CorruptReplayError):
+        parse_replay_header(b"not a replay at all" + b"\x00" * 64)
+    with pytest.raises(CorruptReplayError):
+        # valid magic, truncated/garbage payload
+        parse_replay_header(b"MPQ\x1b" + (8).to_bytes(4, "little") * 3 + b"\xff" * 8)
+
+
+@pytest.mark.slow
+@pytest.mark.skipif(
+    not os.path.isdir(os.path.expanduser(os.environ.get("SC2PATH", "~/StarCraftII"))),
+    reason="no SC2 install (set SC2PATH) — two-pass decode needs the game binary",
+)
+def test_two_pass_decode_one_real_replay():
+    """Full two-pass decode of one bundled replay through a real SC2 client
+    (the reference's replay_obs_test analogue). Runs only with an install."""
+    from distar_tpu.envs.replay_decoder import ReplayDecoder
+
+    decoder = ReplayDecoder(cfg={"minimum_action_length": 1})
+    try:
+        steps = decoder.run(REPLAYS[0], 0) or decoder.run(REPLAYS[0], 1)
+        assert steps, "decode produced no steps for either player"
+        first = steps[0]
+        for key in ("spatial_info", "entity_info", "scalar_info", "action_info"):
+            assert key in first
+    finally:
+        decoder.close()
