@@ -161,4 +161,175 @@ int shuttle_fetch(const char* host, int port, int timeout_ms, uint8_t** out, uin
 
 void shuttle_free(uint8_t* p) { std::free(p); }
 
+// --------------------------------------------------------------------------
+// LZ4-block-format codec (public format: lz4 block spec) for the data plane.
+//
+// Native-code role: the reference compresses every trajectory/model payload
+// with lz4 (distar/ctools/utils/file_helper.py:21). This image has no lz4
+// python package and zlib-1 compresses our ~7 MB trajectory windows at only
+// ~10 MB/s (measured, tools/bench_dataplane.py) — slower than just sending
+// raw bytes over loopback/DCN. This is a from-scratch hash-chain LZ77
+// encoder emitting the standard LZ4 block stream (token nibbles, 255-run
+// length extensions, little-endian 16-bit offsets, >=4-byte matches, tail
+// literals), giving lz4-class compress speed with zero dependencies.
+//
+//   int64_t shuttlez_compress(src, len, dst, cap)   -> compressed size
+//   int64_t shuttlez_decompress(src, len, dst, cap) -> decompressed size
+//   uint64_t shuttlez_bound(len)                    -> worst-case dst size
+// Both return <0 on error (cap too small / malformed stream).
+
+namespace {
+
+inline uint32_t read_u32(const uint8_t* p) {
+  uint32_t v;
+  std::memcpy(&v, p, 4);
+  return v;
+}
+
+constexpr int kHashBits = 16;
+constexpr int kHashSize = 1 << kHashBits;
+
+inline uint32_t hash4(uint32_t v) {
+  // Fibonacci hashing of the 4-byte window
+  return (v * 2654435761u) >> (32 - kHashBits);
+}
+
+constexpr int kMinMatch = 4;
+constexpr int kLastLiterals = 5;       // spec: last 5 bytes are literals
+constexpr int kMaxOffset = 65535;
+
+inline uint8_t* put_length(uint8_t* op, uint64_t len) {
+  while (len >= 255) {
+    *op++ = 255;
+    len -= 255;
+  }
+  *op++ = static_cast<uint8_t>(len);
+  return op;
+}
+
+}  // namespace
+
+uint64_t shuttlez_bound(uint64_t len) { return len + len / 255 + 16; }
+
+int64_t shuttlez_compress(const uint8_t* src, uint64_t len, uint8_t* dst, uint64_t cap) {
+  if (cap < shuttlez_bound(len)) return -1;
+  uint8_t* op = dst;
+  if (len < kMinMatch + kLastLiterals) {
+    // too small to match: one literal-only sequence
+    uint8_t token = len < 15 ? static_cast<uint8_t>(len) << 4 : 0xF0;
+    *op++ = token;
+    if (len >= 15) op = put_length(op, len - 15);
+    std::memcpy(op, src, len);
+    return (op + len) - dst;
+  }
+  std::vector<uint32_t> table(kHashSize, 0);  // position + 1 (0 = empty)
+  const uint64_t mflimit = len - kLastLiterals;
+  uint64_t anchor = 0;
+  uint64_t ip = 0;
+  uint64_t search_nb = 1 << 6;  // lz4-style skip acceleration: the longer a
+                                // stretch stays matchless (incompressible
+                                // float noise), the bigger the stride
+  while (ip + kMinMatch <= mflimit) {
+    uint32_t h = hash4(read_u32(src + ip));
+    uint64_t cand = table[h] ? table[h] - 1 : UINT64_MAX;
+    table[h] = static_cast<uint32_t>(ip + 1);
+    if (cand == UINT64_MAX || ip - cand > kMaxOffset ||
+        read_u32(src + cand) != read_u32(src + ip)) {
+      ip += (search_nb++ >> 6);
+      continue;
+    }
+    search_nb = 1 << 6;
+    // extend the match forward
+    uint64_t mlen = kMinMatch;
+    while (ip + mlen < mflimit && src[cand + mlen] == src[ip + mlen]) ++mlen;
+    // emit sequence: literals [anchor, ip) + match (offset, mlen)
+    uint64_t lit = ip - anchor;
+    uint8_t* token = op++;
+    if (lit >= 15) {
+      *token = 0xF0;
+      op = put_length(op, lit - 15);
+    } else {
+      *token = static_cast<uint8_t>(lit) << 4;
+    }
+    std::memcpy(op, src + anchor, lit);
+    op += lit;
+    uint16_t offset = static_cast<uint16_t>(ip - cand);
+    *op++ = static_cast<uint8_t>(offset & 0xff);
+    *op++ = static_cast<uint8_t>(offset >> 8);
+    uint64_t mextra = mlen - kMinMatch;
+    if (mextra >= 15) {
+      *token |= 0x0F;
+      op = put_length(op, mextra - 15);
+    } else {
+      *token |= static_cast<uint8_t>(mextra);
+    }
+    // index a couple of positions inside the match to help the next search
+    uint64_t step_end = ip + mlen;
+    for (uint64_t p = ip + 1; p + kMinMatch <= step_end && p + kMinMatch <= mflimit;
+         p += (mlen > 64 ? 16 : 4)) {
+      table[hash4(read_u32(src + p))] = static_cast<uint32_t>(p + 1);
+    }
+    ip += mlen;
+    anchor = ip;
+  }
+  // tail literals
+  uint64_t lit = len - anchor;
+  uint8_t* token = op++;
+  if (lit >= 15) {
+    *token = 0xF0;
+    op = put_length(op, lit - 15);
+  } else {
+    *token = static_cast<uint8_t>(lit) << 4;
+  }
+  std::memcpy(op, src + anchor, lit);
+  op += lit;
+  return op - dst;
+}
+
+int64_t shuttlez_decompress(const uint8_t* src, uint64_t len, uint8_t* dst, uint64_t cap) {
+  const uint8_t* ip = src;
+  const uint8_t* iend = src + len;
+  uint8_t* op = dst;
+  uint8_t* oend = dst + cap;
+  while (ip < iend) {
+    uint8_t token = *ip++;
+    // literals
+    uint64_t lit = token >> 4;
+    if (lit == 15) {
+      uint8_t b;
+      do {
+        if (ip >= iend) return -1;
+        b = *ip++;
+        lit += b;
+      } while (b == 255);
+    }
+    if (ip + lit > iend || op + lit > oend) return -2;
+    std::memcpy(op, ip, lit);
+    ip += lit;
+    op += lit;
+    if (ip >= iend) break;  // last sequence has no match
+    // match
+    if (ip + 2 > iend) return -3;
+    uint16_t offset = static_cast<uint16_t>(ip[0] | (ip[1] << 8));
+    ip += 2;
+    if (offset == 0 || static_cast<uint64_t>(op - dst) < offset) return -4;
+    uint64_t mlen = (token & 0x0F);
+    if (mlen == 15) {
+      uint8_t b;
+      do {
+        if (ip >= iend) return -5;
+        b = *ip++;
+        mlen += b;
+      } while (b == 255);
+    }
+    mlen += kMinMatch;
+    if (op + mlen > oend) return -6;
+    const uint8_t* match = op - offset;
+    // overlapping copy must be byte-wise
+    for (uint64_t i = 0; i < mlen; ++i) op[i] = match[i];
+    op += mlen;
+  }
+  return op - dst;
+}
+
 }  // extern "C"

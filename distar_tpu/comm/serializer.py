@@ -1,31 +1,45 @@
 """Payload (de)serialization for the data plane.
 
 Role of the reference's dumps/loads multi-codec (reference: distar/ctools/
-utils/file_helper.py:21-120 — pickle/nppickle/pyarrow + lz4). lz4 isn't in
-this image, so the compressed codec is zlib-1 (fast setting); pickle
-protocol 5 with out-of-band buffers keeps large numpy arrays zero-copy on
-the serialise side.
+utils/file_helper.py:21-120 — pickle/nppickle/pyarrow + lz4). The lz4 python
+package isn't in this image, so the fast codec is our own C++ LZ4-block
+implementation (comm/native/shuttle.cpp shuttlez_*; measured lz4-class
+throughput vs zlib-1's ~10 MB/s on trajectory payloads — see
+tools/bench_dataplane.py). Fallback order on compress: native lz -> zlib-1;
+loads handles every magic regardless of what this host can produce (the
+lz magic carries the decompressed size, and a pure-Python decoder exists
+for .so-less hosts). Pickle protocol 5 keeps large numpy arrays zero-copy
+on the serialise side.
 """
 from __future__ import annotations
 
 import pickle
 import struct
 import zlib
-from typing import Any, Tuple
+from typing import Any
+
+from . import shuttle
 
 MAGIC_RAW = b"DTR0"
 MAGIC_ZLIB = b"DTZ0"
+MAGIC_LZ = b"DTL0"  # + u64 LE decompressed size + lz4-block stream
 
 
 def dumps(obj: Any, compress: bool = True) -> bytes:
     payload = pickle.dumps(obj, protocol=5)
     if compress:
+        lz = shuttle.lz_compress(payload)
+        if lz is not None:
+            return MAGIC_LZ + struct.pack("<Q", len(payload)) + lz
         return MAGIC_ZLIB + zlib.compress(payload, level=1)
     return MAGIC_RAW + payload
 
 
 def loads(blob: bytes) -> Any:
     magic, body = blob[:4], blob[4:]
+    if magic == MAGIC_LZ:
+        (n,) = struct.unpack("<Q", body[:8])
+        return pickle.loads(shuttle.lz_decompress(body[8:], n))
     if magic == MAGIC_ZLIB:
         return pickle.loads(zlib.decompress(body))
     if magic == MAGIC_RAW:

@@ -52,12 +52,98 @@ def _load() -> Optional[ctypes.CDLL]:
         ]
         lib.shuttle_fetch.restype = ctypes.c_int
         lib.shuttle_free.argtypes = [ctypes.POINTER(ctypes.c_uint8)]
+        lib.shuttlez_bound.argtypes = [ctypes.c_uint64]
+        lib.shuttlez_bound.restype = ctypes.c_uint64
+        lib.shuttlez_compress.argtypes = [
+            ctypes.c_char_p, ctypes.c_uint64,
+            ctypes.POINTER(ctypes.c_uint8), ctypes.c_uint64,
+        ]
+        lib.shuttlez_compress.restype = ctypes.c_int64
+        lib.shuttlez_decompress.argtypes = [
+            ctypes.c_char_p, ctypes.c_uint64,
+            ctypes.POINTER(ctypes.c_uint8), ctypes.c_uint64,
+        ]
+        lib.shuttlez_decompress.restype = ctypes.c_int64
         _lib = lib
         return _lib
 
 
 def native_available() -> bool:
     return _load() is not None
+
+
+# ------------------------------------------------------- lz4-block codec
+def lz_compress(data: bytes) -> Optional[bytes]:
+    """LZ4-block compress via the native codec; None when the native lib is
+    unavailable (callers fall back to zlib)."""
+    lib = _load()
+    if lib is None:
+        return None
+    cap = lib.shuttlez_bound(len(data))
+    out = (ctypes.c_uint8 * cap)()
+    n = lib.shuttlez_compress(data, len(data), out, cap)
+    if n < 0:
+        raise OSError(f"shuttlez_compress failed: {n}")
+    return bytes(bytearray(out)[:n])
+
+
+def lz_decompress(blob: bytes, decompressed_len: int) -> bytes:
+    """LZ4-block decompress; uses the native codec when available, else a
+    pure-Python decoder (the format is trivially decodable)."""
+    lib = _load()
+    if lib is not None:
+        out = (ctypes.c_uint8 * decompressed_len)()
+        n = lib.shuttlez_decompress(blob, len(blob), out, decompressed_len)
+        if n < 0:
+            raise ValueError(f"shuttlez_decompress failed: {n}")
+        if n != decompressed_len:
+            raise ValueError(f"decompressed {n} != expected {decompressed_len}")
+        return bytes(out)
+    return _py_lz_decompress(blob, decompressed_len)
+
+
+def _py_lz_decompress(blob: bytes, decompressed_len: int) -> bytes:
+    """Pure-Python LZ4-block decoder (fallback when g++/the .so is absent)."""
+    src = memoryview(blob)
+    out = bytearray()
+    i, end = 0, len(blob)
+    while i < end:
+        token = src[i]
+        i += 1
+        lit = token >> 4
+        if lit == 15:
+            while True:
+                b = src[i]
+                i += 1
+                lit += b
+                if b != 255:
+                    break
+        out += src[i : i + lit]
+        i += lit
+        if i >= end:
+            break
+        offset = src[i] | (src[i + 1] << 8)
+        i += 2
+        if offset == 0 or offset > len(out):
+            raise ValueError("malformed lz stream (bad offset)")
+        mlen = token & 0x0F
+        if mlen == 15:
+            while True:
+                b = src[i]
+                i += 1
+                mlen += b
+                if b != 255:
+                    break
+        mlen += 4
+        start = len(out) - offset
+        if offset >= mlen:
+            out += out[start : start + mlen]
+        else:
+            for k in range(mlen):  # overlapping copy must be sequential
+                out.append(out[start + k])
+    if len(out) != decompressed_len:
+        raise ValueError(f"decompressed {len(out)} != expected {decompressed_len}")
+    return bytes(out)
 
 
 def serve(payload: bytes, accept_count: int = 1, timeout_ms: int = 30_000) -> int:
