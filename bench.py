@@ -318,15 +318,24 @@ def run_child():
 
 
 def main():
-    deadline = time.monotonic() + float(os.environ.get("BENCH_DEADLINE", 3000.0))
+    # Defaults are sized to the DRIVER's observed kill window (~600 s,
+    # BENCH_r03 rc=124): finish under it with margin. A local long-haul run
+    # overrides via env (e.g. BENCH_DEADLINE=7200).
+    deadline = time.monotonic() + float(os.environ.get("BENCH_DEADLINE", 540.0))
     # per-attempt cap so one child hung in the chip claim doesn't eat the
     # whole deadline — a lingering previous holder needs time to expire, and
     # a fresh claim sometimes lands where the stuck one never will
-    attempt_timeout = float(os.environ.get("BENCH_ATTEMPT_TIMEOUT", 1200.0))
+    attempt_timeout = float(os.environ.get("BENCH_ATTEMPT_TIMEOUT", 240.0))
     backoff = 20.0
     last_result = [None]  # last full result line relayed from a child
     last_stage = ["(no stage reached)"]
     stderr_tail = []
+    stdout_lock = threading.Lock()  # pump + heartbeat both write result lines
+
+    def emit_line(line):
+        with stdout_lock:
+            sys.stdout.write(line + "\n")
+            sys.stdout.flush()
 
     def pump(stream, is_stdout):
         for line in iter(stream.readline, ""):
@@ -341,7 +350,7 @@ def main():
                 if isinstance(parsed, dict) and "metric" in parsed:
                     last_result[0] = line
                     # re-print immediately: the harness keeps the tail
-                    print(line, flush=True)
+                    emit_line(line)
             else:
                 if line.startswith("BENCH-STAGE"):
                     last_stage[0] = line
@@ -349,14 +358,47 @@ def main():
                 del stderr_tail[:-40]
         stream.close()
 
+    def parent_heartbeat():
+        # Print a parseable JSON line every ~60 s: if the driver SIGKILLs the
+        # whole tree, the artifact tail still carries a diagnostic (or the
+        # freshest real result) instead of being empty (BENCH_r03 postmortem).
+        n = 0
+        while True:
+            time.sleep(60)
+            n += 1
+            if last_result[0] is not None:
+                emit_line(last_result[0])
+            else:
+                emit_line(
+                    json.dumps(
+                        {
+                            "metric": "SL replay-frames/sec/chip (full model, fwd+loss+bwd+adam)",
+                            "value": 0.0,
+                            "unit": "frames/s",
+                            "vs_baseline": 0.0,
+                            "heartbeat": n,
+                            "stage": last_stage[0],
+                        }
+                    )
+                )
+
+    threading.Thread(target=parent_heartbeat, daemon=True).start()
+
     attempt = 0
     while time.monotonic() < deadline - 30:
         attempt += 1
+        child_env = dict(os.environ)
+        # respect an explicit user budget; otherwise hand the child what's
+        # left of the parent deadline so its sweep self-limits
+        child_env.setdefault(
+            "BENCH_TIME_BUDGET", str(max(60.0, deadline - time.monotonic() - 60.0))
+        )
         proc = subprocess.Popen(
             [sys.executable, "-u", os.path.abspath(__file__), "--run"],
             stdout=subprocess.PIPE,
             stderr=subprocess.PIPE,
             text=True,
+            env=child_env,
         )
         threads = [
             threading.Thread(target=pump, args=(proc.stdout, True), daemon=True),
@@ -386,7 +428,7 @@ def main():
         backoff *= 2
 
     if last_result[0] is None:
-        print(
+        emit_line(
             json.dumps(
                 {
                     "metric": "SL replay-frames/sec/chip (full model, fwd+loss+bwd+adam)",

@@ -196,6 +196,7 @@ inline uint32_t hash4(uint32_t v) {
 
 constexpr int kMinMatch = 4;
 constexpr int kLastLiterals = 5;       // spec: last 5 bytes are literals
+constexpr int kMFLimit = 12;           // spec: last match starts >=12 bytes from end
 constexpr int kMaxOffset = 65535;
 
 inline uint8_t* put_length(uint8_t* op, uint64_t len) {
@@ -214,7 +215,7 @@ uint64_t shuttlez_bound(uint64_t len) { return len + len / 255 + 16; }
 int64_t shuttlez_compress(const uint8_t* src, uint64_t len, uint8_t* dst, uint64_t cap) {
   if (cap < shuttlez_bound(len)) return -1;
   uint8_t* op = dst;
-  if (len < kMinMatch + kLastLiterals) {
+  if (len < kMFLimit + 1) {
     // too small to match: one literal-only sequence
     uint8_t token = len < 15 ? static_cast<uint8_t>(len) << 4 : 0xF0;
     *op++ = token;
@@ -223,13 +224,14 @@ int64_t shuttlez_compress(const uint8_t* src, uint64_t len, uint8_t* dst, uint64
     return (op + len) - dst;
   }
   std::vector<uint32_t> table(kHashSize, 0);  // position + 1 (0 = empty)
-  const uint64_t mflimit = len - kLastLiterals;
+  const uint64_t matchlimit = len - kLastLiterals;  // matches may extend to here
+  const uint64_t mflimit = len - kMFLimit;          // matches must START before here
   uint64_t anchor = 0;
   uint64_t ip = 0;
   uint64_t search_nb = 1 << 6;  // lz4-style skip acceleration: the longer a
                                 // stretch stays matchless (incompressible
                                 // float noise), the bigger the stride
-  while (ip + kMinMatch <= mflimit) {
+  while (ip < mflimit) {
     uint32_t h = hash4(read_u32(src + ip));
     uint64_t cand = table[h] ? table[h] - 1 : UINT64_MAX;
     table[h] = static_cast<uint32_t>(ip + 1);
@@ -241,7 +243,7 @@ int64_t shuttlez_compress(const uint8_t* src, uint64_t len, uint8_t* dst, uint64
     search_nb = 1 << 6;
     // extend the match forward
     uint64_t mlen = kMinMatch;
-    while (ip + mlen < mflimit && src[cand + mlen] == src[ip + mlen]) ++mlen;
+    while (ip + mlen < matchlimit && src[cand + mlen] == src[ip + mlen]) ++mlen;
     // emit sequence: literals [anchor, ip) + match (offset, mlen)
     uint64_t lit = ip - anchor;
     uint8_t* token = op++;

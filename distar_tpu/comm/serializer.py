@@ -38,7 +38,14 @@ def dumps(obj: Any, compress: bool = True) -> bytes:
 def loads(blob: bytes) -> Any:
     magic, body = blob[:4], blob[4:]
     if magic == MAGIC_LZ:
+        if len(body) < 8:
+            raise ValueError("truncated lz payload header")
         (n,) = struct.unpack("<Q", body[:8])
+        # sanity-cap the peer-supplied size before allocating: LZ4 block
+        # format cannot exceed ~255x expansion, so anything above that is a
+        # corrupt/hostile header, not a legitimate payload
+        if n > max(1024, (len(body) - 8) * 255):
+            raise ValueError(f"implausible decompressed size {n} for {len(body) - 8}-byte stream")
         return pickle.loads(shuttle.lz_decompress(body[8:], n))
     if magic == MAGIC_ZLIB:
         return pickle.loads(zlib.decompress(body))

@@ -103,25 +103,39 @@ def lz_decompress(blob: bytes, decompressed_len: int) -> bytes:
 
 
 def _py_lz_decompress(blob: bytes, decompressed_len: int) -> bytes:
-    """Pure-Python LZ4-block decoder (fallback when g++/the .so is absent)."""
+    """Pure-Python LZ4-block decoder (fallback when g++/the .so is absent).
+
+    Raises ValueError (never IndexError) on truncated/malformed streams so
+    callers see the same error contract as the native decoder.
+    """
     src = memoryview(blob)
     out = bytearray()
     i, end = 0, len(blob)
+
+    def read_byte(pos: int) -> int:
+        if pos >= end:
+            raise ValueError("malformed lz stream (truncated)")
+        return src[pos]
+
     while i < end:
         token = src[i]
         i += 1
         lit = token >> 4
         if lit == 15:
             while True:
-                b = src[i]
+                b = read_byte(i)
                 i += 1
                 lit += b
                 if b != 255:
                     break
+        if i + lit > end:
+            raise ValueError("malformed lz stream (truncated literals)")
         out += src[i : i + lit]
         i += lit
         if i >= end:
             break
+        if i + 2 > end:
+            raise ValueError("malformed lz stream (truncated offset)")
         offset = src[i] | (src[i + 1] << 8)
         i += 2
         if offset == 0 or offset > len(out):
@@ -129,7 +143,7 @@ def _py_lz_decompress(blob: bytes, decompressed_len: int) -> bytes:
         mlen = token & 0x0F
         if mlen == 15:
             while True:
-                b = src[i]
+                b = read_byte(i)
                 i += 1
                 mlen += b
                 if b != 255:

@@ -287,7 +287,11 @@ class ReplayDecoder:
             from .sc2.replay_header import parse_replay_header
 
             base_build = parse_replay_header(replay_path)["base_build"]
-        except (OSError, ValueError) as e:
+        except Exception as e:
+            # unreadable OR structurally-unexpected header (e.g. field 1 not a
+            # struct raises AttributeError inside parse_replay_header): any
+            # failure here must fall through to client-served replay_info, not
+            # fail the whole replay decode
             # unreadable header: fall back to asking whatever client is up
             # (any version serves replay_info)
             logging.warning("replay header parse failed for %s: %r", replay_path, e)

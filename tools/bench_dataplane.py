@@ -31,7 +31,7 @@ def _mb(n_bytes: int) -> float:
 
 
 def bench_serializer(payload, iters: int = 5):
-    from distar_tpu.comm.serializer import dumps, loads
+    from distar_tpu.comm.serializer import MAGIC_LZ, MAGIC_ZLIB, dumps, loads
 
     out = {}
     for compress in (True, False):
@@ -44,7 +44,12 @@ def bench_serializer(payload, iters: int = 5):
         for _ in range(iters):
             loads(blob)
         dt_l = (time.perf_counter() - t0) / iters
-        key = "zlib1" if compress else "raw"
+        # label by the codec that actually ran (the blob magic), not by
+        # assumption: with g++ present dumps(compress=True) emits LZ4
+        if not compress:
+            key = "raw"
+        else:
+            key = {MAGIC_LZ: "lz4", MAGIC_ZLIB: "zlib1"}.get(blob[:4], "compressed")
         out[key] = {
             "blob_mb": round(_mb(len(blob)), 2),
             "dumps_mb_s": round(_mb(len(blob)) / dt_d, 1),
@@ -120,8 +125,9 @@ def main():
 
     ser = bench_serializer(payload)
     shut = bench_shuttle(raw)
+    compressed_label = next((k for k in ser if k != "raw"), "compressed")
     adap = {
-        "zlib1": bench_adapter(payload, compress=True),
+        compressed_label: bench_adapter(payload, compress=True),
         "raw": bench_adapter(payload, compress=False),
     }
 
