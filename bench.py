@@ -366,11 +366,13 @@ def main():
         while True:
             time.sleep(60)
             n += 1
-            if last_result[0] is not None:
-                emit_line(last_result[0])
-            else:
-                emit_line(
-                    json.dumps(
+            # decide under the lock: a real result landing between the check
+            # and the write must never be followed by a fake 0.0 tail line
+            with stdout_lock:
+                if last_result[0] is not None:
+                    line = last_result[0]
+                else:
+                    line = json.dumps(
                         {
                             "metric": "SL replay-frames/sec/chip (full model, fwd+loss+bwd+adam)",
                             "value": 0.0,
@@ -380,7 +382,8 @@ def main():
                             "stage": last_stage[0],
                         }
                     )
-                )
+                sys.stdout.write(line + "\n")
+                sys.stdout.flush()
 
     threading.Thread(target=parent_heartbeat, daemon=True).start()
 

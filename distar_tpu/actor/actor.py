@@ -221,7 +221,7 @@ class Actor:
         every ~10s; a learner-sent reset_flag additionally restarts
         episodes). Returns True when a reset was requested."""
         reset = False
-        for side, pid in enumerate(infer):
+        for side in list(infer):
             player = player_ids[side]
             if player not in job.get("update_players", []):
                 continue
@@ -262,36 +262,73 @@ class Actor:
         # each env steps in its own worker thread (real SC2 steps are slow
         # and high-variance); inference batches over the ready set
         from .env_pool import RESET, EnvWorkerPool
+        from .scripted import build_scripted, is_scripted
 
         pool = EnvWorkerPool([self._env_fn] * n_env)
 
-        # slots: (env, side); one BatchedInference per side (player)
-        params = {pid: self._load_player_params(pid) for pid in set(player_ids)}
+        # scripted sides (job pipelines like 'scripted.random') act without a
+        # model: no inference slot, no teacher, no trajectories (role of the
+        # reference's importable scripted agents, pysc2/agents/)
+        pipelines = job.get("pipelines", [])
+        scripted_sides = {
+            side for side in range(len(player_ids))
+            if side < len(pipelines) and is_scripted(pipelines[side])
+        }
+
+        # slots: (env, side); one BatchedInference per model-driven side
+        params = {
+            pid: self._load_player_params(pid)
+            for side, pid in enumerate(player_ids)
+            if side not in scripted_sides
+        }
         infer = {
             side: BatchedInference(self.model, params[pid], n_env, seed=side)
             for side, pid in enumerate(player_ids)
+            if side not in scripted_sides
         }
         teacher_hidden = {side: infer[side]._zero_hidden() for side in infer}
         teacher_params = {
             side: self._load_teacher_params(side, job, params[pid])
             for side, pid in enumerate(player_ids)
+            if side not in scripted_sides
         }
+        from ..league.player import FRAC_ID as _FRAC_ID
+
+        _frac_ids = job.get("frac_ids", [])
+
+        def _side_race(side: int) -> str:
+            frac = _frac_ids[side] if side < len(_frac_ids) else 1
+            return _FRAC_ID.get(frac, ["zerg"])[0]
+
         agents = {
-            (e, side): Agent(
-                pid,
-                z=self._sample_z(side, job),
-                traj_len=self.cfg.traj_len,
-                seed=self.cfg.seed + e * 2 + side,
+            (e, side): (
+                build_scripted(
+                    pipelines[side], pid,
+                    seed=self.cfg.seed + e * 2 + side, race=_side_race(side),
+                )
+                if side in scripted_sides
+                else Agent(
+                    pid,
+                    z=self._sample_z(side, job),
+                    traj_len=self.cfg.traj_len,
+                    seed=self.cfg.seed + e * 2 + side,
+                )
             )
             for e in range(n_env)
             for side, pid in enumerate(player_ids)
         }
         for (e, side), ag in agents.items():
             ag.model_last_iter = self._model_iters.get(ag.player_id, 0)
-            ag.collect_trajectories = ag.player_id in job.get("send_data_players", [])
+            ag.collect_trajectories = (
+                side not in scripted_sides
+                and ag.player_id in job.get("send_data_players", [])
+            )
         sides = list(range(len(player_ids)))
         hidden_backup = {
-            (e, side): infer[side].hidden_for_slot(e) for e in range(n_env) for side in sides
+            (e, side): infer[side].hidden_for_slot(e)
+            for e in range(n_env)
+            for side in sides
+            if side in infer
         }
 
         def reset_slot(e: int) -> None:
@@ -299,6 +336,9 @@ class Actor:
             teacher LSTM carries (shared by episode-end and league-reset).
             The fresh obs arrives asynchronously via the pool."""
             for side in sides:
+                if side in scripted_sides:
+                    agents[(e, side)].reset()
+                    continue
                 agents[(e, side)].reset(z=self._sample_z(side, job))
                 infer[side].reset_slot(e)
                 teacher_hidden[side] = tuple(
@@ -409,6 +449,11 @@ class Actor:
                 # inactive filler (hidden state preserved).
                 env_actions: Dict[int, dict] = {e: {} for e in range(n_env)}
                 for side, pid in enumerate(player_ids):
+                    if side in scripted_sides:
+                        for e in range(n_env):
+                            if e in obs and side in obs[e]:
+                                env_actions[e][side] = agents[(e, side)].step(obs[e][side])
+                        continue
                     prepared, active = [], []
                     for e in range(n_env):
                         if e in obs and side in obs[e]:

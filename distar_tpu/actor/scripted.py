@@ -1,0 +1,155 @@
+"""Scripted (model-free) agents implementing docs/agent_contract.md.
+
+Role of the reference's scripted demo agents (reference:
+distar/pysc2/agents/random_agent.py, scripted_agent.py, base_agent.py):
+cheap league opponents and smoke fixtures that plug into the Actor by
+pipeline name — no network, no inference batch slot, no trajectories.
+
+Actions are drawn from the 327-entry ACTIONS table and respect each
+action's per-head applicability masks (lib/actions.py), so every emitted
+dict is a structurally valid env action; RandomAgent additionally applies
+the per-race legality mask (lib/stat.ACTION_RACE_MASK) when constructed
+with a ``race``.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ..lib import features as F
+from ..lib.actions import (
+    ACTIONS,
+    QUEUED_MASK,
+    SELECTED_UNITS_MASK,
+    TARGET_LOCATION_MASK,
+    TARGET_UNIT_MASK,
+)
+
+
+class ScriptedAgent:
+    """Base scripted agent: the Actor-facing duck type with no model.
+
+    Subclasses implement ``act(obs) -> action dict``; everything else
+    (reset/z handling, episode stats, trajectory hooks) is inert here.
+    """
+
+    HAS_MODEL = False
+
+    def __init__(self, player_id: str = "scripted", seed: int = 0, **_kwargs):
+        self.player_id = player_id
+        self.model_last_iter = 0
+        self.collect_trajectories = False
+        self._output = None  # the Actor's collect-on-receipt guard stays off
+        self._rng = np.random.default_rng(seed)
+        self._steps = 0
+
+    # ------------------------------------------------------------- contract
+    def reset(self, z: Optional[dict] = None) -> None:
+        self._steps = 0
+
+    def act(self, obs: dict) -> dict:  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def step(self, obs: dict) -> dict:
+        self._steps += 1
+        return self.act(obs)
+
+    def collect_data(self, *a, **k):  # scripted agents never emit trajectories
+        return None
+
+    def episode_stats(self) -> dict:
+        """Schema-compatible stats for league meters (all-zero: a scripted
+        opponent has no Z target or behaviour stats to report)."""
+        from ..lib.stat import CUM_DICT
+
+        return {
+            "bo_distance": 0.0,
+            "cum_distance": 0.0,
+            "bo_reward_total": 0.0,
+            "cum_reward_total": 0.0,
+            "battle_reward_total": 0.0,
+            "cumulative_stat": [0] * len(CUM_DICT),
+            "unit_num": {},
+        }
+
+    # --------------------------------------------------------------- helpers
+    def _valid_units(self, obs: dict) -> int:
+        n = int(np.asarray(obs.get("entity_num", 1)))
+        return max(1, min(n, F.MAX_ENTITY_NUM))
+
+    def _noop(self) -> dict:
+        return {
+            "action_type": 0,
+            "delay": int(self._rng.integers(1, 16)),
+            "queued": 0,
+            "selected_units": np.zeros(F.MAX_SELECTED_UNITS_NUM, np.int64),
+            "selected_units_num": 0,
+            "target_unit": 0,
+            "target_location": 0,
+        }
+
+
+class IdleAgent(ScriptedAgent):
+    """Always no-op — the cheapest possible opponent / smoke fixture."""
+
+    def act(self, obs: dict) -> dict:
+        return self._noop()
+
+
+class RandomAgent(ScriptedAgent):
+    """Uniform-random structurally-valid actions (role of the reference
+    pysc2/agents/random_agent.py): a random applicable action type (drawn
+    from the race-legal set when ``race`` is given — lib/stat
+    ACTION_RACE_MASK, the same gate play mode applies to model logits),
+    random valid unit selections, random map target."""
+
+    def __init__(self, player_id: str = "random", seed: int = 0,
+                 noop_prob: float = 0.25, race: Optional[str] = None, **kwargs):
+        super().__init__(player_id, seed, **kwargs)
+        self.noop_prob = noop_prob
+        if race is not None:
+            from ..lib.stat import ACTION_RACE_MASK
+
+            self._action_ids = np.flatnonzero(ACTION_RACE_MASK[race])
+        else:
+            self._action_ids = np.arange(len(ACTIONS))
+
+    def act(self, obs: dict) -> dict:
+        if self._rng.random() < self.noop_prob:
+            return self._noop()
+        at = int(self._rng.choice(self._action_ids))
+        n_valid = self._valid_units(obs)
+        act = self._noop()
+        act["action_type"] = at
+        if QUEUED_MASK[at]:
+            act["queued"] = int(self._rng.integers(0, 2))
+        if SELECTED_UNITS_MASK[at]:
+            k = int(self._rng.integers(1, min(F.MAX_SELECTED_UNITS_NUM, n_valid) + 1))
+            sel = self._rng.choice(n_valid, size=k, replace=False).astype(np.int64)
+            act["selected_units"][: len(sel)] = sel
+            act["selected_units_num"] = int(len(sel))
+        if TARGET_UNIT_MASK[at]:
+            act["target_unit"] = int(self._rng.integers(0, n_valid))
+        if TARGET_LOCATION_MASK[at]:
+            act["target_location"] = int(
+                self._rng.integers(0, F.SPATIAL_SIZE[0] * F.SPATIAL_SIZE[1])
+            )
+        return act
+
+
+SCRIPTED_PIPELINES = {
+    "scripted.random": RandomAgent,
+    "scripted.idle": IdleAgent,
+}
+
+
+def is_scripted(pipeline: Optional[str]) -> bool:
+    return bool(pipeline) and pipeline in SCRIPTED_PIPELINES
+
+
+def build_scripted(pipeline: str, player_id: str, seed: int = 0,
+                   race: Optional[str] = None) -> ScriptedAgent:
+    """Agent-by-pipeline-name (role of the reference import_helper
+    agent registry, distar/agent/import_helper.py:11-14)."""
+    return SCRIPTED_PIPELINES[pipeline](player_id=player_id, seed=seed, race=race)

@@ -129,10 +129,19 @@ def main() -> None:
         return
 
     sc2_dir = find_sc2()
-    if args.maps_dir:
-        from ..envs.sc2 import maps as map_registry
+    # auto-install the bundled Ladder2019Season2 maps (or a user-supplied
+    # dir) so offline hosts play without ad-hoc downloads (role of the
+    # reference auto-install, rl_train.py:115-116); a read-only install dir
+    # is fine — run_configs.map_data falls back to the bundle at load time
+    from ..envs.sc2 import maps as map_registry
 
-        map_registry.install_maps(args.maps_dir, sc2_dir)
+    try:
+        map_registry.install_maps(args.maps_dir or None, sc2_dir)
+    except OSError as e:
+        import logging
+
+        logging.warning(f"map auto-install into {sc2_dir} failed ({e!r}); "
+                        "relying on the bundled-map fallback")
 
     from ..model.config import default_model_config
     from ..utils.config import deep_merge_dicts

@@ -77,16 +77,54 @@ def get_localized_map_name(map_name: str) -> List[str]:
     return MAPS[name]["localized_names"]
 
 
-def install_maps(source_dir: str, sc2_dir: Optional[str] = None) -> int:
-    """Copy bundled .SC2Map files into the install's Maps dir (role of the
-    auto-install at reference rl_train.py:115-116). Returns #installed."""
+def bundled_maps_dir() -> str:
+    """The Ladder2019Season2 .SC2Map bundle shipped with the package (role of
+    the reference's distar/envs/maps/Ladder2019Season2/): offline hosts can
+    play and decode without any network fetch. Integrity is pinned by
+    MANIFEST.json (sha256 per file)."""
+    return os.path.join(os.path.dirname(_DATA), "maps", "Ladder2019Season2")
+
+
+def verify_bundled_maps(source_dir: Optional[str] = None) -> List[str]:
+    """Check every bundled map against its MANIFEST.json sha256; returns the
+    list of corrupt/missing filenames (empty == all good)."""
+    import hashlib
+
+    source_dir = source_dir or bundled_maps_dir()
+    manifest_path = os.path.join(source_dir, "MANIFEST.json")
+    with open(manifest_path) as f:
+        manifest = json.load(f)["files"]
+    bad = []
+    for name, meta in manifest.items():
+        path = os.path.join(source_dir, name)
+        if not os.path.exists(path):
+            bad.append(name)
+            continue
+        h = hashlib.sha256(open(path, "rb").read()).hexdigest()
+        if h != meta["sha256"]:
+            bad.append(name)
+    return bad
+
+
+def install_maps(source_dir: Optional[str] = None, sc2_dir: Optional[str] = None) -> int:
+    """Copy .SC2Map files into the install's Maps dir (role of the
+    auto-install at reference rl_train.py:115-116). ``source_dir`` defaults
+    to the bundled Ladder2019Season2 set. Returns #installed."""
+    if source_dir is None:
+        source_dir = bundled_maps_dir()
     sc2_dir = os.path.expanduser(sc2_dir or os.environ.get("SC2PATH", "~/StarCraftII"))
+    # maps sitting directly in source_dir install under Maps/<dirname>/ so
+    # they land where map_data's primary 'Maps/Ladder2019Season2/<file>'
+    # lookup (and a conventional install's idempotency check) expects them
+    season = os.path.basename(os.path.normpath(source_dir))
     installed = 0
     for root, _, files in os.walk(source_dir):
         for f in files:
             if not f.lower().endswith(".sc2map"):
                 continue
             rel = os.path.relpath(os.path.join(root, f), source_dir)
+            if os.sep not in rel and season:
+                rel = os.path.join(season, rel)
             dst = os.path.join(sc2_dir, "Maps", rel)
             if os.path.exists(dst):
                 continue

@@ -150,6 +150,30 @@ class RunConfig:
             if os.path.exists(path):
                 with open(path, "rb") as f:
                     return f.read()
+        # not in the install: fall back to the package's bundled ladder maps
+        # (distar_tpu/data/maps/...) so offline hosts play without installs;
+        # match on normalized basenames (bundle files keep Blizzard's
+        # punctuation, e.g. TurboCruise'84LE)
+        from . import maps as map_registry
+
+        def norm(s: str) -> str:
+            return "".join(c for c in s.lower() if c.isalnum())
+
+        bundle = map_registry.bundled_maps_dir()
+        if os.path.isdir(bundle):
+            by_norm = {
+                norm(f[: -len(".SC2Map")]): f
+                for f in os.listdir(bundle)
+                if f.endswith(".SC2Map")
+            }
+            for name in map_names:
+                stem = os.path.basename(name)
+                if stem.endswith(".SC2Map"):
+                    stem = stem[: -len(".SC2Map")]
+                hit = by_norm.get(norm(stem))
+                if hit:
+                    with open(os.path.join(bundle, hit), "rb") as f:
+                        return f.read()
         raise ValueError(f"Map '{map_name}' not found.")
 
     def abs_replay_path(self, replay_path: str) -> str:

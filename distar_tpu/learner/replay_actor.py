@@ -88,6 +88,8 @@ class ReplayActor:
         self._num_workers = max(num_workers, 1)
         self._token = token
         self.pushed = 0
+        self.failed = 0  # decode attempts that raised
+        self.empty = 0   # decodes that produced no steps (e.g. race-filtered)
         self._lock = threading.Lock()
         per = len(self._paths) // self._num_workers
         self._shards = [
@@ -112,8 +114,12 @@ class ReplayActor:
                         steps = decoder.run(path, player_idx)
                     except Exception:
                         logging.exception("decode failed: %s p%d", path, player_idx)
+                        with self._lock:
+                            self.failed += 1
                         continue
                     if not steps:
+                        with self._lock:
+                            self.empty += 1
                         continue
                     adapter.push(self._token, steps)
                     with self._lock:

@@ -150,6 +150,9 @@ class RLLearner(BaseLearner):
                 f"shrunk to dp={new_mesh.shape['dp']} (other axes preserved)"
             )
             self.mesh = new_mesh
+        from ..parallel.mesh import set_context_mesh
+
+        set_context_mesh(self.mesh)  # ring attention resolves sp at trace time
         batch = next(self._dataloader)
         self.optimizer = build_optimizer(
             learning_rate=lc.learning_rate,
@@ -204,10 +207,13 @@ class RLLearner(BaseLearner):
             "opt_state": jax.jit(self.optimizer.init, out_shardings=opt_sh)(params),
         }
         step_fn = make_rl_train_step(self.model, self.loss_cfg, self.optimizer, B, T)
+        from ..parallel.mesh import dp_axes
+
         self._shardings = dict(
             repl=repl,
             param=param_sh,
             batch=time_batch_sharding(self.mesh),  # [T(,+1), B, ...]
+            batch_nosp=NamedSharding(self.mesh, P(None, dp_axes(self.mesh))),
             flat=batch_sharding(self.mesh),  # [B]-leading leaves
         )
         self._train_step = jax.jit(
@@ -220,9 +226,27 @@ class RLLearner(BaseLearner):
 
     def shard_batch(self, batch):
         """Place a host batch onto the mesh: B sharded over dp everywhere
-        (axis 1 for time-major leaves, axis 0 for hidden_state)."""
+        (axis 1 for time-major leaves, axis 0 for hidden_state). On an sp>1
+        mesh the time axis additionally shards over sp — per leaf, because
+        the batch mixes T+1 (obs/values) and T (reward/mask) leading dims
+        and only sp-divisible ones can shard."""
         hidden = batch.pop("hidden_state")
-        out = jax.tree.map(lambda x: jax.device_put(jnp.asarray(x), self._shardings["batch"]), batch)
+        sp = self.mesh.shape["sp"]
+        dp_prod = self.mesh.shape["dp"] * self.mesh.shape["fsdp"]
+
+        def put(x):
+            x = jnp.asarray(x)
+            if x.ndim >= 2:
+                sh = self._shardings["batch"]
+                if sp > 1 and x.shape[0] % sp:
+                    sh = self._shardings["batch_nosp"]
+            elif x.ndim == 1 and x.shape[0] % dp_prod == 0:
+                sh = self._shardings["flat"]
+            else:
+                sh = self._shardings["repl"]
+            return jax.device_put(x, sh)
+
+        out = jax.tree.map(put, batch)
         out["hidden_state"] = jax.tree.map(
             lambda x: jax.device_put(jnp.asarray(x), self._shardings["flat"]), hidden
         )

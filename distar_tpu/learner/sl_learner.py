@@ -100,6 +100,9 @@ class SLLearner(BaseLearner):
                 f"shrunk to dp={new_mesh.shape['dp']} (other axes preserved)"
             )
             self.mesh = new_mesh
+        from ..parallel.mesh import set_context_mesh
+
+        set_context_mesh(self.mesh)  # ring attention resolves sp at trace time
         core = self.model_cfg.encoder.core_lstm
         self._hidden = tuple(
             (jnp.zeros((B, core.hidden_size)), jnp.zeros((B, core.hidden_size)))

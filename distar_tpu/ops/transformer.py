@@ -31,13 +31,19 @@ class Attention(nn.Module):
 
     ``impl='pallas'`` routes the fused mask+softmax+PV kernel
     (ops.pallas_kernels.masked_attention) — TPU only; the default XLA path
-    runs everywhere and fuses well at trainer batch sizes."""
+    runs everywhere and fuses well at trainer batch sizes.
+
+    ``impl='ring'`` shards the set/sequence axis over the context mesh's
+    ``sp`` axis and runs exact ring attention (parallel.ring_attention:
+    K/V blocks rotate via ppermute, online softmax) — the context-parallel
+    path for sequences beyond one chip's HBM. Falls back to the XLA path
+    when no sp>1 mesh is declared (parallel.set_context_mesh)."""
 
     head_dim: int
     head_num: int
     output_dim: int
     dtype: Dtype = jnp.float32
-    impl: str = "xla"  # 'xla' | 'pallas'
+    impl: str = "xla"  # 'xla' | 'pallas' | 'ring'
 
     @nn.compact
     def __call__(self, x, mask: Optional[jnp.ndarray] = None):
@@ -53,10 +59,22 @@ class Attention(nn.Module):
             mask_b = jnp.ones((B, N), bool)
         else:
             mask_b = mask
-        if self.impl == "pallas":
+        impl = self.impl
+        ring_mesh = None
+        if impl == "ring":
+            from ..parallel.mesh import get_context_mesh
+
+            ring_mesh = get_context_mesh()
+            if ring_mesh is None or ring_mesh.shape.get("sp", 1) <= 1 or N % ring_mesh.shape["sp"]:
+                impl = "xla"
+        if impl == "pallas":
             from .pallas_kernels import masked_attention
 
             out = masked_attention(q, k, v, mask_b)
+        elif impl == "ring":
+            from ..parallel.ring_attention import ring_self_attention
+
+            out = ring_self_attention(q, k, v, mask_b.astype(bool), ring_mesh)
         else:
             score = jnp.einsum("bhqd,bhkd->bhqk", q, k) / jnp.sqrt(float(self.head_dim))
             score = jnp.where(mask_b[:, None, None, :], score, NEG_INF)

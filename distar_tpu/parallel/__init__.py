@@ -1,4 +1,13 @@
-from .mesh import MeshSpec, make_mesh, batch_sharding, replicated_sharding
+from .mesh import (
+    MeshSpec,
+    make_mesh,
+    batch_sharding,
+    replicated_sharding,
+    param_sharding,
+    fsdp_param_sharding,
+    set_context_mesh,
+    get_context_mesh,
+)
 from .ring_attention import ring_attention, ring_self_attention
 from .grad_clip import GradClipConfig, build_grad_clip
 from .optimizer import build_optimizer
@@ -8,6 +17,10 @@ __all__ = [
     "make_mesh",
     "batch_sharding",
     "replicated_sharding",
+    "param_sharding",
+    "fsdp_param_sharding",
+    "set_context_mesh",
+    "get_context_mesh",
     "GradClipConfig",
     "build_grad_clip",
     "build_optimizer",

@@ -24,6 +24,20 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 AXES = ("dp", "fsdp", "tp", "sp")
 
+# The mesh model-internal sharded ops (ring attention over sp) resolve at
+# trace time. Modules can't take a Mesh constructor arg without threading it
+# through every config layer, so the learner declares it here before tracing.
+_CONTEXT_MESH: Optional[Mesh] = None
+
+
+def set_context_mesh(mesh: Optional[Mesh]) -> None:
+    global _CONTEXT_MESH
+    _CONTEXT_MESH = mesh
+
+
+def get_context_mesh() -> Optional[Mesh]:
+    return _CONTEXT_MESH
+
 
 @dataclasses.dataclass(frozen=True)
 class MeshSpec:
@@ -74,38 +88,70 @@ def replicated_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
-def fsdp_param_sharding(mesh: Mesh, tree):
-    """Parameter shardings for the fsdp axis: every large-enough leaf is
-    sharded on its largest fsdp-divisible dimension; small or indivisible
-    leaves stay replicated.
+def param_sharding(mesh: Mesh, tree):
+    """Parameter partition specs over the mesh's fsdp AND tp axes.
 
-    This is ZeRO-3-style parameter sharding done the XLA way: params (and,
-    via ``jnp.zeros_like`` inheritance, Adam moments) live sharded over the
-    fsdp axis, and GSPMD inserts the all-gather before use and the
-    reduce-scatter after the backward — the role the reference fills with
-    manual per-param NCCL allreduce (dist_helper.py:369-431), except the
-    optimizer state is also 1/fsdp-sized per device.
+    tp (tensor parallelism, Megatron-style over ICI): attention QKV kernels
+    shard the head/output dimension ("Dense_0" under an Attention module),
+    attention output projections shard the input dimension ("Dense_1"), and
+    any other large-enough kernel shards its largest tp-divisible dimension.
+    GSPMD propagates the activation shardings and inserts the all-reduces the
+    reference would hand-place with NCCL.
+
+    fsdp (ZeRO-3): after tp placement, the largest still-unsharded
+    fsdp-divisible dimension is sharded over fsdp; params (and, via
+    ``jnp.zeros_like`` inheritance, Adam moments) live 1/fsdp-sized per
+    device, with the all-gather before use and reduce-scatter after the
+    backward inserted by the partitioner (role of the reference's manual
+    per-param NCCL allreduce, dist_helper.py:369-431).
 
     ``tree`` may hold arrays or ShapeDtypeStructs; returns a matching tree
     of NamedShardings.
     """
-    n = mesh.shape["fsdp"]
+    ntp = mesh.shape["tp"]
+    nfsdp = mesh.shape["fsdp"]
 
-    def spec_for(x) -> NamedSharding:
-        if n <= 1 or not getattr(x, "shape", ()):  # scalars replicate
-            return NamedSharding(mesh, P())
-        shape = x.shape
-        best = None
-        for i, d in enumerate(shape):
-            if d % n == 0 and d >= 2 * n and (best is None or d > shape[best]):
-                best = i
-        if best is None:
+    def spec_for(path, x) -> NamedSharding:
+        shape = getattr(x, "shape", ())
+        if not shape:  # scalars replicate
             return NamedSharding(mesh, P())
         spec = [None] * len(shape)
-        spec[best] = "fsdp"
+        names = [getattr(p, "key", str(p)) for p in path]
+        if ntp > 1:
+            tp_dim = None
+            in_attention = any(str(n).startswith("Attention") for n in names)
+            if in_attention and names and str(names[-1]) == "kernel" and len(shape) == 2:
+                # Megatron split: QKV projection over heads (columns), output
+                # projection over the contracted (row) dimension
+                cand = 1 if any(str(n) == "Dense_0" for n in names) else 0
+                if shape[cand] % ntp == 0 and shape[cand] >= 2 * ntp:
+                    tp_dim = cand
+            if tp_dim is None:
+                best = None
+                for i, d in enumerate(shape):
+                    if d % ntp == 0 and d >= 2 * ntp and (best is None or d > shape[best]):
+                        best = i
+                tp_dim = best
+            if tp_dim is not None:
+                spec[tp_dim] = "tp"
+        if nfsdp > 1:
+            best = None
+            for i, d in enumerate(shape):
+                if spec[i] is None and d % nfsdp == 0 and d >= 2 * nfsdp and (
+                    best is None or d > shape[best]
+                ):
+                    best = i
+            if best is not None:
+                spec[best] = "fsdp"
         return NamedSharding(mesh, P(*spec))
 
-    return jax.tree.map(spec_for, tree)
+    return jax.tree_util.tree_map_with_path(spec_for, tree)
+
+
+def fsdp_param_sharding(mesh: Mesh, tree):
+    """Back-compat name: fsdp-only callers get the general placement (on a
+    tp=1 mesh the tp rules are inert, so behaviour is unchanged)."""
+    return param_sharding(mesh, tree)
 
 
 def shrink_dp(mesh: Mesh, batch_size: int) -> Mesh:

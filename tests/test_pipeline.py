@@ -232,3 +232,67 @@ def test_remote_roles_over_http(tmp_path):
     finally:
         league_server.stop()
         co_server.stop()
+
+
+@pytest.mark.slow
+def test_scripted_vs_model_job():
+    """A scripted pipeline (no model, no inference slot, no trajectories)
+    plays side 1 against the model-driven side 0 on the mock env (role of the
+    reference's scripted demo agents, pysc2/agents/)."""
+    from distar_tpu.actor.scripted import RandomAgent, build_scripted, is_scripted
+
+    assert is_scripted("scripted.random") and is_scripted("scripted.idle")
+    assert isinstance(build_scripted("scripted.random", "X"), RandomAgent)
+
+    actor = Actor(
+        cfg={"actor": {"env_num": 2, "traj_len": 2, "seed": 5}},
+        model_cfg=SMALL_MODEL,
+        env_fn=lambda: MockEnv(episode_game_loops=300, seed=2),
+    )
+    job = {
+        "player_ids": ["MP0", "BOT"],
+        "pipelines": ["default", "scripted.random"],
+        "send_data_players": [],
+        "update_players": [],
+        "teacher_player_ids": ["T", "none"],
+        "branch": "eval_test",
+        "env_info": {"map_name": "mock"},
+    }
+    results = actor.run_job(episodes=2, job=job)
+    assert len(results) >= 2
+    for r in results:
+        assert r["0"]["player_id"] == "MP0"
+        assert r["1"]["player_id"] == "BOT"
+        assert r["1"]["bo_reward_total"] == 0.0
+
+
+def test_scripted_agents_emit_valid_actions():
+    """Every scripted action respects the per-head ACTIONS masks and the
+    fixed feature shapes."""
+    from distar_tpu.actor.scripted import IdleAgent, RandomAgent
+    from distar_tpu.lib import features as F
+    from distar_tpu.lib.actions import (
+        SELECTED_UNITS_MASK, TARGET_LOCATION_MASK, TARGET_UNIT_MASK,
+    )
+
+    rng = np.random.default_rng(0)
+    obs = F.fake_step_data(train=False, rng=rng)
+    for agent in (RandomAgent("r", seed=1, noop_prob=0.1), IdleAgent("i")):
+        agent.reset()
+        for _ in range(50):
+            a = agent.step(obs)
+            at = a["action_type"]
+            assert 0 <= at < len(SELECTED_UNITS_MASK)
+            assert 0 <= a["delay"] <= F.MAX_DELAY
+            assert a["selected_units"].shape == (F.MAX_SELECTED_UNITS_NUM,)
+            n = int(np.asarray(obs["entity_num"]))
+            if a["selected_units_num"]:
+                assert SELECTED_UNITS_MASK[at]
+                sel = a["selected_units"][: a["selected_units_num"]]
+                assert (sel < n).all() and len(set(sel.tolist())) == len(sel)
+            if a["target_unit"]:
+                assert TARGET_UNIT_MASK[at]
+                assert a["target_unit"] < n
+            if a["target_location"]:
+                assert TARGET_LOCATION_MASK[at]
+                assert a["target_location"] < F.SPATIAL_SIZE[0] * F.SPATIAL_SIZE[1]

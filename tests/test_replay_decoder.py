@@ -317,3 +317,33 @@ def test_replay_actor_shards_and_feeds_remote_dataloader(server):
     assert batch["entity_num"].shape == (2 * 4,)
     assert batch["new_episodes"].tolist() == [True, True]
     assert np.isfinite(batch["entity_num"]).all()
+
+
+def test_replay_fleet_report(tmp_path):
+    """The fleet ops CLI (role of reference replay_actions/benchmark_replay/
+    mem_leak_check): sharded decode over ReplayActor with a frames/s +
+    RSS-slope report; failures counted, not fatal."""
+    from distar_tpu.bin.replay_fleet import _FakeDecoder, process_tree_rss_mb, run_fleet
+
+    for i in range(5):
+        (tmp_path / f"r{i}.SC2Replay").touch()
+    (tmp_path / "corrupt.SC2Replay").touch()
+    report = run_fleet(
+        str(tmp_path), workers=3,
+        decoder_factory=lambda: _FakeDecoder(steps_per_replay=16),
+        rss_interval_s=0.2,
+    )
+    assert report["replays"] == 6
+    assert report["trajectories"] == 10  # 5 good replays x 2 players
+    assert report["failed_decodes"] == 2
+    assert report["frames"] == 160
+    assert report["value"] > 0
+    assert report["rss"]["peak_mb"] >= report["rss"]["start_mb"] * 0.5
+    assert report["decoder"].startswith("fake")
+    assert process_tree_rss_mb() > 10  # this test process alone
+    # SLURM-style sharding: two tasks split the list without overlap
+    r0 = run_fleet(str(tmp_path), workers=1, ntasks=2, proc_id=0,
+                   decoder_factory=lambda: _FakeDecoder(4), rss_interval_s=1.0)
+    r1 = run_fleet(str(tmp_path), workers=1, ntasks=2, proc_id=1,
+                   decoder_factory=lambda: _FakeDecoder(4), rss_interval_s=1.0)
+    assert r0["replays"] + r1["replays"] == 6

@@ -374,3 +374,31 @@ def test_sc2_tools_cli_over_fake_server(server, capsys):
         assert "steps/s" in out
     finally:
         sys.argv = argv
+
+
+def test_bundled_maps_manifest_and_fallback(tmp_path):
+    """The shipped Ladder2019Season2 bundle: sha256 manifest verifies, the
+    training maps are present, install_maps defaults to the bundle, and
+    RunConfig.map_data falls back to it when the install has no Maps dir
+    (offline-host story; reference bundles distar/envs/maps/...)."""
+    assert map_registry.verify_bundled_maps() == []
+    bundled = set(os.listdir(map_registry.bundled_maps_dir()))
+    for stem in ("KairosJunctionLE", "KingsCoveLE", "NewRepugnancyLE", "CyberForestLE"):
+        assert f"{stem}.SC2Map" in bundled
+    # install defaults to the bundle
+    n = map_registry.install_maps(sc2_dir=str(tmp_path))
+    assert n == len([f for f in bundled if f.endswith(".SC2Map")])
+    assert (tmp_path / "Maps" / "Ladder2019Season2" / "KairosJunctionLE.SC2Map").exists()
+    assert map_registry.install_maps(sc2_dir=str(tmp_path)) == 0  # idempotent
+    # map_data falls back to the bundle for a bare install dir, including
+    # punctuation-normalized names (TurboCruise84 -> TurboCruise'84LE)
+    rc = run_configs.RunConfig(
+        replay_dir="/tmp", data_dir=str(tmp_path / "no_such_install"),
+        tmp_dir=None, version="4.10",
+    )
+    data = rc.map_data("Ladder2019Season2/KairosJunctionLE.SC2Map")
+    assert data[:4] == b"MPQ\x1a"
+    assert rc.map_data("Ladder2019Season2/TurboCruise84LE.SC2Map")[:4] == b"MPQ\x1a"
+    with pytest.raises(ValueError):
+        rc.map_data("Ladder2019Season2/NoSuchLE.SC2Map")
+
