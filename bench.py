@@ -134,6 +134,23 @@ def _measure(kind, label, train_step, args, feedback, frames, peak, iters=4):
     return point
 
 
+def _bench_model_cfg():
+    """Flagship model config for the bench: bf16 on the MXU, with the hot-op
+    implementations switchable for on-silicon A/B
+    (BENCH_ATTN_IMPL=pallas|xla|ring, BENCH_SCATTER_IMPL=pallas|xla)."""
+    cfg = {"dtype": "bfloat16"}
+    attn = os.environ.get("BENCH_ATTN_IMPL")
+    scatter = os.environ.get("BENCH_SCATTER_IMPL")
+    enc = {}
+    if attn:
+        enc["entity"] = {"attention_impl": attn}
+    if scatter:
+        enc["scatter"] = {"impl": scatter}
+    if enc:
+        cfg["encoder"] = enc
+    return cfg
+
+
 def _bench_sl(batch_size, unroll_len, peak, iters=4):
     import jax
 
@@ -148,7 +165,7 @@ def _bench_sl(batch_size, unroll_len, peak, iters=4):
             "log_freq": 10 ** 9,
         },
         # bfloat16 matmuls/convs on the MXU (params stay f32)
-        "model": {"dtype": "bfloat16"},
+        "model": _bench_model_cfg(),
     }
     label = f"b{batch_size}xt{unroll_len}"
     _stage(f"sl-init {label}")
@@ -173,6 +190,78 @@ def _bench_sl(batch_size, unroll_len, peak, iters=4):
     return point
 
 
+def _bench_sl_real(batch_size, unroll_len, peak, iters=6):
+    """SL throughput through the PRODUCTION data path: disk-backed
+    ReplayDataset (synthetically generated decoded steps, same frozen
+    contract as SC2 decode output) -> SLDataloader windowing/collate ->
+    DevicePrefetcher double-buffer -> train step. Reports the host-side
+    data_time share alongside frames/s — the number a fake in-memory
+    dataloader overstates (reference: the sl_training dataloader path,
+    SURVEY.md §2.3)."""
+    import shutil
+    import statistics
+    import tempfile
+
+    from distar_tpu.learner import SLLearner
+    from distar_tpu.learner.hooks import LambdaHook
+    from distar_tpu.learner.sl_dataloader import ReplayDataset, SLDataloader, make_fake_dataset
+
+    label = f"b{batch_size}xt{unroll_len}"
+    _stage(f"sl-real-dataset {label}")
+    root = tempfile.mkdtemp(prefix="bench_sl_realdata_")
+    try:
+        make_fake_dataset(
+            root,
+            n_trajectories=max(2, batch_size // 2),
+            steps_per_traj=unroll_len * 2,
+            seed=0,
+        )
+        cfg = {
+            "common": {"experiment_name": "bench_sl_real"},
+            "learner": {
+                "batch_size": batch_size,
+                "unroll_len": unroll_len,
+                "save_freq": 10 ** 9,
+                "log_freq": 10 ** 9,
+                "prefetch_depth": 2,
+            },
+            "model": _bench_model_cfg(),
+        }
+        _stage(f"sl-real-init {label}")
+        learner = SLLearner(cfg)
+        learner.set_dataloader(SLDataloader(ReplayDataset(root), batch_size, unroll_len))
+        times = {"data": [], "train": []}
+
+        def rec(lrn):
+            # LogReduceHook (priority 10) folds log_buffer into the meters
+            # and clears it before priority-50 hooks run; read the meters
+            vr = lrn.variable_record
+            times["data"].append(float(vr.get("data_time").val))
+            times["train"].append(float(vr.get("train_time").val))
+
+        learner.hooks.add(LambdaHook("bench_rec", "after_iter", rec, freq=1))
+        _stage(f"sl-real-steps {label} (first iter compiles)")
+        learner.run(max_iterations=iters)
+        # drop compile/warmup iterations
+        keep = slice(2, None) if len(times["train"]) > 3 else slice(1, None)
+        data_t = statistics.fmean(times["data"][keep])
+        train_t = statistics.fmean(times["train"][keep])
+        total = data_t + train_t
+        point = {
+            "frames_per_sec": round(batch_size * unroll_len / total, 2),
+            "step_time_s": round(train_t, 4),
+            "data_time_s": round(data_t, 4),
+            "data_time_share": round(data_t / total, 4),
+            "batch": batch_size,
+            "unroll": unroll_len,
+            "iters_measured": len(times["train"][keep]),
+        }
+        del learner
+        return point
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
 def _bench_rl(batch_size, unroll_len, peak, iters=4):
     import jax.numpy as jnp
 
@@ -187,7 +276,7 @@ def _bench_rl(batch_size, unroll_len, peak, iters=4):
             "log_freq": 10 ** 9,
             "value_pretrain_iters": -1,
         },
-        "model": {"dtype": "bfloat16"},
+        "model": _bench_model_cfg(),
     }
     label = f"b{batch_size}xt{unroll_len}"
     _stage(f"rl-init {label}")
@@ -240,11 +329,21 @@ def run_child():
 
     budget = float(os.environ.get("BENCH_TIME_BUDGET", 10 ** 9))
     t0 = time.perf_counter()
-    state = {"sl_best": None, "rl_best": None, "sl_sweep": [], "rl_sweep": []}
+    state = {
+        "sl_best": None, "rl_best": None, "sl_real_best": None,
+        "sl_sweep": [], "rl_sweep": [], "sl_real_sweep": [],
+    }
 
     def emit():
         sl, rl = state["sl_best"], state["rl_best"]
-        if sl is not None or rl is None:
+        if sl is None and rl is None and state["sl_real_best"] is not None:
+            # sl_real-only run: the real-data point IS a full train step —
+            # make it the headline rather than a misleading 0.0
+            point = state["sl_real_best"]
+            headline_metric = "SL replay-frames/sec/chip (full model, real data path)"
+            value = point["frames_per_sec"]
+            vs = round(value / SL_BASELINE_FRAMES, 3)
+        elif sl is not None or rl is None:
             headline_metric = "SL replay-frames/sec/chip (full model, fwd+loss+bwd+adam)"
             value = sl["frames_per_sec"] if sl else 0.0
             vs = round(value / SL_BASELINE_FRAMES, 3)
@@ -266,6 +365,8 @@ def run_child():
         }
         if sl and "mfu" in sl:
             out["mfu"] = sl["mfu"]
+        if state["sl_real_best"] is not None:
+            out["sl_real_data"] = state["sl_real_best"]
         if rl:
             out["rl"] = dict(
                 rl,
@@ -275,8 +376,9 @@ def run_child():
         print(json.dumps(out), flush=True)
 
     mode = os.environ.get("BENCH_MODE", "both")
+    fns = {"sl": _bench_sl, "rl": _bench_rl, "sl_real": _bench_sl_real}
     if "BENCH_BATCH" in os.environ or "BENCH_UNROLL" in os.environ:
-        kind = mode if mode in ("sl", "rl") else "sl"
+        kind = mode if mode in fns else "sl"
         plan = [(kind, int(os.environ.get("BENCH_BATCH", 6)), int(os.environ.get("BENCH_UNROLL", 64)))]
     else:
         plan = [
@@ -285,20 +387,22 @@ def run_child():
             # baseline regime (reference per-A100 SL slice: batch 6 x traj 64)
             ("sl", 6, 64),
             ("rl", 6, 64),
+            # production data path: disk dataset + windowing + prefetch
+            ("sl_real", 6, 64),
             # push batch toward the HBM limit
             ("sl", 16, 64),
             ("sl", 32, 64),
             ("rl", 12, 64),
         ]
-        if mode in ("sl", "rl"):
+        if mode in fns:
             plan = [p for p in plan if p[0] == mode]
 
     for kind, b, t in plan:
-        have_any = state["sl_best"] or state["rl_best"]
+        have_any = state["sl_best"] or state["rl_best"] or state["sl_real_best"]
         if have_any and time.perf_counter() - t0 > budget:
             break
         try:
-            point = (_bench_sl if kind == "sl" else _bench_rl)(b, t, peak)
+            point = fns[kind](b, t, peak)
         except Exception as e:  # OOM at the top of the sweep is expected
             err = {"batch": b, "unroll": t, "error": repr(e)[:300]}
             state[f"{kind}_sweep"].append(err)
@@ -310,7 +414,7 @@ def run_child():
             state[f"{kind}_best"] = point
         emit()
 
-    if not (state["sl_best"] or state["rl_best"]):
+    if not (state["sl_best"] or state["rl_best"] or state["sl_real_best"]):
         raise RuntimeError(f"no config completed: {state}")
 
 

@@ -71,6 +71,10 @@ class Actor:
         self._player_params = dict(player_params or {})
         self._rng = np.random.default_rng(self.cfg.seed)
         self.results: List[dict] = []
+        # highest learner iteration ever received per player — survives
+        # across jobs (the per-job _model_iters resets), for freshness
+        # monitoring/telemetry
+        self.model_iter_highwater: Dict[str, int] = {}
 
     # ---------------------------------------------------------------- params
     def _initial_params(self):
@@ -105,9 +109,15 @@ class Actor:
         if self.adapter is not None:
             data = self._pull_latest_model(player_id)
             if data is not None:
-                self._model_iters[player_id] = data.get("iter", 0)
+                self._note_model_iter(player_id, data.get("iter", 0))
                 return jax.tree.map(np.asarray, data["params"])
         return self._initial_params()
+
+    def _note_model_iter(self, player_id: str, it: int) -> None:
+        self._model_iters[player_id] = it
+        self.model_iter_highwater[player_id] = max(
+            self.model_iter_highwater.get(player_id, 0), it
+        )
 
     def _sample_z(
         self,
@@ -230,7 +240,7 @@ class Actor:
                 new_params = jax.tree.map(np.asarray, data["params"])
                 params[player] = new_params
                 infer[side].params = new_params
-                self._model_iters[player] = data.get("iter", 0)
+                self._note_model_iter(player, data.get("iter", 0))
                 reset = reset or bool(data.get("reset_flag", False))
         return reset
 

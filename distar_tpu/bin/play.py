@@ -81,7 +81,17 @@ def main() -> None:
     p.add_argument("--platform", default="auto", choices=("auto", "cpu", "tpu"),
                    help="inference device; cpu works anywhere (the reference's "
                         "--cpu flag), auto uses the default jax backend")
+    p.add_argument("--lan-host", action="store_true",
+                   help="HUMAN side of a remote showmatch: host a LAN game "
+                        "full-screen and print the handshake port for the "
+                        "agent machine (role of reference play_vs_agent)")
+    p.add_argument("--lan", default="",
+                   help="AGENT side of a remote showmatch: host:port of the "
+                        "human machine's handshake (reference lan_sc2_env)")
     args = p.parse_args()
+
+    if args.lan_host:
+        return run_lan_host(args)
 
     if args.platform == "cpu" or (args.platform == "auto" and args.game_type == "mock"):
         # pin before any backend init; the image's sitecustomize pins the
@@ -148,6 +158,41 @@ def main() -> None:
 
     full_model_cfg = deep_merge_dicts(default_model_config(), model_cfg)
 
+    if args.lan:
+        # agent side of a remote showmatch: join the human's hosted game
+        from ..envs.sc2.lan import LanSC2Env
+
+        host, sep, port = args.lan.rpartition(":")
+        if not sep or not port.isdigit():
+            raise SystemExit(
+                f"--lan expects host:port (the endpoint --lan-host printed), "
+                f"got {args.lan!r}"
+            )
+        host = host or "127.0.0.1"
+        name1 = side_name(args.model1, "model1")
+        player_params = {}
+        if args.model1:
+            player_params[name1] = load_params(args.model1, full_model_cfg)
+        job = {
+            "player_ids": [name1],
+            "send_data_players": [],
+            "update_players": [],
+            "teacher_player_ids": ["none"],
+            "branch": "eval_test",
+            "env_info": {"map_name": args.map_name},
+            "z_path": [args.z_path] if args.z_path else [],
+            "opponent_id": "remote_human",
+        }
+        actor = Actor(
+            cfg={"actor": {"env_num": 1, "traj_len": 10 ** 9}},
+            model_cfg=model_cfg,
+            env_fn=lambda: LanSC2Env(host, int(port), agent_race=args.race1),
+            player_params=player_params,
+        )
+        results = actor.run_job(episodes=1, job=job)
+        report(results)
+        return
+
     # matchup -> env player ids + the model-driven sides (reference
     # play.py:101-112)
     name1 = side_name(args.model1, "model1")
@@ -212,6 +257,54 @@ def main() -> None:
     )
     results = actor.run_job(episodes=args.game_count, job=job)
     report(results)
+
+
+def run_lan_host(args) -> None:
+    """Human side of a remote showmatch: host the LAN game, print the
+    handshake endpoint, then play full-screen until the game ends."""
+    import socket
+    import time
+
+    find_sc2()
+    from ..envs.sc2 import maps as map_registry
+    from ..envs.sc2.lan import host_lan_game
+
+    try:
+        map_registry.install_maps(args.maps_dir or None)
+    except OSError:
+        pass
+    controller, handshake_port, proc, join_thread = host_lan_game(
+        args.map_name, race=args.race1, realtime=True
+    )
+    # the outward-facing address: a connected UDP socket reveals the local
+    # interface IP without sending a packet (gethostbyname(hostname) often
+    # resolves to 127.0.1.1 via /etc/hosts — useless to a remote machine)
+    try:
+        probe = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        probe.connect(("10.255.255.255", 1))
+        ip = probe.getsockname()[0]
+        probe.close()
+    except OSError:
+        ip = socket.gethostbyname(socket.gethostname())
+    print(
+        f"LAN game hosted. On the agent machine run:\n"
+        f"  python -m distar_tpu.bin.play --lan {ip}:{handshake_port} "
+        f"--model1 <ckpt> --race1 {args.race2}\n"
+        f"(substitute this machine's reachable IP if {ip} is wrong)\n"
+        f"Waiting for the agent to join...",
+        flush=True,
+    )
+    join_thread.join()
+    print("Agent joined — play! (this process exits when the game ends)", flush=True)
+    try:
+        while True:
+            time.sleep(5)
+            controller.ping()
+    except Exception:
+        pass
+    finally:
+        if proc is not None:
+            proc.close()
 
 
 def report(results) -> None:

@@ -74,6 +74,7 @@ class Encoder(nn.Module):
             locations,
             (static_cfg(self.cfg).spatial_y, static_cfg(self.cfg).spatial_x),
             static_cfg(self.cfg).encoder.scatter.type,
+            impl=static_cfg(self.cfg).encoder.scatter.get("impl", "xla"),
         )
         embedded_spatial, map_skip = SpatialEncoder(static_cfg(self.cfg), name="spatial_encoder")(
             spatial_info, scatter_map

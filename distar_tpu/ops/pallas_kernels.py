@@ -50,13 +50,24 @@ def _attention_kernel(q_ref, k_ref, v_ref, mask_ref, out_ref, *, scale: float):
     out_ref[0, 0] = jnp.dot(p, v, preferred_element_type=jnp.float32)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
 def masked_attention(
     q: jnp.ndarray,  # [B, H, N, Dh]
     k: jnp.ndarray,
     v: jnp.ndarray,
     mask: jnp.ndarray,  # [B, N] bool key validity
-    interpret: bool = False,
+    interpret: Optional[bool] = None,  # None: native on TPU, interpret elsewhere
 ) -> jnp.ndarray:
+    """Fused masked attention. Differentiable: the forward runs the Pallas
+    kernel; the backward recomputes the softmax in plain XLA (flash-attention
+    style pallas-fwd/recompute-bwd split — the backward is matmul-dominated
+    and XLA tiles it onto the MXU fine)."""
+    return _masked_attention_fwd_kernel(q, k, v, mask, interpret)
+
+
+def _masked_attention_fwd_kernel(q, k, v, mask, interpret):
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
     B, H, N, Dh = q.shape
     scale = 1.0 / (Dh ** 0.5)
     mask2 = mask[:, None, None, :].astype(jnp.float32)  # [B, 1, 1, N]
@@ -82,6 +93,28 @@ def masked_attention(
     )(q, k, v, mask2)
 
 
+def _masked_attention_vjp_fwd(q, k, v, mask, interpret):
+    out = _masked_attention_fwd_kernel(q, k, v, mask, interpret)
+    return out, (q, k, v, mask)
+
+
+def _masked_attention_vjp_bwd(interpret, res, dout):
+    q, k, v, mask = res
+    scale = 1.0 / (q.shape[-1] ** 0.5)
+    score = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    score = jnp.where(mask[:, None, None, :], score, NEG_INF)
+    p = jax.nn.softmax(score, axis=-1)
+    dv = jnp.einsum("bhqk,bhqd->bhkd", p, dout)
+    dp = jnp.einsum("bhqd,bhkd->bhqk", dout, v)
+    ds = p * (dp - jnp.sum(dp * p, axis=-1, keepdims=True))
+    dq = jnp.einsum("bhqk,bhkd->bhqd", ds, k) * scale
+    dk = jnp.einsum("bhqk,bhqd->bhkd", ds, q) * scale
+    return dq, dk, dv, None
+
+
+masked_attention.defvjp(_masked_attention_vjp_fwd, _masked_attention_vjp_bwd)
+
+
 def masked_attention_reference(q, k, v, mask):
     """jnp oracle with identical semantics."""
     scale = 1.0 / (q.shape[-1] ** 0.5)
@@ -104,13 +137,22 @@ def _scatter_kernel(emb_ref, idx_ref, out_ref, *, n_entities: int):
     jax.lax.fori_loop(0, n_entities, body, 0)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
 def scatter_add_connection(
     embeddings: jnp.ndarray,  # [B, N, D] (invalid entities must be zeroed)
     flat_idx: jnp.ndarray,  # [B, N] int32 cell index in [0, H*W)
     hw: int,
-    interpret: bool = False,
+    interpret: Optional[bool] = None,  # None: native on TPU, interpret elsewhere
 ) -> jnp.ndarray:
-    """Per-batch scatter-add; returns [B, H*W, D]."""
+    """Per-batch scatter-add; returns [B, H*W, D]. Differentiable: the
+    scatter-add's VJP w.r.t. embeddings is a plain gather of the output
+    cotangent at the same indices (XLA backward)."""
+    return _scatter_add_fwd_kernel(embeddings, flat_idx, hw, interpret)
+
+
+def _scatter_add_fwd_kernel(embeddings, flat_idx, hw, interpret):
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
     B, N, D = embeddings.shape
 
     return pl.pallas_call(
@@ -124,3 +166,18 @@ def scatter_add_connection(
         out_specs=pl.BlockSpec((1, hw, D), lambda b: (b, 0, 0), memory_space=pltpu.VMEM),
         interpret=interpret,
     )(embeddings, flat_idx.astype(jnp.int32))
+
+
+def _scatter_add_vjp_fwd(embeddings, flat_idx, hw, interpret):
+    return _scatter_add_fwd_kernel(embeddings, flat_idx, hw, interpret), flat_idx
+
+
+def _scatter_add_vjp_bwd(hw, interpret, flat_idx, dout):
+    # d(embeddings)[b, n] = dout[b, idx[b, n]]
+    demb = jnp.take_along_axis(
+        dout, flat_idx.astype(jnp.int32)[..., None].clip(0, hw - 1), axis=1
+    )
+    return demb, None
+
+
+scatter_add_connection.defvjp(_scatter_add_vjp_fwd, _scatter_add_vjp_bwd)

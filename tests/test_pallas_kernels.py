@@ -56,3 +56,102 @@ def test_scatter_add_collisions(rng):
     out = scatter_add_connection(emb, flat, 9, interpret=True)
     np.testing.assert_allclose(np.asarray(out[0, 0]), [4.0, 4.0])
     assert float(jnp.abs(out[0, 1:]).sum()) == 0.0
+
+
+def test_masked_attention_vjp_matches_reference(rng):
+    """Trainable kernel: pallas forward, XLA-recompute backward — gradients
+    must match the dense reference's exactly."""
+    B, H, N, Dh = 2, 2, 32, 16
+    q = jnp.asarray(rng.standard_normal((B, H, N, Dh)).astype(np.float32))
+    k = jnp.asarray(rng.standard_normal((B, H, N, Dh)).astype(np.float32))
+    v = jnp.asarray(rng.standard_normal((B, H, N, Dh)).astype(np.float32))
+    mask = sequence_mask(jnp.array([9, 32]), N)
+    g1 = jax.grad(
+        lambda q, k, v: jnp.sum(masked_attention(q, k, v, mask, True) ** 2),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    g2 = jax.grad(
+        lambda q, k, v: jnp.sum(masked_attention_reference(q, k, v, mask) ** 2),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-4)
+
+
+def test_scatter_add_vjp_is_gather(rng):
+    B, N, D, HW = 2, 24, 4, 40
+    emb = jnp.asarray(rng.standard_normal((B, N, D)).astype(np.float32))
+    idx = jnp.asarray(rng.integers(0, HW, (B, N)), jnp.int32)
+
+    def xla_ref(e):
+        bias = jnp.arange(B, dtype=jnp.int32)[:, None] * HW
+        buf = jnp.zeros((B * HW, D))
+        return buf.at[(idx + bias).reshape(-1)].add(e.reshape(-1, D)).reshape(B, HW, D)
+
+    ga = jax.grad(lambda e: jnp.sum(scatter_add_connection(e, idx, HW, True) ** 2))(emb)
+    gb = jax.grad(lambda e: jnp.sum(xla_ref(e) ** 2))(emb)
+    np.testing.assert_allclose(np.asarray(ga), np.asarray(gb), rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.slow
+def test_small_model_trains_with_pallas_ops():
+    """Full small-model SL train step with BOTH pallas hot-ops enabled
+    (attention_impl='pallas', scatter impl='pallas', interpret on CPU):
+    the A/B the bench runs on silicon must be a real training path.
+
+    Runs in a SUBPROCESS: pallas interpret mode at train-step scale leaves
+    native state behind that can segfault unrelated later jit compiles in
+    the same process (reproduced at suite scale), so its lifetime is scoped
+    to a child interpreter."""
+    import os
+    import subprocess
+    import sys
+
+    code = """
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+from distar_tpu.learner import SLLearner
+
+model = {
+    "encoder": {
+        "entity": {"layer_num": 1, "hidden_dim": 32, "output_dim": 16,
+                   "head_dim": 8, "attention_impl": "pallas"},
+        "spatial": {"down_channels": [4, 4, 8], "project_dim": 4,
+                    "resblock_num": 1, "fc_dim": 16},
+        "scatter": {"output_dim": 4, "impl": "pallas"},
+        "core_lstm": {"hidden_size": 32, "num_layers": 1},
+    },
+    "policy": {
+        "action_type_head": {"res_dim": 16, "res_num": 1, "gate_dim": 32},
+        "delay_head": {"decode_dim": 16},
+        "queued_head": {"decode_dim": 16},
+        "selected_units_head": {"func_dim": 16},
+        "target_unit_head": {"func_dim": 16},
+        "location_head": {"res_dim": 8, "res_num": 1,
+                          "upsample_dims": [4, 4, 1], "map_skip_dim": 8},
+    },
+    "value": {"res_dim": 8, "res_num": 1},
+}
+learner = SLLearner(
+    {
+        "common": {"experiment_name": "pallas_sl_smoke"},
+        "learner": {"batch_size": 2, "unroll_len": 2,
+                    "save_freq": 10 ** 9, "log_freq": 10 ** 9},
+        "model": model,
+    }
+)
+learner.run(max_iterations=2)
+assert learner.last_iter.val == 2
+assert np.isfinite(learner.variable_record.get("total_loss").avg)
+print("PALLAS-TRAIN-OK")
+"""
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = subprocess.run(
+        [sys.executable, "-c", code], env=env, cwd=repo,
+        capture_output=True, text=True, timeout=900,
+    )
+    assert out.returncode == 0, f"child failed:\n{out.stdout[-2000:]}\n{out.stderr[-2000:]}"
+    assert "PALLAS-TRAIN-OK" in out.stdout

@@ -132,3 +132,45 @@ def test_save_replay_hook_fires_on_episode_end():
     env.reset()
     env.step({0: _action(delay=8), 1: _action(delay=8)})
     assert len(saved) == 1 and "outcome" in saved[0]
+
+
+def test_lan_env_handshake_and_join():
+    """LAN showmatch plumbing (role of reference lan_sc2_env/remote_sc2_env):
+    host creates the game + serves the port config; the agent machine fetches
+    it, joins via its own client, and drives a one-agent SC2Env. Both clients
+    here talk to one fake server sharing a FakeGameCore (= the shared game)."""
+    from distar_tpu.envs.sc2.fake_sc2 import FakeGameCore, FakeSC2Server
+    from distar_tpu.envs.sc2.lan import LanPorts, LanSC2Env, host_lan_game
+    from distar_tpu.envs.sc2.remote_controller import RemoteController
+
+    server = FakeSC2Server(game=FakeGameCore(end_at=400, map_size=(120, 140)))
+    try:
+        host_controller = RemoteController("127.0.0.1", server.port, timeout_seconds=5)
+        controller, handshake_port, _proc, join_thread = host_lan_game(
+            "KairosJunction",
+            race="zerg",
+            realtime=False,
+            controller=host_controller,
+            ports=LanPorts(15000, 15001, 15002, 15003),
+        )
+        assert _proc is None  # injected controller: nothing launched
+
+        env = LanSC2Env(
+            "127.0.0.1",
+            handshake_port,
+            agent_race="zerg",
+            controller_factory=lambda: RemoteController(
+                "127.0.0.1", server.port, timeout_seconds=5
+            ),
+        )
+        join_thread.join(timeout=10)
+        assert not join_thread.is_alive(), "host join never completed"
+        obs = env.reset()
+        assert 0 in obs and "entity_info" in obs[0] and "spatial_info" in obs[0]
+        for _ in range(4):
+            out, reward, done, info = env.step({0: _action(delay=2)})
+            if done:
+                break
+        env.close()
+    finally:
+        server.stop()

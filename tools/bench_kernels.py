@@ -1,0 +1,181 @@
+"""Pallas-vs-XLA kernel microbench: the silicon A/B for the two flagship
+kernels (SURVEY.md §2.3 scatter_connection, §5 entity masked attention).
+
+Runs each op at actor-inference and learner-training shapes, forward and
+forward+backward, against its XLA reference, and emits a table
+(op, shape, impl, us, speedup). On the tunneled TPU the Pallas kernels lower
+natively; on CPU they run interpret=True (labelled — interpret numbers are
+for correctness only, never perf).
+
+Usage:
+  python tools/bench_kernels.py [--platform tpu|cpu] [--out artifacts/...json]
+
+The chosen config defaults (encoder.entity.attention_impl,
+encoder.scatter.impl) should follow this table's data on real silicon.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def _time(fn, args, iters=30, warmup=3):
+    import jax
+
+    out = None
+    for _ in range(warmup):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters * 1e6  # us
+
+
+def run(platform: str | None = None, iters: int = 30) -> dict:
+    if platform:
+        import jax
+
+        jax.config.update("jax_platforms", platform)
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distar_tpu.ops.pallas_kernels import (
+        masked_attention,
+        masked_attention_reference,
+        scatter_add_connection,
+    )
+
+    backend = jax.default_backend()
+    interpret = backend != "tpu"  # pallas interprets off-TPU
+    rng = np.random.default_rng(0)
+    rows = []
+
+    # flagship entity-transformer geometry (config: head_dim 128, 2 heads,
+    # 512 entities); B=8 ~ actor lockstep fleet, B=64 ~ a learner microbatch.
+    # interpret mode (off-TPU) runs a python-level emulation — use toy shapes
+    # there, the numbers are correctness-only anyway
+    if interpret:
+        H, N, Dh = 2, 64, 32
+        batches = (2,)
+    else:
+        H, N, Dh = 2, 512, 128
+        batches = (8, 64)
+    for B in batches:
+        q, k, v = (
+            jnp.asarray(rng.standard_normal((B, H, N, Dh)), jnp.float32)
+            for _ in range(3)
+        )
+        mask = jnp.asarray(rng.random((B, N)) > 0.2).at[:, 0].set(True)
+
+        impls = {
+            "pallas": jax.jit(lambda q, k, v, m: masked_attention(q, k, v, m, interpret)),
+            "xla": jax.jit(masked_attention_reference),
+        }
+        ref = None
+        fwd_us = {}
+        for name, fn in impls.items():
+            out = fn(q, k, v, mask)
+            ref = out if ref is None else ref
+            np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-3, rtol=2e-3)
+            fwd_us[name] = _time(fn, (q, k, v, mask), iters)
+        for name in impls:
+            rows.append({
+                "op": "masked_attention", "pass": "fwd", "shape": f"{B}x{H}x{N}x{Dh}",
+                "impl": name, "us": round(fwd_us[name], 1),
+                "speedup_vs_xla": round(fwd_us["xla"] / fwd_us[name], 3),
+            })
+
+        grads = {
+            name: jax.jit(jax.grad(lambda q, k, v, fn=fn: jnp.sum(fn(q, k, v, mask) ** 2), argnums=(0, 1, 2)))
+            for name, fn in impls.items()
+        }
+        bwd_us = {name: _time(g, (q, k, v), max(iters // 3, 5)) for name, g in grads.items()}
+        for name in impls:
+            rows.append({
+                "op": "masked_attention", "pass": "fwd+bwd", "shape": f"{B}x{H}x{N}x{Dh}",
+                "impl": name, "us": round(bwd_us[name], 1),
+                "speedup_vs_xla": round(bwd_us["xla"] / bwd_us[name], 3),
+            })
+
+    # scatter-connection geometry: 512 entities x 32-dim onto the 152x160 map
+    if interpret:
+        Hm, Wm, D = 20, 16, 8
+    else:
+        Hm, Wm, D = 152, 160, 32
+    for B in batches:
+        emb = jnp.asarray(rng.standard_normal((B, N, D)), jnp.float32)
+        idx = jnp.asarray(rng.integers(0, Hm * Wm, (B, N)), jnp.int32)
+
+        def _xla_scatter(e, i, hw):
+            # same math as ops.scatter_connection's XLA add path
+            Bn, Nn, Dn = e.shape
+            bias = jnp.arange(Bn, dtype=jnp.int32)[:, None] * hw
+            buf = jnp.zeros((Bn * hw, Dn), e.dtype)
+            return buf.at[(i + bias).reshape(-1)].add(e.reshape(Bn * Nn, Dn)).reshape(Bn, hw, Dn)
+
+        impls = {
+            "pallas": jax.jit(lambda e, i: scatter_add_connection(e, i, Hm * Wm, interpret)),
+            "xla": jax.jit(lambda e, i: _xla_scatter(e, i, Hm * Wm)),
+        }
+
+        ref = None
+        fwd_us = {}
+        for name, fn in impls.items():
+            out = fn(emb, idx)
+            ref = out if ref is None else ref
+            np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-3, rtol=2e-3)
+            fwd_us[name] = _time(fn, (emb, idx), iters)
+        for name in impls:
+            rows.append({
+                "op": "scatter_add_connection", "pass": "fwd", "shape": f"{B}x{N}x{D}->{Hm}x{Wm}",
+                "impl": name, "us": round(fwd_us[name], 1),
+                "speedup_vs_xla": round(fwd_us["xla"] / fwd_us[name], 3),
+            })
+
+        grads = {
+            "pallas": jax.jit(jax.grad(lambda e: jnp.sum(scatter_add_connection(e, idx, Hm * Wm, interpret) ** 2))),
+            "xla": jax.jit(jax.grad(lambda e: jnp.sum(_xla_scatter(e, idx, Hm * Wm) ** 2))),
+        }
+        bwd_us = {name: _time(g, (emb,), max(iters // 3, 5)) for name, g in grads.items()}
+        for name in grads:
+            rows.append({
+                "op": "scatter_add_connection", "pass": "fwd+bwd", "shape": f"{B}x{N}x{D}->{Hm}x{Wm}",
+                "impl": name, "us": round(bwd_us[name], 1),
+                "speedup_vs_xla": round(bwd_us["xla"] / bwd_us[name], 3),
+            })
+
+    return {
+        "metric": "pallas-vs-xla kernel microbench",
+        "backend": backend,
+        "pallas_mode": "interpret (correctness only)" if interpret else "native",
+        "rows": rows,
+    }
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--platform", default=None, choices=[None, "cpu", "tpu"])
+    p.add_argument("--iters", type=int, default=30)
+    p.add_argument("--out", default=None)
+    args = p.parse_args()
+    report = run(args.platform, args.iters)
+    for r in report["rows"]:
+        print(f"  {r['op']:24s} {r['pass']:8s} {r['shape']:20s} {r['impl']:7s} "
+              f"{r['us']:10.1f} us   x{r['speedup_vs_xla']:.2f}")
+    if args.out:
+        os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+        with open(args.out, "w") as f:
+            json.dump(report, f, indent=1)
+    print(json.dumps({k: v for k, v in report.items() if k != "rows"}))
+
+
+if __name__ == "__main__":
+    main()

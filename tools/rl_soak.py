@@ -1,0 +1,265 @@
+"""RL end-to-end soak: ~100 league-RL iterations on the mock env, with
+invariant checks the 2-iteration smoke can't see.
+
+Role: the long-horizon proof of the reference rl_train call stack
+(SURVEY.md §3.1 — actor rollouts -> adapter data plane -> learner train
+step -> weight publication -> league train-info/snapshot), asserting:
+
+  * weight propagation: the actor's received-model high-water mark keeps
+    rising and tracks the learner within the publication cadence
+  * off-policy staleness: bounded (mean/max) across every batch
+  * league lifecycle: train-info advances the player's total_train_steps
+    and the one_phase_step snapshot fires (historical player appears)
+  * compute-time stability: median train time of the last quarter vs the
+    first quarter after warmup — catches leaks/regressions that creep in
+    over minutes, the failure mode a 2-iter smoke can't see (wall iter time
+    is reported but not asserted: it settles at the actor production rate)
+
+Usage:  python tools/rl_soak.py [--iters 100] [--out artifacts/rl_soak.json]
+Exit code 0 and a JSON report on success; any invariant violation raises.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+SMALL_MODEL = {
+    "encoder": {
+        "entity": {"layer_num": 1, "hidden_dim": 32, "output_dim": 16, "head_dim": 8},
+        "spatial": {"down_channels": [4, 4, 8], "project_dim": 4, "resblock_num": 1, "fc_dim": 16},
+        "scatter": {"output_dim": 4},
+        "core_lstm": {"hidden_size": 32, "num_layers": 1},
+    },
+    "policy": {
+        "action_type_head": {"res_dim": 16, "res_num": 1, "gate_dim": 32},
+        "delay_head": {"decode_dim": 16},
+        "queued_head": {"decode_dim": 16},
+        "selected_units_head": {"func_dim": 16},
+        "target_unit_head": {"func_dim": 16},
+        "location_head": {"res_dim": 8, "res_num": 1, "upsample_dims": [4, 4, 1], "map_skip_dim": 8},
+    },
+    "value": {"res_dim": 8, "res_num": 1},
+}
+
+def _pin_cpu() -> None:
+    """The image's sitecustomize pins jax to the tunneled TPU; the soak is a
+    host-side correctness run and must not contend for the chip (same recipe
+    as __graft_entry__._pin_virtual_cpu_mesh / tests/conftest.py)."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache_distar_tpu")
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+
+
+def run_soak(iters: int = 100, batch_size: int = 4, traj_len: int = 2,
+             env_num: int = 2) -> dict:
+    _pin_cpu()
+    # sized so >=1 one_phase_step snapshot fires inside the soak
+    one_phase_step = max(1, int(iters * batch_size * traj_len * 0.6))
+    from distar_tpu.actor import Actor
+    from distar_tpu.comm import Adapter, Coordinator
+    from distar_tpu.envs import MockEnv
+    from distar_tpu.league import League
+    from distar_tpu.learner import RLLearner
+    from distar_tpu.learner.hooks import LambdaHook
+    from distar_tpu.learner.rl_dataloader import RLDataLoader
+
+    league_cfg = {
+        "league": {
+            "active_players": {
+                "player_id": ["MP0"],
+                "checkpoint_path": ["mp0.ckpt"],
+                "pipeline": ["default"],
+                "frac_id": [1],
+                "z_path": ["3map.json"],
+                "z_prob": [0.0],
+                "teacher_id": ["T"],
+                "teacher_path": ["t.ckpt"],
+                "one_phase_step": [one_phase_step],
+                "chosen_weight": [1.0],
+            },
+            "historical_players": {
+                "player_id": ["HP0"],
+                "checkpoint_path": ["hp0.ckpt"],
+                "pipeline": ["default"],
+                "frac_id": [1],
+                "z_path": ["3map.json"],
+                "z_prob": [0.0],
+            },
+        }
+    }
+    league = League(league_cfg)
+    co = Coordinator()
+    actor_adapter = Adapter(coordinator=co)
+    learner_adapter = Adapter(coordinator=co)
+    actor = Actor(
+        cfg={"actor": {"env_num": env_num, "traj_len": traj_len, "seed": 7}},
+        league=league,
+        adapter=actor_adapter,
+        model_cfg=SMALL_MODEL,
+        env_fn=lambda: MockEnv(episode_game_loops=300, seed=11),
+    )
+
+    stop = threading.Event()
+    actor_err: list = []
+
+    def actor_loop():
+        while not stop.is_set():
+            try:
+                actor.run_job(episodes=1)
+            except Exception as e:  # pragma: no cover - surfaced in report
+                actor_err.append(repr(e))
+                return
+
+    t = threading.Thread(target=actor_loop, daemon=True)
+    t.start()
+
+    learner = RLLearner(
+        {
+            "common": {"experiment_name": "rl_soak"},
+            "learner": {"batch_size": batch_size, "unroll_len": traj_len,
+                        "save_freq": 10 ** 9, "log_freq": 25},
+            "model": SMALL_MODEL,
+        }
+    )
+    learner.set_dataloader(RLDataLoader(learner_adapter, "MP0", batch_size))
+    learner.attach_comm(learner_adapter, "MP0", league=league,
+                        send_model_freq=4, send_train_info_freq=4)
+
+    telemetry = {
+        "iter_times": [], "train_times": [], "data_times": [],
+        "staleness_mean": [], "staleness_max": [],
+        "total_loss": [], "grad_norm": [], "actor_model_iter": [],
+        "historical_count": [],
+    }
+    last_t = [time.perf_counter()]
+
+    def record(lrn):
+        now = time.perf_counter()
+        telemetry["iter_times"].append(now - last_t[0])
+        last_t[0] = now
+        vr = lrn.variable_record
+        telemetry["train_times"].append(vr.get("train_time").val)
+        telemetry["data_times"].append(vr.get("data_time").val)
+        telemetry["staleness_mean"].append(vr.get("staleness/mean").val)
+        telemetry["staleness_max"].append(vr.get("staleness/max").val)
+        telemetry["total_loss"].append(vr.get("total_loss").val)
+        telemetry["grad_norm"].append(vr.get("grad_norm").val)
+        telemetry["actor_model_iter"].append(
+            max(actor.model_iter_highwater.values() or [0])
+        )
+        telemetry["historical_count"].append(len(league.historical_players))
+
+    learner.hooks.add(LambdaHook("soak_record", "after_iter", record, freq=1))
+    t0 = time.perf_counter()
+    learner.run(max_iterations=iters)
+    wall = time.perf_counter() - t0
+    stop.set()
+    t.join(timeout=120)
+
+    assert not actor_err, f"actor loop died: {actor_err}"
+    assert learner.last_iter.val == iters
+
+    # ---- invariants -----------------------------------------------------
+    propagated = telemetry["actor_model_iter"]
+    assert propagated[-1] > 0, "actor never received published weights"
+    assert propagated[-1] >= iters - 24, (
+        f"actor weights stale at end: iter {propagated[-1]} vs learner {iters}"
+    )
+    # (no monotonicity assertion on the high-water mark — it is
+    # non-decreasing by construction; backwards application of a stale
+    # publication is prevented at the source by _refresh_models' iter guard)
+
+    smax = max(telemetry["staleness_max"])
+    assert smax <= iters, f"staleness {smax} exceeds total iterations"
+    smean_tail = statistics.fmean(telemetry["staleness_mean"][iters // 2:])
+    assert smean_tail < 64, f"tail staleness mean {smean_tail:.1f} unbounded"
+
+    train_steps = league.all_players["MP0"].total_agent_step
+    assert train_steps > 0, "league never saw train info"
+    snapshots = telemetry["historical_count"][-1] - telemetry["historical_count"][0]
+    assert snapshots >= 1, (
+        f"no league snapshot fired in {iters} iters "
+        f"(train_steps={train_steps}, one_phase_step={one_phase_step})"
+    )
+
+    # leak check on COMPUTE time only: wall iter time legitimately settles
+    # at the actor's production rate once the compile-window trajectory
+    # backlog drains (off-policy equilibrium), so data wait is reported, not
+    # asserted
+    times = telemetry["train_times"][5:]  # drop compile/warmup
+    q = max(len(times) // 4, 1)
+    head, tail = times[:q], times[-q:]
+    ratio = statistics.median(tail) / max(statistics.median(head), 1e-9)
+    assert ratio < 2.5, f"train time drifted {ratio:.2f}x over the soak"
+
+    finite = [x for x in telemetry["total_loss"] if x == x and abs(x) != float("inf")]
+    assert len(finite) == len(telemetry["total_loss"]), "non-finite loss seen"
+
+    return {
+        "iters": iters,
+        "wall_s": round(wall, 1),
+        "train_time_s": {
+            "median": round(statistics.median(times), 3),
+            "p90": round(sorted(times)[int(len(times) * 0.9)], 3),
+            "head_median": round(statistics.median(head), 3),
+            "tail_median": round(statistics.median(tail), 3),
+            "drift_ratio": round(ratio, 3),
+        },
+        "wall_iter_s": {
+            "median": round(statistics.median(telemetry["iter_times"][5:]), 3),
+            "data_share": round(
+                sum(telemetry["data_times"]) /
+                max(sum(telemetry["data_times"]) + sum(telemetry["train_times"]), 1e-9),
+                3,
+            ),
+        },
+        "staleness": {
+            "mean_tail": round(smean_tail, 2),
+            "max": int(smax),
+        },
+        "weights": {
+            "actor_final_iter": int(propagated[-1]),
+        },
+        "league": {
+            "train_steps": int(train_steps),
+            "snapshots": int(snapshots),
+            "games": int(league.all_players["MP0"].total_game_count),
+            "elo_games": int(league.elo.game_count),
+        },
+        "loss": {
+            "first10_mean": round(statistics.fmean(telemetry["total_loss"][:10]), 4),
+            "last10_mean": round(statistics.fmean(telemetry["total_loss"][-10:]), 4),
+        },
+    }
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--iters", type=int, default=100)
+    p.add_argument("--out", default="artifacts/rl_soak.json")
+    args = p.parse_args()
+    report = run_soak(args.iters)
+    report["invariants"] = [
+        "actor weights propagate and end within 24 iters of the learner",
+        "staleness max <= total iters; tail staleness mean < 64",
+        "league train-info advances and >=1 one_phase_step snapshot fires",
+        "median TRAIN time drifts < 2.5x from first to last quarter (wall iter time reported, not asserted)",
+        "every loss value finite",
+    ]
+    os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+    with open(args.out, "w") as f:
+        json.dump(report, f, indent=1)
+    print(json.dumps(report))
+
+
+if __name__ == "__main__":
+    main()
