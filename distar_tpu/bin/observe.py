@@ -1,0 +1,145 @@
+"""Headless observer: render a running game's raw observation without the
+SC2 UI.
+
+Role of the reference's human renderer for *debugging* (reference:
+distar/pysc2/lib/renderer_human.py — the repo's deliberate divergence keeps
+SC2's own UI for realtime human play, but headless hosts still need a
+visual). Two zero-dependency outputs:
+
+  * ``--ascii``   — a downsampled live map in the terminal (own units 'o',
+    enemies 'x', neutral '.', terrain shading by height)
+  * ``--frames DIR`` — binary PPM (P6) images per observation, viewable by
+    any image tool and easy to strip into a GIF later
+
+Drives either an already-running client (``--endpoint host:port`` — works
+against the fake server too) or a freshly launched one joined to a replay
+via sc2_tools; reads raw protos only, so it never perturbs the game.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+from typing import Optional, Tuple
+
+import numpy as np
+
+ASCII_RAMP = " .:-=+*#%@"
+
+
+def decode_terrain(game_info, map_size: Tuple[int, int]) -> np.ndarray:
+    """start_raw.terrain_height ImageData -> [H,W] u8 (zeros when absent —
+    the fake server ships no height map)."""
+    W, H = map_size
+    img = getattr(getattr(game_info, "start_raw", None), "terrain_height", None)
+    data = getattr(img, "data", b"") if img is not None else b""
+    if img is None or not data or img.bits_per_pixel != 8:
+        return np.zeros((H, W), np.uint8)
+    arr = np.frombuffer(data, np.uint8)
+    if arr.size != img.size.x * img.size.y:
+        return np.zeros((H, W), np.uint8)
+    arr = arr.reshape(img.size.y, img.size.x)
+    return arr[:H, :W] if arr.shape >= (H, W) else np.zeros((H, W), np.uint8)
+
+
+def obs_to_grid(raw_obs, map_size: Tuple[int, int], own_player: int,
+                terrain: Optional[np.ndarray] = None) -> dict:
+    """Raw proto -> numpy layers: terrain [H,W] u8, own(+ally)/enemy/neutral
+    unit masks (proto Alliance: Self=1, Ally=2, Neutral=3, Enemy=4)."""
+    W, H = map_size
+    if terrain is None:
+        terrain = np.zeros((H, W), np.uint8)
+    own = np.zeros((H, W), bool)
+    enemy = np.zeros((H, W), bool)
+    neutral = np.zeros((H, W), bool)
+    for u in raw_obs.units:
+        x = int(np.clip(u.pos.x, 0, W - 1))
+        y = int(np.clip(u.pos.y, 0, H - 1))
+        if u.alliance in (1, 2):  # self + allies
+            own[y, x] = True
+        elif u.alliance == 4:
+            enemy[y, x] = True
+        else:  # neutral: minerals, geysers, destructibles
+            neutral[y, x] = True
+    return {"terrain": terrain, "own": own, "enemy": enemy, "neutral": neutral}
+
+
+def render_ascii(grid: dict, width: int = 64) -> str:
+    H, W = grid["own"].shape
+    step_x = max(W // width, 1)
+    step_y = max(H // (width // 2), 1)
+    rows = []
+    for y in range(0, H, step_y):
+        row = []
+        for x in range(0, W, step_x):
+            oy, ox = slice(y, y + step_y), slice(x, x + step_x)
+            if grid["own"][oy, ox].any():
+                row.append("o")
+            elif grid["enemy"][oy, ox].any():
+                row.append("x")
+            elif grid["neutral"][oy, ox].any():
+                row.append("'")
+            else:
+                t = grid["terrain"][oy, ox]
+                shade = int(t.mean()) * (len(ASCII_RAMP) - 1) // 255 if t.size else 0
+                row.append(ASCII_RAMP[shade] if shade else ".")
+        rows.append("".join(row))
+    return "\n".join(rows)
+
+
+def render_ppm(grid: dict, path: str) -> None:
+    H, W = grid["own"].shape
+    img = np.zeros((H, W, 3), np.uint8)
+    img[..., :] = grid["terrain"][..., None] // 2 + 40  # terrain shading
+    img[grid["neutral"]] = (180, 180, 90)
+    img[grid["own"]] = (60, 220, 60)
+    img[grid["enemy"]] = (220, 60, 60)
+    img = img[::-1]  # y-up -> image row order
+    with open(path, "wb") as f:
+        f.write(f"P6 {W} {H} 255\n".encode())
+        f.write(img.tobytes())
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--endpoint", default="", help="host:port of a running client")
+    p.add_argument("--player", type=int, default=1)
+    p.add_argument("--interval", type=float, default=1.0, help="seconds between frames")
+    p.add_argument("--count", type=int, default=0, help="frames to capture (0 = forever)")
+    p.add_argument("--ascii", action="store_true", help="live terminal map")
+    p.add_argument("--frames", default="", help="directory for PPM frames")
+    args = p.parse_args(argv)
+
+    from ..envs.sc2.remote_controller import RemoteController
+
+    if not args.endpoint:
+        raise SystemExit("--endpoint host:port required (launch a client via "
+                         "bin/sc2_tools or point at a live game)")
+    host, _, port = args.endpoint.rpartition(":")
+    controller = RemoteController(host or "127.0.0.1", int(port), timeout_seconds=30)
+    gi = controller.game_info()
+    map_size = (gi.start_raw.map_size.x, gi.start_raw.map_size.y)
+    terrain = decode_terrain(gi, map_size)
+    if args.frames:
+        os.makedirs(args.frames, exist_ok=True)
+
+    n = 0
+    while args.count == 0 or n < args.count:
+        obs = controller.observe()
+        grid = obs_to_grid(obs.observation.raw_data, map_size, args.player, terrain)
+        loop = obs.observation.game_loop
+        if args.ascii:
+            sys.stdout.write(f"\x1b[2J\x1b[Hloop {loop}\n{render_ascii(grid)}\n")
+            sys.stdout.flush()
+        if args.frames:
+            render_ppm(grid, os.path.join(args.frames, f"frame_{n:05d}_loop{loop}.ppm"))
+        n += 1
+        if args.count == 0 or n < args.count:
+            time.sleep(args.interval)
+    if args.frames:
+        print(f"{n} frames written to {args.frames}")
+
+
+if __name__ == "__main__":
+    main()

@@ -336,6 +336,13 @@ class FakeSC2Server:
             conn.close()
             return
         state = _ConnState()
+        # real SC2's status is process-global, not per-connection: a second
+        # connection (e.g. bin/observe attaching to a live game) arrives
+        # mid-game and may observe immediately
+        with self.game.lock:
+            if self.game.started and not self.game.ended:
+                state.status = sc_pb.in_game
+                state.player_id = 1
         while not self._stop.is_set():
             payload = conn.recv()
             if payload is None:

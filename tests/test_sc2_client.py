@@ -402,3 +402,32 @@ def test_bundled_maps_manifest_and_fallback(tmp_path):
     with pytest.raises(ValueError):
         rc.map_data("Ladder2019Season2/NoSuchLE.SC2Map")
 
+
+
+def test_headless_observer_renders_live_game(tmp_path, server):
+    """bin/observe (role of the reference renderer_human for headless
+    debugging): a SECOND connection attaches to a live game (real SC2 status
+    is process-global — fake now mirrors that) and renders ASCII + PPM."""
+    import distar_tpu.bin.observe as OB
+
+    c = connect(server)
+    create = sc_pb.RequestCreateGame()
+    create.local_map.map_path = "FakeMap.SC2Map"
+    create.player_setup.add(type=sc_pb.Participant)
+    create.player_setup.add(type=sc_pb.Computer, race=2, difficulty=7)
+    c.create_game(create)
+    c.join_game(sc_pb.RequestJoinGame(options=sc_pb.InterfaceOptions(raw=True, score=True), race=2))
+
+    d = tmp_path / "frames"
+    OB.main(["--endpoint", f"127.0.0.1:{server.port}", "--count", "2",
+             "--interval", "0.01", "--frames", str(d)])
+    frames = sorted(os.listdir(d))
+    assert len(frames) == 2
+    head = (d / frames[0]).read_bytes()[:20]
+    assert head.startswith(b"P6 ")
+
+    obs = c.observe()
+    gi = c.game_info()
+    size = (gi.start_raw.map_size.x, gi.start_raw.map_size.y)
+    art = OB.render_ascii(OB.obs_to_grid(obs.observation.raw_data, size, 1))
+    assert "o" in art and "x" in art  # both sides visible

@@ -1,0 +1,190 @@
+"""On-chip measurement campaign: everything the round needs from ONE
+successful chip claim, in priority order.
+
+  1. bench.py sweep (SL/RL/sl_real)     -> BENCH_LOCAL_r04.json (repo root)
+  2. kernel microbench (pallas vs XLA)  -> artifacts/pallas_microbench_tpu.json
+  3. full-step attention A/B            -> artifacts/fullstep_ab_tpu.json
+  4. jax.profiler trace of the SL step  -> experiments/profile_sl/
+
+Each stage is its own subprocess (a crash in one never loses the others'
+results) and everything is skipped if its artifact already exists, so the
+campaign is resumable: run it in a loop until the relay frees up.
+
+Usage:  python tools/tpu_campaign.py [--deadline 14400]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(cmd, env_extra=None, timeout=3600, log_name="stage"):
+    env = dict(os.environ, **(env_extra or {}))
+    print(f"[campaign] {log_name}: {' '.join(cmd)} (timeout {timeout}s)", flush=True)
+    t0 = time.time()
+    try:
+        out = subprocess.run(
+            cmd, env=env, cwd=REPO, capture_output=True, text=True, timeout=timeout
+        )
+    except subprocess.TimeoutExpired as e:
+        print(f"[campaign] {log_name}: TIMEOUT after {time.time() - t0:.0f}s", flush=True)
+        return None, (e.stdout or "") if isinstance(e.stdout, str) else ""
+    print(
+        f"[campaign] {log_name}: rc={out.returncode} in {time.time() - t0:.0f}s",
+        flush=True,
+    )
+    if out.returncode != 0:
+        print(out.stderr[-1500:], flush=True)
+    return out.returncode, out.stdout
+
+
+def _last_json_line(stdout: str):
+    best = None
+    for line in stdout.splitlines():
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            d = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(d, dict) and d.get("value"):
+            best = d
+    return best
+
+
+def stage_bench(deadline: int) -> bool:
+    out_path = os.path.join(REPO, "BENCH_LOCAL_r04.json")
+    if os.path.exists(out_path):
+        print("[campaign] bench: artifact exists, skipping", flush=True)
+        return True
+    rc, stdout = _run(
+        [sys.executable, "-u", "bench.py"],
+        env_extra={
+            "BENCH_DEADLINE": str(deadline),
+            "BENCH_ATTEMPT_TIMEOUT": "1200",
+        },
+        timeout=deadline + 120,
+        log_name="bench-sweep",
+    )
+    best = _last_json_line(stdout or "")
+    if best:
+        with open(out_path, "w") as f:
+            json.dump(best, f, indent=1)
+        print(f"[campaign] bench: LANDED {best['value']} {best.get('unit')}", flush=True)
+        return True
+    print("[campaign] bench: no nonzero result this pass", flush=True)
+    return False
+
+
+def stage_kernels() -> bool:
+    out_path = os.path.join(REPO, "artifacts", "pallas_microbench_tpu.json")
+    if os.path.exists(out_path):
+        return True
+    rc, _ = _run(
+        [sys.executable, "tools/bench_kernels.py", "--out", out_path],
+        timeout=2400,
+        log_name="kernel-microbench",
+    )
+    return rc == 0 and os.path.exists(out_path)
+
+
+def stage_fullstep_ab() -> bool:
+    """A/B the attention/scatter impls inside the full SL step (one modest
+    config per impl; compile cache makes reruns cheap)."""
+    out_path = os.path.join(REPO, "artifacts", "fullstep_ab_tpu.json")
+    if os.path.exists(out_path):
+        return True
+    results = {}
+    for name, env_extra in (
+        ("xla", {}),
+        ("pallas", {"BENCH_ATTN_IMPL": "pallas", "BENCH_SCATTER_IMPL": "pallas"}),
+    ):
+        rc, stdout = _run(
+            [sys.executable, "-u", "bench.py", "--run"],
+            env_extra={
+                "BENCH_MODE": "sl",
+                "BENCH_BATCH": "6",
+                "BENCH_UNROLL": "64",
+                **env_extra,
+            },
+            timeout=1800,
+            log_name=f"fullstep-{name}",
+        )
+        best = _last_json_line(stdout or "")
+        if best:
+            results[name] = best.get("sl") or best
+    if len(results) < 2:
+        # a one-sided artifact would permanently skip the stage on resume
+        # without ever delivering the comparison — don't persist it
+        print(f"[campaign] fullstep-ab incomplete ({sorted(results)}); will retry", flush=True)
+        return False
+    with open(out_path, "w") as f:
+        json.dump(
+            {"metric": "full SL step impl A/B (b6xt64)", "configs": results},
+            f,
+            indent=1,
+        )
+    return True
+
+
+def stage_profile() -> bool:
+    prof_dir = os.path.join(REPO, "experiments", "profile_sl")
+    if os.path.isdir(prof_dir) and os.listdir(prof_dir):
+        return True
+    code = """
+import os, time, json
+import jax
+jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache_distar_tpu_bench")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+from distar_tpu.learner import SLLearner
+cfg = {
+    "common": {"experiment_name": "profile_sl"},
+    "learner": {"batch_size": 6, "unroll_len": 64,
+                "save_freq": 10 ** 9, "log_freq": 10 ** 9},
+    "model": {"dtype": "bfloat16"},
+}
+learner = SLLearner(cfg)
+data = dict(next(learner._dataloader))
+data.pop("new_episodes", None); data.pop("traj_lens", None)
+batch = jax.tree.map(jax.numpy.asarray, data)
+args = (learner.state["params"], learner.state["opt_state"], batch, learner._hidden)
+out = learner._train_step(*args); jax.block_until_ready(out)  # compile+warm
+prof = os.path.join(os.getcwd(), "experiments", "profile_sl")
+jax.profiler.start_trace(prof)
+for _ in range(3):
+    out = learner._train_step(out[0], out[1], batch, out[2])
+jax.block_until_ready(out)
+jax.profiler.stop_trace()
+print("PROFILE-OK", prof)
+"""
+    rc, stdout = _run(
+        [sys.executable, "-c", code], timeout=2400, log_name="profile-sl"
+    )
+    return rc == 0 and "PROFILE-OK" in (stdout or "")
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--deadline", type=int, default=14400,
+                   help="bench-sweep chip-claim budget (s)")
+    args = p.parse_args()
+    ok_bench = stage_bench(args.deadline)
+    # only proceed to the extras once the headline number exists — they
+    # contend for the same chip claim
+    if not ok_bench:
+        sys.exit(1)
+    stage_kernels()
+    stage_fullstep_ab()
+    stage_profile()
+    print("[campaign] done", flush=True)
+
+
+if __name__ == "__main__":
+    main()
