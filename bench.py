@@ -139,6 +139,8 @@ def _bench_model_cfg():
     implementations switchable for on-silicon A/B
     (BENCH_ATTN_IMPL=pallas|xla|ring, BENCH_SCATTER_IMPL=pallas|xla)."""
     cfg = {"dtype": "bfloat16"}
+    if os.environ.get("BENCH_REMAT", "").lower() in ("1", "true", "yes"):
+        cfg["remat"] = True  # trade recompute for HBM: bigger batches fit
     attn = os.environ.get("BENCH_ATTN_IMPL")
     scatter = os.environ.get("BENCH_SCATTER_IMPL")
     enc = {}

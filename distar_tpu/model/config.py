@@ -85,6 +85,11 @@ def default_model_config() -> Config:
             # masked sum by entity_num (reference default), 'constant' by 512.
             "entity_reduce_type": "selected_units_num",
             "dtype": "float32",  # compute dtype for matmuls; 'bfloat16' on TPU
+            # rematerialize the activation-heavy encoder blocks in the
+            # backward pass (jax.checkpoint): trades ~1 extra forward of
+            # those blocks for a large cut in live activations — the HBM
+            # knob that buys bigger batches on-chip
+            "remat": False,
             "encoder": {
                 "scalar": {
                     # ordered: (key, arc, in_dim_or_classes, out_dim, context?, baseline?)

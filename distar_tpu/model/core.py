@@ -76,7 +76,12 @@ class Encoder(nn.Module):
             static_cfg(self.cfg).encoder.scatter.type,
             impl=static_cfg(self.cfg).encoder.scatter.get("impl", "xla"),
         )
-        embedded_spatial, map_skip = SpatialEncoder(static_cfg(self.cfg), name="spatial_encoder")(
+        spatial_cls = (
+            nn.remat(SpatialEncoder)
+            if static_cfg(self.cfg).get("remat", False)
+            else SpatialEncoder
+        )
+        embedded_spatial, map_skip = spatial_cls(static_cfg(self.cfg), name="spatial_encoder")(
             spatial_info, scatter_map
         )
         lstm_input = jnp.concatenate(

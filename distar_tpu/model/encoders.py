@@ -212,9 +212,16 @@ class EntityEncoder(nn.Module):
         bias = self.param("ent_embed_bias", nn.initializers.zeros_init(), (width,))
         h = jax.nn.relu(h + bias)
         mask = sequence_mask(entity_num, h.shape[1])
-        # transformer layers only (embedding fc already applied above)
-        for _ in range(ent.layer_num):
-            h = TransformerLayer(
+        # transformer layers only (embedding fc already applied above);
+        # remat recomputes each layer in the backward instead of keeping its
+        # [B*T, 512, C] activations live (model cfg `remat`)
+        layer_cls = (
+            nn.remat(TransformerLayer)
+            if static_cfg(self.cfg).get("remat", False)
+            else TransformerLayer
+        )
+        for i in range(ent.layer_num):
+            h = layer_cls(
                 ent.head_dim,
                 ent.hidden_dim,
                 ent.output_dim,
@@ -224,6 +231,9 @@ class EntityEncoder(nn.Module):
                 ent.ln_type,
                 cdtype(self.cfg),
                 attn_impl=ent.get("attention_impl", "xla"),
+                # explicit name: params stay loadable across the remat toggle
+                # (nn.remat's auto-name prefix would otherwise differ)
+                name=f"TransformerLayer_{i}",
             )(h, mask)
         # the reference's build_activation returns an INPLACE ReLU, so its
         # `entity_fc(act(x))` also rewrites x before the pooling branch

@@ -254,3 +254,70 @@ def test_su_head_parallel_matches_scan(small_cfg, model_and_params):
             np.asarray(outs["scan"]["logit"][head]),
             rtol=2e-4, atol=2e-4,
         )
+
+
+def test_remat_preserves_numerics(rng):
+    """cfg.remat wraps the activation-heavy blocks in jax.checkpoint: the
+    HBM-for-FLOPs knob must not change forward or gradient numerics."""
+    import jax
+    import jax.numpy as jnp
+
+    from distar_tpu.lib import features as F
+    from distar_tpu.model import Model, default_model_config
+    from distar_tpu.utils import deep_merge_dicts
+
+    small = {
+        "encoder": {
+            "entity": {"layer_num": 1, "hidden_dim": 32, "output_dim": 16, "head_dim": 8},
+            "spatial": {"down_channels": [4, 4, 8], "project_dim": 4, "resblock_num": 1, "fc_dim": 16},
+            "scatter": {"output_dim": 4},
+            "core_lstm": {"hidden_size": 32, "num_layers": 1},
+        },
+        "policy": {
+            "action_type_head": {"res_dim": 16, "res_num": 1, "gate_dim": 32},
+            "delay_head": {"decode_dim": 16},
+            "queued_head": {"decode_dim": 16},
+            "selected_units_head": {"func_dim": 16},
+            "target_unit_head": {"func_dim": 16},
+            "location_head": {"res_dim": 8, "res_num": 1, "upsample_dims": [4, 4, 1], "map_skip_dim": 8},
+        },
+        "value": {"res_dim": 8, "res_num": 1},
+    }
+    B = 2
+    obs = F.batch_tree([F.fake_step_data(train=False, rng=rng) for _ in range(B)])
+    obs = jax.tree.map(jnp.asarray, obs)
+
+    outs = {}
+    params = None
+    for remat in (False, True):
+        cfg = deep_merge_dicts(default_model_config(), dict(small, remat=remat))
+        model = Model(cfg)
+        H = cfg.encoder.core_lstm.hidden_size
+        hidden = tuple(
+            (jnp.zeros((B, H)), jnp.zeros((B, H)))
+            for _ in range(cfg.encoder.core_lstm.num_layers)
+        )
+        if params is None:
+            params = model.init(
+                jax.random.PRNGKey(0),
+                obs["spatial_info"], obs["entity_info"], obs["scalar_info"],
+                obs["entity_num"], hidden, jax.random.PRNGKey(1),
+                method=model.sample_action,
+            )
+
+        def loss(p):
+            out = model.apply(
+                p, obs["spatial_info"], obs["entity_info"], obs["scalar_info"],
+                obs["entity_num"], hidden, jax.random.PRNGKey(1),
+                method=model.sample_action,
+            )
+            return sum(jnp.sum(l.astype(jnp.float32) ** 2) for l in jax.tree.leaves(out["logit"]))
+
+        val, grad = jax.jit(jax.value_and_grad(loss))(params)
+        outs[remat] = (val, grad)
+
+    v0, g0 = outs[False]
+    v1, g1 = outs[True]
+    assert jnp.allclose(v0, v1, rtol=1e-5), (v0, v1)
+    for a, b in zip(jax.tree.leaves(g0), jax.tree.leaves(g1)):
+        assert jnp.allclose(a, b, rtol=1e-4, atol=1e-5)
