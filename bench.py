@@ -134,12 +134,16 @@ def _measure(kind, label, train_step, args, feedback, frames, peak, iters=4):
     return point
 
 
+def _env_truthy(name):
+    return os.environ.get(name, "").lower() in ("1", "true", "yes")
+
+
 def _bench_model_cfg():
     """Flagship model config for the bench: bf16 on the MXU, with the hot-op
     implementations switchable for on-silicon A/B
     (BENCH_ATTN_IMPL=pallas|xla|ring, BENCH_SCATTER_IMPL=pallas|xla)."""
     cfg = {"dtype": "bfloat16"}
-    if os.environ.get("BENCH_REMAT", "").lower() in ("1", "true", "yes"):
+    if _env_truthy("BENCH_REMAT"):
         cfg["remat"] = True  # trade recompute for HBM: bigger batches fit
     attn = os.environ.get("BENCH_ATTN_IMPL")
     scatter = os.environ.get("BENCH_SCATTER_IMPL")
@@ -153,11 +157,15 @@ def _bench_model_cfg():
     return cfg
 
 
-def _bench_sl(batch_size, unroll_len, peak, iters=4):
+def _bench_sl(batch_size, unroll_len, peak, iters=4, remat=False):
     import jax
 
     from distar_tpu.learner import SLLearner
 
+    model_cfg = _bench_model_cfg()
+    if remat:
+        model_cfg = dict(model_cfg, remat=True)
+    remat = bool(model_cfg.get("remat", False))  # env-driven runs tag too
     cfg = {
         "common": {"experiment_name": "bench_sl"},
         "learner": {
@@ -167,9 +175,9 @@ def _bench_sl(batch_size, unroll_len, peak, iters=4):
             "log_freq": 10 ** 9,
         },
         # bfloat16 matmuls/convs on the MXU (params stay f32)
-        "model": _bench_model_cfg(),
+        "model": model_cfg,
     }
-    label = f"b{batch_size}xt{unroll_len}"
+    label = f"b{batch_size}xt{unroll_len}" + ("-remat" if remat else "")
     _stage(f"sl-init {label}")
     learner = SLLearner(cfg)
     data = dict(next(learner._dataloader))
@@ -188,6 +196,8 @@ def _bench_sl(batch_size, unroll_len, peak, iters=4):
         batch_size * unroll_len, peak, iters,
     )
     point.update(batch=batch_size, unroll=unroll_len)
+    if remat:
+        point["remat"] = True
     del learner
     return point
 
@@ -399,9 +409,12 @@ def run_child():
         if mode in fns:
             plan = [p for p in plan if p[0] == mode]
 
-    for kind, b, t in plan:
+    def out_of_budget():
         have_any = state["sl_best"] or state["rl_best"] or state["sl_real_best"]
-        if have_any and time.perf_counter() - t0 > budget:
+        return bool(have_any) and time.perf_counter() - t0 > budget
+
+    for kind, b, t in plan:
+        if out_of_budget():
             break
         try:
             point = fns[kind](b, t, peak)
@@ -409,7 +422,24 @@ def run_child():
             err = {"batch": b, "unroll": t, "error": repr(e)[:300]}
             state[f"{kind}_sweep"].append(err)
             print(f"BENCH-STAGE {kind}-failed b{b}xt{t}: {e!r}"[:400], file=sys.stderr, flush=True)
-            continue
+            already_remat = _env_truthy("BENCH_REMAT")
+            if (
+                kind == "sl"
+                and "RESOURCE_EXHAUSTED" in repr(e)
+                and not already_remat  # retry would rebuild the same config
+                and not out_of_budget()  # a fresh trace+compile won't fit
+            ):
+                # HBM edge: retry once with rematerialization — recompute
+                # buys the activations back and the config may fit
+                try:
+                    point = _bench_sl(b, t, peak, remat=True)
+                except Exception as e2:
+                    state["sl_sweep"].append(
+                        {"batch": b, "unroll": t, "remat": True, "error": repr(e2)[:300]}
+                    )
+                    continue
+            else:
+                continue
         state[f"{kind}_sweep"].append(point)
         best = state[f"{kind}_best"]
         if best is None or point["frames_per_sec"] > best["frames_per_sec"]:

@@ -33,8 +33,10 @@ SMALL_MODEL = {
 LEAGUE_CFG = {
     "league": {
         # force pfsp so jobs pit MP0 against history (sp with a single main
-        # would self-match and skip ELO/payoff, which the test asserts on)
-        "branch_probs": {"MainPlayer": {"pfsp": 1.0}},
+        # would self-match and skip ELO/payoff, which the test asserts on).
+        # sp/eval must be EXPLICIT zeros: deep_merge keeps default weights
+        # for keys the override omits, which made this test flaky
+        "branch_probs": {"MainPlayer": {"sp": 0.0, "pfsp": 1.0, "eval": 0.0}},
         "active_players": {
             "player_id": ["MP0"],
             "checkpoint_path": ["mp0.ckpt"],

@@ -11,6 +11,11 @@ results) and everything is skipped if its artifact already exists, so the
 campaign is resumable: run it in a loop until the relay frees up.
 
 Usage:  python tools/tpu_campaign.py [--deadline 14400]
+
+Kill-switch: ``touch /tmp/tpu_campaign_stop`` makes the campaign exit 0
+immediately (and between chip-holding stages), so ``... && break`` retry
+loops stop re-claiming the chip — e.g. before the driver's own bench
+window. The file is intentionally persistent: remove it to re-arm.
 """
 from __future__ import annotations
 
@@ -170,19 +175,32 @@ print("PROFILE-OK", prof)
     return rc == 0 and "PROFILE-OK" in (stdout or "")
 
 
+STOP_FILE = "/tmp/tpu_campaign_stop"
+
+
 def main() -> None:
     p = argparse.ArgumentParser()
     p.add_argument("--deadline", type=int, default=14400,
                    help="bench-sweep chip-claim budget (s)")
     args = p.parse_args()
+    if os.path.exists(STOP_FILE):
+        # operator kill-switch: exit 0 so retry loops (`... && break`) stop
+        # re-claiming the chip (e.g. before the driver's own bench window)
+        print("[campaign] stop file present, exiting", flush=True)
+        return
     ok_bench = stage_bench(args.deadline)
     # only proceed to the extras once the headline number exists — they
     # contend for the same chip claim
     if not ok_bench:
         sys.exit(1)
-    stage_kernels()
-    stage_fullstep_ab()
-    stage_profile()
+    for stage in (stage_kernels, stage_fullstep_ab, stage_profile):
+        if os.path.exists(STOP_FILE):
+            # re-checked between stages: each holds the chip for up to ~40
+            # min, and the switch must also halt an in-flight campaign
+            print("[campaign] stop file present, halting before "
+                  f"{stage.__name__}", flush=True)
+            return
+        stage()
     print("[campaign] done", flush=True)
 
 
