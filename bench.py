@@ -77,7 +77,14 @@ def _stage(name: str) -> None:
     print(f"BENCH-STAGE {name} t={time.time():.0f}", file=sys.stderr, flush=True)
 
 
+_HEARTBEAT_STARTED = []
+
+
 def _start_heartbeat() -> None:
+    if _HEARTBEAT_STARTED:  # once per process: in-process callers (tests)
+        return              # must not accumulate immortal printer threads
+    _HEARTBEAT_STARTED.append(True)
+
     def beat():
         t0 = time.time()
         while True:
