@@ -272,35 +272,41 @@ class Actor:
         # each env steps in its own worker thread (real SC2 steps are slow
         # and high-variance); inference batches over the ready set
         from .env_pool import RESET, EnvWorkerPool
-        from .scripted import build_scripted, is_scripted
+        from .. import plugins
 
         pool = EnvWorkerPool([self._env_fn] * n_env)
 
-        # scripted sides (job pipelines like 'scripted.random') act without a
-        # model: no inference slot, no teacher, no trajectories (role of the
-        # reference's importable scripted agents, pysc2/agents/)
+        # model-free sides act without the batched inference: no slot, no
+        # teacher, no trajectories. That's scripted built-ins
+        # ('scripted.random') AND custom plugin pipelines, which own their
+        # inference (plugins.py; role of the reference's importable agent
+        # pipelines, distar/agent/import_helper.py + pysc2/agents/)
         pipelines = job.get("pipelines", [])
-        scripted_sides = {
+
+        def _pipeline(side: int) -> str:
+            return pipelines[side] if side < len(pipelines) else "default"
+
+        modelfree_sides = {
             side for side in range(len(player_ids))
-            if side < len(pipelines) and is_scripted(pipelines[side])
+            if plugins.is_model_free(_pipeline(side))
         }
 
         # slots: (env, side); one BatchedInference per model-driven side
         params = {
             pid: self._load_player_params(pid)
             for side, pid in enumerate(player_ids)
-            if side not in scripted_sides
+            if side not in modelfree_sides
         }
         infer = {
             side: BatchedInference(self.model, params[pid], n_env, seed=side)
             for side, pid in enumerate(player_ids)
-            if side not in scripted_sides
+            if side not in modelfree_sides
         }
         teacher_hidden = {side: infer[side]._zero_hidden() for side in infer}
         teacher_params = {
             side: self._load_teacher_params(side, job, params[pid])
             for side, pid in enumerate(player_ids)
-            if side not in scripted_sides
+            if side not in modelfree_sides
         }
         from ..league.player import FRAC_ID as _FRAC_ID
 
@@ -312,11 +318,11 @@ class Actor:
 
         agents = {
             (e, side): (
-                build_scripted(
-                    pipelines[side], pid,
+                plugins.build_agent(
+                    _pipeline(side), pid,
                     seed=self.cfg.seed + e * 2 + side, race=_side_race(side),
                 )
-                if side in scripted_sides
+                if side in modelfree_sides
                 else Agent(
                     pid,
                     z=self._sample_z(side, job),
@@ -330,7 +336,7 @@ class Actor:
         for (e, side), ag in agents.items():
             ag.model_last_iter = self._model_iters.get(ag.player_id, 0)
             ag.collect_trajectories = (
-                side not in scripted_sides
+                side not in modelfree_sides
                 and ag.player_id in job.get("send_data_players", [])
             )
         sides = list(range(len(player_ids)))
@@ -346,7 +352,7 @@ class Actor:
             teacher LSTM carries (shared by episode-end and league-reset).
             The fresh obs arrives asynchronously via the pool."""
             for side in sides:
-                if side in scripted_sides:
+                if side in modelfree_sides:
                     agents[(e, side)].reset()
                     continue
                 agents[(e, side)].reset(z=self._sample_z(side, job))
@@ -459,7 +465,7 @@ class Actor:
                 # inactive filler (hidden state preserved).
                 env_actions: Dict[int, dict] = {e: {} for e in range(n_env)}
                 for side, pid in enumerate(player_ids):
-                    if side in scripted_sides:
+                    if side in modelfree_sides:
                         for e in range(n_env):
                             if e in obs and side in obs[e]:
                                 env_actions[e][side] = agents[(e, side)].step(obs[e][side])

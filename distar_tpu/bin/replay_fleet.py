@@ -149,6 +149,7 @@ def run_fleet(
     ntasks: Optional[int] = None,
     proc_id: Optional[int] = None,
     decoder_cfg: Optional[dict] = None,
+    pipeline: str = "default",
 ) -> dict:
     """Decode a replay shard and return the telemetry report (the CLI body,
     callable in-process for tests)."""
@@ -156,10 +157,12 @@ def run_fleet(
 
     fake = decoder_factory is not None
     if decoder_factory is None:
-        def decoder_factory():
-            from ..envs.replay_decoder import ReplayDecoder
+        from .. import plugins
 
-            return ReplayDecoder(cfg=decoder_cfg or {})
+        decoder_cls = plugins.load_component(pipeline, "ReplayDecoder")
+
+        def decoder_factory():
+            return decoder_cls(cfg=decoder_cfg or {})
 
     sink = _StatsSink()
     sampler = _RssSampler(rss_interval_s)
@@ -207,6 +210,9 @@ def main(argv=None) -> None:
                    help="de-dupe keyboard-spam actions (reference FilterActions)")
     p.add_argument("--rss-interval", type=float, default=5.0)
     p.add_argument("--ntasks", type=int, default=None, help="override SLURM_NTASKS")
+    p.add_argument("--pipeline", default="default",
+                   help="decoder implementation: 'default' or an importable "
+                        "custom-pipeline module (plugins.py)")
     p.add_argument("--proc-id", type=int, default=None, help="override SLURM_PROCID")
     p.add_argument("--fake-decoder", action="store_true",
                    help="synthetic decoder (no SC2): harness smoke only")
@@ -221,6 +227,7 @@ def main(argv=None) -> None:
         proc_id=args.proc_id,
         decoder_cfg={"parse_race": args.parse_race,
                      "filter_action": args.filter_actions},
+        pipeline=args.pipeline,
     )
     print(json.dumps(report))
 

@@ -21,7 +21,7 @@ from ..actor import Actor
 from ..comm import Adapter, Coordinator, CoordinatorServer
 from ..envs import MockEnv
 from ..league import League, LeagueAPIServer
-from ..learner import RLLearner
+from .. import plugins
 from ..learner.rl_dataloader import RLDataLoader
 from ..utils import read_config
 
@@ -120,7 +120,8 @@ def run_all(args) -> None:
     t = threading.Thread(target=actor_loop, daemon=True)
     t.start()
 
-    learner = RLLearner(_learner_cfg(args, model_cfg))
+    learner = plugins.load_component(args.pipeline, "RLLearner")(
+        _learner_cfg(args, model_cfg))
     learner.set_dataloader(RLDataLoader(learner_adapter, player_id, args.batch_size))
     learner.attach_comm(learner_adapter, player_id, league=league,
                         send_model_freq=4, send_train_info_freq=4)
@@ -167,7 +168,8 @@ def run_learner(args) -> None:
         ckpt = reply.get("checkpoint_path", "")
         if ckpt and os.path.exists(ckpt):
             load_path = ckpt
-    learner = RLLearner(_learner_cfg(args, model_cfg, load_path=load_path))
+    learner = plugins.load_component(args.pipeline, "RLLearner")(
+        _learner_cfg(args, model_cfg, load_path=load_path))
     learner.set_dataloader(RLDataLoader(adapter, args.player_id, args.batch_size))
     learner.attach_comm(adapter, args.player_id, league=league)
     learner.run(max_iterations=args.iters)
@@ -209,6 +211,9 @@ def main() -> None:
     p.add_argument("--league-addr", default="", help="host:port of the league server")
     p.add_argument("--coordinator-addr", default="", help="host:port of the coordinator")
     p.add_argument("--player-id", default="MP0")
+    p.add_argument("--pipeline", default="default",
+                   help="learner implementation to run: 'default' or an "
+                        "importable custom-pipeline module (plugins.py)")
     p.add_argument("--dist-method", default="single_node",
                    choices=["auto", "slurm", "single_node", "explicit"])
     p.add_argument("--dist-coordinator-address", default="",

@@ -16,7 +16,7 @@ from __future__ import annotations
 
 import argparse
 
-from ..learner import SLLearner
+from .. import plugins
 from ..utils import read_config
 from .rl_train import _addr
 
@@ -26,7 +26,7 @@ def _learner(args) -> None:
 
     user_cfg = read_config(args.config) if args.config else {}
     model_cfg = user_cfg.get("model", SMOKE_MODEL if args.smoke_model else {})
-    learner = SLLearner(
+    learner = plugins.load_component(args.pipeline, "SLLearner")(
         {
             "common": {"experiment_name": args.experiment_name},
             "learner": {
@@ -63,14 +63,14 @@ def _learner(args) -> None:
 
 def _replay_actor(args) -> None:
     from ..comm import Adapter
-    from ..envs.replay_decoder import ReplayDecoder
     from ..learner.replay_actor import ReplayActor
 
+    decoder_cls = plugins.load_component(args.pipeline, "ReplayDecoder")
     coordinator = _addr(args.coordinator_addr)
     ReplayActor(
         replays=args.replays,
         adapter_factory=lambda: Adapter(coordinator_addr=coordinator),
-        decoder_factory=lambda: ReplayDecoder(),
+        decoder_factory=lambda: decoder_cls(cfg={}),
         num_workers=args.num_workers,
         epochs=args.epochs,
     ).run()
@@ -107,6 +107,9 @@ def main() -> None:
     p.add_argument("--smoke-model", action="store_true", default=True)
     p.add_argument("--full-model", dest="smoke_model", action="store_false")
     p.add_argument("--coordinator-addr", default="127.0.0.1:8422")
+    p.add_argument("--pipeline", default="default",
+                   help="learner implementation: 'default' or an importable "
+                        "custom-pipeline module (plugins.py)")
     p.add_argument("--replays", default="", help="replay list file or directory")
     p.add_argument("--num-workers", type=int, default=1)
     p.add_argument("--epochs", type=int, default=1)

@@ -1,0 +1,139 @@
+"""Pipeline plugin registry: resolution + a custom external agent playing
+through the Actor (role of the reference's agent plugin system,
+distar/agent/import_helper.py + distar/agent/template/)."""
+import os
+import sys
+import textwrap
+
+import pytest
+
+from distar_tpu import plugins
+
+
+CUSTOM_PIPELINE_SRC = textwrap.dedent(
+    """
+    \"\"\"A minimal external pipeline module (docs/agent_contract.md).\"\"\"
+    from distar_tpu.actor.scripted import ScriptedAgent
+    from distar_tpu.learner import SLLearner as _SL
+
+
+    class Agent(ScriptedAgent):
+        HAS_MODEL = False
+
+        def __init__(self, player_id="custom", seed=0, race=None, **kwargs):
+            super().__init__(player_id=player_id, seed=seed)
+            self.race = race
+            self.acted = 0
+
+        def act(self, obs):
+            self.acted += 1
+            # always no-op: action_type 0 is structurally valid everywhere
+            return {
+                "action_type": 0, "delay": 4, "queued": 0,
+                "selected_units": [], "target_unit": 0,
+                "target_location": 0,
+            }
+
+
+    class SLLearner(_SL):
+        pass
+    """
+)
+
+
+@pytest.fixture()
+def custom_pipeline(tmp_path, monkeypatch):
+    (tmp_path / "my_custom_pipeline.py").write_text(CUSTOM_PIPELINE_SRC)
+    monkeypatch.syspath_prepend(str(tmp_path))
+    yield "my_custom_pipeline"
+    sys.modules.pop("my_custom_pipeline", None)
+
+
+def test_default_resolution():
+    from distar_tpu.actor.agent import Agent
+    from distar_tpu.envs.replay_decoder import ReplayDecoder
+    from distar_tpu.learner import RLLearner, SLLearner
+
+    assert plugins.load_component("default", "Agent") is Agent
+    assert plugins.load_component("", "RLLearner") is RLLearner
+    assert plugins.load_component(None, "SLLearner") is SLLearner
+    assert plugins.load_component("default", "ReplayDecoder") is ReplayDecoder
+
+
+def test_scripted_resolution():
+    from distar_tpu.actor.scripted import RandomAgent
+
+    assert plugins.load_component("scripted.random", "Agent") is RandomAgent
+    with pytest.raises(ValueError, match="only Agent"):
+        plugins.load_component("scripted.random", "RLLearner")
+
+
+def test_error_messages():
+    with pytest.raises(ValueError, match="unknown component"):
+        plugins.load_component("default", "Frobnicator")
+    with pytest.raises(ValueError, match="bot"):
+        plugins.load_component("bot", "Agent")
+    with pytest.raises(ImportError, match="not importable"):
+        plugins.load_component("definitely_not_a_module_xyz", "Agent")
+
+
+def test_external_resolution(custom_pipeline):
+    agent_cls = plugins.load_component(custom_pipeline, "Agent")
+    ag = plugins.build_agent(custom_pipeline, "P9", seed=3, race="zerg")
+    assert isinstance(ag, agent_cls)
+    assert ag.player_id == "P9" and ag.race == "zerg"
+    # the module exposes SLLearner but no RLLearner
+    assert plugins.load_component(custom_pipeline, "SLLearner") is not None
+    with pytest.raises(AttributeError, match="defines no 'RLLearner'"):
+        plugins.load_component(custom_pipeline, "RLLearner")
+    assert plugins.is_external(custom_pipeline)
+    assert plugins.is_model_free(custom_pipeline)
+    assert not plugins.is_external("scripted.random")
+    assert not plugins.is_model_free("default")
+
+
+def test_custom_agent_vs_model_job(custom_pipeline):
+    """An external pipeline plays side 1 against the model side 0 on the
+    mock env: no inference slot, no trajectories, episodes complete."""
+    from distar_tpu.actor import Actor
+    from distar_tpu.envs import MockEnv
+
+    small_model = {
+        "encoder": {
+            "entity": {"layer_num": 1, "hidden_dim": 32, "output_dim": 16,
+                       "head_dim": 8},
+            "spatial": {"down_channels": [4, 4, 8], "project_dim": 4,
+                        "resblock_num": 1, "fc_dim": 16},
+            "scatter": {"output_dim": 4},
+            "core_lstm": {"hidden_size": 32, "num_layers": 1},
+        },
+        "policy": {
+            "action_type_head": {"res_dim": 16, "res_num": 1, "gate_dim": 32},
+            "delay_head": {"decode_dim": 16},
+            "queued_head": {"decode_dim": 16},
+            "selected_units_head": {"func_dim": 16},
+            "target_unit_head": {"func_dim": 16},
+            "location_head": {"res_dim": 8, "res_num": 1,
+                              "upsample_dims": [4, 4, 1], "map_skip_dim": 8},
+        },
+        "value": {"res_dim": 8, "res_num": 1},
+    }
+    actor = Actor(
+        cfg={"actor": {"env_num": 1, "traj_len": 2, "seed": 11}},
+        model_cfg=small_model,
+        env_fn=lambda: MockEnv(episode_game_loops=300, seed=4),
+    )
+    job = {
+        "player_ids": ["MP0", "EXT"],
+        "pipelines": ["default", custom_pipeline],
+        "send_data_players": [],
+        "update_players": [],
+        "teacher_player_ids": ["T", "none"],
+        "branch": "eval_test",
+        "env_info": {"map_name": "mock"},
+    }
+    results = actor.run_job(episodes=1, job=job)
+    assert len(results) >= 1
+    for r in results:
+        assert r["0"]["player_id"] == "MP0"
+        assert r["1"]["player_id"] == "EXT"
