@@ -44,11 +44,16 @@ def is_default(pipeline) -> bool:
 
 
 def is_external(pipeline) -> bool:
-    """True for user-module pipelines (not default/bot/scripted)."""
-    from .actor.scripted import is_scripted
+    """True for user-module pipelines (not default/bot/scripted).
 
+    Any ``scripted.*`` name classifies as scripted — including typos,
+    which load_component diagnoses against the registry rather than
+    treating as an importable module.
+    """
     return not (
-        is_default(pipeline) or pipeline == "bot" or is_scripted(pipeline)
+        is_default(pipeline)
+        or pipeline == "bot"
+        or str(pipeline).startswith("scripted.")
     )
 
 

@@ -1,0 +1,96 @@
+"""Direct unit tests for PFSP weighting math and the league race-meter
+grids (previously covered only through the full-league pipeline tests)."""
+import numpy as np
+import pytest
+
+from distar_tpu.league.algorithms import pfsp
+from distar_tpu.league.stat_meters import CumStat, DistStat, RaceMeterGrid, UnitNumStat
+
+
+# ------------------------------------------------------------------- pfsp
+def test_pfsp_distributions_sum_to_one():
+    w = np.array([0.1, 0.5, 0.9])
+    for weighting in ("squared", "variance", "normal"):
+        p = pfsp(w, weighting)
+        assert p.shape == w.shape
+        assert abs(p.sum() - 1.0) < 1e-12
+        assert (p >= 0).all()
+
+
+def test_pfsp_squared_favours_losing_matchups():
+    # (1-w)^2: the opponent we lose to (w=0.1) dominates
+    p = pfsp(np.array([0.1, 0.9]), "squared")
+    assert p[0] > 0.9
+
+
+def test_pfsp_variance_favours_even_matchups():
+    p = pfsp(np.array([0.05, 0.5, 0.95]), "variance")
+    assert p[1] == p.max()
+    # symmetric around 0.5
+    assert abs(p[0] - p[2]) < 1e-12
+
+
+def test_pfsp_normal_caps_at_half():
+    # min(0.5, 1-w): every w <= 0.5 contributes identically
+    p = pfsp(np.array([0.0, 0.3, 0.5]), "normal")
+    assert abs(p[0] - p[1]) < 1e-12 and abs(p[1] - p[2]) < 1e-12
+
+
+def test_pfsp_degenerate_cases():
+    # all-zero win rates -> uniform (cold-start payoff)
+    p = pfsp(np.array([0.0, 0.0, 0.0]), "variance")
+    assert np.allclose(p, 1 / 3)
+    # all-won (w=1) zeroes every weighting -> uniform fallback
+    p = pfsp(np.array([1.0, 1.0]), "squared")
+    assert np.allclose(p, 0.5)
+    with pytest.raises(KeyError):
+        pfsp(np.array([0.5]), "bogus")
+
+
+# ------------------------------------------------------------ stat meters
+def test_race_meter_grid_update_and_render():
+    g = RaceMeterGrid(decay=0.9, warm_up_size=1)
+    g.update("zerg", {"a": 1.0, "bad": "not-a-number"})
+    g.update("zerg", {"a": 3.0})
+    g.update("terran", {"a": 2.0})
+    assert g.game_count == {"zerg": 2, "terran": 1}
+    info = g.stat_info_dict
+    # warm_up_size=1: second update applies the EMA decay
+    assert info["zerg"]["a"] == pytest.approx(0.9 * 1.0 + 0.1 * 3.0)
+    assert info["terran"]["a"] == 2.0
+    text = g.get_text()
+    assert "zerg" in text and "terran" in text
+    assert RaceMeterGrid().get_text() == "(empty)"
+
+
+def test_dist_stat_consumes_known_keys_only():
+    d = DistStat(warm_up_size=1)
+    d.update_from_result("zerg", {
+        "bo_distance": 4.0, "cum_distance": 2.0, "winloss": 1.0,
+    })
+    info = d.stat_info_dict["zerg"]
+    assert info["bo_distance"] == 4.0 and info["cum_distance"] == 2.0
+    assert "winloss" not in info  # not a DistStat key
+
+
+def test_cum_stat_names_active_slots():
+    from distar_tpu.lib.stat import CUM_DICT
+
+    c = CumStat(warm_up_size=1)
+    cum = [0] * len(CUM_DICT)
+    cum[0] = 1
+    cum[2] = 1
+    c.update_from_result("zerg", {"cumulative_stat": cum})
+    info = c.stat_info_dict["zerg"]
+    assert str(CUM_DICT[0]) in info and str(CUM_DICT[2]) in info
+    assert str(CUM_DICT[1]) not in info
+    c.update_from_result("zerg", {})  # no cumulative_stat: no-op
+    assert c.game_count["zerg"] == 1
+
+
+def test_unit_num_stat_prefixes_unit_names():
+    u = UnitNumStat(warm_up_size=1)
+    u.update_from_result("zerg", {"unit_num": {"zergling": 30, "drone": 12}})
+    info = u.stat_info_dict["zerg"]
+    assert info["unit_num/zergling"] == 30.0
+    assert info["unit_num/drone"] == 12.0

@@ -30,23 +30,63 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def _run(cmd, env_extra=None, timeout=3600, log_name="stage"):
+    """Run a stage subprocess, polling the stop file so the kill-switch
+    halts even a mid-flight chip-holding child within seconds."""
     env = dict(os.environ, **(env_extra or {}))
     print(f"[campaign] {log_name}: {' '.join(cmd)} (timeout {timeout}s)", flush=True)
     t0 = time.time()
-    try:
-        out = subprocess.run(
-            cmd, env=env, cwd=REPO, capture_output=True, text=True, timeout=timeout
-        )
-    except subprocess.TimeoutExpired as e:
-        print(f"[campaign] {log_name}: TIMEOUT after {time.time() - t0:.0f}s", flush=True)
-        return None, (e.stdout or "") if isinstance(e.stdout, str) else ""
+    proc = subprocess.Popen(
+        cmd, env=env, cwd=REPO, text=True,
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+    )
+    # drain pipes on threads: a chatty child must not deadlock the poll loop
+    import threading
+
+    chunks = {"out": [], "err": []}
+
+    def _drain(stream, key):
+        for line in iter(stream.readline, ""):
+            chunks[key].append(line)
+        stream.close()
+
+    drains = [
+        threading.Thread(target=_drain, args=(proc.stdout, "out"), daemon=True),
+        threading.Thread(target=_drain, args=(proc.stderr, "err"), daemon=True),
+    ]
+    for d in drains:
+        d.start()
+    stopped = False
+    while proc.poll() is None:
+        if time.time() - t0 > timeout:
+            proc.kill()
+            proc.wait()
+            print(f"[campaign] {log_name}: TIMEOUT after {time.time() - t0:.0f}s",
+                  flush=True)
+            break
+        if os.path.exists(STOP_FILE):
+            stopped = True
+            proc.terminate()  # frees the chip claim; bench traps nothing
+            try:
+                proc.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait()
+            print(f"[campaign] {log_name}: stop file present, child terminated",
+                  flush=True)
+            break
+        time.sleep(10)
+    for d in drains:
+        d.join(timeout=5)
+    stdout, stderr = "".join(chunks["out"]), "".join(chunks["err"])
+    if stopped or time.time() - t0 > timeout:
+        return None, stdout
     print(
-        f"[campaign] {log_name}: rc={out.returncode} in {time.time() - t0:.0f}s",
+        f"[campaign] {log_name}: rc={proc.returncode} in {time.time() - t0:.0f}s",
         flush=True,
     )
-    if out.returncode != 0:
-        print(out.stderr[-1500:], flush=True)
-    return out.returncode, out.stdout
+    if proc.returncode != 0:
+        print(stderr[-1500:], flush=True)
+    return proc.returncode, stdout
 
 
 def _last_json_line(stdout: str):
