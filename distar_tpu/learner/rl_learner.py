@@ -25,6 +25,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from ..losses import ReinforcementLossConfig, compute_rl_loss
 from ..model import Model, default_model_config
 from ..parallel import GradClipConfig, MeshSpec, build_optimizer, make_mesh
+from ..parallel.grad_clip import leaf_norms
 from ..utils import Config, deep_merge_dicts
 from .base_learner import DEFAULT_LEARNER_CONFIG, BaseLearner
 from .data import FakeRLDataloader
@@ -42,6 +43,8 @@ RL_LEARNER_DEFAULTS = deep_merge_dicts(
             "grad_clip": {"type": "norm", "threshold": 10.0},
             "value_pretrain_iters": -1,
             "use_dapo": False,
+            # per-parameter grad/param-norm logging (reference save_grad)
+            "save_grad": False,
         },
         "model": {},
     },
@@ -53,8 +56,13 @@ def _flatten_time(tree):
 
 
 def make_rl_train_step(model: Model, loss_cfg: ReinforcementLossConfig, optimizer,
-                       batch_size: int, unroll_len: int):
-    """Build the pure train-step fn (params, opt_state, batch) -> updated."""
+                       batch_size: int, unroll_len: int, save_grad: bool = False):
+    """Build the pure train-step fn (params, opt_state, batch) -> updated.
+
+    With ``save_grad`` the info dict additionally carries per-parameter
+    grad/param L2 norms (reference save_grad TB dumps,
+    rl_learner.py:35-47,118-130) — static at trace time, so the toggle
+    never mixes compiled variants."""
 
     def loss_fn(params, batch, only_update_value):
         obs = {
@@ -102,6 +110,9 @@ def make_rl_train_step(model: Model, loss_cfg: ReinforcementLossConfig, optimize
             params, batch, only_update_value
         )
         info["grad_norm"] = optax.global_norm(grads)
+        if save_grad:
+            info.update(leaf_norms(grads, "grad_norm"))
+            info.update(leaf_norms(params, "param_norm"))
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return params, opt_state, info
@@ -206,7 +217,10 @@ class RLLearner(BaseLearner):
             "params": params,
             "opt_state": jax.jit(self.optimizer.init, out_shardings=opt_sh)(params),
         }
-        step_fn = make_rl_train_step(self.model, self.loss_cfg, self.optimizer, B, T)
+        step_fn = make_rl_train_step(
+            self.model, self.loss_cfg, self.optimizer, B, T,
+            save_grad=self.cfg.learner.get("save_grad", False),
+        )
         from ..parallel.mesh import dp_axes
 
         self._shardings = dict(
@@ -365,6 +379,7 @@ class RLLearner(BaseLearner):
                 make_rl_train_step(
                     self.model, self.loss_cfg, self.optimizer,
                     lc.batch_size, lc.unroll_len,
+                    save_grad=lc.get("save_grad", False),
                 ),
                 donate_argnums=(0, 1),
                 out_shardings=(self._shardings["param"], opt_sh, self._shardings["repl"]),

@@ -19,6 +19,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from ..losses import SupervisedLossConfig, compute_sl_loss
 from ..model import Model, default_model_config
 from ..parallel import GradClipConfig, MeshSpec, build_optimizer, make_mesh
+from ..parallel.grad_clip import leaf_norms
 from ..utils import deep_merge_dicts
 from .base_learner import DEFAULT_LEARNER_CONFIG, BaseLearner
 from .data import FakeSLDataloader
@@ -35,13 +36,16 @@ SL_LEARNER_DEFAULTS = deep_merge_dicts(
             "weight_decay": 1e-5,
             "grad_clip": {"type": "norm", "threshold": 1.0},
             "label_smooth": 0.0,
+            # per-parameter grad/param-norm logging (reference save_grad)
+            "save_grad": False,
         },
         "model": {},
     },
 )
 
 
-def make_sl_train_step(model: Model, loss_cfg: SupervisedLossConfig, optimizer, batch_size: int):
+def make_sl_train_step(model: Model, loss_cfg: SupervisedLossConfig, optimizer,
+                       batch_size: int, save_grad: bool = False):
     def loss_fn(params, batch, hidden_state):
         logits, out_state = model.apply(
             params,
@@ -65,6 +69,10 @@ def make_sl_train_step(model: Model, loss_cfg: SupervisedLossConfig, optimizer, 
             params, batch, hidden_state
         )
         info["grad_norm"] = optax.global_norm(grads)
+        if save_grad:
+            # per-parameter norms (reference save_grad TB dumps)
+            info.update(leaf_norms(grads, "grad_norm"))
+            info.update(leaf_norms(params, "param_norm"))
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return params, opt_state, out_state, info
@@ -143,7 +151,10 @@ class SLLearner(BaseLearner):
         flat_sh = batch_sharding(self.mesh)
         self._shardings = dict(repl=repl, param=param_sh, flat=flat_sh)
         self._train_step = jax.jit(
-            make_sl_train_step(self.model, self.loss_cfg, self.optimizer, B),
+            make_sl_train_step(
+                self.model, self.loss_cfg, self.optimizer, B,
+                save_grad=self.cfg.learner.get("save_grad", False),
+            ),
             donate_argnums=(0, 1),
             # params/opt keep their fsdp shardings; the carried hidden state
             # shards over batch; the info scalars replicate (prefix leaves

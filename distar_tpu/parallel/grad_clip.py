@@ -89,3 +89,23 @@ def build_grad_clip(cfg: GradClipConfig) -> optax.GradientTransformation:
         return optax.GradientTransformation(init, update)
 
     raise NotImplementedError(cfg.type)
+
+
+def leaf_norms(tree, prefix: str):
+    """Per-parameter L2 norms keyed by pytree path.
+
+    Role of the reference's ``save_grad`` per-parameter grad/param-norm TB
+    dumps (reference: distar/agent/default/rl_learner.py:35-47,118-130):
+    computed inside the jitted step (a handful of scalar reductions is
+    noise next to the model matmuls) and folded into the step's info dict,
+    so the existing one-batched-D2H log path ships them.
+    """
+    out = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        name = "/".join(
+            str(getattr(k, "key", getattr(k, "idx", k))) for k in path
+        )
+        out[f"{prefix}/{name}"] = jnp.sqrt(
+            jnp.sum(jnp.square(leaf.astype(jnp.float32)))
+        )
+    return out

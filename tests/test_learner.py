@@ -161,6 +161,48 @@ def test_sl_learner_steps(tmp_path):
     assert np.isfinite(learner.variable_record.get("action_type_acc").avg)
 
 
+def test_sl_learner_save_grad_logs_leaf_norms(tmp_path):
+    """learner.save_grad folds per-parameter grad/param L2 norms into the
+    log (role of the reference's save_grad TB dumps,
+    rl_learner.py:35-47,118-130)."""
+    from distar_tpu.learner import SLLearner
+
+    cfg = {
+        "common": {"experiment_name": "sg", "save_path": str(tmp_path)},
+        "learner": {"batch_size": 4, "unroll_len": 2, "save_freq": 100000,
+                    "log_freq": 1, "save_grad": True},
+        "model": SMALL_MODEL,
+    }
+    learner = SLLearner(cfg)
+    learner.run(max_iterations=1)
+    names = set(learner.variable_record.vars())
+    per_param_grad = [n for n in names if n.startswith("grad_norm/")]
+    per_param_w = [n for n in names if n.startswith("param_norm/")]
+    assert len(per_param_grad) > 10 and len(per_param_grad) == len(per_param_w)
+    for n in per_param_grad[:5] + per_param_w[:5]:
+        assert np.isfinite(learner.variable_record.get(n).avg)
+
+
+@pytest.mark.slow
+def test_rl_learner_save_grad_logs_leaf_norms(tmp_path):
+    """RL wiring of learner.save_grad (both the init jit and the admin
+    config-patch rebuild thread the same kwarg into make_rl_train_step)."""
+    from distar_tpu.learner import RLLearner
+
+    cfg = {
+        "common": {"experiment_name": "sg_rl", "save_path": str(tmp_path)},
+        "learner": {"batch_size": 2, "unroll_len": 2, "save_freq": 100000,
+                    "log_freq": 1, "save_grad": True},
+        "model": SMALL_MODEL,
+    }
+    learner = RLLearner(cfg)
+    learner.run(max_iterations=1)
+    names = set(learner.variable_record.vars())
+    grads = [n for n in names if n.startswith("grad_norm/")]
+    assert len(grads) > 10
+    assert len(grads) == len([n for n in names if n.startswith("param_norm/")])
+
+
 @pytest.mark.slow
 def test_rl_learner_with_value_feature(tmp_path):
     """Centralized-critic path: use_value_feature routes opponent features
