@@ -8,6 +8,7 @@ the reference's stateful-BPTT-across-windows design.
 """
 from __future__ import annotations
 
+import os
 from typing import Any, Dict, Optional
 
 import jax
@@ -38,6 +39,10 @@ SL_LEARNER_DEFAULTS = deep_merge_dicts(
             "label_smooth": 0.0,
             # per-parameter grad/param-norm logging (reference save_grad)
             "save_grad": False,
+            # loss-spike debug snapshots (reference sl_learner debug mode)
+            "debug_loss_spike": False,
+            "debug_spike_factor": 10.0,
+            "debug_spike_warmup": 200,
         },
         "model": {},
     },
@@ -177,7 +182,7 @@ class SLLearner(BaseLearner):
         data = dict(data)  # callers may reuse the batch dict
         on_device = data.pop("_on_device", False)
         new_episodes = np.asarray(data.pop("new_episodes"))
-        data.pop("traj_lens", None)
+        traj_lens = data.pop("traj_lens", None)
         if new_episodes.any():
             # reset hidden state for restarted trajectories (reference
             # sl_learner.py:31-35)
@@ -187,10 +192,88 @@ class SLLearner(BaseLearner):
             data = jax.tree.map(
                 lambda x: jax.device_put(jnp.asarray(x), self._shardings["flat"]), data
             )
+        debug_on = self.cfg.learner.get("debug_loss_spike", False)
+        if debug_on:
+            # the step's exact inputs: batch + post-reset hidden (params are
+            # donated, so a spike's checkpoint is one Adam step past — noted
+            # in the snapshot)
+            pre_step = {
+                "batch": data,
+                "hidden_state": self._hidden,
+                "new_episodes": new_episodes,
+                "traj_lens": traj_lens,
+            }
         params, opt_state, out_state, info = self._train_step(
             self._state["params"], self._state["opt_state"], data, self._hidden
         )
         self._state = {"params": params, "opt_state": opt_state}
         self._hidden = jax.tree.map(jax.lax.stop_gradient, out_state)
         # one batched D2H transfer instead of a round-trip per metric
-        return {k: float(v) for k, v in jax.device_get(info).items()}
+        log = {k: float(v) for k, v in jax.device_get(info).items()}
+        if debug_on:
+            self._loss_spike_guard(log, pre_step)
+        return log
+
+    # snapshots per run: a misbehaving trigger must not flood the disk
+    _DEBUG_DUMP_CAP = 20
+    # EMAs this small are "no signal yet" (masked heads are exactly 0.0 for
+    # batches without those actions) — never treat growth from them as a spike
+    _DEBUG_EMA_FLOOR = 0.01
+
+    def _loss_spike_guard(self, log: Dict[str, float], pre_step: dict) -> None:
+        """Debug mode: EMA-track every loss term; when one spikes past
+        ``debug_spike_factor``× its EMA after ``debug_spike_warmup`` iters —
+        or goes non-finite — save a checkpoint and dump the step's exact
+        inputs (batch, post-reset hidden state, episode boundaries) + log
+        for offline repro (role of the reference SL debug mode,
+        sl_learner.py:55-60: 0.95/0.05 EMA, 10x trigger, iter>200)."""
+        if not hasattr(self, "_debug_ema"):
+            self._debug_ema = {}
+            self._debug_dumps = 0
+        factor = float(self.cfg.learner.get("debug_spike_factor", 10.0))
+        warmup = int(self.cfg.learner.get("debug_spike_warmup", 200))
+        dumped = False
+        for k, v in log.items():
+            if "loss" not in k:
+                continue
+            prev = self._debug_ema.get(k)
+            blown_up = not np.isfinite(v)  # divergence is the headline event
+            spiked = (
+                prev is not None
+                and np.isfinite(prev)
+                and (blown_up or (prev > self._DEBUG_EMA_FLOOR and v > prev * factor))
+            )
+            if (
+                spiked
+                and self.last_iter.val > warmup
+                and not dumped  # one snapshot per iteration is plenty
+                and self._debug_dumps < self._DEBUG_DUMP_CAP
+            ):
+                dumped = True
+                self._debug_dumps += 1
+                self._dump_spike(k, v, prev, log, pre_step)
+            if not blown_up:  # never poison the EMA with inf/nan
+                self._debug_ema[k] = v if prev is None else prev * 0.95 + v * 0.05
+        return
+
+    def _dump_spike(self, key, value, ema, log, pre_step) -> None:
+        from ..comm.serializer import dumps
+
+        os.makedirs(os.path.join(self.save_dir, "debug"), exist_ok=True)
+        path = os.path.join(
+            self.save_dir, "debug",
+            f"{key.replace('/', '_')}_iter_{self.last_iter.val}"
+            f"_rank{self.rank}_{self._debug_dumps}.spike",
+        )
+        with open(path, "wb") as f:
+            f.write(dumps({
+                "key": key, "value": value, "ema": ema, "log": log,
+                **{k: jax.device_get(v) for k, v in pre_step.items()},
+                "note": "params in the companion checkpoint are one "
+                        "optimizer step PAST the spike (donated buffers); "
+                        "batch/hidden_state are the step's exact inputs",
+            }, compress=True))
+        self.save(self.checkpoint_path())
+        self.logger.info(
+            f"loss spike: {key}={value:.4f} (ema {ema:.4f}); snapshot {path}"
+        )

@@ -203,7 +203,69 @@ def test_rl_learner_save_grad_logs_leaf_norms(tmp_path):
     assert len(grads) == len([n for n in names if n.startswith("param_norm/")])
 
 
-@pytest.mark.slow
+def test_sl_loss_spike_guard_snapshots(tmp_path):
+    """debug_loss_spike: a loss term jumping past factor x its EMA (or going
+    non-finite) after warmup dumps the step's exact inputs + a checkpoint
+    (reference SL debug mode, sl_learner.py:55-60)."""
+    import glob
+    import os
+
+    from distar_tpu.comm.serializer import loads
+    from distar_tpu.learner import SLLearner
+
+    cfg = {
+        "common": {"experiment_name": "spike", "save_path": str(tmp_path)},
+        "learner": {"batch_size": 4, "unroll_len": 2, "save_freq": 100000,
+                    "log_freq": 10, "debug_loss_spike": True,
+                    "debug_spike_factor": 10.0, "debug_spike_warmup": 0},
+        "model": SMALL_MODEL,
+    }
+    learner = SLLearner(cfg)
+    learner.run(max_iterations=1)  # primes the EMA from real values
+
+    def spike_files():
+        return glob.glob(os.path.join(str(tmp_path), "debug", "*.spike"))
+
+    pre_step = {"batch": {"x": np.zeros(2)}, "hidden_state": learner._hidden,
+                "new_episodes": np.zeros(4, bool), "traj_lens": None}
+
+    # drive the guard directly with a synthetic 20x spike
+    base = dict(learner._debug_ema)
+    spiked_key = next(k for k in base if "loss" in k and base[k] > 0.01)
+    log = dict(base)
+    log[spiked_key] = base[spiked_key] * 20 + 1.0
+    learner.last_iter.update(5)
+    learner._loss_spike_guard(log, pre_step)
+
+    dumps = spike_files()
+    assert len(dumps) == 1
+    snap = loads(open(dumps[0], "rb").read())
+    assert snap["key"] == spiked_key
+    # the step's exact inputs travel with the snapshot
+    assert "batch" in snap and "hidden_state" in snap and "new_episodes" in snap
+    assert "note" in snap  # params-offset caveat recorded
+    assert os.path.exists(learner.checkpoint_path())
+    # the dump folded the spike into the EMA (0.95/0.05)
+    assert learner._debug_ema[spiked_key] == pytest.approx(
+        base[spiked_key] * 0.95 + log[spiked_key] * 0.05
+    )
+
+    # near-zero EMA (masked heads) must NOT trigger on normal growth
+    learner._debug_ema[spiked_key] = 1e-6
+    learner._loss_spike_guard({spiked_key: 0.5}, pre_step)
+    assert len(spike_files()) == 1
+
+    # a finite -> non-finite transition MUST trigger and not poison the EMA
+    learner._debug_ema[spiked_key] = 2.0
+    learner._loss_spike_guard({spiked_key: float("nan")}, pre_step)
+    assert len(spike_files()) == 2
+    assert learner._debug_ema[spiked_key] == 2.0
+
+    # the dump cap bounds disk usage
+    learner._debug_dumps = learner._DEBUG_DUMP_CAP
+    learner._loss_spike_guard({spiked_key: 1e9}, pre_step)
+    assert len(spike_files()) == 2
+
 def test_rl_learner_with_value_feature(tmp_path):
     """Centralized-critic path: use_value_feature routes opponent features
     through the ValueEncoder into every baseline tower."""
