@@ -16,7 +16,13 @@ from typing import Any, Dict, Iterator, Optional
 import jax
 
 from ..utils import Config, EasyTimer, build_logger, deep_merge_dicts
-from ..utils.checkpoint import CountVar, auto_checkpoint, load_checkpoint, save_checkpoint
+from ..utils.checkpoint import (
+    AsyncCheckpointer,
+    CountVar,
+    auto_checkpoint,
+    load_checkpoint,
+    save_checkpoint,
+)
 from .hooks import HookRegistry, default_hooks
 
 DEFAULT_LEARNER_CONFIG = Config(
@@ -48,6 +54,7 @@ class BaseLearner:
         )
         self.timer = EasyTimer()
         self.last_iter = CountVar(0)
+        self._checkpointer = AsyncCheckpointer()
         self.log_buffer: Dict[str, Any] = {}
         self.hooks: HookRegistry = default_hooks(
             save_freq=self.cfg.learner.save_freq, log_freq=self.cfg.learner.log_freq
@@ -69,10 +76,19 @@ class BaseLearner:
     def checkpoint_path(self) -> str:
         return os.path.join(self.save_dir, "checkpoints", f"iteration_{self.last_iter.val}.ckpt")
 
-    def save(self, path: str) -> None:
-        save_checkpoint(path, self._state, metadata={"last_iter": self.last_iter.val})
+    def save(self, path: str, sync: bool = False) -> None:
+        """Checkpoint the train state. By default (learner.async_save) the
+        serialize+write overlaps training on a background thread; ``sync``
+        forces a durable write before returning (crash/debug paths)."""
+        meta = {"last_iter": self.last_iter.val}
+        if sync or not self.cfg.learner.get("async_save", True):
+            self._checkpointer.wait()  # never race an in-flight async write
+            save_checkpoint(path, self._state, metadata=meta)
+        else:
+            self._checkpointer.save(path, self._state, metadata=meta)
 
     def restore(self, path: str) -> None:
+        self._checkpointer.wait()  # the path may still be being written
         out = load_checkpoint(path, target=self._state)
         self._state = out["state"]
         self.last_iter.update(out["metadata"].get("last_iter", 0))
@@ -110,7 +126,8 @@ class BaseLearner:
         max_iterations = max_iterations or self.cfg.learner.max_iterations
         self._maybe_enable_prefetch()
 
-        @auto_checkpoint(lambda: self.save(self.checkpoint_path()))
+        # crash path writes synchronously: the process may be about to die
+        @auto_checkpoint(lambda: self.save(self.checkpoint_path(), sync=True))
         def _run():
             self.hooks.call("before_run", self)
             while self.last_iter.val < max_iterations:
@@ -127,3 +144,4 @@ class BaseLearner:
             self.hooks.call("after_run", self)
 
         _run()
+        self._checkpointer.wait()  # drain the async writer before returning

@@ -388,7 +388,8 @@ class RLLearner(BaseLearner):
         if getattr(self, "_pending_save", False):
             self._pending_save = False
             path = self.checkpoint_path()
-            self.save(path)
+            # an operator asked for this one: durable before we log "saved"
+            self.save(path, sync=True)
             self.logger.info(f"admin checkpoint saved: {path}")
         if getattr(self, "_pending_value_reset", False):
             self._pending_value_reset = False

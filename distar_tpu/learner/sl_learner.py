@@ -273,7 +273,7 @@ class SLLearner(BaseLearner):
                         "optimizer step PAST the spike (donated buffers); "
                         "batch/hidden_state are the step's exact inputs",
             }, compress=True))
-        self.save(self.checkpoint_path())
+        self.save(self.checkpoint_path(), sync=True)  # debug artifacts are durable
         self.logger.info(
             f"loss spike: {key}={value:.4f} (ema {ema:.4f}); snapshot {path}"
         )

@@ -39,17 +39,79 @@ class CountVar:
         self._value = int(value)
 
 
-def save_checkpoint(path: str, state: Any, metadata: Optional[Dict] = None) -> str:
-    """Serialise a pytree (host-transferred) to ``path`` (msgpack)."""
+def _host_snapshot(state: Any):
+    """Device->host copy of a pytree: the only part of a save that must
+    happen before donated buffers are reused by the next train step."""
+    return jax.tree.map(lambda x: np.asarray(x) if hasattr(x, "shape") else x, state)
+
+
+def _write_checkpoint(path: str, host_state: Any, metadata: Optional[Dict]) -> str:
+    import threading
+
     os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
-    host_state = jax.tree.map(lambda x: np.asarray(x) if hasattr(x, "shape") else x, state)
     payload = {"state": host_state, "metadata": metadata or {}}
     blob = serialization.msgpack_serialize(_to_serialisable(payload))
-    tmp = path + ".tmp"
+    # unique tmp: a crash-path sync save can race an in-flight async writer
+    # on the same target; distinct tmps + atomic replace keep both complete
+    tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
     with open(tmp, "wb") as f:
         f.write(blob)
     os.replace(tmp, path)
     return path
+
+
+def save_checkpoint(path: str, state: Any, metadata: Optional[Dict] = None) -> str:
+    """Serialise a pytree (host-transferred) to ``path`` (msgpack)."""
+    return _write_checkpoint(path, _host_snapshot(state), metadata)
+
+
+class AsyncCheckpointer:
+    """Overlap checkpoint serialization + disk IO with training.
+
+    TPU-first divergence from the reference's synchronous torch.save in the
+    hot loop (checkpoint_helper.py:125-140): ``save`` snapshots the pytree
+    to host memory synchronously (cheap D2H; required before the next step
+    reuses the donated buffers), then a single background thread does the
+    msgpack serialize + atomic write. At most one save is in flight — a new
+    save first joins the previous one, bounding extra host memory to one
+    checkpoint copy and keeping file ordering. ``wait()`` drains (call it
+    at run end and before any load of a path that may still be writing).
+    """
+
+    def __init__(self):
+        self._thread = None
+        self._error: Optional[BaseException] = None
+
+    def save(self, path: str, state: Any, metadata: Optional[Dict] = None) -> str:
+        import threading
+
+        # join BEFORE snapshotting: at most one host copy exists at a time
+        # (this also surfaces any previous write failure loudly)
+        self.wait()
+        host_state = _host_snapshot(state)
+
+        def _write():
+            try:
+                _write_checkpoint(path, host_state, metadata)
+            except BaseException as e:  # surfaced by the next wait()/save()
+                self._error = e
+
+        t = threading.Thread(target=_write, name="async-ckpt-writer", daemon=True)
+        # start before publishing: a signal handler's sync save between the
+        # two statements joins the previous (finished) thread, never an
+        # unstarted one
+        t.start()
+        self._thread = t
+        return path
+
+    def wait(self) -> None:
+        t = self._thread
+        if t is not None:
+            t.join()
+            self._thread = None
+        if self._error is not None:
+            err, self._error = self._error, None
+            raise RuntimeError("async checkpoint write failed") from err
 
 
 def load_checkpoint(path: str, target: Any = None) -> Dict:

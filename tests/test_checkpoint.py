@@ -1,0 +1,109 @@
+"""Checkpoint save/load + the async writer (previously covered only
+indirectly through learner/pipeline tests)."""
+import os
+import threading
+
+import numpy as np
+
+from distar_tpu.utils.checkpoint import (
+    AsyncCheckpointer,
+    load_checkpoint,
+    save_checkpoint,
+)
+
+
+def _state(v=1.0):
+    return {"params": {"w": np.full((4, 4), v), "b": np.zeros(4)},
+            "step": np.asarray(3)}
+
+
+def test_roundtrip(tmp_path):
+    path = str(tmp_path / "c.ckpt")
+    save_checkpoint(path, _state(2.0), metadata={"last_iter": 7})
+    out = load_checkpoint(path)
+    assert out["metadata"]["last_iter"] == 7
+    np.testing.assert_array_equal(out["state"]["params"]["w"], np.full((4, 4), 2.0))
+
+
+def test_partial_restore_keeps_missing_and_drops_extra(tmp_path):
+    path = str(tmp_path / "c.ckpt")
+    save_checkpoint(path, {"params": {"w": np.ones(2), "legacy": np.zeros(1)}})
+    target = {"params": {"w": np.zeros(2), "new_head": np.full(3, 9.0)}}
+    out = load_checkpoint(path, target=target)
+    np.testing.assert_array_equal(out["state"]["params"]["w"], np.ones(2))
+    # missing leaf keeps the target's value; the checkpoint's extra is dropped
+    np.testing.assert_array_equal(out["state"]["params"]["new_head"], np.full(3, 9.0))
+    assert "legacy" not in out["state"]["params"]
+
+
+def test_async_checkpointer_roundtrip_and_ordering(tmp_path):
+    path = str(tmp_path / "a.ckpt")
+    ck = AsyncCheckpointer()
+    # back-to-back saves: the second must observe the first's completion
+    ck.save(path, _state(1.0), metadata={"last_iter": 1})
+    ck.save(path, _state(5.0), metadata={"last_iter": 2})
+    ck.wait()
+    out = load_checkpoint(path)
+    assert out["metadata"]["last_iter"] == 2
+    np.testing.assert_array_equal(out["state"]["params"]["w"], np.full((4, 4), 5.0))
+    ck.wait()  # idempotent
+
+
+def test_async_checkpointer_snapshots_before_mutation(tmp_path):
+    """save() must copy to host before returning: mutating the source array
+    afterwards must not corrupt the written checkpoint."""
+    path = str(tmp_path / "m.ckpt")
+    ck = AsyncCheckpointer()
+    live = {"w": np.ones(8)}
+    ck.save(path, live)
+    live["w"][:] = -1.0  # the 'next train step' reusing the buffer
+    ck.wait()
+    out = load_checkpoint(path)
+    np.testing.assert_array_equal(out["state"]["w"], np.ones(8))
+
+
+def test_async_checkpointer_overlaps_writer(tmp_path, monkeypatch):
+    """The writer runs off-thread: save() returns while the (gated) write
+    is still pending, and wait() observes its completion."""
+    from distar_tpu.utils import checkpoint as ckpt_mod
+
+    gate = threading.Event()
+    wrote = []
+    real = ckpt_mod._write_checkpoint
+
+    def gated(path, host_state, metadata):
+        assert gate.wait(10), "test gate never opened"
+        wrote.append(path)
+        return real(path, host_state, metadata)
+
+    monkeypatch.setattr(ckpt_mod, "_write_checkpoint", gated)
+    path = str(tmp_path / "big.ckpt")
+    ck = AsyncCheckpointer()
+    ck.save(path, _state(3.0))
+    # save() returned while the writer is blocked on the gate: true overlap
+    assert wrote == [] and not os.path.exists(path)
+    gate.set()
+    ck.wait()
+    assert wrote == [path] and os.path.exists(path)
+
+
+def test_async_checkpointer_surfaces_writer_errors(tmp_path, monkeypatch):
+    """A failed background write must raise loudly at the next wait()/save(),
+    never be silently swallowed (a learner believing checkpoints exist)."""
+    import pytest
+
+    from distar_tpu.utils import checkpoint as ckpt_mod
+
+    def boom(path, host_state, metadata):
+        raise OSError("disk full")
+
+    monkeypatch.setattr(ckpt_mod, "_write_checkpoint", boom)
+    path = str(tmp_path / "fail.ckpt")
+    ck = AsyncCheckpointer()
+    ck.save(path, _state())
+    with pytest.raises(RuntimeError, match="async checkpoint write failed"):
+        ck.wait()
+    # the error is consumed: the checkpointer is usable again
+    monkeypatch.setattr(ckpt_mod, "_write_checkpoint", lambda p, s, m: None)
+    ck.save(path, _state())
+    ck.wait()
