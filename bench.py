@@ -330,7 +330,10 @@ def run_child():
     # persistent compile cache: the flagship train step is expensive to
     # compile; retries and later rounds must not pay it again
     try:
-        jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache_distar_tpu_bench")
+        jax.config.update(
+            "jax_compilation_cache_dir",
+            os.environ.get("BENCH_COMPILE_CACHE", "/tmp/jax_cache_distar_tpu_bench"),
+        )
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     except Exception:
         pass
@@ -533,6 +536,8 @@ def main():
     attempt = 0
     while time.monotonic() < deadline - 30:
         attempt += 1
+        # judge each child on its own progress, not its predecessor's
+        last_stage[0] = "(no stage reached)"
         child_env = dict(os.environ)
         # respect an explicit user budget; otherwise hand the child what's
         # left of the parent deadline so its sweep self-limits
@@ -555,9 +560,17 @@ def main():
         try:
             proc.wait(timeout=max(5.0, min(attempt_timeout, deadline - time.monotonic())))
         except subprocess.TimeoutExpired:
-            if last_result[0] is not None:
-                # the child already landed a number — it's working, not
-                # stuck; let it use the rest of the deadline for the sweep
+            # a child stuck in the chip claim should die fast (a FRESH claim
+            # sometimes lands where the stuck one never will) — but one that
+            # is past backend-init is tracing/compiling: killing it mid-
+            # compile caches nothing and the retry repeats the same compile
+            # (livelock). Let progressing children use the whole deadline.
+            stuck = last_result[0] is None and (
+                last_stage[0] == "(no stage reached)"
+                or "import-jax" in last_stage[0]
+                or "backend-init" in last_stage[0]
+            )
+            if not stuck:
                 try:
                     proc.wait(timeout=max(5.0, deadline - time.monotonic()))
                 except subprocess.TimeoutExpired:

@@ -3,7 +3,8 @@
 Three rounds of BENCH_r{N} artifacts died to harness bugs, not model bugs —
 so the sweep/retry/emit logic gets direct coverage: the _bench_* measurement
 functions are monkeypatched and run_child exercised in-process on the CPU
-backend. No model is built; these are fast.
+backend (fast), plus one slow-marked subprocess test that builds the real
+model to prove the parent never kills a compiling child (the livelock).
 """
 import json
 import os
@@ -144,3 +145,33 @@ def test_headline_modes(monkeypatch, capsys):
     assert "RL learner" in final["metric"]
     assert final["value"] == 64.0
     assert final["rl"]["vs_baseline_frames"] == round(64.0 / bench.RL_BASELINE_FRAMES, 3)
+
+
+@pytest.mark.slow
+def test_parent_extends_attempt_past_compile(tmp_path):
+    """A child past backend-init must not be killed at BENCH_ATTEMPT_TIMEOUT:
+    killing mid-compile caches nothing and the retry repeats the same
+    compile forever (the BENCH_r01-r03 livelock). With an attempt timeout
+    far shorter than trace+compile, the sweep must still land a number."""
+    import json as _json
+    import subprocess
+    import sys as _sys
+
+    env = dict(os.environ)
+    env.update(
+        BENCH_PLATFORM="cpu", BENCH_MODE="sl", BENCH_BATCH="2",
+        BENCH_UNROLL="4", BENCH_DEADLINE="420", BENCH_ATTEMPT_TIMEOUT="10",
+        # fresh compile cache: a warm shared cache would finish under the
+        # attempt timeout and silently stop exercising the extend logic
+        BENCH_COMPILE_CACHE=str(tmp_path / "jax_cache"),
+    )
+    out = subprocess.run(
+        [_sys.executable, "-u",
+         os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                      "bench.py")],
+        env=env, capture_output=True, text=True, timeout=430,
+    )
+    lines = [l for l in out.stdout.splitlines() if l.startswith("{")]
+    assert lines, out.stderr[-500:]
+    final = _json.loads(lines[-1])
+    assert final["value"] > 0, final
