@@ -1,0 +1,113 @@
+"""Direct unit tests for StopWatch and the learner hook registry
+(previously exercised only through full learner runs; EasyTimer has a
+basic check in test_utils.py — here it gets the reuse semantics)."""
+import time
+import types
+
+import pytest
+
+from distar_tpu.learner.hooks import (
+    Hook,
+    HookRegistry,
+    LambdaHook,
+    LoadCkptHook,
+    SaveCkptHook,
+)
+from distar_tpu.utils.timing import EasyTimer, StopWatch
+
+
+# ------------------------------------------------------------------ timing
+def test_easy_timer_measures_block():
+    t = EasyTimer()
+    with t:
+        time.sleep(0.02)
+    first = t.value
+    assert first > 0.015
+    with t:  # reusable; value overwritten
+        pass
+    assert t.value < first  # empty block must re-measure, not accumulate
+
+
+def test_stopwatch_disabled_records_nothing():
+    sw = StopWatch(enabled=False)
+    with sw("phase"):
+        time.sleep(0.005)
+    assert sw.times == {} and sw.summary() == {}
+
+
+def test_stopwatch_enabled_accumulates_and_summarises():
+    sw = StopWatch(enabled=True)
+    for _ in range(3):
+        with sw("step"):
+            time.sleep(0.003)
+
+    @sw.decorate("fn")
+    def work(x):
+        return x + 1
+
+    assert work(1) == 2
+    s = sw.summary()
+    assert s["step"]["num"] == 3
+    assert s["step"]["sum"] >= 0.009
+    assert s["step"]["avg"] == pytest.approx(s["step"]["sum"] / 3)
+    assert s["fn"]["num"] == 1
+
+
+# ------------------------------------------------------------------- hooks
+def _fake_learner(iter_val=0):
+    learner = types.SimpleNamespace()
+    learner.last_iter = types.SimpleNamespace(val=iter_val)
+    learner.calls = []
+    return learner
+
+
+def test_registry_orders_by_priority_and_respects_freq():
+    reg = HookRegistry()
+    order = []
+    reg.add(LambdaHook("b", "after_iter", lambda l: order.append("b"), priority=60))
+    reg.add(LambdaHook("a", "after_iter", lambda l: order.append("a"), priority=10))
+    reg.add(LambdaHook("c", "after_iter", lambda l: order.append("c"),
+                       priority=30, freq=2))
+    learner = _fake_learner(iter_val=1)
+    reg.call("after_iter", learner)
+    assert order == ["a", "b"]  # freq=2 hook skipped on odd iter
+    order.clear()
+    learner.last_iter.val = 2
+    reg.call("after_iter", learner)
+    assert order == ["a", "c", "b"]  # priority order, freq hook included
+
+
+def test_registry_freq_only_gates_iter_positions():
+    reg = HookRegistry()
+    ran = []
+    reg.add(LambdaHook("r", "before_run", lambda l: ran.append(1), freq=1000))
+    reg.call("before_run", _fake_learner(iter_val=1))
+    assert ran == [1]  # run-positions ignore freq
+
+
+def test_hook_position_validated():
+    with pytest.raises(AssertionError):
+        Hook("x", "mid_iter")
+
+
+def test_save_hook_rank_gated(tmp_path):
+    learner = _fake_learner(iter_val=5)
+    learner.rank = 1
+    saved = []
+    learner.save = lambda p: saved.append(p)
+    learner.checkpoint_path = lambda: str(tmp_path / "c.ckpt")
+    SaveCkptHook()(learner)
+    assert saved == []  # only rank 0 writes
+    learner.rank = 0
+    learner.logger = types.SimpleNamespace(info=lambda *a, **k: None)
+    SaveCkptHook()(learner)
+    assert saved == [str(tmp_path / "c.ckpt")]
+
+
+def test_load_hook_ignores_missing_path(tmp_path):
+    learner = _fake_learner()
+    learner.cfg = types.SimpleNamespace(
+        learner={"load_path": str(tmp_path / "nope.ckpt")}
+    )
+    learner.restore = lambda p: (_ for _ in ()).throw(AssertionError("called"))
+    LoadCkptHook()(learner)  # missing file: no restore attempt
