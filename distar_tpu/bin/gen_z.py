@@ -91,7 +91,7 @@ def demo_episodes(n: int = 8, seed: int = 0):
     return eps
 
 
-def main() -> None:
+def main(argv=None) -> None:
     p = argparse.ArgumentParser()
     p.add_argument("--input", default="", help="episodes JSONL")
     p.add_argument("--replays", default="", help="directory of .SC2Replay files")
@@ -99,7 +99,7 @@ def main() -> None:
     p.add_argument("--min-winloss", type=int, default=1)
     p.add_argument("--min-mmr", type=int, default=0)
     p.add_argument("--demo", action="store_true")
-    args = p.parse_args()
+    args = p.parse_args(argv)
 
     if args.demo:
         episodes = demo_episodes()
