@@ -328,15 +328,24 @@ def run_child():
     import jax
 
     # persistent compile cache: the flagship train step is expensive to
-    # compile; retries and later rounds must not pay it again
-    try:
-        jax.config.update(
-            "jax_compilation_cache_dir",
-            os.environ.get("BENCH_COMPILE_CACHE", "/tmp/jax_cache_distar_tpu_bench"),
-        )
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-    except Exception:
-        pass
+    # compile; retries and later rounds must not pay it again. NOT when
+    # called in-process from pytest: the harness tests must not repoint the
+    # suite's live cache config mid-run (global jax state). A bench.py
+    # SUBPROCESS spawned by a pytest-descended parent has its own jax state
+    # and must still configure (argv distinguishes the two).
+    in_pytest_process = (
+        "PYTEST_CURRENT_TEST" in os.environ
+        and os.path.basename(sys.argv[0]) != "bench.py"
+    )
+    if not in_pytest_process:
+        try:
+            jax.config.update(
+                "jax_compilation_cache_dir",
+                os.environ.get("BENCH_COMPILE_CACHE", "/tmp/jax_cache_distar_tpu_bench"),
+            )
+            jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        except Exception:
+            pass
     if os.environ.get("BENCH_PLATFORM"):
         # for CPU smoke tests of the harness itself: the image's
         # sitecustomize pins the platform via jax.config, so the

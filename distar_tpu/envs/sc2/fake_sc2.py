@@ -353,6 +353,15 @@ class FakeSC2Server:
 
     def stop(self) -> None:
         self._stop.set()
+        # closing an fd does NOT wake a thread blocked in accept() on Linux;
+        # poke the listener so the loop observes _stop and exits instead of
+        # parking forever as a leaked daemon thread
+        poke_host = self.host if self.host not in ("0.0.0.0", "") else "127.0.0.1"
+        try:
+            with socket.create_connection((poke_host, self.port), timeout=1):
+                pass
+        except OSError:
+            pass
         try:
             self._listener.close()
         except OSError:
@@ -363,6 +372,9 @@ class FakeSC2Server:
             try:
                 sock, _ = self._listener.accept()
             except OSError:
+                return
+            if self._stop.is_set():  # stop()'s wake-up poke, not a client
+                sock.close()
                 return
             t = threading.Thread(target=self._serve_client, args=(sock,), daemon=True)
             t.start()
