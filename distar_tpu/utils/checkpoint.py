@@ -40,9 +40,13 @@ class CountVar:
 
 
 def _host_snapshot(state: Any):
-    """Device->host copy of a pytree: the only part of a save that must
-    happen before donated buffers are reused by the next train step."""
-    return jax.tree.map(lambda x: np.asarray(x) if hasattr(x, "shape") else x, state)
+    """Device->host COPY of a pytree: the only part of a save that must
+    happen before donated buffers are reused by the next train step.
+
+    np.array (not np.asarray): asarray aliases numpy inputs and can alias
+    CPU-backend jax buffers — a snapshot that shares memory with donated
+    state is silently corrupted by the next step."""
+    return jax.tree.map(lambda x: np.array(x) if hasattr(x, "shape") else x, state)
 
 
 def _write_checkpoint(path: str, host_state: Any, metadata: Optional[Dict]) -> str:

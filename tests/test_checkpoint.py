@@ -12,6 +12,22 @@ from distar_tpu.utils.checkpoint import (
 )
 
 
+def _gated_writer(monkeypatch):
+    """Monkeypatch the module-level writer behind an Event gate; returns the
+    gate so a test can hold the write pending deterministically."""
+    from distar_tpu.utils import checkpoint as ckpt_mod
+
+    gate = threading.Event()
+    real = ckpt_mod._write_checkpoint
+
+    def gated(path, host_state, metadata):
+        assert gate.wait(10), "test gate never opened"
+        return real(path, host_state, metadata)
+
+    monkeypatch.setattr(ckpt_mod, "_write_checkpoint", gated)
+    return gate
+
+
 def _state(v=1.0):
     return {"params": {"w": np.full((4, 4), v), "b": np.zeros(4)},
             "step": np.asarray(3)}
@@ -49,14 +65,19 @@ def test_async_checkpointer_roundtrip_and_ordering(tmp_path):
     ck.wait()  # idempotent
 
 
-def test_async_checkpointer_snapshots_before_mutation(tmp_path):
-    """save() must copy to host before returning: mutating the source array
-    afterwards must not corrupt the written checkpoint."""
+def test_async_checkpointer_snapshots_before_mutation(tmp_path, monkeypatch):
+    """save() must COPY to host before returning: mutating the source array
+    afterwards must not corrupt the written checkpoint (np.asarray would
+    alias the live buffer — the donated-buffer corruption this API exists
+    to prevent). The writer is gated so the mutation deterministically
+    happens while the write is still pending."""
+    gate = _gated_writer(monkeypatch)
     path = str(tmp_path / "m.ckpt")
     ck = AsyncCheckpointer()
     live = {"w": np.ones(8)}
     ck.save(path, live)
     live["w"][:] = -1.0  # the 'next train step' reusing the buffer
+    gate.set()
     ck.wait()
     out = load_checkpoint(path)
     np.testing.assert_array_equal(out["state"]["w"], np.ones(8))
@@ -65,18 +86,18 @@ def test_async_checkpointer_snapshots_before_mutation(tmp_path):
 def test_async_checkpointer_overlaps_writer(tmp_path, monkeypatch):
     """The writer runs off-thread: save() returns while the (gated) write
     is still pending, and wait() observes its completion."""
+    gate = _gated_writer(monkeypatch)
+    wrote = []
     from distar_tpu.utils import checkpoint as ckpt_mod
 
-    gate = threading.Event()
-    wrote = []
-    real = ckpt_mod._write_checkpoint
+    inner = ckpt_mod._write_checkpoint  # the gated wrapper
 
-    def gated(path, host_state, metadata):
-        assert gate.wait(10), "test gate never opened"
+    def recording(path, host_state, metadata):
+        r = inner(path, host_state, metadata)
         wrote.append(path)
-        return real(path, host_state, metadata)
+        return r
 
-    monkeypatch.setattr(ckpt_mod, "_write_checkpoint", gated)
+    monkeypatch.setattr(ckpt_mod, "_write_checkpoint", recording)
     path = str(tmp_path / "big.ckpt")
     ck = AsyncCheckpointer()
     ck.save(path, _state(3.0))
