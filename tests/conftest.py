@@ -32,3 +32,16 @@ import pytest
 @pytest.fixture
 def rng():
     return np.random.default_rng(0)
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _bound_compiled_program_accumulation():
+    """Drop compiled-executable caches at each module boundary.
+
+    A single long-lived process accumulating a few hundred XLA-CPU
+    executables segfaulted inside backend_compile_and_load (deterministic
+    at the same test, twice, near the end of a serial full-suite run).
+    Clearing per-module bounds native accumulation; the persistent disk
+    cache keeps cross-module recompiles cheap."""
+    yield
+    jax.clear_caches()

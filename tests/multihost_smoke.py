@@ -98,7 +98,7 @@ def main() -> int:
         )
         for r in range(2)
     ]
-    rcs = [p.wait(timeout=300) for p in procs]
+    rcs = [p.wait(timeout=600) for p in procs]
     if any(rcs):
         print(f"multihost smoke FAILED: rcs={rcs}")
         return 1
