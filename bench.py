@@ -145,6 +145,10 @@ def _env_truthy(name):
     return os.environ.get(name, "").lower() in ("1", "true", "yes")
 
 
+def _env_entity_cap():
+    return int(os.environ.get("BENCH_MAX_ENTITIES", 0)) or None
+
+
 def _bench_model_cfg():
     """Flagship model config for the bench: bf16 on the MXU, with the hot-op
     implementations switchable for on-silicon A/B
@@ -180,16 +184,26 @@ def _bench_sl(batch_size, unroll_len, peak, iters=4, remat=False):
             "unroll_len": unroll_len,
             "save_freq": 10 ** 9,
             "log_freq": 10 ** 9,
+            # pad-to-bucket entity cap (learner/data.cap_entities): the
+            # entity transformer + pointer decode are O(N^2)/O(N) in the
+            # PADDED count; real frames rarely exceed ~300 entities
+            "max_entities": _env_entity_cap(),
         },
         # bfloat16 matmuls/convs on the MXU (params stay f32)
         "model": model_cfg,
     }
-    label = f"b{batch_size}xt{unroll_len}" + ("-remat" if remat else "")
+    cap = cfg["learner"]["max_entities"]
+    label = (
+        f"b{batch_size}xt{unroll_len}"
+        + ("-remat" if remat else "")
+        + (f"-e{cap}" if cap else "")
+    )
     _stage(f"sl-init {label}")
     learner = SLLearner(cfg)
     data = dict(next(learner._dataloader))
     data.pop("new_episodes", None)
     data.pop("traj_lens", None)
+    data = learner._cap(data)  # the MEASURED batch must carry the cap too
     batch = jax.tree.map(jax.numpy.asarray, data)
     args = (learner.state["params"], learner.state["opt_state"], batch, learner._hidden)
 
@@ -205,6 +219,8 @@ def _bench_sl(batch_size, unroll_len, peak, iters=4, remat=False):
     point.update(batch=batch_size, unroll=unroll_len)
     if remat:
         point["remat"] = True
+    if cap:
+        point["max_entities"] = cap
     del learner
     return point
 
@@ -225,7 +241,8 @@ def _bench_sl_real(batch_size, unroll_len, peak, iters=6):
     from distar_tpu.learner.hooks import LambdaHook
     from distar_tpu.learner.sl_dataloader import ReplayDataset, SLDataloader, make_fake_dataset
 
-    label = f"b{batch_size}xt{unroll_len}"
+    cap = _env_entity_cap()
+    label = f"b{batch_size}xt{unroll_len}" + (f"-e{cap}" if cap else "")
     _stage(f"sl-real-dataset {label}")
     root = tempfile.mkdtemp(prefix="bench_sl_realdata_")
     try:
@@ -243,6 +260,7 @@ def _bench_sl_real(batch_size, unroll_len, peak, iters=6):
                 "save_freq": 10 ** 9,
                 "log_freq": 10 ** 9,
                 "prefetch_depth": 2,
+                "max_entities": _env_entity_cap(),
             },
             "model": _bench_model_cfg(),
         }
@@ -275,6 +293,8 @@ def _bench_sl_real(batch_size, unroll_len, peak, iters=6):
             "unroll": unroll_len,
             "iters_measured": len(times["train"][keep]),
         }
+        if cap:
+            point["max_entities"] = cap
         del learner
         return point
     finally:

@@ -205,3 +205,49 @@ class FakeSLDataloader:
 
     def __next__(self) -> Dict:
         return fake_sl_batch(self._batch_size, self._unroll_len, rng=self._rng)
+
+
+def cap_entities(batch: Dict, n: int) -> Dict:
+    """Slice a (host) SL batch's entity axis to the first ``n`` slots.
+
+    The pad-to-bucket throughput lever (SURVEY §7 hard part 5): the entity
+    transformer and pointer decode are O(N^2)/O(N) in the PADDED entity
+    count, and real decoded frames rarely exceed ~300 entities, so training
+    at the reference's MAX_ENTITY_NUM=512 pad wastes most of the set-
+    attention FLOPs. Every model shape derives from the input, and padded
+    rows are masked out of every reduction, so for samples with
+    entity_num <= n the sliced batch is numerically EXACT (tested).
+
+    Samples above the cap follow the reference's own cap semantics
+    (truncate at the ceiling), with affected heads masked out of the loss
+    rather than mislabeled: entity_num clamps to n, end-token labels remap
+    to the new end slot, and any selected_units/target_unit label that
+    referenced a dropped entity zeroes that head's action_mask for the
+    step (no loss contribution).
+    """
+    entity_info = {k: v[:, :n] for k, v in batch["entity_info"].items()}
+    old_num = np.asarray(batch["entity_num"])
+    new_num = np.minimum(old_num, n)
+
+    ai = dict(batch["action_info"])
+    am = dict(batch["action_mask"])
+    su = np.asarray(ai["selected_units"])
+    was_end = su == old_num[..., None]
+    dropped = (su >= new_num[..., None]) & ~was_end
+    ai["selected_units"] = np.where(was_end | dropped, new_num[..., None], su)
+    su_mask = np.asarray(am["selected_units"])
+    am["selected_units"] = np.where(dropped.any(-1), 0.0, su_mask).astype(su_mask.dtype)
+
+    tu = np.asarray(ai["target_unit"])
+    tu_bad = tu >= new_num
+    ai["target_unit"] = np.where(tu_bad, 0, tu)
+    tu_mask = np.asarray(am["target_unit"])
+    am["target_unit"] = np.where(tu_bad, 0.0, tu_mask).astype(tu_mask.dtype)
+
+    return dict(
+        batch,
+        entity_info=entity_info,
+        entity_num=new_num,
+        action_info=ai,
+        action_mask=am,
+    )

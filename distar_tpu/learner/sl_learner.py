@@ -39,6 +39,8 @@ SL_LEARNER_DEFAULTS = deep_merge_dicts(
             "label_smooth": 0.0,
             # per-parameter grad/param-norm logging (reference save_grad)
             "save_grad": False,
+            # pad-to-bucket entity cap (throughput; see data.cap_entities)
+            "max_entities": None,
             # loss-spike debug snapshots (reference sl_learner debug mode)
             "debug_loss_spike": False,
             "debug_spike_factor": 10.0,
@@ -129,6 +131,9 @@ class SLLearner(BaseLearner):
             clip=GradClipConfig(**lc.grad_clip),
         )
         batch = next(self._dataloader)
+        batch.pop("new_episodes", None)
+        batch.pop("traj_lens", None)
+        batch = self._cap(batch)  # init at the capped shape: one compile, not two
         batch = jax.tree.map(jnp.asarray, batch)
 
         def init_fn(rng, spatial, entity, scalar, entity_num, action, sun, hidden):
@@ -167,9 +172,17 @@ class SLLearner(BaseLearner):
             out_shardings=(param_sh, opt_sh, flat_sh, repl),
         )
 
+    def _cap(self, data):
+        n = self.cfg.learner.get("max_entities")
+        if n:
+            from .data import cap_entities
+
+            data = cap_entities(data, int(n))
+        return data
+
     def _place_batch(self, data):
         """Prefetch placement: device-put ahead of time, host fields kept."""
-        data = dict(data)
+        data = self._cap(dict(data))
         host = {k: np.asarray(data.pop(k)) for k in ("new_episodes", "traj_lens") if k in data}
         out = jax.tree.map(
             lambda x: jax.device_put(jnp.asarray(x), self._shardings["flat"]), data
@@ -181,6 +194,8 @@ class SLLearner(BaseLearner):
     def _train(self, data) -> Dict[str, Any]:
         data = dict(data)  # callers may reuse the batch dict
         on_device = data.pop("_on_device", False)
+        if not on_device:
+            data = self._cap(data)
         new_episodes = np.asarray(data.pop("new_episodes"))
         traj_lens = data.pop("traj_lens", None)
         if new_episodes.any():

@@ -5,6 +5,25 @@ import pytest
 from distar_tpu.learner.sl_dataloader import ReplayDataset, SLDataloader, make_fake_dataset
 from distar_tpu.lib.z_library import ZLibrary, build_z_library, save_z_library, z_entry_to_target
 
+SMALL_MODEL = {
+    "encoder": {
+        "entity": {"layer_num": 1, "hidden_dim": 32, "output_dim": 16, "head_dim": 8},
+        "spatial": {"down_channels": [4, 4, 8], "project_dim": 4, "resblock_num": 1, "fc_dim": 16},
+        "scatter": {"output_dim": 4},
+        "core_lstm": {"hidden_size": 32, "num_layers": 1},
+    },
+    "policy": {
+        "action_type_head": {"res_dim": 16, "res_num": 1, "gate_dim": 32},
+        "delay_head": {"decode_dim": 16},
+        "queued_head": {"decode_dim": 16},
+        "selected_units_head": {"func_dim": 16},
+        "target_unit_head": {"func_dim": 16},
+        "location_head": {"res_dim": 8, "res_num": 1, "upsample_dims": [4, 4, 1], "map_skip_dim": 8},
+    },
+    "value": {"res_dim": 8, "res_num": 1},
+}
+
+
 
 def test_dataset_roundtrip(tmp_path):
     ds = make_fake_dataset(str(tmp_path), n_trajectories=2, steps_per_traj=6)
@@ -162,3 +181,79 @@ def test_z_entry_types():
     entry = [[1, 2], [3], [0, 0], 500, 3]  # z_type 3: both rewards off
     z = z_entry_to_target(entry)
     assert not z["use_bo_reward"] and not z["use_cum_reward"]
+
+
+def test_cap_entities_exact_below_cap(tmp_path):
+    """The pad-to-bucket cap (learner.max_entities) is numerically exact for
+    samples within the cap: same data trained with 512 padding and with the
+    entity axis sliced to 256 yields the same loss grid (padded rows are
+    masked out of every reduction; all model shapes derive from inputs)."""
+    import jax
+
+    from distar_tpu.learner import SLLearner
+    from distar_tpu.learner.data import cap_entities, fake_sl_batch
+
+    rng = np.random.default_rng(7)
+    batch = fake_sl_batch(4, 2, rng=rng)
+    # keep every sample within the bucket (end tokens land at entity_num)
+    batch["entity_num"] = np.minimum(batch["entity_num"], 250)
+    su = batch["action_info"]["selected_units"]
+    batch["action_info"]["selected_units"] = np.minimum(
+        su, batch["entity_num"][..., None]
+    )
+    batch["action_info"]["target_unit"] = np.minimum(
+        batch["action_info"]["target_unit"],
+        np.maximum(batch["entity_num"] - 1, 0),
+    )
+    batch["new_episodes"] = np.zeros(4, bool)
+
+    cfg = {
+        "common": {"experiment_name": "cap", "save_path": str(tmp_path)},
+        "learner": {"batch_size": 4, "unroll_len": 2, "save_freq": 100000,
+                    "log_freq": 10 ** 9},
+        "model": SMALL_MODEL,
+    }
+    logs = {}
+    for name, max_e in (("full", None), ("capped", 256)):
+        c = dict(cfg, learner=dict(cfg["learner"], max_entities=max_e),
+                 common=dict(cfg["common"], experiment_name=f"cap_{name}"))
+        learner = SLLearner(c)
+        logs[name] = learner._train(dict(batch))
+        if max_e:
+            shapes = {k: v.shape for k, v in cap_entities(batch, 256)["entity_info"].items()}
+            assert all(s[1] == 256 for s in shapes.values())
+    for k in logs["full"]:
+        np.testing.assert_allclose(
+            logs["full"][k], logs["capped"][k], rtol=2e-4, atol=2e-4,
+            err_msg=f"loss term {k} diverged under the entity cap",
+        )
+
+
+def test_cap_entities_masks_out_overflow():
+    """Samples ABOVE the cap: entity_num clamps, end tokens remap, and any
+    label referencing a dropped entity zeroes that head's mask."""
+    from distar_tpu.learner.data import cap_entities, fake_sl_batch
+
+    batch = fake_sl_batch(2, 1, rng=np.random.default_rng(3))
+    batch["entity_num"] = np.asarray([300, 100], np.int64)
+    su = np.zeros_like(batch["action_info"]["selected_units"])
+    su[0, 0] = 280   # dropped under cap 256
+    su[0, 1] = 300   # old end token
+    su[1, :] = 100   # end token, within cap
+    batch["action_info"]["selected_units"] = su
+    batch["action_info"]["target_unit"] = np.asarray([280, 5])
+    batch["action_mask"]["selected_units"] = np.ones(2, np.float32)
+    batch["action_mask"]["target_unit"] = np.ones(2, np.float32)
+
+    out = cap_entities(batch, 256)
+    assert list(out["entity_num"]) == [256, 100]
+    su2 = out["action_info"]["selected_units"]
+    assert su2[0, 0] == 256 and su2[0, 1] == 256  # dropped + end -> new end
+    assert (su2[1] == 100).all()                  # untouched below the cap
+    assert out["action_mask"]["selected_units"][0] == 0.0  # dropped label
+    assert out["action_mask"]["selected_units"][1] == 1.0
+    assert out["action_info"]["target_unit"][0] == 0
+    assert out["action_mask"]["target_unit"][0] == 0.0
+    assert out["action_mask"]["target_unit"][1] == 1.0
+    for v in out["entity_info"].values():
+        assert v.shape[1] == 256
