@@ -146,7 +146,10 @@ def _env_truthy(name):
 
 
 def _env_entity_cap():
-    return int(os.environ.get("BENCH_MAX_ENTITIES", 0)) or None
+    try:
+        return int(os.environ.get("BENCH_MAX_ENTITIES", 0)) or None
+    except ValueError:  # exported-but-empty / junk: degrade, don't abort
+        return None
 
 
 def _bench_model_cfg():
@@ -314,15 +317,17 @@ def _bench_rl(batch_size, unroll_len, peak, iters=4):
             "save_freq": 10 ** 9,
             "log_freq": 10 ** 9,
             "value_pretrain_iters": -1,
+            "max_entities": _env_entity_cap(),
         },
         "model": _bench_model_cfg(),
     }
-    label = f"b{batch_size}xt{unroll_len}"
+    cap = cfg["learner"]["max_entities"]
+    label = f"b{batch_size}xt{unroll_len}" + (f"-e{cap}" if cap else "")
     _stage(f"rl-init {label}")
     learner = RLLearner(cfg)
     data = dict(next(learner._dataloader))
     data.pop("model_last_iter", None)
-    batch = learner.shard_batch(data)
+    batch = learner.shard_batch(learner._cap(data))
     args = (learner.state["params"], learner.state["opt_state"], batch, jnp.asarray(False))
 
     def feedback(args, out):
@@ -338,6 +343,8 @@ def _bench_rl(batch_size, unroll_len, peak, iters=4):
         unroll=unroll_len,
         steps_per_sec=round(1.0 / point["step_time_s"], 4),
     )
+    if cap:
+        point["max_entities"] = cap
     del learner
     return point
 

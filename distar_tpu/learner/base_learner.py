@@ -64,6 +64,18 @@ class BaseLearner:
         self._setup_dataloader()
         self._setup_state()
 
+    # pad-to-bucket entity cap: subclasses set _CAP_FN to the layout-aware
+    # slicer (data.cap_entities / cap_entities_rl); one choke point for all
+    # of setup/prefetch/train host paths
+    _CAP_FN = None
+
+    def _cap(self, batch):
+        n = self.cfg.learner.get("max_entities")
+        fn = type(self)._CAP_FN
+        if n and fn is not None:
+            batch = fn(batch, int(n))
+        return batch
+
     # -------------------------------------------------------------- plumbing
     @property
     def name(self) -> str:

@@ -251,3 +251,60 @@ def cap_entities(batch: Dict, n: int) -> Dict:
         action_info=ai,
         action_mask=am,
     )
+
+
+def cap_entities_rl(batch: Dict, n: int) -> Dict:
+    """RL-layout counterpart of :func:`cap_entities` (time-major batches:
+    obs [T+1, B, N, ...], actions/teacher logits [T, B, ...]).
+
+    Same contract: numerically exact for samples with entity_num <= n —
+    model shapes derive from inputs, masked rows vanish from every
+    reduction, and within the cap a teacher's sliced logit tail carries
+    ~zero mass. ABOVE the cap the teacher's sliced distribution would
+    renormalize over a truncated candidate set (a biased KL), so overflow
+    steps zero their selected_units/target_unit action masks entirely —
+    no loss contribution rather than a distorted one.
+    """
+    entity_info = {k: v[:, :, :n] for k, v in batch["entity_info"].items()}
+    old_num = np.asarray(batch["entity_num"])          # [T+1, B]
+    new_num = np.minimum(old_num, n)
+    act_num_old = old_num[:-1]                         # the acted steps
+    act_num_new = new_num[:-1]
+    overflow = act_num_old > n                         # [T, B]
+
+    ai = dict(batch["action_info"])
+    su = np.asarray(ai["selected_units"])              # [T, B, S]
+    was_end = su == act_num_old[..., None]
+    # clamp EVERY out-of-range lane (post-end sampled junk included: left
+    # >= n it would gather out of bounds in the sliced pointer decode)
+    oob = (su >= act_num_new[..., None]) & ~was_end
+    ai["selected_units"] = np.where(was_end | oob, act_num_new[..., None], su)
+    tu = np.asarray(ai["target_unit"])                 # [T, B]
+    tu_bad = tu >= act_num_new
+    ai["target_unit"] = np.where(tu_bad, 0, tu)
+
+    mask = {k: (dict(v) if isinstance(v, dict) else v) for k, v in batch["mask"].items()}
+    am = mask["actions_mask"]
+    su_mask = np.asarray(am["selected_units"])
+    am["selected_units"] = np.where(overflow, 0.0, su_mask).astype(su_mask.dtype)
+    tu_mask = np.asarray(am["target_unit"])
+    am["target_unit"] = np.where(overflow | tu_bad, 0.0, tu_mask).astype(tu_mask.dtype)
+
+    teacher = dict(batch["teacher_logit"])
+    teacher["selected_units"] = np.asarray(teacher["selected_units"])[..., : n + 1]
+    teacher["target_unit"] = np.asarray(teacher["target_unit"])[..., :n]
+
+    out = dict(
+        batch,
+        entity_info=entity_info,
+        entity_num=new_num,
+        action_info=ai,
+        mask=mask,
+        teacher_logit=teacher,
+    )
+    if "successive_logit" in batch:  # DAPO carries the same logit layout
+        succ = dict(batch["successive_logit"])
+        succ["selected_units"] = np.asarray(succ["selected_units"])[..., : n + 1]
+        succ["target_unit"] = np.asarray(succ["target_unit"])[..., :n]
+        out["successive_logit"] = succ
+    return out

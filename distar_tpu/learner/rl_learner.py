@@ -28,7 +28,7 @@ from ..parallel import GradClipConfig, MeshSpec, build_optimizer, make_mesh
 from ..parallel.grad_clip import leaf_norms
 from ..utils import Config, deep_merge_dicts
 from .base_learner import DEFAULT_LEARNER_CONFIG, BaseLearner
-from .data import FakeRLDataloader
+from .data import FakeRLDataloader, cap_entities_rl
 
 RL_LEARNER_DEFAULTS = deep_merge_dicts(
     DEFAULT_LEARNER_CONFIG,
@@ -45,6 +45,8 @@ RL_LEARNER_DEFAULTS = deep_merge_dicts(
             "use_dapo": False,
             # per-parameter grad/param-norm logging (reference save_grad)
             "save_grad": False,
+            # pad-to-bucket entity cap (throughput; see data.cap_entities_rl)
+            "max_entities": None,
         },
         "model": {},
     },
@@ -123,6 +125,8 @@ def make_rl_train_step(model: Model, loss_cfg: ReinforcementLossConfig, optimize
 class RLLearner(BaseLearner):
     """Data-parallel league-RL learner."""
 
+    _CAP_FN = staticmethod(cap_entities_rl)
+
     def __init__(self, cfg: Optional[dict] = None, mesh=None):
         cfg = deep_merge_dicts(RL_LEARNER_DEFAULTS, cfg or {})
         self.mesh = mesh if mesh is not None else make_mesh(MeshSpec())
@@ -164,7 +168,7 @@ class RLLearner(BaseLearner):
         from ..parallel.mesh import set_context_mesh
 
         set_context_mesh(self.mesh)  # ring attention resolves sp at trace time
-        batch = next(self._dataloader)
+        batch = self._cap(next(self._dataloader))
         self.optimizer = build_optimizer(
             learning_rate=lc.learning_rate,
             betas=tuple(lc.betas),
@@ -270,7 +274,7 @@ class RLLearner(BaseLearner):
     def _place_batch(self, batch):
         """Prefetch placement: everything device-put ahead of time except the
         host-side staleness field."""
-        batch = dict(batch)
+        batch = self._cap(dict(batch))
         model_last_iter = np.asarray(batch.pop("model_last_iter"))
         out = self.shard_batch(batch)
         out["model_last_iter"] = model_last_iter
@@ -422,7 +426,7 @@ class RLLearner(BaseLearner):
         model_last_iter = np.asarray(data.pop("model_last_iter"))
         staleness = self.last_iter.val - model_last_iter
         if not on_device:
-            data = self.shard_batch(data)
+            data = self.shard_batch(self._cap(data))
         params, opt_state, info = self._train_step(
             self._state["params"], self._state["opt_state"], data,
             jnp.asarray(only_value),

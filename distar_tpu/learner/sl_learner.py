@@ -23,7 +23,7 @@ from ..parallel import GradClipConfig, MeshSpec, build_optimizer, make_mesh
 from ..parallel.grad_clip import leaf_norms
 from ..utils import deep_merge_dicts
 from .base_learner import DEFAULT_LEARNER_CONFIG, BaseLearner
-from .data import FakeSLDataloader
+from .data import FakeSLDataloader, cap_entities
 
 SL_LEARNER_DEFAULTS = deep_merge_dicts(
     DEFAULT_LEARNER_CONFIG,
@@ -88,6 +88,8 @@ def make_sl_train_step(model: Model, loss_cfg: SupervisedLossConfig, optimizer,
 
 
 class SLLearner(BaseLearner):
+    _CAP_FN = staticmethod(cap_entities)
+
     def __init__(self, cfg: Optional[dict] = None, mesh=None):
         cfg = deep_merge_dicts(SL_LEARNER_DEFAULTS, cfg or {})
         self.mesh = mesh if mesh is not None else make_mesh(MeshSpec())
@@ -171,14 +173,6 @@ class SLLearner(BaseLearner):
             # broadcast over their subtrees)
             out_shardings=(param_sh, opt_sh, flat_sh, repl),
         )
-
-    def _cap(self, data):
-        n = self.cfg.learner.get("max_entities")
-        if n:
-            from .data import cap_entities
-
-            data = cap_entities(data, int(n))
-        return data
 
     def _place_batch(self, data):
         """Prefetch placement: device-put ahead of time, host fields kept."""

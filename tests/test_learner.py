@@ -340,3 +340,67 @@ def test_device_prefetcher_order_and_errors():
 
     with _pytest.raises(RuntimeError, match="producer failed"):
         next(pf)
+
+
+def test_rl_cap_entities_exact_below_cap(tmp_path):
+    """cap_entities_rl (learner.max_entities on the RL learner) is
+    numerically exact within the cap: same batch trained at the 512 pad and
+    sliced to 256 yields the same loss grid. (A real teacher's logits carry
+    ~zero mass beyond its masked candidates; the fake teacher's off-label
+    tails are e^-40 relative — negligible.)"""
+    from distar_tpu.learner import RLLearner
+    from distar_tpu.learner.data import fake_rl_batch
+
+    rng = np.random.default_rng(11)
+    batch = fake_rl_batch(4, 2, rng=rng, hidden_size=32, hidden_layers=1)
+    batch["entity_num"] = np.minimum(batch["entity_num"], 250)
+    batch["model_last_iter"] = np.zeros(4)
+    # re-pin end tokens to the clamped entity_num (fake labels put the end
+    # flag at the ORIGINAL entity_num)
+    su = batch["action_info"]["selected_units"]
+    sun = batch["selected_units_num"]
+    for t in range(su.shape[0]):
+        for b in range(su.shape[1]):
+            su[t, b, sun[t, b] - 1] = batch["entity_num"][t, b]
+    onehot = np.eye(513, dtype=np.float32)[su]
+    batch["teacher_logit"]["selected_units"] = (40.0 * onehot - 20.0).astype(np.float32)
+
+    logs = {}
+    for name, cap in (("full", None), ("capped", 256)):
+        cfg = {
+            "common": {"experiment_name": f"rlcap_{name}", "save_path": str(tmp_path)},
+            "learner": {"batch_size": 4, "unroll_len": 2, "save_freq": 100000,
+                        "log_freq": 10 ** 9, "max_entities": cap},
+            "model": SMALL_MODEL,
+        }
+        learner = RLLearner(cfg)
+        logs[name] = learner._train(dict(batch))
+    for k in logs["full"]:
+        if k.startswith("staleness"):
+            continue
+        np.testing.assert_allclose(
+            logs["full"][k], logs["capped"][k], rtol=2e-4, atol=2e-4,
+            err_msg=f"RL loss term {k} diverged under the entity cap",
+        )
+
+
+def test_rl_cap_entities_overflow_semantics():
+    """Above-cap RL steps: every out-of-range selected_units lane clamps
+    into range (post-end junk lanes would gather OOB in the sliced decode)
+    and the su/tu masks zero for overflow steps (a truncated teacher
+    distribution would bias the KL)."""
+    from distar_tpu.learner.data import cap_entities_rl, fake_rl_batch
+
+    batch = fake_rl_batch(2, 1, rng=np.random.default_rng(5))
+    batch["entity_num"][:] = 100
+    batch["entity_num"][0, 0] = 300  # step 0, sample 0 overflows cap 256
+    su = batch["action_info"]["selected_units"]
+    su[0, 0, :] = 280  # junk + labels beyond the cap
+    out = cap_entities_rl(batch, 256)
+    assert out["entity_num"].max() == 256
+    assert out["action_info"]["selected_units"].max() <= 256  # all in range
+    am = out["mask"]["actions_mask"]
+    assert am["selected_units"][0, 0] == 0.0 and am["target_unit"][0, 0] == 0.0
+    assert am["selected_units"][0, 1] == 1.0  # non-overflow sample untouched
+    assert out["teacher_logit"]["selected_units"].shape[-1] == 257
+    assert out["teacher_logit"]["target_unit"].shape[-1] == 256
