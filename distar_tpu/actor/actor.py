@@ -43,6 +43,9 @@ ACTOR_DEFAULTS = Config(
             # directories searched for the job's z_path libraries
             "z_dirs": ["", "data/z_libraries"],
             "fake_reward_prob": 1.0,
+            # pad-to-bucket entity cap for inference obs (agents slice in
+            # pre_process; matches the learner-side learner.max_entities)
+            "max_entities": None,
         }
     }
 )
@@ -328,6 +331,7 @@ class Actor:
                     z=self._sample_z(side, job),
                     traj_len=self.cfg.traj_len,
                     seed=self.cfg.seed + e * 2 + side,
+                    max_entities=self.cfg.get("max_entities"),
                 )
             )
             for e in range(n_env)
@@ -412,6 +416,13 @@ class Actor:
         from ..lib import features as F
 
         filler = F.fake_step_data(train=False, rng=self._rng)
+        cap = self.cfg.get("max_entities")
+        if cap:
+            # capped lanes batch at the bucket shape: the filler must match
+            filler["entity_info"] = {
+                k: v[:cap] for k, v in filler["entity_info"].items()
+            }
+            filler["entity_num"] = np.minimum(np.asarray(filler["entity_num"]), cap)
         obs: Dict[int, dict] = {}
         episodes_done, results = 0, []
         last_model_refresh = time.time()

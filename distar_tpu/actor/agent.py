@@ -74,9 +74,15 @@ class Agent:
         use_cum_reward: bool = True,
         clip_bo: bool = False,
         seed: int = 0,
+        max_entities: Optional[int] = None,
     ):
         self.player_id = player_id
         self._traj_len = traj_len
+        # pad-to-bucket entity cap: slice the obs BEFORE it reaches the
+        # model/trajectory so sampled indices, end-token detection, and the
+        # stored learner data all agree on the capped entity set
+        # (learner/data.cap_entities contract)
+        self._max_entities = max_entities
         self.use_bo_reward = use_bo_reward
         self.use_cum_reward = use_cum_reward
         self._clip_bo = clip_bo
@@ -153,6 +159,17 @@ class Agent:
         """Augment a feature-level obs with last-action fields and the Z
         conditioning targets (reference _pre_process :257-304)."""
         obs = copy.copy(obs)
+        n = self._max_entities
+        self._capped_end = None
+        if n and next(iter(obs["entity_info"].values())).shape[0] > n:
+            raw_num = int(np.asarray(obs["entity_num"]))
+            obs["entity_info"] = {k: v[:n] for k, v in obs["entity_info"].items()}
+            obs["entity_num"] = np.minimum(np.asarray(obs["entity_num"]), n)
+            if raw_num > n:
+                # the model's end token (index == capped entity_num) aliases
+                # a REAL tag index in the env's uncapped tag list: remember
+                # it so post_process can strip it from the env action
+                self._capped_end = int(np.asarray(obs["entity_num"]))
         scalar = dict(obs["scalar_info"])
         scalar["last_action_type"] = np.asarray(self._last_action["action_type"], np.int16)
         scalar["last_delay"] = np.asarray(self._last_action["delay"], np.int16)
@@ -188,11 +205,19 @@ class Agent:
         self._last_action = {k: int(np.asarray(a[k]).reshape(-1)[0]) if k != "selected_units"
                              else 0 for k in F.ACTION_HEADS}
         self._last_action["selected_units"] = 0
+        selected = np.asarray(a["selected_units"])
+        if getattr(self, "_capped_end", None) is not None:
+            # uncapped frames rely on the env dropping index == n_tags; with
+            # the obs capped below the real count the end token would alias
+            # tags[capped_end], so remap it to the real out-of-range index
+            selected = np.where(
+                selected == self._capped_end, np.iinfo(np.int64).max, selected
+            )
         return {
             "action_type": np.asarray(a["action_type"]),
             "delay": np.asarray(a["delay"]),
             "queued": np.asarray(a["queued"]),
-            "selected_units": np.asarray(a["selected_units"]),
+            "selected_units": selected,
             "target_unit": np.asarray(a["target_unit"]),
             "target_location": np.asarray(a["target_location"]),
         }

@@ -11,6 +11,9 @@ import pytest
 
 from distar_tpu.actor.env_pool import RESET, STEP, EnvWorkerPool
 
+from conftest import SMALL_MODEL
+
+
 
 class SleepEnv:
     """Contract-shaped env whose step blocks like a real SC2 process."""
@@ -183,3 +186,70 @@ def test_extracted_z_libraries_load_and_sample():
     for fname in os.listdir(z_dir):
         l = ZLibrary(os.path.join(z_dir, fname))
         assert l.sample_any("KingsCove", mix_race="zerg") is not None, fname
+
+
+def test_agent_entity_cap_slices_obs():
+    """actor.max_entities: the agent slices entity arrays in pre_process so
+    the model, sampled indices, end-token detection, and stored trajectory
+    data all agree on the capped entity set."""
+    from distar_tpu.actor.agent import Agent
+    from distar_tpu.lib import features as F
+
+    rng = np.random.default_rng(0)
+    obs = F.fake_step_data(train=False, rng=rng)
+    obs["entity_num"] = np.asarray(400, np.int64)
+    ag = Agent("P0", traj_len=2, seed=0, max_entities=256)
+    ag.reset()
+    ag.pre_process(obs)
+    capped = ag._observation
+    assert capped["entity_num"] == 256
+    for v in capped["entity_info"].values():
+        assert v.shape[0] == 256
+
+    # overflow frames: the model's end token (== capped entity_num) would
+    # alias a REAL tag index in the env's uncapped list; post_process must
+    # strip it from the env action (trajectory output keeps the raw indices)
+    out = {"action_info": {
+        "action_type": np.asarray(0), "delay": np.asarray(1),
+        "queued": np.asarray(0),
+        "selected_units": np.asarray([3, 256, 0]),  # unit, END, junk
+        "target_unit": np.asarray(0), "target_location": np.asarray(0),
+    }}
+    act = ag.post_process(out)
+    assert act["selected_units"][0] == 3
+    assert act["selected_units"][1] > 10 ** 9  # end token out of tag range
+    assert (ag._output["action_info"]["selected_units"] == [3, 256, 0]).all()
+
+    # below the cap: untouched values, no end-token remap
+    obs2 = F.fake_step_data(train=False, rng=rng)
+    obs2["entity_num"] = np.asarray(31, np.int64)
+    ag.pre_process(obs2)
+    assert ag._observation["entity_num"] == 31
+    act2 = ag.post_process(out)
+    assert act2["selected_units"][1] == 256  # no aliasing below the cap
+
+
+def test_actor_job_with_entity_cap():
+    """A model-vs-scripted job on the mock env completes with the inference
+    obs capped to 256 entities — env_num=2 so inactive-slot FILLER obs mix
+    into the batch and must carry the bucket shape too."""
+    from distar_tpu.actor import Actor
+    from distar_tpu.envs import MockEnv
+
+    actor = Actor(
+        cfg={"actor": {"env_num": 2, "traj_len": 2, "seed": 3,
+                       "max_entities": 256}},
+        model_cfg=SMALL_MODEL,
+        env_fn=lambda: MockEnv(episode_game_loops=300, seed=9),
+    )
+    job = {
+        "player_ids": ["MP0", "S"],
+        "pipelines": ["default", "scripted.idle"],
+        "send_data_players": [],
+        "update_players": [],
+        "teacher_player_ids": ["T", "none"],
+        "branch": "eval_test",
+        "env_info": {"map_name": "mock"},
+    }
+    results = actor.run_job(episodes=1, job=job)
+    assert len(results) >= 1 and results[0]["0"]["player_id"] == "MP0"
