@@ -150,6 +150,8 @@ def stage_fullstep_ab() -> bool:
     for name, env_extra in (
         ("xla", {}),
         ("pallas", {"BENCH_ATTN_IMPL": "pallas", "BENCH_SCATTER_IMPL": "pallas"}),
+        # pad-to-bucket entity cap (exact below the cap; PERF.md)
+        ("e256", {"BENCH_MAX_ENTITIES": "256"}),
     ):
         rc, stdout = _run(
             [sys.executable, "-u", "bench.py", "--run"],
