@@ -99,8 +99,8 @@ def main() -> None:
         import jax
 
         jax.config.update("jax_platforms", "cpu")
-        jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache_distar_tpu")
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        from ..utils.compile_cache import configure as _cc
+        _cc(jax, "/tmp/jax_cache_distar_tpu")
 
     from .rl_train import SMOKE_MODEL
 

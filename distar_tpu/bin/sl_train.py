@@ -122,8 +122,8 @@ def main() -> None:
         import jax
 
         jax.config.update("jax_platforms", args.platform)
-        jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache_distar_tpu")
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        from ..utils.compile_cache import configure as _cc
+        _cc(jax, "/tmp/jax_cache_distar_tpu")
     user_cfg = read_config(args.config) if args.config else {}
     learner_cfg = user_cfg.get("learner", {})
     if args.batch_size is None:

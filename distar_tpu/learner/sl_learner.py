@@ -239,6 +239,7 @@ class SLLearner(BaseLearner):
         if not hasattr(self, "_debug_ema"):
             self._debug_ema = {}
             self._debug_dumps = 0
+            self._debug_nonfinite = set()  # keys already reported as blown up
         factor = float(self.cfg.learner.get("debug_spike_factor", 10.0))
         warmup = int(self.cfg.learner.get("debug_spike_warmup", 200))
         dumped = False
@@ -247,10 +248,18 @@ class SLLearner(BaseLearner):
                 continue
             prev = self._debug_ema.get(k)
             blown_up = not np.isfinite(v)  # divergence is the headline event
-            spiked = (
+            if not blown_up:
+                self._debug_nonfinite.discard(k)  # recovered: re-arm
+            elif k in self._debug_nonfinite:
+                continue  # one snapshot per divergence event, not per iter
+            # blown_up alone qualifies — a run that is non-finite from the
+            # FIRST iteration (prev never seeded) is exactly the scenario
+            # this mode exists to capture; ratio spikes need a finite EMA
+            spiked = blown_up or (
                 prev is not None
                 and np.isfinite(prev)
-                and (blown_up or (prev > self._DEBUG_EMA_FLOOR and v > prev * factor))
+                and prev > self._DEBUG_EMA_FLOOR
+                and v > prev * factor
             )
             if (
                 spiked
@@ -260,6 +269,8 @@ class SLLearner(BaseLearner):
             ):
                 dumped = True
                 self._debug_dumps += 1
+                if blown_up:
+                    self._debug_nonfinite.add(k)
                 self._dump_spike(k, v, prev, log, pre_step)
             if not blown_up:  # never poison the EMA with inf/nan
                 self._debug_ema[k] = v if prev is None else prev * 0.95 + v * 0.05
@@ -283,6 +294,7 @@ class SLLearner(BaseLearner):
                         "batch/hidden_state are the step's exact inputs",
             }, compress=True))
         self.save(self.checkpoint_path(), sync=True)  # debug artifacts are durable
+        ema_txt = f"{ema:.4f}" if ema is not None else "unseeded"
         self.logger.info(
-            f"loss spike: {key}={value:.4f} (ema {ema:.4f}); snapshot {path}"
+            f"loss spike: {key}={value:.4f} (ema {ema_txt}); snapshot {path}"
         )

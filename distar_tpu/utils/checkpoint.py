@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import os
 import signal
+import time
 import traceback
 from typing import Any, Callable, Dict, Optional
 
@@ -58,9 +59,26 @@ def _write_checkpoint(path: str, host_state: Any, metadata: Optional[Dict]) -> s
     # unique tmp: a crash-path sync save can race an in-flight async writer
     # on the same target; distinct tmps + atomic replace keep both complete
     tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
-    with open(tmp, "wb") as f:
-        f.write(blob)
-    os.replace(tmp, path)
+    try:
+        with open(tmp, "wb") as f:
+            f.write(blob)
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    # reap orphans from SIGKILLed writers (full-size state copies): any
+    # same-target tmp quiet for >10 min belongs to a dead process
+    import glob as _glob
+
+    for stale in _glob.glob(_glob.escape(path) + ".tmp.*"):
+        try:
+            if time.time() - os.path.getmtime(stale) > 600:
+                os.unlink(stale)
+        except OSError:
+            pass
     return path
 
 

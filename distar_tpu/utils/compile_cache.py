@@ -1,0 +1,52 @@
+"""Host-keyed persistent-compile-cache paths.
+
+XLA:CPU AOT cache entries bake in the COMPILING machine's CPU feature set;
+loading them on a host with different features logs "could lead to
+execution errors such as SIGILL" — and this container demonstrably moves
+between hosts with different features (observed: entries compiled with
++prefer-no-scatter/+amx-avx512-era flags loaded on a host without them,
+followed by segfaults inside backend_compile_and_load). Keying the cache
+directory by a hash of the host's CPU flags makes a migrated VM start a
+fresh cache instead of executing foreign machine code.
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+
+
+def _host_cpu_key() -> str:
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                # x86 lists 'flags'; ARM lists 'Features'
+                if line.startswith(("flags", "Features")):
+                    flags = " ".join(sorted(line.split(":", 1)[1].split()))
+                    return hashlib.sha1(flags.encode()).hexdigest()[:8]
+    except OSError:
+        pass
+    import platform
+
+    # last resort: the full uname tuple — never hash an empty string, which
+    # would give distinct hosts the same key and reintroduce shared caches
+    return hashlib.sha1("|".join(platform.uname()).encode()).hexdigest()[:8]
+
+
+def cache_dir(base: str) -> str:
+    """``/tmp/jax_cache_x`` -> ``/tmp/jax_cache_x-<cpu-flags-hash>``."""
+    return f"{base}-{_host_cpu_key()}"
+
+
+def configure(jax, base: str) -> None:
+    """Point jax's persistent compile cache at the host-keyed directory."""
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir(base))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception as e:
+        # losing the cache means cold multi-minute compiles everywhere the
+        # callers warn about — degrade, but never silently
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "persistent compile cache NOT configured (%r); compiles will be cold", e
+        )

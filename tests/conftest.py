@@ -21,9 +21,13 @@ import jax
 
 jax.config.update("jax_platforms", "cpu")
 # persistent compile cache: identical small-model jits recur across test
-# modules; cached XLA executables cut warm suite time drastically
-jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache_distar_tpu")
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+# modules; cached XLA executables cut warm suite time drastically. The dir
+# is keyed by the HOST's cpu flags: this container migrates between hosts,
+# and XLA:CPU AOT entries compiled elsewhere can SIGILL when loaded here
+# (utils/compile_cache.py; the round-4 full-suite segfaults)
+from distar_tpu.utils.compile_cache import configure as _configure_cache  # noqa: E402
+
+_configure_cache(jax, "/tmp/jax_cache_distar_tpu")
 
 import numpy as np
 import pytest

@@ -11,23 +11,7 @@ import pytest
 from distar_tpu.parallel import GradClipConfig, MeshSpec, build_grad_clip, build_optimizer, make_mesh
 
 
-SMALL_MODEL = {
-    "encoder": {
-        "entity": {"layer_num": 1, "hidden_dim": 32, "output_dim": 16, "head_dim": 8},
-        "spatial": {"down_channels": [4, 4, 8], "project_dim": 4, "resblock_num": 1, "fc_dim": 16},
-        "scatter": {"output_dim": 4},
-        "core_lstm": {"hidden_size": 32, "num_layers": 1},
-    },
-    "policy": {
-        "action_type_head": {"res_dim": 16, "res_num": 1, "gate_dim": 32},
-        "delay_head": {"decode_dim": 16},
-        "queued_head": {"decode_dim": 16},
-        "selected_units_head": {"func_dim": 16},  # hidden_dim must equal key_dim
-        "target_unit_head": {"func_dim": 16},
-        "location_head": {"res_dim": 8, "res_num": 1, "upsample_dims": [4, 4, 1], "map_skip_dim": 8},
-    },
-    "value": {"res_dim": 8, "res_num": 1},
-}
+from conftest import SMALL_MODEL  # shared tiny model config
 
 
 def test_mesh_axes():
@@ -261,10 +245,16 @@ def test_sl_loss_spike_guard_snapshots(tmp_path):
     assert len(spike_files()) == 2
     assert learner._debug_ema[spiked_key] == 2.0
 
+    # non-finite from the FIRST iteration (no EMA ever seeded) also dumps —
+    # a run that diverges immediately is the headline event
+    learner._debug_ema.pop("fresh_loss", None)
+    learner._loss_spike_guard({"fresh_loss": float("inf")}, pre_step)
+    assert len(spike_files()) == 3
+
     # the dump cap bounds disk usage
     learner._debug_dumps = learner._DEBUG_DUMP_CAP
     learner._loss_spike_guard({spiked_key: 1e9}, pre_step)
-    assert len(spike_files()) == 2
+    assert len(spike_files()) == 3
 
 def test_rl_learner_with_value_feature(tmp_path):
     """Centralized-critic path: use_value_feature routes opponent features

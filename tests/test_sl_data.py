@@ -5,23 +5,7 @@ import pytest
 from distar_tpu.learner.sl_dataloader import ReplayDataset, SLDataloader, make_fake_dataset
 from distar_tpu.lib.z_library import ZLibrary, build_z_library, save_z_library, z_entry_to_target
 
-SMALL_MODEL = {
-    "encoder": {
-        "entity": {"layer_num": 1, "hidden_dim": 32, "output_dim": 16, "head_dim": 8},
-        "spatial": {"down_channels": [4, 4, 8], "project_dim": 4, "resblock_num": 1, "fc_dim": 16},
-        "scatter": {"output_dim": 4},
-        "core_lstm": {"hidden_size": 32, "num_layers": 1},
-    },
-    "policy": {
-        "action_type_head": {"res_dim": 16, "res_num": 1, "gate_dim": 32},
-        "delay_head": {"decode_dim": 16},
-        "queued_head": {"decode_dim": 16},
-        "selected_units_head": {"func_dim": 16},
-        "target_unit_head": {"func_dim": 16},
-        "location_head": {"res_dim": 8, "res_num": 1, "upsample_dims": [4, 4, 1], "map_skip_dim": 8},
-    },
-    "value": {"res_dim": 8, "res_num": 1},
-}
+from conftest import SMALL_MODEL  # shared tiny model config
 
 
 

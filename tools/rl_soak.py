@@ -55,8 +55,8 @@ def _pin_cpu() -> None:
     import jax
 
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache_distar_tpu")
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    from distar_tpu.utils.compile_cache import configure as _cc
+    _cc(jax, "/tmp/jax_cache_distar_tpu")
 
 
 def run_soak(iters: int = 100, batch_size: int = 4, traj_len: int = 2,

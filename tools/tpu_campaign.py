@@ -55,9 +55,10 @@ def _run(cmd, env_extra=None, timeout=3600, log_name="stage"):
     ]
     for d in drains:
         d.start()
-    stopped = False
+    stopped = timed_out = False
     while proc.poll() is None:
         if time.time() - t0 > timeout:
+            timed_out = True
             proc.kill()
             proc.wait()
             print(f"[campaign] {log_name}: TIMEOUT after {time.time() - t0:.0f}s",
@@ -78,7 +79,9 @@ def _run(cmd, env_extra=None, timeout=3600, log_name="stage"):
     for d in drains:
         d.join(timeout=5)
     stdout, stderr = "".join(chunks["out"]), "".join(chunks["err"])
-    if stopped or time.time() - t0 > timeout:
+    # only the kill branches are failures: a child that finished cleanly
+    # just past the timeout instant keeps its real rc + result
+    if stopped or timed_out:
         return None, stdout
     print(
         f"[campaign] {log_name}: rc={proc.returncode} in {time.time() - t0:.0f}s",
@@ -188,8 +191,8 @@ def stage_profile() -> bool:
     code = """
 import os, time, json
 import jax
-jax.config.update("jax_compilation_cache_dir", "/tmp/jax_cache_distar_tpu_bench")
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+from distar_tpu.utils.compile_cache import configure as _cc
+_cc(jax, "/tmp/jax_cache_distar_tpu_bench")
 from distar_tpu.learner import SLLearner
 cfg = {
     "common": {"experiment_name": "profile_sl"},
