@@ -60,7 +60,11 @@ def _pin_cpu() -> None:
 
 
 def run_soak(iters: int = 100, batch_size: int = 4, traj_len: int = 2,
-             env_num: int = 2) -> dict:
+             env_num: int = 2, features: bool = False) -> dict:
+    """``features=True`` additionally exercises the round-4 knobs in
+    combination for the whole soak: actor+learner pad-to-bucket entity
+    caps, per-parameter save_grad logging, and periodic ASYNC checkpoint
+    saves racing the train loop."""
     _pin_cpu()
     # sized so >=1 one_phase_step snapshot fires inside the soak
     one_phase_step = max(1, int(iters * batch_size * traj_len * 0.6))
@@ -101,7 +105,8 @@ def run_soak(iters: int = 100, batch_size: int = 4, traj_len: int = 2,
     actor_adapter = Adapter(coordinator=co)
     learner_adapter = Adapter(coordinator=co)
     actor = Actor(
-        cfg={"actor": {"env_num": env_num, "traj_len": traj_len, "seed": 7}},
+        cfg={"actor": {"env_num": env_num, "traj_len": traj_len, "seed": 7,
+                       **({"max_entities": 256} if features else {})}},
         league=league,
         adapter=actor_adapter,
         model_cfg=SMALL_MODEL,
@@ -125,8 +130,12 @@ def run_soak(iters: int = 100, batch_size: int = 4, traj_len: int = 2,
     learner = RLLearner(
         {
             "common": {"experiment_name": "rl_soak"},
+            # features spread LAST: dict literals resolve duplicates
+            # last-wins, so it must override the base save_freq
             "learner": {"batch_size": batch_size, "unroll_len": traj_len,
-                        "save_freq": 10 ** 9, "log_freq": 25},
+                        "save_freq": 10 ** 9, "log_freq": 25,
+                        **({"max_entities": 256, "save_grad": True,
+                            "save_freq": max(iters // 5, 1)} if features else {})},
             "model": SMALL_MODEL,
         }
     )
@@ -205,6 +214,7 @@ def run_soak(iters: int = 100, batch_size: int = 4, traj_len: int = 2,
     assert len(finite) == len(telemetry["total_loss"]), "non-finite loss seen"
 
     return {
+        "features_on": bool(features),
         "iters": iters,
         "wall_s": round(wall, 1),
         "train_time_s": {
@@ -246,8 +256,10 @@ def main() -> None:
     p = argparse.ArgumentParser()
     p.add_argument("--iters", type=int, default=100)
     p.add_argument("--out", default="artifacts/rl_soak.json")
+    p.add_argument("--features", action="store_true",
+                   help="soak with entity caps + save_grad + async saves on")
     args = p.parse_args()
-    report = run_soak(args.iters)
+    report = run_soak(args.iters, features=args.features)
     report["invariants"] = [
         "actor weights propagate and end within 24 iters of the learner",
         "staleness max <= total iters; tail staleness mean < 64",
