@@ -1,0 +1,46 @@
+"""Host-keyed persistent-compile-cache paths (utils/compile_cache.py):
+the module that stops migrated containers from loading foreign-machine
+XLA AOT code (the round-4 segfault root cause)."""
+import jax
+
+from distar_tpu.utils import compile_cache as cc
+
+
+def test_cache_dir_is_host_keyed_and_stable():
+    a = cc.cache_dir("/tmp/base")
+    b = cc.cache_dir("/tmp/base")
+    assert a == b, "key must be deterministic within one host"
+    assert a.startswith("/tmp/base-") and len(a.split("-")[-1]) == 8
+    assert cc.cache_dir("/tmp/other").split("-")[-1] == a.split("-")[-1]
+
+
+def test_host_key_never_empty():
+    key = cc._host_cpu_key()
+    assert isinstance(key, str) and len(key) == 8
+    import hashlib
+
+    # the empty-string hash would give distinct hosts the same key
+    assert key != hashlib.sha1(b"").hexdigest()[:8]
+
+
+def test_configure_sets_jax_config(monkeypatch):
+    prev = jax.config.jax_compilation_cache_dir
+    try:
+        cc.configure(jax, "/tmp/cc_test_base")
+        assert jax.config.jax_compilation_cache_dir == cc.cache_dir("/tmp/cc_test_base")
+    finally:
+        jax.config.update("jax_compilation_cache_dir", prev)
+
+
+def test_configure_degrades_loudly_not_silently(caplog):
+    class BrokenJax:
+        class config:
+            @staticmethod
+            def update(*a, **k):
+                raise RuntimeError("no such flag")
+
+    import logging
+
+    with caplog.at_level(logging.WARNING):
+        cc.configure(BrokenJax, "/tmp/x")  # must not raise
+    assert any("compile cache" in r.message for r in caplog.records)
