@@ -171,7 +171,7 @@ def _bench_model_cfg():
     return cfg
 
 
-def _bench_sl(batch_size, unroll_len, peak, iters=4, remat=False):
+def _bench_sl(batch_size, unroll_len, peak, iters=4, remat=False, cap=None):
     import jax
 
     from distar_tpu.learner import SLLearner
@@ -190,7 +190,7 @@ def _bench_sl(batch_size, unroll_len, peak, iters=4, remat=False):
             # pad-to-bucket entity cap (learner/data.cap_entities): the
             # entity transformer + pointer decode are O(N^2)/O(N) in the
             # PADDED count; real frames rarely exceed ~300 entities
-            "max_entities": _env_entity_cap(),
+            "max_entities": cap if cap is not None else _env_entity_cap(),
         },
         # bfloat16 matmuls/convs on the MXU (params stay f32)
         "model": model_cfg,
@@ -228,7 +228,7 @@ def _bench_sl(batch_size, unroll_len, peak, iters=4, remat=False):
     return point
 
 
-def _bench_sl_real(batch_size, unroll_len, peak, iters=6):
+def _bench_sl_real(batch_size, unroll_len, peak, iters=6, cap=None):
     """SL throughput through the PRODUCTION data path: disk-backed
     ReplayDataset (synthetically generated decoded steps, same frozen
     contract as SC2 decode output) -> SLDataloader windowing/collate ->
@@ -244,7 +244,7 @@ def _bench_sl_real(batch_size, unroll_len, peak, iters=6):
     from distar_tpu.learner.hooks import LambdaHook
     from distar_tpu.learner.sl_dataloader import ReplayDataset, SLDataloader, make_fake_dataset
 
-    cap = _env_entity_cap()
+    cap = cap if cap is not None else _env_entity_cap()
     label = f"b{batch_size}xt{unroll_len}" + (f"-e{cap}" if cap else "")
     _stage(f"sl-real-dataset {label}")
     root = tempfile.mkdtemp(prefix="bench_sl_realdata_")
@@ -263,7 +263,7 @@ def _bench_sl_real(batch_size, unroll_len, peak, iters=6):
                 "save_freq": 10 ** 9,
                 "log_freq": 10 ** 9,
                 "prefetch_depth": 2,
-                "max_entities": _env_entity_cap(),
+                "max_entities": cap if cap is not None else _env_entity_cap(),
             },
             "model": _bench_model_cfg(),
         }
@@ -304,7 +304,7 @@ def _bench_sl_real(batch_size, unroll_len, peak, iters=6):
         shutil.rmtree(root, ignore_errors=True)
 
 
-def _bench_rl(batch_size, unroll_len, peak, iters=4):
+def _bench_rl(batch_size, unroll_len, peak, iters=4, cap=None):
     import jax.numpy as jnp
 
     from distar_tpu.learner import RLLearner
@@ -317,7 +317,7 @@ def _bench_rl(batch_size, unroll_len, peak, iters=4):
             "save_freq": 10 ** 9,
             "log_freq": 10 ** 9,
             "value_pretrain_iters": -1,
-            "max_entities": _env_entity_cap(),
+            "max_entities": cap if cap is not None else _env_entity_cap(),
         },
         "model": _bench_model_cfg(),
     }
@@ -442,16 +442,28 @@ def run_child():
         plan = [
             # tiny probe first: lands a nonzero number before anything big
             ("sl", 2, 8),
-            # baseline regime (reference per-A100 SL slice: batch 6 x traj 64)
+            # baseline regime (reference per-A100 SL slice: batch 6 x traj
+            # 64) at the 256-entity bucket — exact for real frame entity
+            # counts and the strongest per-chip number (PERF.md) — then full
+            ("sl", 6, 64, 256),
             ("sl", 6, 64),
+            ("rl", 6, 64, 256),
             ("rl", 6, 64),
             # production data path: disk dataset + windowing + prefetch
             ("sl_real", 6, 64),
-            # push batch toward the HBM limit
-            ("sl", 16, 64),
-            ("sl", 32, 64),
+            # push batch toward the HBM limit (bucketed: bigger batches fit)
+            ("sl", 16, 64, 256),
+            ("sl", 32, 64, 256),
             ("rl", 12, 64),
         ]
+        if _env_entity_cap() is not None:
+            # an explicit BENCH_MAX_ENTITIES governs every config: drop the
+            # plan's own buckets (they would duplicate whole compiles)
+            seen = set()
+            plan = [
+                p[:3] for p in plan
+                if p[:3] not in seen and not seen.add(p[:3])
+            ]
         if mode in fns:
             plan = [p for p in plan if p[0] == mode]
 
@@ -459,13 +471,17 @@ def run_child():
         have_any = state["sl_best"] or state["rl_best"] or state["sl_real_best"]
         return bool(have_any) and time.perf_counter() - t0 > budget
 
-    for kind, b, t in plan:
+    for entry in plan:
+        kind, b, t = entry[:3]
+        cap = entry[3] if len(entry) > 3 else None
         if out_of_budget():
             break
         try:
-            point = fns[kind](b, t, peak)
+            point = fns[kind](b, t, peak, cap=cap)
         except Exception as e:  # OOM at the top of the sweep is expected
             err = {"batch": b, "unroll": t, "error": repr(e)[:300]}
+            if cap:
+                err["max_entities"] = cap
             state[f"{kind}_sweep"].append(err)
             print(f"BENCH-STAGE {kind}-failed b{b}xt{t}: {e!r}"[:400], file=sys.stderr, flush=True)
             already_remat = _env_truthy("BENCH_REMAT")
@@ -478,11 +494,13 @@ def run_child():
                 # HBM edge: retry once with rematerialization — recompute
                 # buys the activations back and the config may fit
                 try:
-                    point = _bench_sl(b, t, peak, remat=True)
+                    point = _bench_sl(b, t, peak, remat=True, cap=cap)
                 except Exception as e2:
-                    state["sl_sweep"].append(
-                        {"batch": b, "unroll": t, "remat": True, "error": repr(e2)[:300]}
-                    )
+                    retry_err = {"batch": b, "unroll": t, "remat": True,
+                                 "error": repr(e2)[:300]}
+                    if cap:
+                        retry_err["max_entities"] = cap
+                    state["sl_sweep"].append(retry_err)
                     continue
             else:
                 continue

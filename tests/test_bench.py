@@ -52,7 +52,7 @@ def test_oom_retries_with_remat(sl_only_env, monkeypatch, capsys):
     and the sweep must record both the failure and the retried point."""
     calls = []
 
-    def fake_sl(b, t, peak, iters=4, remat=False):
+    def fake_sl(b, t, peak, iters=4, remat=False, cap=None):
         calls.append(remat)
         if not remat:
             raise RuntimeError("RESOURCE_EXHAUSTED: HBM OOM allocating 1.9G")
@@ -73,7 +73,7 @@ def test_oom_retries_with_remat(sl_only_env, monkeypatch, capsys):
 def test_non_oom_error_is_not_retried(sl_only_env, monkeypatch, capsys):
     calls = []
 
-    def fake_sl(b, t, peak, iters=4, remat=False):
+    def fake_sl(b, t, peak, iters=4, remat=False, cap=None):
         calls.append(remat)
         raise ValueError("shape mismatch")
 
@@ -90,7 +90,7 @@ def test_env_remat_run_skips_oom_retry(sl_only_env, monkeypatch, capsys):
     monkeypatch.setenv("BENCH_REMAT", "1")
     calls = []
 
-    def fake_sl(b, t, peak, iters=4, remat=False):
+    def fake_sl(b, t, peak, iters=4, remat=False, cap=None):
         calls.append(remat)
         raise RuntimeError("RESOURCE_EXHAUSTED")
 
@@ -111,7 +111,7 @@ def test_full_plan_budget_break(monkeypatch, capsys):
 
     seen = []
 
-    def fake_sl(b, t, peak, iters=4, remat=False):
+    def fake_sl(b, t, peak, iters=4, remat=False, cap=None):
         seen.append((b, t))
         return _fake_point(b, t)
 
@@ -134,7 +134,7 @@ def test_headline_modes(monkeypatch, capsys):
     monkeypatch.setenv("BENCH_UNROLL", "16")
     monkeypatch.delenv("BENCH_REMAT", raising=False)
 
-    def fake_rl(b, t, peak, iters=4, remat=False):
+    def fake_rl(b, t, peak, iters=4, remat=False, cap=None):
         point = _fake_point(b, t, fps=64.0)
         point["steps_per_sec"] = 1.0
         return point
@@ -145,6 +145,41 @@ def test_headline_modes(monkeypatch, capsys):
     assert "RL learner" in final["metric"]
     assert final["value"] == 64.0
     assert final["rl"]["vs_baseline_frames"] == round(64.0 / bench.RL_BASELINE_FRAMES, 3)
+
+
+def test_default_plan_routes_entity_caps(monkeypatch, capsys):
+    """4-tuple plan entries carry their bucket into the measurement fns;
+    the capped baseline regime runs immediately after the probe so the
+    strongest number lands earliest in the driver's window."""
+    monkeypatch.delenv("BENCH_BATCH", raising=False)
+    monkeypatch.delenv("BENCH_UNROLL", raising=False)
+    monkeypatch.delenv("BENCH_REMAT", raising=False)
+    monkeypatch.setenv("BENCH_MODE", "both")
+    monkeypatch.setenv("BENCH_TIME_BUDGET", str(10 ** 9))
+
+    calls = []
+
+    def fake(kind):
+        def fn(b, t, peak, iters=4, remat=False, cap=None):
+            calls.append((kind, b, t, cap))
+            point = _fake_point(b, t)
+            if kind == "rl":
+                point["steps_per_sec"] = 1.0
+            return point
+
+        return fn
+
+    monkeypatch.setattr(bench, "_bench_sl", fake("sl"))
+    monkeypatch.setattr(bench, "_bench_rl", fake("rl"))
+    monkeypatch.setattr(bench, "_bench_sl_real", fake("sl_real"))
+    bench.run_child()
+
+    assert calls[0] == ("sl", 2, 8, None)          # probe first
+    assert calls[1] == ("sl", 6, 64, 256)          # capped baseline next
+    assert ("rl", 6, 64, 256) in calls             # capped RL regime
+    assert ("sl", 32, 64, 256) in calls            # HBM edge bucketed
+    assert ("sl_real", 6, 64, None) in calls       # real-data path uncapped
+    _final_json(capsys)  # a valid headline line printed
 
 
 @pytest.mark.slow
@@ -177,3 +212,36 @@ def test_parent_extends_attempt_past_compile(tmp_path):
     assert lines, out.stderr[-500:]
     final = _json.loads(lines[-1])
     assert final["value"] > 0, final
+
+
+def test_env_cap_governs_whole_sweep(monkeypatch, capsys):
+    """BENCH_MAX_ENTITIES overrides the plan's own buckets — no entry runs
+    at a different bucket and no duplicate configs pay a second compile."""
+    monkeypatch.delenv("BENCH_BATCH", raising=False)
+    monkeypatch.delenv("BENCH_UNROLL", raising=False)
+    monkeypatch.delenv("BENCH_REMAT", raising=False)
+    monkeypatch.setenv("BENCH_MODE", "both")
+    monkeypatch.setenv("BENCH_TIME_BUDGET", str(10 ** 9))
+    monkeypatch.setenv("BENCH_MAX_ENTITIES", "384")
+
+    calls = []
+
+    def fake(kind):
+        def fn(b, t, peak, iters=4, remat=False, cap=None):
+            calls.append((kind, b, t, cap))
+            point = _fake_point(b, t)
+            if kind == "rl":
+                point["steps_per_sec"] = 1.0
+            return point
+
+        return fn
+
+    monkeypatch.setattr(bench, "_bench_sl", fake("sl"))
+    monkeypatch.setattr(bench, "_bench_rl", fake("rl"))
+    monkeypatch.setattr(bench, "_bench_sl_real", fake("sl_real"))
+    bench.run_child()
+
+    assert all(cap is None for _, _, _, cap in calls)  # env governs via fns
+    configs = [(k, b, t) for k, b, t, _ in calls]
+    assert len(configs) == len(set(configs))  # duplicates deduped
+    assert ("sl", 6, 64) in configs and ("rl", 6, 64) in configs
