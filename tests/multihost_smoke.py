@@ -57,8 +57,9 @@ def worker(rank: int, port: int) -> None:
         g = jax.grad(loss)(w)
         return w - 0.1 * g, loss(w)
 
+    raw_step = step
     step = jax.jit(
-        step,
+        raw_step,
         in_shardings=(repl, batch_sharding, batch_sharding),
         out_shardings=(repl, repl),
     )
@@ -69,14 +70,13 @@ def worker(rank: int, port: int) -> None:
     y_global = (x_global @ np.asarray(w) * 0.5).astype(np.float32)
 
     # each process supplies ITS addressable shards of the global batch
-    def make_global(arr):
-        sharding = NamedSharding(mesh, P("dp"))
+    def make_global(arr, sharding):
         return jax.make_array_from_callback(
             arr.shape, sharding, lambda idx: arr[idx]
         )
 
-    x = make_global(x_global)
-    y = make_global(y_global)
+    x = make_global(x_global, batch_sharding)
+    y = make_global(y_global, batch_sharding)
     losses = []
     for _ in range(3):
         w, l = step(w, x, y)
@@ -84,6 +84,40 @@ def worker(rank: int, port: int) -> None:
     assert losses[-1] < losses[0], losses
     if rank == 0:
         print(f"multihost smoke ok: world={info['world_size']} losses={losses}")
+
+    # ---- phase 2: ZeRO-style fsdp sharding ACROSS the process boundary.
+    # Interleave the device order so every fsdp pair holds one device from
+    # each process: the param all-gather and grad reduce-scatter must ride
+    # the cross-process collective layer, not stay intra-host.
+    devs = jax.devices()
+    by_proc = {0: [d for d in devs if d.process_index == 0],
+               1: [d for d in devs if d.process_index == 1]}
+    assert len(by_proc[0]) == len(by_proc[1]) == 4
+    order = [by_proc[p][i] for i in range(4) for p in (0, 1)]
+    mesh2 = make_mesh(MeshSpec(dp=4, fsdp=2), order)
+    pairs = mesh2.devices.reshape(4, 2)
+    assert all(
+        {d.process_index for d in row} == {0, 1} for row in pairs
+    ), "fsdp pairs must straddle the two processes"
+
+    from distar_tpu.parallel.mesh import batch_sharding as lib_batch_sharding
+
+    w_sh = NamedSharding(mesh2, P("fsdp"))     # param sharded over fsdp
+    bs2 = lib_batch_sharding(mesh2)            # the library's dp x fsdp spec
+    repl2 = NamedSharding(mesh2, P())
+    step2 = jax.jit(raw_step, in_shardings=(w_sh, bs2, bs2), out_shardings=(w_sh, repl2))
+
+    w2 = make_global(np.asarray(rng.standard_normal((16, 4)), np.float32), w_sh)
+    x2 = make_global(x_global, bs2)
+    y2 = make_global(y_global, bs2)
+    losses2 = []
+    for _ in range(3):
+        w2, l2 = step2(w2, x2, y2)
+        losses2.append(float(l2))
+    assert losses2[-1] < losses2[0], losses2
+    assert "fsdp" in str(w2.sharding.spec)
+    if rank == 0:
+        print(f"multihost fsdp smoke ok: cross-process shards, losses={losses2}")
 
 
 def main() -> int:

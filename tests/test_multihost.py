@@ -14,3 +14,4 @@ def test_two_process_distributed_smoke():
     )
     assert proc.returncode == 0, proc.stderr[-2000:] + proc.stdout[-500:]
     assert "multihost smoke ok" in proc.stdout
+    assert "multihost fsdp smoke ok" in proc.stdout  # cross-process shards ran
