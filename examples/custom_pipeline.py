@@ -1,0 +1,63 @@
+"""A runnable custom pipeline (role of the reference's agent template,
+distar/agent/template/agent.py): copy this file anywhere on sys.path,
+rename it, and select it by module name.
+
+Try it (no game needed):
+
+    # the learner: a subclass that logs through the standard stack
+    PYTHONPATH=examples python -m distar_tpu.bin.sl_train \
+        --platform cpu --iters 2 --pipeline custom_pipeline
+
+    # the agent: plays side 1 of a league job (docs/agent_contract.md)
+    #   league config:  pipeline: [custom_pipeline]
+    #   or a job dict:  {"pipelines": ["default", "custom_pipeline"]}
+
+Custom agents OWN their inference (distar_tpu/plugins.py): ``act`` may
+run its own jitted model, a policy table, or a remote call — the Actor
+gives it no inference slot, teacher, or trajectory assembly.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from distar_tpu.actor.scripted import ScriptedAgent
+from distar_tpu.learner import RLLearner as _RLLearner
+from distar_tpu.learner import SLLearner as _SLLearner
+from distar_tpu.lib.actions import ACTIONS, TARGET_LOCATION_MASK
+
+
+class Agent(ScriptedAgent):
+    """Attack-move toward the map centre every few decisions, else no-op.
+
+    Demonstrates the contract surface: read the feature-level obs, emit a
+    structurally valid action dict (per-head applicability comes from the
+    ACTIONS table).
+    """
+
+    HAS_MODEL = False
+
+    _ATTACK = next(
+        i for i, a in enumerate(ACTIONS) if a["name"] == "Attack_pt" and TARGET_LOCATION_MASK[i]
+    )
+
+    def act(self, obs: dict) -> dict:
+        n = int(np.asarray(obs["entity_num"]))
+        if self._steps % 4 == 0 and n > 0:
+            return {
+                "action_type": self._ATTACK,
+                "delay": 8,
+                "queued": 0,
+                "selected_units": list(range(min(n, 8))),
+                "target_unit": 0,
+                "target_location": 76 * 160 + 80,  # map centre (y*W + x)
+            }
+        return self._noop()  # the base class's structurally valid no-op
+
+
+class SLLearner(_SLLearner):
+    """Example learner override: everything inherited; hook your own loss,
+    dataloader, or logging here."""
+
+
+class RLLearner(_RLLearner):
+    """Same for RL — `rl_train --pipeline custom_pipeline` builds this."""

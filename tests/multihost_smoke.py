@@ -41,11 +41,12 @@ def worker(rank: int, port: int) -> None:
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     from distar_tpu.parallel import MeshSpec, make_mesh
+    from distar_tpu.parallel.mesh import batch_sharding as lib_batch_sharding
 
     mesh = make_mesh(MeshSpec(dp=8))
     assert mesh.devices.size == 8
 
-    batch_sharding = NamedSharding(mesh, P("dp"))
+    batch_sharding = lib_batch_sharding(mesh)  # P("dp") on a dp-only mesh
     repl = NamedSharding(mesh, P())
 
     # one data-parallel "train step": per-shard loss grads psum over dp
@@ -99,8 +100,6 @@ def worker(rank: int, port: int) -> None:
     assert all(
         {d.process_index for d in row} == {0, 1} for row in pairs
     ), "fsdp pairs must straddle the two processes"
-
-    from distar_tpu.parallel.mesh import batch_sharding as lib_batch_sharding
 
     w_sh = NamedSharding(mesh2, P("fsdp"))     # param sharded over fsdp
     bs2 = lib_batch_sharding(mesh2)            # the library's dp x fsdp spec
