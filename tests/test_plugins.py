@@ -137,3 +137,28 @@ def test_custom_agent_vs_model_job(custom_pipeline):
     for r in results:
         assert r["0"]["player_id"] == "MP0"
         assert r["1"]["player_id"] == "EXT"
+
+
+def test_shipped_example_pipeline(monkeypatch):
+    """examples/custom_pipeline.py must stay loadable through the registry
+    (it is the user-facing template) and act within the contract."""
+    import os
+
+    examples = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "examples"
+    )
+    monkeypatch.syspath_prepend(examples)
+    import numpy as np
+
+    from distar_tpu.lib import features as F
+    from distar_tpu.lib.actions import ACTIONS
+
+    for comp in ("Agent", "SLLearner", "RLLearner"):
+        assert plugins.load_component("custom_pipeline", comp) is not None
+    ag = plugins.build_agent("custom_pipeline", "EX", seed=0, race="zerg")
+    ag.reset()
+    obs = F.fake_step_data(train=False, rng=np.random.default_rng(1))
+    for _ in range(4):
+        act = ag.step(obs)
+        assert 0 <= int(np.asarray(act["action_type"])) < len(ACTIONS)
+    sys.modules.pop("custom_pipeline", None)
