@@ -1,0 +1,61 @@
+"""MockEnv contract tests (the game-free test double every smoke loop and
+CI pipeline rides on — previously covered only through those pipelines)."""
+from distar_tpu.envs import MockEnv
+from distar_tpu.lib import features as F
+
+
+def _noop(delay):
+    return {"action_type": 0, "delay": delay, "queued": 0,
+            "selected_units": [], "target_unit": 0, "target_location": 0}
+
+
+def test_obs_matches_feature_schema():
+    env = MockEnv(seed=1)
+    obs = env.reset()
+    assert set(obs) == {0, 1}
+    o = obs[0]
+    for key in ("spatial_info", "scalar_info", "entity_info", "entity_num",
+                "action_result", "battle_score", "opponent_battle_score"):
+        assert key in o, key
+    assert set(o["entity_info"]) == set(dict(F.ENTITY_INFO))
+    for v in o["entity_info"].values():
+        assert v.shape[0] == F.MAX_ENTITY_NUM
+    assert 0 < int(o["entity_num"]) <= F.MAX_ENTITY_NUM
+
+
+def test_step_advances_by_min_delay_and_terminates():
+    env = MockEnv(episode_game_loops=100, seed=2)
+    env.reset()
+    obs, rewards, done, info = env.step({0: _noop(30), 1: _noop(10)})
+    assert info["game_loop"] == 10  # earliest due agent drives the clock
+    assert not done and all(r == 0.0 for r in rewards.values())
+    # zero AND negative delays still make progress (no infinite loops)
+    _, _, _, info = env.step({0: _noop(0), 1: _noop(0)})
+    assert info["game_loop"] == 11
+    _, _, _, info = env.step({0: _noop(-5), 1: _noop(3)})
+    assert info["game_loop"] == 12
+
+    while not done:
+        obs, rewards, done, info = env.step({0: _noop(50), 1: _noop(50)})
+    assert info["game_loop"] >= 100
+    assert sorted(rewards.values()) == [-1.0, 1.0]  # zero-sum terminal
+    assert info["winner"] in (0, 1)
+
+
+def test_win_rule_first_and_reset_restarts_clock():
+    env = MockEnv(episode_game_loops=20, win_rule="first", seed=3)
+    env.reset()
+    done = False
+    while not done:
+        _, rewards, done, info = env.step({0: _noop(8), 1: _noop(8)})
+    assert info["winner"] == 0 and rewards[0] == 1.0
+
+    obs = env.reset()
+    assert float(obs[0]["scalar_info"]["time"]) == 0.0
+
+
+def test_value_feature_toggle():
+    env = MockEnv(include_value_feature=True, seed=4)
+    obs = env.reset()
+    assert "value_feature" in obs[0]
+    assert "value_feature" not in MockEnv(seed=4).reset()[0]
