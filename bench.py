@@ -349,7 +349,56 @@ def _bench_rl(batch_size, unroll_len, peak, iters=4, cap=None):
     return point
 
 
+def _run_child_simulated(spec: str) -> None:
+    """Harness-test seam (tests/test_bench.py): play back a scripted child —
+    stages, sleeps, result lines — with no jax and no backend, so the
+    parent's kill/extend/retry decisions are testable deterministically
+    instead of via a real multi-minute cold compile (which is what made the
+    round-4 harness test flaky under CPU oversubscription).
+
+    ``spec``: ';'-separated per-attempt scripts, each a comma-separated op
+    list — ``stage:<name>:<sleep_s>`` or ``result:<frames_per_sec>``. The
+    attempt index persists in the BENCH_SIMULATE_STATE file (attempts past
+    the last script replay the last one)."""
+    scripts = spec.split(";")
+    idx = 0
+    state = os.environ.get("BENCH_SIMULATE_STATE")
+    if state:
+        try:
+            with open(state) as f:
+                idx = int(f.read().strip() or 0)
+        except (OSError, ValueError):
+            idx = 0
+        with open(state, "w") as f:
+            f.write(str(idx + 1))
+    for op in filter(None, scripts[min(idx, len(scripts) - 1)].split(",")):
+        parts = op.split(":")
+        if parts[0] == "stage":
+            _stage(parts[1])
+            if len(parts) > 2:
+                time.sleep(float(parts[2]))
+        elif parts[0] == "result":
+            fps = float(parts[1])
+            print(
+                json.dumps(
+                    {
+                        "metric": "SL replay-frames/sec/chip (simulated child)",
+                        "value": fps,
+                        "unit": "frames/s",
+                        "vs_baseline": round(fps / SL_BASELINE_FRAMES, 3),
+                        "sl": {"frames_per_sec": fps},
+                        "sl_sweep": [],
+                        "rl_sweep": [],
+                    }
+                ),
+                flush=True,
+            )
+
+
 def run_child():
+    if os.environ.get("BENCH_SIMULATE"):
+        _run_child_simulated(os.environ["BENCH_SIMULATE"])
+        return
     _start_heartbeat()
     _stage("import-jax")
     import jax
@@ -365,14 +414,14 @@ def run_child():
         and os.path.basename(sys.argv[0]) != "bench.py"
     )
     if not in_pytest_process:
-        try:
-            jax.config.update(
-                "jax_compilation_cache_dir",
-                os.environ.get("BENCH_COMPILE_CACHE", "/tmp/jax_cache_distar_tpu_bench"),
-            )
-            jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-        except Exception:
-            pass
+        # host-keyed: XLA:CPU AOT entries bake in the compiling machine's
+        # features and this container migrates hosts (utils/compile_cache.py)
+        from distar_tpu.utils.compile_cache import configure as _configure_cache
+
+        _configure_cache(
+            jax,
+            os.environ.get("BENCH_COMPILE_CACHE", "/tmp/jax_cache_distar_tpu_bench"),
+        )
     if os.environ.get("BENCH_PLATFORM"):
         # for CPU smoke tests of the harness itself: the image's
         # sitecustomize pins the platform via jax.config, so the

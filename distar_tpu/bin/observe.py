@@ -1,11 +1,15 @@
-"""Headless observer: render a running game's raw observation without the
-SC2 UI.
+"""Observer: render a running game's raw observation without the SC2 UI.
 
-Role of the reference's human renderer for *debugging* (reference:
-distar/pysc2/lib/renderer_human.py — the repo's deliberate divergence keeps
-SC2's own UI for realtime human play, but headless hosts still need a
-visual). Two zero-dependency outputs:
+Role of the reference's human renderer (reference: distar/pysc2/lib/
+renderer_human.py — a 1.8k-LoC pygame window with camera controls and unit
+overlays). The repo's deliberate divergence: realtime human PLAY uses SC2's
+own UI (bin/play.py --human), so the renderer's remaining jobs are
+observing and debugging — covered here with zero extra dependencies:
 
+  * ``--interactive`` — curses UI with the reference renderer's observer
+    affordances: camera pan (arrows/hjkl) + zoom (+/-), a cursor
+    (WASD) that inspects the units under it (type/hp/orders overlay),
+    pause, and a live HUD (loop, camera rect, unit counts)
   * ``--ascii``   — a downsampled live map in the terminal (own units 'o',
     enemies 'x', neutral '.', terrain shading by height)
   * ``--frames DIR`` — binary PPM (P6) images per observation, viewable by
@@ -40,7 +44,9 @@ def decode_terrain(game_info, map_size: Tuple[int, int]) -> np.ndarray:
     if arr.size != img.size.x * img.size.y:
         return np.zeros((H, W), np.uint8)
     arr = arr.reshape(img.size.y, img.size.x)
-    return arr[:H, :W] if arr.shape >= (H, W) else np.zeros((H, W), np.uint8)
+    if arr.shape[0] >= H and arr.shape[1] >= W:
+        return arr[:H, :W]
+    return np.zeros((H, W), np.uint8)
 
 
 def obs_to_grid(raw_obs, map_size: Tuple[int, int], own_player: int,
@@ -101,6 +107,189 @@ def render_ppm(grid: dict, path: str) -> None:
         f.write(img.tobytes())
 
 
+class CameraView:
+    """Viewport math + character rendering for the interactive observer,
+    kept curses-free so it is testable headlessly (the curses loop in
+    ``run_interactive`` is a thin input shell around it).
+
+    World coordinates are game cells (y-up); the view renders y-down. One
+    character covers ``scale`` world cells horizontally and ``2*scale``
+    vertically (terminal glyphs are ~2x taller than wide)."""
+
+    MIN_SCALE = 0.25
+
+    def __init__(self, map_size: Tuple[int, int], cols: int = 64, rows: int = 24):
+        self.W, self.H = int(map_size[0]), int(map_size[1])
+        self.cols, self.rows = max(cols, 8), max(rows, 4)
+        self.cx, self.cy = self.W / 2.0, self.H / 2.0
+        # start fully zoomed out: the whole map fits the view
+        self.scale = max(self.W / self.cols, self.H / (2.0 * self.rows), self.MIN_SCALE)
+        self.cur_col, self.cur_row = self.cols // 2, self.rows // 2
+
+    # ------------------------------------------------------------- controls
+    def pan(self, dx_chars: int, dy_chars: int) -> None:
+        """Move the camera by character steps (dy_chars > 0 pans DOWN on
+        screen = toward smaller world y)."""
+        self.cx = float(np.clip(self.cx + dx_chars * self.scale, 0, self.W))
+        self.cy = float(np.clip(self.cy - dy_chars * 2.0 * self.scale, 0, self.H))
+
+    def zoom(self, factor: float) -> None:
+        max_scale = max(self.W / self.cols, self.H / (2.0 * self.rows), self.MIN_SCALE)
+        self.scale = float(np.clip(self.scale * factor, self.MIN_SCALE, max_scale))
+
+    def move_cursor(self, d_col: int, d_row: int) -> None:
+        self.cur_col = int(np.clip(self.cur_col + d_col, 0, self.cols - 1))
+        self.cur_row = int(np.clip(self.cur_row + d_row, 0, self.rows - 1))
+
+    # ------------------------------------------------------------- geometry
+    def world_rect(self):
+        """(x0, y0, x1, y1) world-cell bounds of the viewport."""
+        half_w = self.cols * self.scale / 2.0
+        half_h = self.rows * 2.0 * self.scale / 2.0
+        return (self.cx - half_w, self.cy - half_h, self.cx + half_w, self.cy + half_h)
+
+    def char_rect(self, col: int, row: int):
+        """World rect covered by one character cell (row 0 = TOP = max y)."""
+        x0, y0, x1, y1 = self.world_rect()
+        cw = (x1 - x0) / self.cols
+        ch = (y1 - y0) / self.rows
+        cx0 = x0 + col * cw
+        cy1 = y1 - row * ch
+        return (cx0, cy1 - ch, cx0 + cw, cy1)
+
+    # ------------------------------------------------------------ rendering
+    def render(self, grid: dict) -> list:
+        """Viewport -> list of row strings (same glyph language as
+        render_ascii, plus '+' for the cursor)."""
+        H, W = grid["own"].shape
+        rows = []
+        for r in range(self.rows):
+            row = []
+            for c in range(self.cols):
+                x0, y0, x1, y1 = self.char_rect(c, r)
+                xs = slice(max(int(x0), 0), max(int(np.ceil(x1)), 0))
+                ys = slice(max(int(y0), 0), max(int(np.ceil(y1)), 0))
+                out_of_map = xs.start >= W or ys.start >= H or x1 <= 0 or y1 <= 0
+                if (r, c) == (self.cur_row, self.cur_col):
+                    row.append("+")
+                elif out_of_map:
+                    row.append(" ")
+                elif grid["own"][ys, xs].any():
+                    row.append("o")
+                elif grid["enemy"][ys, xs].any():
+                    row.append("x")
+                elif grid["neutral"][ys, xs].any():
+                    row.append("'")
+                else:
+                    t = grid["terrain"][ys, xs]
+                    shade = int(t.mean()) * (len(ASCII_RAMP) - 1) // 255 if t.size else 0
+                    row.append(ASCII_RAMP[shade] if shade else ".")
+            rows.append("".join(row))
+        return rows
+
+    def inspect(self, raw_obs) -> list:
+        """Units under the cursor's character cell, nearest first — the
+        unit overlay (fields after the reference's select/overlay panel)."""
+        x0, y0, x1, y1 = self.char_rect(self.cur_col, self.cur_row)
+        mx, my = (x0 + x1) / 2.0, (y0 + y1) / 2.0
+        hits = []
+        for u in raw_obs.units:
+            if x0 <= u.pos.x < x1 and y0 <= u.pos.y < y1:
+                d = (u.pos.x - mx) ** 2 + (u.pos.y - my) ** 2
+                orders = [o.ability_id for o in getattr(u, "orders", [])]
+                hits.append((d, {
+                    "tag": u.tag,
+                    "unit_type": u.unit_type,
+                    "alliance": u.alliance,
+                    "health": float(u.health),
+                    "health_max": float(u.health_max),
+                    "pos": (float(u.pos.x), float(u.pos.y)),
+                    "orders": orders,
+                }))
+        return [info for _, info in sorted(hits, key=lambda t: t[0])]
+
+
+def hud_line(view: CameraView, loop: int, grid: dict, paused: bool) -> str:
+    x0, y0, x1, y1 = view.world_rect()
+    return (
+        f"loop {loop}  cam[{x0:.0f},{y0:.0f}..{x1:.0f},{y1:.0f}] "
+        f"x{view.scale:.2f}  own {int(grid['own'].sum())} "
+        f"enemy {int(grid['enemy'].sum())}"
+        + ("  [PAUSED]" if paused else "")
+        + "  (q quit, arrows pan, +/- zoom, wasd cursor, space pause)"
+    )
+
+
+def run_interactive(controller, map_size, terrain, interval: float) -> None:
+    """Curses shell: keyboard -> CameraView, one observe() per frame."""
+    import curses
+
+    def loop(scr):
+        curses.curs_set(0)
+        scr.nodelay(True)
+        h, w = scr.getmaxyx()
+        view = CameraView(map_size, cols=min(w - 2, 100), rows=max(h - 8, 6))
+        paused = False
+        obs = controller.observe()
+        last = 0.0
+        def put(row, text):
+            # clamp to the window: short terminals / resize races must not
+            # kill the observer with a curses.error
+            if 0 <= row < h - 1:
+                try:
+                    scr.addnstr(row, 0, text, w - 1)
+                except curses.error:
+                    pass
+
+        while True:
+            now = time.time()
+            if not paused and now - last >= interval:
+                obs = controller.observe()
+                last = now
+            raw = obs.observation.raw_data
+            grid = obs_to_grid(raw, map_size, 1, terrain)
+            scr.erase()
+            put(0, hud_line(view, obs.observation.game_loop, grid, paused))
+            for i, row in enumerate(view.render(grid)):
+                put(1 + i, row)
+            for i, u in enumerate(view.inspect(raw)[:4]):
+                put(
+                    2 + view.rows + i,
+                    f"> type {u['unit_type']} ally {u['alliance']} "
+                    f"hp {u['health']:.0f}/{u['health_max']:.0f} "
+                    f"at ({u['pos'][0]:.1f},{u['pos'][1]:.1f}) orders {u['orders']}",
+                )
+            scr.refresh()
+            key = scr.getch()
+            if key in (ord("q"), 27):
+                return
+            elif key == ord(" "):
+                paused = not paused
+            elif key in (curses.KEY_LEFT, ord("h")):
+                view.pan(-4, 0)
+            elif key in (curses.KEY_RIGHT, ord("l")):
+                view.pan(4, 0)
+            elif key in (curses.KEY_UP, ord("k")):
+                view.pan(0, -2)
+            elif key in (curses.KEY_DOWN, ord("j")):
+                view.pan(0, 2)
+            elif key in (ord("+"), ord("=")):
+                view.zoom(0.5)
+            elif key == ord("-"):
+                view.zoom(2.0)
+            elif key == ord("a"):
+                view.move_cursor(-1, 0)
+            elif key == ord("d"):
+                view.move_cursor(1, 0)
+            elif key == ord("w"):
+                view.move_cursor(0, -1)
+            elif key == ord("s"):
+                view.move_cursor(0, 1)
+            time.sleep(0.03)
+
+    curses.wrapper(loop)
+
+
 def main(argv=None) -> None:
     p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     p.add_argument("--endpoint", default="", help="host:port of a running client")
@@ -108,6 +297,8 @@ def main(argv=None) -> None:
     p.add_argument("--interval", type=float, default=1.0, help="seconds between frames")
     p.add_argument("--count", type=int, default=0, help="frames to capture (0 = forever)")
     p.add_argument("--ascii", action="store_true", help="live terminal map")
+    p.add_argument("--interactive", action="store_true",
+                   help="curses UI: camera pan/zoom + unit-inspect cursor")
     p.add_argument("--frames", default="", help="directory for PPM frames")
     args = p.parse_args(argv)
 
@@ -121,6 +312,9 @@ def main(argv=None) -> None:
     gi = controller.game_info()
     map_size = (gi.start_raw.map_size.x, gi.start_raw.map_size.y)
     terrain = decode_terrain(gi, map_size)
+    if args.interactive:
+        run_interactive(controller, map_size, terrain, args.interval)
+        return
     if args.frames:
         os.makedirs(args.frames, exist_ok=True)
 

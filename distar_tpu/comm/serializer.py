@@ -54,6 +54,22 @@ def loads(blob: bytes) -> Any:
     raise ValueError(f"unknown payload magic {magic!r}")
 
 
+def save_payload(path: str, obj: Any, compress: bool = True) -> str:
+    """Serialise + store a payload on any registered storage backend
+    (utils/storage.py scheme routing — the role of the reference
+    file_helper.save_file's ceph/memcached/normal dispatch, :71-120)."""
+    from ..utils import storage
+
+    storage.write_bytes(path, dumps(obj, compress=compress))
+    return path
+
+
+def load_payload(path: str) -> Any:
+    from ..utils import storage
+
+    return loads(storage.read_bytes(path))
+
+
 def frame(blob: bytes) -> bytes:
     """Length-prefix a payload (8-byte big-endian), the adapter wire format
     (role of the reference's length-prefixed frames, adapter.py:140-151)."""

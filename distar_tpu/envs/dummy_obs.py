@@ -135,3 +135,145 @@ def make_raw_action(ability_id: int, unit_tags: Sequence[int] = (),
     if target_pos is not None:
         uc.target_world_space_pos = pos(*target_pos)
     return NS(unit_command=uc)
+
+
+def make_autocast_action(ability_id: int, unit_tags: Sequence[int] = ()):
+    return NS(toggle_autocast=NS(ability_id=ability_id, unit_tags=list(unit_tags)))
+
+
+def build_parity_fixtures():
+    """Deterministic proto fixtures shared by the obs-transform golden
+    parity harness: tools/record_reference_obs_golden.py replays them
+    through the REFERENCE Features.transform_obs / reverse_raw_action
+    (reference features.py:463,854) on torch, tests/test_obs_golden_parity.py
+    replays them through envs/features.ProtoFeatures — both sides see
+    byte-identical inputs, so every output field is a cross-check.
+
+    All ids are drawn from the extracted game-contract tables so every LUT
+    lookup is in-vocabulary on both sides (out-of-vocabulary handling
+    deliberately differs: the reference keeps -1 sentinels, we clamp to the
+    no-op — envs/features.py _lut).
+    """
+    from ..lib import actions as ACT
+
+    def valid(lut, n, skip=0):
+        idxs = np.nonzero(np.asarray(lut) > 0)[0][skip:skip + n]
+        assert len(idxs) == n, "contract table too small for fixtures"
+        return [int(i) for i in idxs]
+
+    unit_ab = valid(ACT.UNIT_ABILITY_REORDER, 2, skip=4)
+    queue_ab = valid(ACT.ABILITY_TO_QUEUE_ACTION, 3)
+    buff_ids = valid(ACT.BUFFS_REORDER_ARRAY, 2)
+    addon_type = valid(ACT.ADDON_REORDER_ARRAY, 1, skip=2)[0]
+    upgrade_ids = valid(ACT.UPGRADES_REORDER_ARRAY, 2, skip=3)
+
+    def pick_ability(kind):
+        """Smallest concrete ability whose canonical (gability, kind) decodes
+        to an action with a selection (and a queued head, so the queued
+        value round-trips on both sides)."""
+        for a, g in sorted(ACT.ABILITY_TO_GABILITY.items()):
+            idx = ACT.GAB_KIND_TO_ACTION.get((g, kind))
+            if idx is None:
+                continue
+            spec = ACT.ACTIONS[idx]
+            if spec["selected_units"] and (kind == "autocast" or spec["queued"]):
+                return a
+        raise AssertionError(f"no fixture ability for kind {kind}")
+
+    quick_ab = pick_ability("quick")
+    pt_ab = pick_ability("pt")
+    unit_ab_cmd = pick_ability("unit")
+    autocast_ab = pick_ability("autocast")
+
+    map_y, map_x = 120, 112  # non-square: catches x/y transpositions
+    game_info = NS(
+        start_raw=NS(
+            map_size=NS(x=map_x, y=map_y),
+            start_locations=[pos(90.5, 100.5)],
+        ),
+        map_name="ParityMap",
+        player_info=[
+            NS(player_id=1, race_requested=2, type=1),
+            NS(player_id=2, race_requested=3, type=1),
+        ],
+    )
+    # exactly ONE base structure: the reference derives the born location
+    # from it and asserts uniqueness (reference features.py:384-393)
+    hatch = make_unit(101, 86, x=30.5, y=40.5, health=1450.0, health_max=1500.0,
+                      energy=25.0, energy_max=200.0)
+    first_obs = build_dummy_obs(
+        units=[hatch], game_loop=0, map_y=map_y, map_x=map_x,
+        rng=np.random.default_rng(11),
+    )
+
+    units = [
+        hatch,
+        make_unit(102, 104, x=31.2, y=44.9, health=40.0, health_max=40.0,
+                  orders=[unit_ab[0]], weapon_cooldown=0.5,
+                  assigned_harvesters=2),
+        make_unit(103, 126, x=35.0, y=41.0, health=150.0, health_max=175.0,
+                  energy=30.0, energy_max=200.0,
+                  orders=[unit_ab[1], queue_ab[0], queue_ab[1], queue_ab[2]],
+                  buff_ids=buff_ids),
+        make_unit(104, 106, x=50.7, y=60.1, health=180.0, health_max=200.0,
+                  cargo_space_max=8, cargo_space_taken=2,
+                  passengers=[make_passenger(201, 105), make_passenger(202, 105)]),
+        make_unit(105, 105, x=36.0, y=42.0, build_progress=0.55, health=20.0,
+                  health_max=35.0),
+        make_unit(106, 48, alliance=4, x=80.4, y=90.8, health=35.0,
+                  health_max=45.0, display_type=2, owner=2,
+                  attack_upgrade_level=1),
+        make_unit(107, 74, alliance=4, x=82.0, y=95.0, health=80.0,
+                  health_max=80.0, shield=60.0, shield_max=80.0, owner=2,
+                  cloak=1, is_hallucination=True),
+        make_unit(108, 21, alliance=4, x=100.0, y=30.0, health=900.0,
+                  health_max=1000.0, owner=2, add_on_tag=109),
+        make_unit(109, addon_type, alliance=4, x=102.0, y=30.0, health=400.0,
+                  health_max=400.0, owner=2),
+        make_unit(110, 341, alliance=3, x=25.0, y=35.0, health=0.0,
+                  health_max=0.0, owner=16, mineral_contents=900,
+                  is_active=False),
+    ]
+    effects = [
+        make_effect(1, [(40.0, 50.0), (41.0, 50.0)], owner=2),   # PsiStorm
+        make_effect(9, [(60.0, 70.0)], owner=1),                 # skipped: own Liberator zone
+        make_effect(9, [(61.0, 71.0)], owner=2),                 # kept
+        make_effect(12, [(62.0, 72.0)], owner=1),                # skipped: own LurkerSpines
+    ]
+    obs = build_dummy_obs(
+        units=units, game_loop=4521, upgrade_ids=upgrade_ids, effects=effects,
+        map_y=map_y, map_x=map_x, minerals=754, killed_minerals=600.0,
+        killed_vespene=200.0, action_results=(2, 3),
+        rng=np.random.default_rng(12),
+    )
+    opp_units = [
+        make_unit(301, 59, x=90.5, y=100.5, health=1300.0, health_max=1500.0),
+        make_unit(302, 48, x=91.0, y=99.0, health=45.0, health_max=45.0),
+        make_unit(303, 48, x=92.5, y=98.0, health=30.0, health_max=45.0),
+        make_unit(304, 105, alliance=4, x=30.0, y=40.0, health=35.0,
+                  health_max=35.0, owner=1),  # OUR unit seen by the opponent
+    ]
+    opponent_obs = build_dummy_obs(
+        units=opp_units, game_loop=4521, upgrade_ids=upgrade_ids[:1],
+        map_y=map_y, map_x=map_x, minerals=310, killed_minerals=150.0,
+        killed_vespene=75.0, player_id=2, rng=np.random.default_rng(13),
+    )
+
+    actions = [
+        ("quick", make_raw_action(quick_ab, [102], queue_command=True)),
+        ("pt", make_raw_action(pt_ab, [102, 103], target_pos=(37.6, 55.2))),
+        ("unit", make_raw_action(unit_ab_cmd, [103, 105], target_unit_tag=106)),
+        ("bad_target", make_raw_action(unit_ab_cmd, [103], target_unit_tag=999999)),
+        ("cancel_slot", make_raw_action(305, [101])),
+        ("unload", make_raw_action(410, [104])),
+        ("frivolous", make_raw_action(6, [102])),
+        ("autocast", make_autocast_action(autocast_ab, [103])),
+        ("no_units", make_raw_action(quick_ab, [])),
+    ]
+    return {
+        "game_info": game_info,
+        "first_obs": first_obs,
+        "obs": obs,
+        "opponent_obs": opponent_obs,
+        "actions": actions,
+    }

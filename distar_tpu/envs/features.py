@@ -529,14 +529,27 @@ class ProtoFeatures:
         gab = ACT.ABILITY_TO_GABILITY.get(ability_id, ability_id)
         return ACT.GAB_KIND_TO_ACTION.get((gab, kind))
 
+    @staticmethod
+    def _proto_field(msg, name):
+        """Submessage presence: real protos need HasField (unset oneof
+        members read as defaults); duck-typed fixtures use None/absence."""
+        if hasattr(msg, "HasField"):
+            try:
+                return getattr(msg, name) if msg.HasField(name) else None
+            except ValueError:
+                return None
+        return getattr(msg, name, None)
+
     def reverse_raw_action(self, raw_action, tags: Sequence[int]) -> Dict:
         """Replay raw action -> model action dict + per-head mask (reference
         reverse_raw_action :854-951): ability canonicalised (cancel/unload
-        remaps) and disambiguated by command kind, selected tags mapped to
-        entity indices with the end-flag appended, location clamped into the
-        map after the y flip. Invalid/unknown actions come back as masked
-        no_ops (invalid=True)."""
-        uc = raw_action.unit_command
+        remaps) and disambiguated by command kind — unit_command as
+        unit/pt/quick, toggle_autocast as autocast (reference :912-922) —
+        selected tags mapped to entity indices with the end-flag appended,
+        location clamped into the map after the y flip. Invalid/unknown
+        actions come back as masked no_ops (invalid=True)."""
+        uc = self._proto_field(raw_action, "unit_command")
+        ac = self._proto_field(raw_action, "toggle_autocast")
         tag_index = {t: i for i, t in enumerate(tags)}
         entity_num = len(tags)
         S = F.MAX_SELECTED_UNITS_NUM
@@ -544,32 +557,39 @@ class ProtoFeatures:
 
         target_unit = 0
         location = 0
-        if hasattr(uc, "HasField"):
-            # real protos: unset oneof members read as defaults, so presence
-            # must come from HasField (duck-typed fixtures use None-absence)
-            pos = uc.target_world_space_pos if uc.HasField("target_world_space_pos") else None
-            target_tag = uc.target_unit_tag if uc.HasField("target_unit_tag") else None
-        else:
-            pos = getattr(uc, "target_world_space_pos", None)
-            target_tag = getattr(uc, "target_unit_tag", None)
-        if target_tag is not None:
-            kind = "unit"
-            if target_tag in tag_index:
-                target_unit = tag_index[target_tag]
+        queued = 0
+        if ac is not None:
+            kind = "autocast"
+            ability_id = ac.ability_id
+            unit_tags = ac.unit_tags
+            action_type = self._ability_to_action(ability_id, kind)
+        elif uc is not None:
+            ability_id = uc.ability_id
+            unit_tags = uc.unit_tags
+            queued = int(getattr(uc, "queue_command", False) or 0)
+            pos = self._proto_field(uc, "target_world_space_pos")
+            target_tag = self._proto_field(uc, "target_unit_tag")
+            if target_tag is not None:
+                kind = "unit"
+                if target_tag in tag_index:
+                    target_unit = tag_index[target_tag]
+                else:
+                    invalid = True
+            elif pos is not None:
+                kind = "pt"
+                x = int(pos.x) if hasattr(pos, "x") else int(pos[0])
+                y = int(pos.y) if hasattr(pos, "y") else int(pos[1])
+                x = min(x, int(self.map_size.x) - 1)
+                y = min(int(self.map_size.y) - y, int(self.map_size.y) - 1)
+                location = max(y, 0) * F.SPATIAL_SIZE[1] + max(x, 0)
             else:
-                invalid = True
-        elif pos is not None:
-            kind = "pt"
-            x = int(pos.x) if hasattr(pos, "x") else int(pos[0])
-            y = int(pos.y) if hasattr(pos, "y") else int(pos[1])
-            x = min(x, int(self.map_size.x) - 1)
-            y = min(int(self.map_size.y) - y, int(self.map_size.y) - 1)
-            location = max(y, 0) * F.SPATIAL_SIZE[1] + max(x, 0)
+                kind = "quick"
+            action_type = self._ability_to_action(ability_id, kind)
+            if action_type is None and kind == "quick":
+                action_type = self._ability_to_action(ability_id, "autocast")
         else:
-            kind = "quick"
-        action_type = self._ability_to_action(uc.ability_id, kind)
-        if action_type is None and kind == "quick":
-            action_type = self._ability_to_action(uc.ability_id, "autocast")
+            unit_tags = []
+            action_type = None
         if action_type is None:
             action_type = 0
             invalid = True
@@ -577,9 +597,14 @@ class ProtoFeatures:
 
         selected = np.zeros(S, np.int64)
         sun = 0
+        # tags matched against THIS obs (the reference collects only tags it
+        # can resolve, :888-894) — kept for every unit-carrying command, not
+        # just spec'd selections, and NOT capped (:930-931 caps the tensor)
+        matched = [(tag_index[t], t) for t in unit_tags if t in tag_index]
+        selected_tags: List[int] = [t for _, t in matched]
         if spec["selected_units"]:
-            idxs = [tag_index[t] for t in uc.unit_tags if t in tag_index][: S - 1]
-            if idxs:
+            if matched:
+                idxs = [i for i, _ in matched][: S - 1]
                 selected[: len(idxs)] = idxs
                 selected[len(idxs)] = entity_num  # end flag (reference :931)
                 sun = len(idxs) + 1
@@ -588,7 +613,7 @@ class ProtoFeatures:
         action = {
             "action_type": np.asarray(action_type, np.int64),
             "delay": np.asarray(0, np.int64),
-            "queued": np.asarray(int(getattr(uc, "queue_command", False)), np.int64),
+            "queued": np.asarray(queued, np.int64),
             "selected_units": selected,
             "target_unit": np.asarray(target_unit, np.int64),
             "target_location": np.asarray(location, np.int64),
@@ -597,7 +622,9 @@ class ProtoFeatures:
         mask = {
             "action_type": head_valid,
             "delay": head_valid,
-            "queued": head_valid * float(spec["queued"]),
+            # autocast commands carry no queue bit on the wire — the
+            # reference leaves queued unset there (mask 0, :887 vs :915)
+            "queued": head_valid * float(spec["queued"]) * (0.0 if ac is not None else 1.0),
             "selected_units": head_valid * float(spec["selected_units"]),
             "target_unit": head_valid * float(spec["target_unit"]),
             "target_location": head_valid * float(spec["target_location"]),
@@ -607,4 +634,10 @@ class ProtoFeatures:
             "selected_units_num": np.asarray(sun, np.int64),
             "mask": mask,
             "invalid": invalid,
+            # raw tags behind the selection, for last-action augmentation
+            # (the decoder's last_selected_units; works for autocast too)
+            "selected_tags": selected_tags,
+            "target_tag": (
+                int(tags[target_unit]) if (kind == "unit" and not invalid) else None
+            ) if uc is not None and ac is None else None,
         }

@@ -476,7 +476,6 @@ class ReplayDecoder:
             ).astype(np.uint8)
             step_data["scalar_info"]["enemy_unit_type_bool"] = enemy_unit_type_bool
 
-            uc = action.action_raw.unit_command
             rev = feature.reverse_raw_action(action.action_raw, tags)
             if rev["invalid"]:
                 continue
@@ -485,10 +484,8 @@ class ReplayDecoder:
             last_action_type = act_info["action_type"].astype(np.int16)
             last_delay = act_info["delay"].astype(np.int16)
             last_queued = act_info["queued"].astype(np.int16)
-            last_selected_tags = list(uc.unit_tags)
-            last_target_tag = (
-                uc.target_unit_tag if uc.HasField("target_unit_tag") else None
-            )
+            last_selected_tags = rev["selected_tags"]
+            last_target_tag = rev["target_tag"]
             step_data.pop("game_info")
             step_data.pop("value_feature", None)
             step_data.update(

@@ -123,10 +123,15 @@ def install_maps(source_dir: Optional[str] = None, sc2_dir: Optional[str] = None
             if not f.lower().endswith(".sc2map"):
                 continue
             rel = os.path.relpath(os.path.join(root, f), source_dir)
-            if os.sep not in rel and season:
+            season_prefixed = os.sep not in rel and bool(season)
+            if season_prefixed:
                 rel = os.path.join(season, rel)
             dst = os.path.join(sc2_dir, "Maps", rel)
             if os.path.exists(dst):
+                continue
+            # hosts that installed before the season-prefix change have the
+            # map directly under Maps/ — treat that as already installed too
+            if season_prefixed and os.path.exists(os.path.join(sc2_dir, "Maps", f)):
                 continue
             os.makedirs(os.path.dirname(dst), exist_ok=True)
             shutil.copyfile(os.path.join(root, f), dst)

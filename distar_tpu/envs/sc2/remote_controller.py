@@ -6,6 +6,14 @@ status-gated validity, create/join/restart/start_replay lifecycle,
 ``observe(target_game_loop)`` with the stub-observation regurgitation, the
 batched ``acts`` used by the env's hot loop, 'Game has already ended'
 suppression, connect retries against a booting process.
+
+Provenance: the status-gating decorator shapes (``valid_status`` /
+``skip_status`` / ``decorate_check_error``) follow the request-validity
+semantics of the SC2 api itself, which DeepMind's Apache-2.0 pysc2
+(``pysc2/lib/remote_controller.py``) first codified as decorators — the
+state machine they encode (which Status values make which request legal)
+is fixed by the game protocol, so any correct client expresses the same
+table. The implementations here are this repo's own.
 """
 from __future__ import annotations
 
@@ -118,37 +126,47 @@ class RemoteController:
         self.ping()
 
     def _connect(self, host, port, proc, timeout_seconds):
-        """Connect to the websocket, retrying while the process boots
-        (reference :147-175)."""
+        """Dial the binary's /sc2api websocket until the deadline lapses.
+
+        A booting SC2 binary refuses TCP for a while, then serves 404 until
+        the /sc2api endpoint registers — both mean "keep dialing". Two
+        conditions end the wait early: the endpoint actively closing the
+        handshake (another client owns the port — one controller per
+        process), and the process dying after it was seen alive (or never
+        appearing within the first quarter of the budget). Role parity with
+        the reference's connect retry (reference remote_controller.py:147)."""
         import websocket
 
-        if ":" in host and not host.startswith("["):  # ipv6
-            host = f"[{host}]"
-        url = f"ws://{host}:{port}/sc2api"
-
-        was_running = False
-        for i in range(timeout_seconds):
-            is_running = proc and proc.running
-            was_running = was_running or is_running
-            if (i >= timeout_seconds // 4 or was_running) and not is_running:
-                logging.warning(
-                    "SC2 isn't running, so bailing early on the websocket connection."
+        wire_host = f"[{host}]" if ":" in host and not host.startswith("[") else host
+        endpoint = f"ws://{wire_host}:{port}/sc2api"
+        start = time.monotonic()
+        boot_grace = timeout_seconds / 4  # how long a proc may take to appear
+        seen_alive = False
+        dials = 0
+        while time.monotonic() - start < timeout_seconds:
+            alive = bool(proc and proc.running)
+            seen_alive = seen_alive or alive
+            if not alive and (seen_alive or time.monotonic() - start >= boot_grace):
+                raise ConnectError(
+                    f"SC2 process is gone; stopped dialing {endpoint} after "
+                    f"{dials} attempts"
                 )
-                break
-            logging.info("Connecting to: %s, attempt: %s, running: %s", url, i, is_running)
+            dials += 1
+            logging.info("dialing %s (attempt %d, proc alive: %s)", endpoint, dials, alive)
             try:
-                return websocket.create_connection(url, timeout=timeout_seconds)
-            except socket.error:
-                pass  # SC2 hasn't started listening yet.
-            except websocket.WebSocketConnectionClosedException:
-                raise ConnectError("Connection rejected. Is something else connected?")
+                return websocket.create_connection(endpoint, timeout=timeout_seconds)
             except websocket.WebSocketBadStatusException as err:
-                if err.status_code == 404:
-                    pass  # listening, but /sc2api not up yet
-                else:
+                if err.status_code != 404:  # 404 = listening, endpoint not up yet
                     raise
+            except websocket.WebSocketConnectionClosedException:
+                raise ConnectError(
+                    f"{endpoint} closed the handshake — is another controller "
+                    "already attached to this process?"
+                )
+            except socket.error:
+                pass  # not listening yet
             time.sleep(1)
-        raise ConnectError("Failed to connect to the SC2 websocket. Is it up?")
+        raise ConnectError(f"no websocket at {endpoint} within {timeout_seconds}s")
 
     def close(self) -> None:
         self._client.close()

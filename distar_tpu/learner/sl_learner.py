@@ -263,7 +263,9 @@ class SLLearner(BaseLearner):
             )
             if (
                 spiked
-                and self.last_iter.val > warmup
+                # warmup only mutes ratio spikes (noisy early losses); a
+                # non-finite loss must dump even at iteration 1
+                and (blown_up or self.last_iter.val > warmup)
                 and not dumped  # one snapshot per iteration is plenty
                 and self._debug_dumps < self._DEBUG_DUMP_CAP
             ):

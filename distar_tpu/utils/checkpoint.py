@@ -8,14 +8,14 @@ otherwise — both produce a single self-contained directory/file per step.
 """
 from __future__ import annotations
 
-import os
 import signal
-import time
 import traceback
 from typing import Any, Callable, Dict, Optional
 
 import jax
 import numpy as np
+
+from . import storage
 
 try:
     from flax import serialization
@@ -51,34 +51,11 @@ def _host_snapshot(state: Any):
 
 
 def _write_checkpoint(path: str, host_state: Any, metadata: Optional[Dict]) -> str:
-    import threading
-
-    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
     payload = {"state": host_state, "metadata": metadata or {}}
     blob = serialization.msgpack_serialize(_to_serialisable(payload))
-    # unique tmp: a crash-path sync save can race an in-flight async writer
-    # on the same target; distinct tmps + atomic replace keep both complete
-    tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
-    try:
-        with open(tmp, "wb") as f:
-            f.write(blob)
-        os.replace(tmp, path)
-    except BaseException:
-        try:
-            os.unlink(tmp)
-        except OSError:
-            pass
-        raise
-    # reap orphans from SIGKILLed writers (full-size state copies): any
-    # same-target tmp quiet for >10 min belongs to a dead process
-    import glob as _glob
-
-    for stale in _glob.glob(_glob.escape(path) + ".tmp.*"):
-        try:
-            if time.time() - os.path.getmtime(stale) > 600:
-                os.unlink(stale)
-        except OSError:
-            pass
+    # scheme-routed (utils/storage.py): local fs by default with atomic
+    # tmp+rename and orphan reaping; mem:// / gs:// / custom for pod IO
+    storage.write_bytes(path, blob)
     return path
 
 
@@ -140,8 +117,7 @@ def load_checkpoint(path: str, target: Any = None) -> Dict:
     """Load a checkpoint; when ``target`` is given the state is restored into
     its structure (partial-match: missing leaves keep target values, extra
     leaves are dropped — the reference's partial-load semantics)."""
-    with open(path, "rb") as f:
-        payload = serialization.msgpack_restore(f.read())
+    payload = serialization.msgpack_restore(storage.read_bytes(path))
     state = payload["state"]
     if target is not None:
         state = _partial_restore(target, state)

@@ -16,15 +16,35 @@ import os
 
 
 def _host_cpu_key() -> str:
+    # LLVM (and therefore XLA:CPU's machine type) picks the target CPU from
+    # family/model/stepping, not the flag list alone — two hosts with
+    # identical flags but different models get different machine types, so
+    # the key must include the identity lines too (round-4 MULTICHIP run
+    # still hit the mismatch warning with a flags-only key)
+    ident: list[str] = []
     try:
         with open("/proc/cpuinfo") as f:
+            seen_processor = False
             for line in f:
+                key = line.split(":", 1)[0].strip()
+                # one per-CPU block is enough (all cores are identical);
+                # stop at the SECOND block rather than at any single key —
+                # ARM lists 'CPU implementer'/'CPU part' AFTER 'Features',
+                # so an early break there would drop the identity lines
+                if key == "processor":
+                    if seen_processor:
+                        break
+                    seen_processor = True
                 # x86 lists 'flags'; ARM lists 'Features'
-                if line.startswith(("flags", "Features")):
-                    flags = " ".join(sorted(line.split(":", 1)[1].split()))
-                    return hashlib.sha1(flags.encode()).hexdigest()[:8]
+                if key in ("flags", "Features"):
+                    ident.append(" ".join(sorted(line.split(":", 1)[1].split())))
+                elif key in ("vendor_id", "cpu family", "model", "model name",
+                             "stepping", "CPU implementer", "CPU part"):
+                    ident.append(line.split(":", 1)[1].strip())
     except OSError:
         pass
+    if ident:
+        return hashlib.sha1("|".join(ident).encode()).hexdigest()[:8]
     import platform
 
     # last resort: the full uname tuple — never hash an empty string, which

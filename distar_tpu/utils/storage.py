@@ -1,0 +1,204 @@
+"""Pluggable byte-blob storage backends, routed by URL scheme.
+
+Role of the reference file_helper's multi-backend payload IO (reference:
+distar/ctools/utils/file_helper.py:30-32 routes read/save through
+ceph/memcached/redis paths next to the local-fs default). The TPU-pod
+analogue of ceph is GCS, and the memcached role (a shared in-memory blob
+store for hot payloads) is covered by the in-process ``mem://`` backend —
+useful in tests and single-host runs; a networked store can register its
+own backend without touching any call site.
+
+Schemes:
+  * plain paths / ``file://``  -> LocalBackend (atomic tmp+rename writes)
+  * ``mem://``                 -> MemBackend (process-local dict)
+  * ``gs://``                  -> GcsBackend (stub: raises with guidance
+                                  until google-cloud-storage is installed;
+                                  nothing in this image may pip install)
+
+``utils.checkpoint`` and ``comm.serializer.save_payload/load_payload``
+route through here, so checkpoints, league snapshots and trajectory
+payloads can live on any registered backend.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Dict, Iterable, Tuple
+
+
+class StorageBackend:
+    """Byte-blob store. Paths are backend-native (scheme stripped)."""
+
+    def write_bytes(self, path: str, data: bytes) -> None:
+        raise NotImplementedError
+
+    def read_bytes(self, path: str) -> bytes:
+        raise NotImplementedError
+
+    def exists(self, path: str) -> bool:
+        raise NotImplementedError
+
+    def delete(self, path: str) -> None:
+        raise NotImplementedError
+
+    def list(self, prefix: str) -> Iterable[str]:
+        raise NotImplementedError
+
+
+class LocalBackend(StorageBackend):
+    """Local filesystem with the atomic write discipline checkpoints need:
+    unique tmp + os.replace (a crash-path sync save can race an in-flight
+    async writer on the same target; distinct tmps keep both complete), and
+    reaping of orphaned tmps from SIGKILLed writers."""
+
+    def write_bytes(self, path: str, data: bytes) -> None:
+        parent = os.path.dirname(os.path.abspath(path))
+        os.makedirs(parent, exist_ok=True)
+        tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
+        try:
+            with open(tmp, "wb") as f:
+                f.write(data)
+            os.replace(tmp, path)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+        import glob
+
+        for stale in glob.glob(glob.escape(path) + ".tmp.*"):
+            try:
+                if time.time() - os.path.getmtime(stale) > 600:
+                    os.unlink(stale)
+            except OSError:
+                pass
+
+    def read_bytes(self, path: str) -> bytes:
+        with open(path, "rb") as f:
+            return f.read()
+
+    def exists(self, path: str) -> bool:
+        return os.path.exists(path)
+
+    def delete(self, path: str) -> None:
+        os.unlink(path)
+
+    def list(self, prefix: str) -> Iterable[str]:
+        import glob
+
+        return sorted(glob.glob(prefix + "*"))
+
+
+class MemBackend(StorageBackend):
+    """Process-local blob dict — the memcached-role backend for tests and
+    single-host runs."""
+
+    def __init__(self):
+        self._blobs: Dict[str, bytes] = {}
+        self._lock = threading.Lock()
+
+    def write_bytes(self, path: str, data: bytes) -> None:
+        with self._lock:
+            self._blobs[path] = bytes(data)
+
+    def read_bytes(self, path: str) -> bytes:
+        with self._lock:
+            if path not in self._blobs:
+                raise FileNotFoundError(f"mem://{path}")
+            return self._blobs[path]
+
+    def exists(self, path: str) -> bool:
+        with self._lock:
+            return path in self._blobs
+
+    def delete(self, path: str) -> None:
+        with self._lock:
+            if path not in self._blobs:
+                raise FileNotFoundError(f"mem://{path}")
+            del self._blobs[path]
+
+    def list(self, prefix: str) -> Iterable[str]:
+        with self._lock:
+            return sorted(k for k in self._blobs if k.startswith(prefix))
+
+
+class GcsBackend(StorageBackend):
+    """GCS stub: the pod-scale analogue of the reference's ceph path. The
+    client library is not in this image (and installing is out of scope);
+    every call raises with the wiring a deployment needs."""
+
+    _HINT = (
+        "gs:// storage needs the google-cloud-storage client, which is not "
+        "bundled. Install it in your deployment image and register a real "
+        "backend: storage.register_backend('gs', YourGcsBackend())."
+    )
+
+    def _unavailable(self):
+        try:
+            import google.cloud.storage  # noqa: F401  (present in real pods)
+        except ImportError as e:
+            raise RuntimeError(self._HINT) from e
+        raise RuntimeError(
+            "google-cloud-storage is importable but the bundled GcsBackend "
+            "is a stub; register a real backend via register_backend()."
+        )
+
+    def write_bytes(self, path, data):
+        self._unavailable()
+
+    def read_bytes(self, path):
+        self._unavailable()
+
+    def exists(self, path):
+        self._unavailable()
+
+    def delete(self, path):
+        self._unavailable()
+
+    def list(self, prefix):
+        self._unavailable()
+
+
+_BACKENDS: Dict[str, StorageBackend] = {
+    "file": LocalBackend(),
+    "mem": MemBackend(),
+    "gs": GcsBackend(),
+}
+
+
+def register_backend(scheme: str, backend: StorageBackend) -> None:
+    _BACKENDS[scheme] = backend
+
+
+def resolve(path: str) -> Tuple[StorageBackend, str]:
+    """``scheme://rest`` -> (backend, rest); schemeless paths are local.
+    Windows drive letters ("C:/...") are not schemes: a scheme needs '://'."""
+    if "://" in path:
+        scheme, rest = path.split("://", 1)
+        backend = _BACKENDS.get(scheme)
+        if backend is None:
+            raise ValueError(f"no storage backend registered for {scheme}://")
+        return backend, rest
+    return _BACKENDS["file"], path
+
+
+def write_bytes(path: str, data: bytes) -> None:
+    backend, rest = resolve(path)
+    backend.write_bytes(rest, data)
+
+
+def read_bytes(path: str) -> bytes:
+    backend, rest = resolve(path)
+    return backend.read_bytes(rest)
+
+
+def exists(path: str) -> bool:
+    backend, rest = resolve(path)
+    return backend.exists(rest)
+
+
+def delete(path: str) -> None:
+    backend, rest = resolve(path)
+    backend.delete(rest)

@@ -1,0 +1,134 @@
+"""Record the REFERENCE obs/action transforms as golden parity fixtures.
+
+Runs the reference ``Features.transform_obs`` and ``reverse_raw_action``
+(reference: distar/agent/default/lib/features.py:463,854 — executed, never
+copied) on the shared deterministic dummy protos from
+``distar_tpu.envs.dummy_obs.build_parity_fixtures`` and saves every output
+field to ``obs_transform.npz``. tests/test_obs_golden_parity.py replays the
+SAME fixtures through ``envs/features.ProtoFeatures`` and diffs field by
+field — the reference's behavior is the spec for the whole obs contract
+(spatial planes, effect lists, the 38-field entity rows and their LUT
+remaps, scalar stats, value features, and replay action decoding).
+
+Run:  python tools/record_reference_obs_golden.py --out /tmp/golden_ref
+"""
+import argparse
+import os
+import sys
+from types import SimpleNamespace as NS
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+REF = "/root/reference"
+
+
+def fixture_fingerprint() -> str:
+    """Hash of the fixture-defining sources: a cached golden npz recorded
+    from OLDER fixtures must never be diffed against newer ones (the test
+    regenerates on mismatch)."""
+    import hashlib
+
+    h = hashlib.sha256()
+    for path in (
+        os.path.join(REPO, "distar_tpu", "envs", "dummy_obs.py"),
+        os.path.abspath(__file__),
+    ):
+        with open(path, "rb") as f:
+            h.update(f.read())
+    return h.hexdigest()[:16]
+
+
+class HF:
+    """HasField adapter: the reference checks proto submessage presence via
+    HasField; the shared fixtures are SimpleNamespace trees using
+    None/absence. Wraps attribute access recursively."""
+
+    def __init__(self, ns):
+        object.__setattr__(self, "_ns", ns)
+
+    def HasField(self, name):
+        return getattr(self._ns, name, None) is not None
+
+    def __getattr__(self, k):
+        v = getattr(self._ns, k)
+        return HF(v) if isinstance(v, NS) else v
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--out", default="/tmp/golden_ref")
+    args = parser.parse_args()
+    os.makedirs(args.out, exist_ok=True)
+
+    sys.path.insert(0, REPO)
+    sys.path.insert(0, REF)
+    from record_reference_golden import install_stub_modules
+
+    install_stub_modules()
+
+    import numpy as np
+    import torch
+
+    from distar_tpu.envs.dummy_obs import build_parity_fixtures
+
+    fx = build_parity_fixtures()
+
+    from distar.agent.default.lib.features import Features
+
+    feat = Features(fx["game_info"], fx["first_obs"], cfg={})
+
+    arrays = {
+        "meta/fingerprint": np.asarray(fixture_fingerprint()),
+        "meta/home_born_location": np.asarray(feat.home_born_location),
+        "meta/away_born_location": np.asarray(feat.away_born_location),
+    }
+
+    def put(key, value):
+        if isinstance(value, torch.Tensor):
+            value = value.numpy()
+        arrays[key] = np.asarray(value)
+
+    ret = feat.transform_obs(
+        fx["obs"], padding_spatial=True, opponent_obs=fx["opponent_obs"]
+    )
+    for k, v in ret["spatial_info"].items():
+        put(f"spatial/{k}", v)
+    for k, v in ret["entity_info"].items():
+        put(f"entity/{k}", v)
+    for k, v in ret["scalar_info"].items():
+        put(f"scalar/{k}", v)
+    for k, v in ret["value_feature"].items():
+        put(f"vf/{k}", v)
+    put("entity_num", ret["entity_num"])
+    gi = ret["game_info"]
+    put("game/tags", np.asarray(gi["tags"], np.int64))
+    put("game/game_loop", gi["game_loop"])
+    put("game/battle_score", gi["battle_score"])
+    put("game/opponent_battle_score", gi["opponent_battle_score"])
+    put("game/action_result", np.asarray(gi["action_result"], np.int64))
+    arrays["game/map_name"] = np.asarray(gi["map_name"])
+
+    tags = gi["tags"]
+    for name, raw_action in fx["actions"]:
+        action = HF(NS(action_raw=raw_action))
+        (action_ret, action_mask, sun, last_sel_tags, last_target_tag,
+         invalid) = feat.reverse_raw_action(action, tags)
+        base = f"act/{name}"
+        for k, v in action_ret.items():
+            put(f"{base}/{k}", v)
+        for k, v in action_mask.items():
+            put(f"{base}/mask_{k}", v)
+        put(f"{base}/selected_units_num", sun)
+        put(f"{base}/invalid", np.asarray(bool(invalid)))
+        put(f"{base}/last_selected_tags",
+            np.asarray(last_sel_tags if last_sel_tags else [], np.int64))
+        put(f"{base}/last_target_tag",
+            np.asarray(-1 if last_target_tag is None else last_target_tag, np.int64))
+
+    path = os.path.join(args.out, "obs_transform.npz")
+    np.savez_compressed(path, **arrays)
+    print(f"recorded obs_transform: {len(arrays)} arrays -> {path}")
+
+
+if __name__ == "__main__":
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    main()

@@ -1,7 +1,7 @@
 """On-chip measurement campaign: everything the round needs from ONE
 successful chip claim, in priority order.
 
-  1. bench.py sweep (SL/RL/sl_real)     -> BENCH_LOCAL_r04.json (repo root)
+  1. bench.py sweep (SL/RL/sl_real)     -> BENCH_LOCAL_r05.json (repo root)
   2. kernel microbench (pallas vs XLA)  -> artifacts/pallas_microbench_tpu.json
   3. full-step attention A/B            -> artifacts/fullstep_ab_tpu.json
   4. jax.profiler trace of the SL step  -> experiments/profile_sl/
@@ -108,7 +108,7 @@ def _last_json_line(stdout: str):
 
 
 def stage_bench(deadline: int) -> bool:
-    out_path = os.path.join(REPO, "BENCH_LOCAL_r04.json")
+    out_path = os.path.join(REPO, "BENCH_LOCAL_r05.json")
     if os.path.exists(out_path):
         print("[campaign] bench: artifact exists, skipping", flush=True)
         return True
@@ -192,7 +192,7 @@ def stage_profile() -> bool:
 import os, time, json
 import jax
 from distar_tpu.utils.compile_cache import configure as _cc
-_cc(jax, "/tmp/jax_cache_distar_tpu_bench")
+_cc(jax, "/tmp/jax_cache_distar_tpu_bench")  # host-keyed by configure()
 from distar_tpu.learner import SLLearner
 cfg = {
     "common": {"experiment_name": "profile_sl"},
