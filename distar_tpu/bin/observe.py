@@ -71,25 +71,30 @@ def obs_to_grid(raw_obs, map_size: Tuple[int, int], own_player: int,
     return {"terrain": terrain, "own": own, "enemy": enemy, "neutral": neutral}
 
 
+def _glyph(grid: dict, ys: slice, xs: slice) -> str:
+    """One character for a world rect: unit presence by precedence, else a
+    terrain shade (the shared glyph language of every renderer here)."""
+    if grid["own"][ys, xs].any():
+        return "o"
+    if grid["enemy"][ys, xs].any():
+        return "x"
+    if grid["neutral"][ys, xs].any():
+        return "'"
+    t = grid["terrain"][ys, xs]
+    shade = int(t.mean()) * (len(ASCII_RAMP) - 1) // 255 if t.size else 0
+    return ASCII_RAMP[shade] if shade else "."
+
+
 def render_ascii(grid: dict, width: int = 64) -> str:
     H, W = grid["own"].shape
     step_x = max(W // width, 1)
     step_y = max(H // (width // 2), 1)
     rows = []
     for y in range(0, H, step_y):
-        row = []
-        for x in range(0, W, step_x):
-            oy, ox = slice(y, y + step_y), slice(x, x + step_x)
-            if grid["own"][oy, ox].any():
-                row.append("o")
-            elif grid["enemy"][oy, ox].any():
-                row.append("x")
-            elif grid["neutral"][oy, ox].any():
-                row.append("'")
-            else:
-                t = grid["terrain"][oy, ox]
-                shade = int(t.mean()) * (len(ASCII_RAMP) - 1) // 255 if t.size else 0
-                row.append(ASCII_RAMP[shade] if shade else ".")
+        row = [
+            _glyph(grid, slice(y, y + step_y), slice(x, x + step_x))
+            for x in range(0, W, step_x)
+        ]
         rows.append("".join(row))
     return "\n".join(rows)
 
@@ -159,8 +164,8 @@ class CameraView:
 
     # ------------------------------------------------------------ rendering
     def render(self, grid: dict) -> list:
-        """Viewport -> list of row strings (same glyph language as
-        render_ascii, plus '+' for the cursor)."""
+        """Viewport -> list of row strings (the shared _glyph language,
+        plus '+' for the cursor and blanks beyond the map edge)."""
         H, W = grid["own"].shape
         rows = []
         for r in range(self.rows):
@@ -174,16 +179,8 @@ class CameraView:
                     row.append("+")
                 elif out_of_map:
                     row.append(" ")
-                elif grid["own"][ys, xs].any():
-                    row.append("o")
-                elif grid["enemy"][ys, xs].any():
-                    row.append("x")
-                elif grid["neutral"][ys, xs].any():
-                    row.append("'")
                 else:
-                    t = grid["terrain"][ys, xs]
-                    shade = int(t.mean()) * (len(ASCII_RAMP) - 1) // 255 if t.size else 0
-                    row.append(ASCII_RAMP[shade] if shade else ".")
+                    row.append(_glyph(grid, ys, xs))
             rows.append("".join(row))
         return rows
 

@@ -27,7 +27,12 @@ class MockEnv(BaseEnv):
         num_agents: int = 2,
         episode_game_loops: int = 2000,
         seed: int = 0,
-        win_rule: str = "random",  # 'random' | 'first' (agent 0 always wins)
+        # 'random' | 'first' (agent 0 always wins) | 'battle' (the agent
+        # whose actions built more army wins — the LEARNABLE rule: policies
+        # that shift probability onto cumulative-stat action types beat a
+        # uniform-random opponent, so winrate/ELO curves can actually move
+        # in the mock world)
+        win_rule: str = "random",
         include_value_feature: bool = False,
     ):
         self.num_agents = num_agents
@@ -37,6 +42,15 @@ class MockEnv(BaseEnv):
         self._include_value_feature = include_value_feature
         self._game_loop = 0
         self._episode_count = 0
+        self._scores = [0.0] * num_agents
+        if win_rule == "battle":
+            from ..lib import actions as ACT
+
+            # ~half the action vocabulary counts as production: learnable
+            # separation without being a needle-in-a-haystack. Slot 0 is the
+            # z-target no-op convention, NOT a real build/train action —
+            # counting it would score idling
+            self._productive = frozenset(ACT.CUMULATIVE_STAT_ACTIONS) - {0}
 
     def _obs(self, idx: int) -> dict:
         obs = F.fake_step_data(train=False, rng=self._rng)
@@ -56,22 +70,41 @@ class MockEnv(BaseEnv):
     def reset(self) -> Dict[int, dict]:
         self._game_loop = 0
         self._episode_count += 1
+        self._scores = [0.0] * self.num_agents
         return {i: self._obs(i) for i in range(self.num_agents)}
 
     def step(self, actions: Dict[int, dict]):
         # advance to the earliest requested next observation (variable delay)
         delays = [int(np.asarray(a["delay"])) for a in actions.values()] or [1]
         self._game_loop += max(min(delays), 1)
+        if self._win_rule == "battle":
+            for i, a in actions.items():
+                at = int(np.asarray(a["action_type"]).reshape(-1)[0])
+                if at in self._productive:
+                    self._scores[i] += 1.0
         done = self._game_loop >= self._episode_game_loops
         obs = {i: self._obs(i) for i in range(self.num_agents)}
+        if self._win_rule == "battle":
+            # battle scores reflect real production so reward shaping /
+            # value features see a consistent signal
+            for i in range(self.num_agents):
+                obs[i]["battle_score"] = self._scores[i]
+                obs[i]["opponent_battle_score"] = max(
+                    s for j, s in enumerate(self._scores) if j != i
+                ) if self.num_agents > 1 else 0.0
         rewards: Dict[int, float] = {i: 0.0 for i in range(self.num_agents)}
         info: dict = {"game_loop": self._game_loop}
         if done:
             if self._win_rule == "first":
                 winner = 0
+            elif self._win_rule == "battle":
+                best = max(self._scores)
+                leaders = [i for i, s in enumerate(self._scores) if s == best]
+                winner = int(self._rng.choice(leaders))  # ties break randomly
             else:
                 winner = int(self._rng.integers(0, self.num_agents))
             for i in range(self.num_agents):
                 rewards[i] = 1.0 if i == winner else -1.0
             info["winner"] = winner
+            info["scores"] = list(self._scores)
         return obs, rewards, done, info

@@ -53,6 +53,21 @@ RL_LEARNER_DEFAULTS = deep_merge_dicts(
 )
 
 
+def make_loss_config(learner_cfg) -> ReinforcementLossConfig:
+    """Loss weights are yaml-surface config like the reference's
+    default_reinforcement_loss.yaml: any ReinforcementLossConfig field can
+    be overridden via ``learner.loss`` (e.g. kl_weight, entropy_weight,
+    pg_weights). List-valued fields arriving from yaml are normalised to
+    the dataclass's tuple-of-tuples form."""
+    overrides = {
+        k: (tuple(tuple(x) for x in v) if isinstance(v, (list, tuple)) else v)
+        for k, v in dict(learner_cfg.get("loss", {}) or {}).items()
+    }
+    # an explicit loss.use_dapo wins over the top-level learner.use_dapo
+    overrides.setdefault("use_dapo", learner_cfg.use_dapo)
+    return ReinforcementLossConfig(**overrides)
+
+
 def _flatten_time(tree):
     return jax.tree.map(lambda x: x.reshape((-1,) + x.shape[2:]), tree)
 
@@ -133,7 +148,7 @@ class RLLearner(BaseLearner):
         self.model_cfg = deep_merge_dicts(default_model_config(), cfg.get("model", {}))
         self.model_cfg.use_value_network = True
         self.model = Model(self.model_cfg)
-        self.loss_cfg = ReinforcementLossConfig(use_dapo=cfg.learner.use_dapo)
+        self.loss_cfg = make_loss_config(cfg.learner)
         self._remaining_value_pretrain = cfg.learner.get("value_pretrain_iters", -1)
         super().__init__(cfg)
 

@@ -174,6 +174,58 @@ class SLLearner(BaseLearner):
             out_shardings=(param_sh, opt_sh, flat_sh, repl),
         )
 
+    def evaluate(self, dataloader, max_batches: int = 0) -> Dict[str, float]:
+        """Held-out metric pass: run the SL forward + loss/metric grid over
+        a dataloader WITHOUT gradients or state mutation, averaging the
+        scalar metrics across batches (the eval axis of SURVEY §7 milestone
+        4 — train acc alone can't show generalization). Hidden state starts
+        cold per batch; windows within a batch still carry it forward
+        through the unroll. Stops at ``max_batches`` (0 = drain)."""
+        if not hasattr(self, "_eval_step"):
+            B = self.cfg.learner.batch_size
+
+            def eval_step(params, batch, hidden_state):
+                logits, out_state = self.model.apply(
+                    params,
+                    batch["spatial_info"], batch["entity_info"],
+                    batch["scalar_info"], batch["entity_num"],
+                    batch["action_info"], batch["selected_units_num"],
+                    hidden_state, B,
+                    method=self.model.sl_forward,
+                )
+                total, info = compute_sl_loss(
+                    logits, batch["action_info"], batch["action_mask"],
+                    batch["selected_units_num"], batch["entity_num"],
+                    self.loss_cfg,
+                )
+                info["total_loss"] = total
+                return info
+
+            self._eval_step = jax.jit(eval_step)
+        sums: Dict[str, float] = {}
+        n = 0
+        core = self.model_cfg.encoder.core_lstm
+        B = self.cfg.learner.batch_size
+        hidden = tuple(
+            (jnp.zeros((B, core.hidden_size)), jnp.zeros((B, core.hidden_size)))
+            for _ in range(core.num_layers)
+        )
+        for batch in dataloader:
+            batch = dict(batch)
+            batch.pop("new_episodes", None)
+            batch.pop("traj_lens", None)
+            batch = self._cap(batch)
+            batch = jax.tree.map(jnp.asarray, batch)
+            info = self._eval_step(self.state["params"], batch, hidden)
+            for k, v in info.items():
+                v = np.asarray(v)
+                if v.ndim == 0 and np.issubdtype(v.dtype, np.floating):
+                    sums[k] = sums.get(k, 0.0) + float(v)
+            n += 1
+            if max_batches and n >= max_batches:
+                break
+        return {k: v / max(n, 1) for k, v in sums.items()}
+
     def _place_batch(self, data):
         """Prefetch placement: device-put ahead of time, host fields kept."""
         data = self._cap(dict(data))

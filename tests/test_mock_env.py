@@ -59,3 +59,52 @@ def test_value_feature_toggle():
     obs = env.reset()
     assert "value_feature" in obs[0]
     assert "value_feature" not in MockEnv(seed=4).reset()[0]
+
+
+def test_win_rule_battle_rewards_production():
+    """The learnable rule: the agent whose actions built more army wins —
+    an always-productive agent beats an always-idle one deterministically,
+    battle_score tracks real production, and reset clears the tally."""
+    from distar_tpu.lib import actions as ACT
+
+    productive = ACT.CUMULATIVE_STAT_ACTIONS[1]  # a real build/train action
+    env = MockEnv(episode_game_loops=60, win_rule="battle", seed=5)
+    env.reset()
+    done = False
+    while not done:
+        act0 = dict(_noop(10), action_type=productive)
+        obs, rewards, done, info = env.step({0: act0, 1: _noop(10)})
+    assert info["winner"] == 0 and rewards[0] == 1.0 and rewards[1] == -1.0
+    assert info["scores"][0] > info["scores"][1] == 0.0
+    assert obs[0]["battle_score"] == info["scores"][0]
+    assert obs[1]["opponent_battle_score"] == info["scores"][0]
+
+    env.reset()
+    _, _, _, info = env.step({0: _noop(10), 1: _noop(10)})
+    # action_type 0 (no_op) is not productive: fresh tally stays zero
+    assert env._scores == [0.0, 0.0]
+
+
+def test_rl_loss_config_overrides():
+    """learner.loss yaml-surface overrides reach ReinforcementLossConfig
+    (the reference's default_reinforcement_loss.yaml dial)."""
+    from distar_tpu.learner.rl_learner import RL_LEARNER_DEFAULTS, make_loss_config
+    from distar_tpu.utils import Config, deep_merge_dicts
+
+    base = make_loss_config(RL_LEARNER_DEFAULTS.learner)
+    assert base.kl_weight == 0.02 and base.use_dapo is False
+
+    cfg = Config(deep_merge_dicts(
+        dict(RL_LEARNER_DEFAULTS),
+        {"learner": {"loss": {
+            "kl_weight": 0.0, "entropy_weight": 3e-5,
+            "pg_weights": [["winloss", 2.0]],
+        }}},
+    ))
+    lc = make_loss_config(cfg.learner)
+    assert lc.kl_weight == 0.0
+    assert lc.entropy_weight == 3e-5
+    assert lc.pg_weights == (("winloss", 2.0),)  # yaml lists -> tuples
+    assert make_loss_config(
+        Config(dict(RL_LEARNER_DEFAULTS.learner, loss={"use_dapo": True}))
+    ).use_dapo is True  # loss.use_dapo must not collide with the top-level

@@ -62,6 +62,20 @@ def test_sl_learner_trains_from_dataset(tmp_path):
     assert learner.last_iter.val == 2
     assert np.isfinite(learner.variable_record.get("total_loss").avg)
 
+    # held-out metric pass (tools/sl_curve.py rides this): averaged scalar
+    # metrics, no state mutation
+    import jax
+
+    before = np.array(jax.tree.leaves(learner.state["params"])[0])
+    eval_ds = make_fake_dataset(str(tmp_path / "eval"), n_trajectories=2,
+                                steps_per_traj=4, seed=9)
+    metrics = learner.evaluate(SLDataloader(eval_ds, 2, 2), max_batches=3)
+    assert {"action_type_acc", "total_loss"} <= set(metrics)
+    assert all(np.isfinite(v) for v in metrics.values())
+    assert 0.0 <= metrics["action_type_acc"] <= 1.0
+    after = np.array(jax.tree.leaves(learner.state["params"])[0])
+    np.testing.assert_array_equal(before, after)
+
 
 @pytest.mark.slow
 def test_sl_learns_from_decoded_replay(tmp_path):
