@@ -276,4 +276,57 @@ def build_parity_fixtures():
         "obs": obs,
         "opponent_obs": opponent_obs,
         "actions": actions,
+        "z_stream": build_z_stream(),
     }
+
+
+def build_z_stream():
+    """Decoded-action stream for the Z-extraction parity check (reference
+    get_z, features.py:419-460 vs envs/features.extract_z): exercises the
+    zergling-spam cap, the spine-crawler proximity filter (one build near
+    our born location — dropped — and one near the enemy's — kept),
+    cumulative-stat marking, the BO-only CUM_EXCLUDE family (build order
+    advances, no cum bit), and BO-length truncation. Locations are flat
+    spatial indices (y*160+x)."""
+    from ..lib import actions as ACT
+
+    def flat(x, y):
+        return y * 160 + x
+
+    zergling = 322  # Train_Zergling_quick on both tables
+    spine = 54      # Build_SpineCrawler_pt
+    assert zergling in ACT.BEGINNING_ORDER_ACTIONS
+    assert spine in ACT.BEGINNING_ORDER_ACTIONS
+    bo = [a for a in ACT.BEGINNING_ORDER_ACTIONS[1:]
+          if a not in (zergling, spine)]
+    # the cumulative set is a strict subset of the BO set (lib/actions.py
+    # derivation), so the disjoint case to pin is BO-but-NOT-cum: static
+    # defense & co. must enter the build order without setting a cum bit
+    bo_not_cum = [a for a in ACT.BEGINNING_ORDER_ACTIONS[1:]
+                  if a not in ACT.CUMULATIVE_STAT_ACTIONS and a != spine]
+    assert bo_not_cum, "contract tables lost the CUM_EXCLUDE family"
+
+    stream = []
+
+    def add(action_type, location=0):
+        stream.append({"action_info": {
+            "action_type": action_type, "target_location": location,
+        }})
+
+    # ordinary build-order prefix
+    for i, a in enumerate(bo[:6]):
+        add(a, flat(30 + i, 40))
+    # zergling spam: 12 trains, cap keeps 8 in the BO
+    for i in range(12):
+        add(zergling, flat(50, 60))
+    # spine near OUR base (born location ~ (30, 79-ish)): filtered out
+    add(spine, flat(31, 80))
+    # spine near the ENEMY's start: kept
+    add(spine, flat(90, 19))
+    # BO-only actions (CUM_EXCLUDE family): BO slot advances, no cum bit
+    for a in bo_not_cum[:3]:
+        add(a, flat(70, 70))
+    # overflow the 20-slot BO window
+    for i, a in enumerate(bo[6:24]):
+        add(a, flat(10 + i, 12))
+    return stream

@@ -156,6 +156,22 @@ def test_value_feature(golden, ours):
         _close(ref, vf[name], k)
 
 
+def test_z_extraction_parity(golden, ours):
+    """extract_z vs the reference get_z on the shared decoded-action stream:
+    zergling-spam cap, spine proximity filter, cumulative marking, 20-slot
+    truncation (reference features.py:419-460)."""
+    from distar_tpu.envs.features import extract_z
+
+    fx, pf, _ = ours
+    home, away = pf.born_locations(fx["first_obs"])
+    assert home == int(golden["meta/home_born_location"])
+    bo, cum, bo_len, bo_loc = extract_z(fx["z_stream"], home, away)
+    np.testing.assert_array_equal(bo, golden["z/beginning_order"])
+    np.testing.assert_array_equal(cum, golden["z/cumulative_stat"])
+    assert bo_len == int(golden["z/bo_len"])
+    np.testing.assert_array_equal(bo_loc, golden["z/bo_location"])
+
+
 def test_reverse_raw_action_parity(golden, ours):
     fx, pf, ret = ours
     tags = ret["game_info"]["tags"]

@@ -124,6 +124,20 @@ def main():
         put(f"{base}/last_target_tag",
             np.asarray(-1 if last_target_tag is None else last_target_tag, np.int64))
 
+    # ---- Z extraction (reference get_z, features.py:419-460) -------------
+    traj = [
+        {"action_info": {
+            "action_type": torch.tensor(s["action_info"]["action_type"]),
+            "target_location": torch.tensor(s["action_info"]["target_location"]),
+        }}
+        for s in fx["z_stream"]
+    ]
+    beginning_order, cumulative_stat, bo_len, bo_location = feat.get_z(traj)
+    put("z/beginning_order", beginning_order)
+    put("z/cumulative_stat", cumulative_stat)
+    put("z/bo_len", bo_len)
+    put("z/bo_location", bo_location)
+
     path = os.path.join(args.out, "obs_transform.npz")
     np.savez_compressed(path, **arrays)
     print(f"recorded obs_transform: {len(arrays)} arrays -> {path}")
