@@ -93,12 +93,16 @@ class RLDataLoader:
         self._cache_size = cache_size
         self._cache = adapter.start_pull_loop(self._token, maxlen=cache_size)
 
+    def buffered(self) -> int:
+        """Trajectories currently banked in the pull cache."""
+        return len(self._cache)
+
     def occupancy(self) -> float:
         """Buffered-trajectory share of the pull cache (0..1): ~0 means the
         learner is actor-starved, ~1 means the actors outrun the learner
         (the saturation axis of the reference's staleness regime,
         rl_learner.py:90-101)."""
-        return len(self._cache) / max(self._cache_size, 1)
+        return self.buffered() / max(self._cache_size, 1)
 
     def __iter__(self) -> Iterator[Dict]:
         return self

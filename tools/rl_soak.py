@@ -65,15 +65,21 @@ def run_soak(iters: int = 100, batch_size: int = 4, traj_len: int = 2,
              env_num: int = 2, features: bool = False, actor_threads: int = 1,
              win_rule: str = "random", opponent_pipeline: str = "default",
              learn: bool = False, episode_game_loops: int = 300,
-             cache_size: int = 64) -> dict:
+             cache_size: int = 64, prefill: int = 0,
+             prefill_timeout: float = 1800.0) -> dict:
     """``features=True`` additionally exercises the round-4 knobs in
     combination for the whole soak: actor+learner pad-to-bucket entity
     caps, per-parameter save_grad logging, and periodic ASYNC checkpoint
     saves racing the train loop.
 
     Round-5 regimes on top:
-      * ``actor_threads``/``env_num`` scale trajectory production until the
-        learner is the bottleneck (VERDICT r4 #5: data_share < 0.3)
+      * ``actor_threads``/``env_num`` scale trajectory production; on a
+        single host the per-frame cost ratio (actor rollout+teacher vs
+        learner fwd+bwd) caps how learner-bound the live equilibrium can
+        get, so ``prefill`` additionally banks N trajectories BEFORE the
+        learner starts — the drain then measures the SATURATED regime (the
+        TPU-learner + CPU-fleet shape: data_share ~0, occupancy ~1,
+        queue-aged staleness) with the same machinery
       * ``win_rule='battle'`` + ``opponent_pipeline='scripted.random'`` +
         ``learn=True`` is the SKILL regime (VERDICT r4 #4b): the learnable
         mock-world rule, a model-free random opponent, and RL hyperparams
@@ -216,6 +222,24 @@ def run_soak(iters: int = 100, batch_size: int = 4, traj_len: int = 2,
         telemetry["prefetch_occupancy"].append(round(dataloader.occupancy(), 3))
 
     learner.hooks.add(LambdaHook("soak_record", "after_iter", record, freq=1))
+    if prefill > cache_size:
+        print(f"[soak] prefill {prefill} clamped to cache {cache_size} "
+              "(the pull cache caps what can be banked)", flush=True)
+    prefill = min(max(prefill, 0), cache_size)
+    prefill_s = 0.0
+    if prefill:
+        t_pf = time.perf_counter()
+        while dataloader.buffered() < prefill:
+            if time.perf_counter() - t_pf > prefill_timeout:
+                break  # run with whatever banked; the report shows how much
+            if actor_err:
+                # dead actors can't refill: running on would drain the bank
+                # then busy-wait forever — abort while there is nothing to lose
+                raise RuntimeError(f"actor died during prefill: {actor_err}")
+            time.sleep(1.0)
+        prefill_s = time.perf_counter() - t_pf
+        print(f"[soak] prefill: {dataloader.buffered()} trajectories "
+              f"banked in {prefill_s:.0f}s", flush=True)
     t0 = time.perf_counter()
     learner.run(max_iterations=iters)
     wall = time.perf_counter() - t0
@@ -297,11 +321,15 @@ def run_soak(iters: int = 100, batch_size: int = 4, traj_len: int = 2,
             "batch_size": batch_size, "traj_len": traj_len,
             "win_rule": win_rule, "opponent_pipeline": opponent_pipeline,
             "learn": bool(learn), "episode_game_loops": episode_game_loops,
-            "cache_size": cache_size,
+            "cache_size": cache_size, "prefill": prefill,
+            "prefill_s": round(prefill_s, 1),
         },
         "skill": {
+            # read winrate points against games_curve: buckets before the
+            # first finished game show the meter's empty default, not play
             "winrate_vs_HP0_curve": curve(telemetry["winrate_hp0"]),
             "elo_gap_curve": curve(telemetry["elo_gap"]),
+            "games_curve": curve(telemetry["games"]),
             "final_winrate_vs_HP0": telemetry["winrate_hp0"][-1] if telemetry["winrate_hp0"] else None,
             "final_elo_gap": telemetry["elo_gap"][-1] if telemetry["elo_gap"] else None,
             "games_played": telemetry["games"][-1] if telemetry["games"] else 0,
@@ -369,15 +397,24 @@ def main() -> None:
     p.add_argument("--episode-loops", type=int, default=300)
     p.add_argument("--cache", type=int, default=64,
                    help="pull-cache depth (trajectories); staleness dial")
+    p.add_argument("--prefill", type=int, default=0,
+                   help="bank N trajectories before the learner starts "
+                        "(saturated-regime measurement)")
     args = p.parse_args()
     if args.cache < 1:
         p.error("--cache must be >= 1 (a zero-depth pull cache deadlocks)")
+    if args.prefill < 0:
+        p.error("--prefill must be >= 0")
+    if args.prefill > args.cache:
+        p.error(f"--prefill {args.prefill} exceeds --cache {args.cache}; "
+                "the pull cache caps what can be banked")
     report = run_soak(
         args.iters, batch_size=args.batch, traj_len=args.traj_len,
         env_num=args.env_num, features=args.features,
         actor_threads=args.actor_threads, win_rule=args.win_rule,
         opponent_pipeline=args.opponent_pipeline, learn=args.learn,
         episode_game_loops=args.episode_loops, cache_size=args.cache,
+        prefill=args.prefill,
     )
     report["invariants"] = [
         "actor weights propagate and end within 24 iters of the learner",
