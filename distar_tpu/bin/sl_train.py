@@ -53,6 +53,37 @@ def _learner(args) -> None:
             RemoteSLDataloader(adapter, args.batch_size, args.traj_len)
         )
     # else: the built-in fake dataloader (schema-complete random batches)
+    if args.eval_data:
+        # held-out metric pass every eval_freq iters (beyond the reference,
+        # which only tracks train-set metrics): catches memorization that
+        # train acc alone can't (tools/sl_curve.py demonstrates the split)
+        import json
+
+        from ..learner.hooks import LambdaHook
+        from ..learner.sl_dataloader import ReplayDataset, SLDataloader
+
+        eval_freq = args.eval_freq or max(args.iters // 8, 1)
+        eval_batches = max(args.eval_batches, 1)  # 0 would drain an
+        # infinite sampler; a bad path must fail BEFORE training starts
+        eval_dataset = ReplayDataset(args.eval_data)
+
+        def _eval(lrn):
+            if getattr(lrn, "rank", 0) != 0:
+                return  # one EVAL line per eval, not one per host
+            metrics = lrn.evaluate(
+                # fresh seed-2 loader per eval: the same fixed sample of
+                # held-out windows every time, so the curve is comparable
+                SLDataloader(eval_dataset, args.batch_size, args.traj_len,
+                             seed=2),
+                max_batches=eval_batches,
+            )
+            print("EVAL " + json.dumps(
+                {"iter": lrn.last_iter.val,
+                 **{k: round(v, 4) for k, v in sorted(metrics.items())}}
+            ), flush=True)
+
+        learner.hooks.add(LambdaHook("holdout_eval", "after_iter", _eval,
+                                     freq=eval_freq))
     learner.run(max_iterations=args.iters)
     print(
         f"sl_train done: {learner.last_iter.val} iters, "
@@ -102,6 +133,12 @@ def main() -> None:
     p.add_argument("--experiment-name", default="sl_train")
     p.add_argument("--data", default="",
                    help="local ReplayDataset directory (decoded trajectories)")
+    p.add_argument("--eval-data", default="",
+                   help="held-out ReplayDataset directory: run a no-grad "
+                        "metric pass every --eval-freq iters")
+    p.add_argument("--eval-freq", type=int, default=0,
+                   help="held-out eval cadence (0 = iters/8)")
+    p.add_argument("--eval-batches", type=int, default=8)
     p.add_argument("--remote", action="store_true",
                    help="pull trajectories from replay actors via the coordinator")
     p.add_argument("--smoke-model", action="store_true", default=True)

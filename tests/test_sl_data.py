@@ -77,6 +77,33 @@ def test_sl_learner_trains_from_dataset(tmp_path):
     np.testing.assert_array_equal(before, after)
 
 
+def test_sl_train_cli_holdout_eval(tmp_path, capsys, monkeypatch):
+    """bin/sl_train --eval-data runs the no-grad held-out pass on cadence
+    and prints parseable EVAL lines (beyond-reference: the reference tracks
+    train metrics only)."""
+    import json
+    import sys as _sys
+
+    from distar_tpu.bin import sl_train
+
+    make_fake_dataset(str(tmp_path / "tr"), n_trajectories=2, steps_per_traj=6)
+    make_fake_dataset(str(tmp_path / "ev"), n_trajectories=2, steps_per_traj=6,
+                      seed=5)
+    monkeypatch.setattr(_sys, "argv", [
+        "sl_train", "--type", "learner",
+        "--data", str(tmp_path / "tr"), "--eval-data", str(tmp_path / "ev"),
+        "--iters", "2", "--eval-freq", "1", "--eval-batches", "2",
+        "--batch-size", "2", "--traj-len", "2",
+        "--experiment-name", "sl_cli_eval_test",
+    ])
+    sl_train.main()
+    out = capsys.readouterr().out
+    evals = [json.loads(l[5:]) for l in out.splitlines() if l.startswith("EVAL ")]
+    assert len(evals) == 2  # freq 1 over 2 iters
+    assert {"iter", "action_type_acc", "total_loss"} <= set(evals[0])
+    assert "sl_train done" in out
+
+
 @pytest.mark.slow
 def test_sl_learns_from_decoded_replay(tmp_path):
     """SURVEY §7 milestone 4's game-free analogue: two-pass-decode a
