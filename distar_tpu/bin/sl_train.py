@@ -68,8 +68,9 @@ def _learner(args) -> None:
         eval_dataset = ReplayDataset(args.eval_data)
 
         def _eval(lrn):
-            if getattr(lrn, "rank", 0) != 0:
-                return  # one EVAL line per eval, not one per host
+            # SPMD: EVERY rank must run the jitted eval over the sharded
+            # params (a rank-gated computation would hang the pod in the
+            # first collective) — only the host-side print is rank-0
             metrics = lrn.evaluate(
                 # fresh seed-2 loader per eval: the same fixed sample of
                 # held-out windows every time, so the curve is comparable
@@ -77,10 +78,11 @@ def _learner(args) -> None:
                              seed=2),
                 max_batches=eval_batches,
             )
-            print("EVAL " + json.dumps(
-                {"iter": lrn.last_iter.val,
-                 **{k: round(v, 4) for k, v in sorted(metrics.items())}}
-            ), flush=True)
+            if getattr(lrn, "rank", 0) == 0:
+                print("EVAL " + json.dumps(
+                    {"iter": lrn.last_iter.val,
+                     **{k: round(v, 4) for k, v in sorted(metrics.items())}}
+                ), flush=True)
 
         learner.hooks.add(LambdaHook("holdout_eval", "after_iter", _eval,
                                      freq=eval_freq))

@@ -213,32 +213,36 @@ def test_parent_extends_attempt_past_compile(tmp_path):
     """A child past backend-init must not be killed at BENCH_ATTEMPT_TIMEOUT:
     killing mid-compile caches nothing and the retry repeats the same
     compile forever (the BENCH_r01-r03 livelock). The simulated child holds
-    the compile stage for 3x the attempt timeout, then lands its number —
-    the parent must wait it out in ONE attempt."""
+    the compile stage for >2x the attempt timeout, then lands its number.
+    Under the livelock bug no attempt EVER lands (each child dies
+    mid-compile), so the landed value is the whole assertion — exact
+    attempt counts are load-dependent (a python start slower than the
+    attempt timeout adds a legitimate pre-stage retry under -n 4
+    oversubscription) and deliberately not pinned."""
     final, attempts = _run_parent(
         tmp_path,
         # margins are sleeps, not compiles: load-independent
-        "stage:backend-init (chip claim):0,stage:sl-compile b2xt4:12,result:123.0",
-        attempt_timeout=4, deadline=90,
+        "stage:backend-init (chip claim):0,stage:sl-compile b2xt4:20,result:123.0",
+        attempt_timeout=8, deadline=120, timeout=150,
     )
     assert final["value"] == 123.0, final
-    assert attempts == 1
+    assert attempts <= 4, f"{attempts} attempts: extend logic not engaging"
 
 
 def test_parent_kills_stuck_claim_and_retries(tmp_path):
     """A child that never gets past the chip claim IS killed at the attempt
-    timeout, and the fresh claim of the next attempt can land (the
+    timeout, and the fresh claim of a later attempt can land (the
     contended-relay regime PERF.md documents)."""
     final, attempts = _run_parent(
         tmp_path,
         # attempt 1: stuck in backend-init far past the attempt timeout;
-        # attempt 2: claims instantly and lands
-        "stage:backend-init (chip claim):60;"
+        # later attempts claim instantly and land
+        "stage:backend-init (chip claim):90;"
         "stage:backend-init (chip claim):0,stage:devices-ok cpu:0,result:55.5",
-        attempt_timeout=4, deadline=90,
+        attempt_timeout=8, deadline=120, timeout=150,
     )
     assert final["value"] == 55.5, final
-    assert attempts == 2
+    assert attempts >= 2, "stuck first attempt was never killed"
 
 
 def test_env_cap_governs_whole_sweep(monkeypatch, capsys):
