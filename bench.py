@@ -586,11 +586,16 @@ def main():
             sys.stdout.write(line + "\n")
             sys.stdout.flush()
 
-    def pump(stream, is_stdout):
+    def pump(stream, is_stdout, first_line_t):
+        # first_line_t is THIS attempt's stamp cell: a pump surviving its
+        # child (grandchild holding the pipe) must not stamp a later
+        # attempt's clock
         for line in iter(stream.readline, ""):
             line = line.rstrip("\n")
             if not line:
                 continue
+            if first_line_t[0] is None:
+                first_line_t[0] = time.monotonic()
             if is_stdout:
                 try:
                     parsed = json.loads(line)
@@ -641,6 +646,7 @@ def main():
         attempt += 1
         # judge each child on its own progress, not its predecessor's
         last_stage[0] = "(no stage reached)"
+        first_line_t = [None]  # fresh cell per attempt (see pump)
         child_env = dict(os.environ)
         # respect an explicit user budget; otherwise hand the child what's
         # left of the parent deadline so its sweep self-limits
@@ -655,14 +661,42 @@ def main():
             env=child_env,
         )
         threads = [
-            threading.Thread(target=pump, args=(proc.stdout, True), daemon=True),
-            threading.Thread(target=pump, args=(proc.stderr, False), daemon=True),
+            threading.Thread(target=pump, args=(proc.stdout, True, first_line_t),
+                             daemon=True),
+            threading.Thread(target=pump, args=(proc.stderr, False, first_line_t),
+                             daemon=True),
         ]
         for th in threads:
             th.start()
-        try:
-            proc.wait(timeout=max(5.0, min(attempt_timeout, deadline - time.monotonic())))
-        except subprocess.TimeoutExpired:
+        # the attempt clock starts when the child first SPEAKS, not when it
+        # forks: on a saturated host interpreter startup alone can exceed
+        # the attempt timeout, and killing a child that never got to run
+        # wastes claim attempts. A silent child gets a bounded boot grace —
+        # capped so a wedged-before-output child still leaves retry budget
+        # inside the deadline (3x matters for test-scale timeouts, +60 s
+        # for driver-scale ones).
+        attempt_start = time.monotonic()
+        silent_grace = min(3 * attempt_timeout, attempt_timeout + 60.0)
+        timed_out = False
+        while True:
+            try:
+                proc.wait(timeout=1.0)
+                break
+            except subprocess.TimeoutExpired:
+                now = time.monotonic()
+                if now >= deadline - 10:
+                    timed_out = True
+                    break
+                base = first_line_t[0]
+                expiry = (
+                    base + attempt_timeout
+                    if base is not None
+                    else attempt_start + silent_grace
+                )
+                if now >= expiry:
+                    timed_out = True
+                    break
+        if timed_out:
             # a child stuck in the chip claim should die fast (a FRESH claim
             # sometimes lands where the stuck one never will) — but one that
             # is past backend-init is tracing/compiling: killing it mid-
