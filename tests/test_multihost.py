@@ -10,7 +10,7 @@ import pytest
 def test_two_process_distributed_smoke():
     script = os.path.join(os.path.dirname(__file__), "multihost_smoke.py")
     proc = subprocess.run(
-        [sys.executable, script], capture_output=True, text=True, timeout=900,
+        [sys.executable, script], capture_output=True, text=True, timeout=1800,
     )
     assert proc.returncode == 0, proc.stderr[-2000:] + proc.stdout[-500:]
     assert "multihost smoke ok" in proc.stdout

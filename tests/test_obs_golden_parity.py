@@ -63,7 +63,7 @@ def golden():
         subprocess.run(
             [sys.executable, RECORDER, "--out", GOLDEN_DIR],
             check=True,
-            timeout=900,
+            timeout=1800,
             cwd="/tmp",
         )
     return np.load(path, allow_pickle=True)

@@ -151,7 +151,7 @@ print("PALLAS-TRAIN-OK")
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     out = subprocess.run(
         [sys.executable, "-c", code], env=env, cwd=repo,
-        capture_output=True, text=True, timeout=900,
+        capture_output=True, text=True, timeout=1800,
     )
     assert out.returncode == 0, f"child failed:\n{out.stdout[-2000:]}\n{out.stderr[-2000:]}"
     assert "PALLAS-TRAIN-OK" in out.stdout
