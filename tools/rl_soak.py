@@ -66,7 +66,8 @@ def run_soak(iters: int = 100, batch_size: int = 4, traj_len: int = 2,
              win_rule: str = "random", opponent_pipeline: str = "default",
              learn: bool = False, episode_game_loops: int = 300,
              cache_size: int = 64, prefill: int = 0,
-             prefill_timeout: float = 1800.0) -> dict:
+             prefill_timeout: float = 1800.0,
+             opponent_heavy: bool = False) -> dict:
     """``features=True`` additionally exercises the round-4 knobs in
     combination for the whole soak: actor+learner pad-to-bucket entity
     caps, per-parameter save_grad logging, and periodic ASYNC checkpoint
@@ -99,6 +100,13 @@ def run_soak(iters: int = 100, batch_size: int = 4, traj_len: int = 2,
 
     league_cfg = {
         "league": {
+            # opponent-heavy matchmaking fills the vs-HP0 payoff meter from
+            # game 1, so a skill run's winrate curve shows the CLIMB (with
+            # the default sp-heavy mix the meter only fills after learning
+            # has already moved the policy)
+            **({"branch_probs": {
+                "MainPlayer": {"sp": 0.1, "pfsp": 0.7, "eval": 0.2},
+            }} if opponent_heavy else {}),
             "active_players": {
                 "player_id": ["MP0"],
                 "checkpoint_path": ["mp0.ckpt"],
@@ -323,6 +331,7 @@ def run_soak(iters: int = 100, batch_size: int = 4, traj_len: int = 2,
             "learn": bool(learn), "episode_game_loops": episode_game_loops,
             "cache_size": cache_size, "prefill": prefill,
             "prefill_s": round(prefill_s, 1),
+            "opponent_heavy": bool(opponent_heavy),
         },
         "skill": {
             # read winrate points against games_curve: buckets before the
@@ -400,6 +409,9 @@ def main() -> None:
     p.add_argument("--prefill", type=int, default=0,
                    help="bank N trajectories before the learner starts "
                         "(saturated-regime measurement)")
+    p.add_argument("--vs-opponent-heavy", action="store_true",
+                   help="matchmaking mix weighted toward HP0 so the "
+                        "winrate curve fills from game 1")
     args = p.parse_args()
     if args.cache < 1:
         p.error("--cache must be >= 1 (a zero-depth pull cache deadlocks)")
@@ -414,7 +426,7 @@ def main() -> None:
         actor_threads=args.actor_threads, win_rule=args.win_rule,
         opponent_pipeline=args.opponent_pipeline, learn=args.learn,
         episode_game_loops=args.episode_loops, cache_size=args.cache,
-        prefill=args.prefill,
+        prefill=args.prefill, opponent_heavy=args.vs_opponent_heavy,
     )
     report["invariants"] = [
         "actor weights propagate and end within 24 iters of the learner",
