@@ -41,6 +41,22 @@ class Coordinator:
                 return None
             return q.popleft()
 
+    def depth(self, token: str, max_age_s: Optional[float] = None) -> int:
+        """Registered-but-unconsumed records for a token — the broker-side
+        backlog (payloads wait in producer serve windows until fetched), the
+        queue hop that client-cache occupancy can't see. ``max_age_s``
+        excludes records older than the producers' serve window: those
+        payloads expired and will never be consumed, so they are loss, not
+        backlog (stats() gives the raw per-token lengths)."""
+        with self._lock:
+            q = self._records.get(token)
+            if not q:
+                return 0
+            if max_age_s is None:
+                return len(q)
+            cutoff = time.time() - max_age_s
+            return sum(1 for r in q if r.get("ts", 0) >= cutoff)
+
     def strike(self, ip: str, port: int) -> None:
         """Report a dead producer endpoint; 5 strikes purges its records."""
         key = f"{ip}:{port}"
@@ -71,6 +87,7 @@ class CoordinatorServer:
             "ask": lambda b: co.ask(b["token"]),
             "strike": lambda b: co.strike(b["ip"], b["port"]),
             "stats": lambda b: co.stats(),
+            "depth": lambda b: co.depth(b["token"], b.get("max_age_s")),
         }
 
         class Handler(BaseHTTPRequestHandler):

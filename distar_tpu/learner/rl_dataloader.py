@@ -93,6 +93,11 @@ class RLDataLoader:
         self._cache_size = cache_size
         self._cache = adapter.start_pull_loop(self._token, maxlen=cache_size)
 
+    @property
+    def token(self) -> str:
+        """The adapter token this loader consumes (telemetry/broker depth)."""
+        return self._token
+
     def buffered(self) -> int:
         """Trajectories currently banked in the pull cache."""
         return len(self._cache)

@@ -59,10 +59,15 @@ def test_shuttle_multi_accept():
 def test_coordinator_broker():
     co = Coordinator()
     assert co.ask("traj") is None
+    assert co.depth("traj") == 0
     co.register("traj", "1.2.3.4", 1111, {"n": 1})
     co.register("traj", "1.2.3.4", 2222)
+    assert co.depth("traj") == 2  # broker backlog (soak staleness accounting)
+    assert co.depth("traj", max_age_s=3600) == 2  # fresh records count
+    assert co.depth("traj", max_age_s=0) == 0  # expired serve windows don't
     rec = co.ask("traj")
     assert (rec["ip"], rec["port"]) == ("1.2.3.4", 1111)  # FIFO
+    assert co.depth("traj") == 1
     # strikes purge dead endpoints
     for _ in range(5):
         co.strike("1.2.3.4", 2222)

@@ -200,6 +200,7 @@ def run_soak(iters: int = 100, batch_size: int = 4, traj_len: int = 2,
         "total_loss": [], "grad_norm": [], "actor_model_iter": [],
         "historical_count": [], "winrate_hp0": [], "elo_gap": [],
         "games": [], "prefetch_occupancy": [], "actor_model_iter_min": [],
+        "broker_depth": [],
     }
     last_t = [time.perf_counter()]
 
@@ -232,6 +233,9 @@ def run_soak(iters: int = 100, batch_size: int = 4, traj_len: int = 2,
         )
         telemetry["games"].append(int(mp0.total_game_count))
         telemetry["prefetch_occupancy"].append(round(dataloader.occupancy(), 3))
+        # live backlog only: records past the producers' 120s serve window
+        # are expired payloads (loss, not aging)
+        telemetry["broker_depth"].append(co.depth(dataloader.token, max_age_s=120.0))
 
     learner.hooks.add(LambdaHook("soak_record", "after_iter", record, freq=1))
     if prefill > cache_size:
@@ -296,7 +300,14 @@ def run_soak(iters: int = 100, batch_size: int = 4, traj_len: int = 2,
         for i, p in enumerate(telemetry["actor_model_iter_min"])
         if i >= iters // 2
     )
-    queue_tail = occ_tail * cache_size / max(batch_size, 1) * 8
+    # queue aging spans BOTH buffered hops: the learner-side pull cache AND
+    # the broker backlog (trajectories registered but not yet fetched, aging
+    # in producer serve windows — curve-regime runs bank 40+ there while
+    # the client cache reads empty)
+    broker_tail = statistics.fmean(telemetry["broker_depth"][iters // 2:])
+    queue_tail = (
+        (occ_tail * cache_size + broker_tail) / max(batch_size, 1) * 8
+    )
     staleness_bound = 32.0 + max(lag_tail, 0.0) + queue_tail
     check(smean_tail < staleness_bound,
           f"tail staleness mean {smean_tail:.1f} exceeds {staleness_bound:.0f} "
@@ -391,6 +402,7 @@ def run_soak(iters: int = 100, batch_size: int = 4, traj_len: int = 2,
             "max": int(smax),
             "actor_lag_tail": round(lag_tail, 2),
             "queue_age_tail": round(queue_tail, 2),
+            "broker_depth_tail": round(broker_tail, 2),
         },
         "weights": {
             "actor_final_iter": int(propagated[-1]),
