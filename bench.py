@@ -270,6 +270,23 @@ def _bench_sl_real(batch_size, unroll_len, peak, iters=6, cap=None):
         _stage(f"sl-real-init {label}")
         learner = SLLearner(cfg)
         learner.set_dataloader(SLDataloader(ReplayDataset(root), batch_size, unroll_len))
+        # Host->device transfer probe: on the tunneled dev chip the fresh-batch
+        # stream (not compute) can bound this point — measure it explicitly so
+        # the frames/s number is interpretable. A real TPU host's local PCIe
+        # moves the same bytes 1-2 orders of magnitude faster.
+        import jax
+        import numpy as _np
+
+        probe = dict(next(SLDataloader(ReplayDataset(root), batch_size, unroll_len)))
+        probe.pop("new_episodes", None)
+        probe.pop("traj_lens", None)
+        probe = learner._cap(probe)
+        batch_bytes = sum(_np.asarray(x).nbytes for x in jax.tree.leaves(probe))
+        t0 = time.perf_counter()
+        placed = jax.device_put(probe)
+        jax.block_until_ready(placed)
+        h2d_s = time.perf_counter() - t0
+        del placed, probe
         times = {"data": [], "train": []}
 
         def rec(lrn):
@@ -295,6 +312,12 @@ def _bench_sl_real(batch_size, unroll_len, peak, iters=6, cap=None):
             "batch": batch_size,
             "unroll": unroll_len,
             "iters_measured": len(times["train"][keep]),
+            "batch_mb": round(batch_bytes / 1e6, 1),
+            "h2d_s": round(h2d_s, 4),
+            "h2d_mb_s": round(batch_bytes / 1e6 / max(h2d_s, 1e-9), 1),
+            # per-iter wall is floored by streaming a fresh batch over the
+            # link; flag when that floor (not compute) sets the number
+            "transfer_bound": bool(h2d_s > 0.5 * train_t),
         }
         if cap:
             point["max_entities"] = cap

@@ -126,7 +126,9 @@ def masked_attention_reference(q, k, v, mask):
 
 # ----------------------------------------------------------------- scatter
 def _scatter_kernel(emb_ref, idx_ref, out_ref, *, n_entities: int):
-    # zero the output tile, then accumulate entity rows at dynamic offsets
+    # zero the output tile, then accumulate entity rows at dynamic offsets.
+    # idx lives in SMEM: scalar reads that drive dynamic slices belong there
+    # (and VMEM's (8, 128) block-tiling rule doesn't apply to SMEM blocks).
     out_ref[0] = jnp.zeros_like(out_ref[0])
 
     def body(i, _):
@@ -161,7 +163,7 @@ def _scatter_add_fwd_kernel(embeddings, flat_idx, hw, interpret):
         grid=(B,),
         in_specs=[
             pl.BlockSpec((1, N, D), lambda b: (b, 0, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, N), lambda b: (b, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, N), lambda b: (b, 0), memory_space=pltpu.SMEM),
         ],
         out_specs=pl.BlockSpec((1, hw, D), lambda b: (b, 0, 0), memory_space=pltpu.VMEM),
         interpret=interpret,

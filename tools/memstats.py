@@ -1,0 +1,115 @@
+"""HBM footprint report for the SL train step across batch sizes.
+
+AOT-lowers and compiles the flagship SL step at each config on the current
+backend and prints XLA's ``memory_analysis()`` (argument/output/temp/total
+bytes) plus compile time — no train steps run, so a chip claim is held only
+for the compiles. This is the diagnostic for the b16/b32 batch-scaling cliff
+seen in BENCH_LOCAL_r05.json (b6: 9.2 ms/step; b16-e256: 645 ms/step;
+b32-e256: compile-helper crash): it separates "spills HBM / falls off the
+fused path" from "remote-compile-helper resource limit".
+
+Usage: python tools/memstats.py [--configs 6,16,32] [--unroll 64]
+       [--cap 256] [--remat] [--out artifacts/memstats_tpu.json]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--configs", default="6,12,16,32")
+    p.add_argument("--unroll", type=int, default=64)
+    p.add_argument("--cap", type=int, default=0, help="entity cap (0 = off)")
+    p.add_argument("--remat", action="store_true")
+    p.add_argument("--out", default="")
+    p.add_argument("--platform", default="",
+                   help="override jax platform (e.g. cpu). The image pins the "
+                        "axon TPU backend via jax.config at interpreter start, "
+                        "so the env var alone is too late — and dialing the "
+                        "relay blocks when the chip is contended.")
+    args = p.parse_args()
+
+    from distar_tpu.utils.compile_cache import configure as _cc
+
+    import jax
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+    _cc(jax, "/tmp/jax_cache_distar_tpu_bench")
+
+    from distar_tpu.learner import SLLearner
+
+    rows = []
+    for b in (int(x) for x in args.configs.split(",")):
+        cfg = {
+            "common": {"experiment_name": "memstats"},
+            "learner": {
+                "batch_size": b,
+                "unroll_len": args.unroll,
+                "save_freq": 10 ** 9,
+                "log_freq": 10 ** 9,
+                "max_entities": args.cap or None,
+            },
+            "model": {"dtype": "bfloat16", **({"remat": True} if args.remat else {})},
+        }
+        label = f"b{b}xt{args.unroll}" + (f"-e{args.cap}" if args.cap else "") + (
+            "-remat" if args.remat else ""
+        )
+        print(f"[memstats] {label}: init", flush=True)
+        row = {"config": label, "batch": b, "unroll": args.unroll}
+        try:
+            learner = SLLearner(cfg)
+            data = dict(next(learner._dataloader))
+            data.pop("new_episodes", None)
+            data.pop("traj_lens", None)
+            data = learner._cap(data)
+            batch = jax.tree.map(jax.numpy.asarray, data)
+            fn_args = (
+                learner.state["params"], learner.state["opt_state"],
+                batch, learner._hidden,
+            )
+            t0 = time.perf_counter()
+            # _train_step is the learner's jitted step (donation + out
+            # shardings already applied) — lower exactly what training runs
+            lowered = learner._train_step.lower(*fn_args)
+            row["trace_s"] = round(time.perf_counter() - t0, 1)
+            t0 = time.perf_counter()
+            compiled = lowered.compile()
+            row["compile_s"] = round(time.perf_counter() - t0, 1)
+            mem = compiled.memory_analysis()
+            if mem is not None:
+                for k in (
+                    "argument_size_in_bytes", "output_size_in_bytes",
+                    "temp_size_in_bytes", "generated_code_size_in_bytes",
+                ):
+                    v = getattr(mem, k, None)
+                    if v is not None:
+                        row[k.replace("_in_bytes", "_mb")] = round(v / 1e6, 1)
+                tot = getattr(mem, "temp_size_in_bytes", 0) + getattr(
+                    mem, "argument_size_in_bytes", 0
+                ) + getattr(mem, "output_size_in_bytes", 0)
+                row["total_mb"] = round(tot / 1e6, 1)
+            del learner, compiled, lowered, batch, fn_args
+        except Exception as e:  # keep sweeping: the cliff config may not compile
+            row["error"] = repr(e)[:300]
+        print(f"[memstats] {json.dumps(row)}", flush=True)
+        rows.append(row)
+
+    out = {"metric": "SL step HBM memory analysis", "backend": jax.default_backend(),
+           "rows": rows}
+    if args.out:
+        os.makedirs(os.path.dirname(args.out), exist_ok=True)
+        with open(args.out, "w") as f:
+            json.dump(out, f, indent=1)
+        print(f"[memstats] wrote {args.out}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -147,15 +147,27 @@ def stage_fullstep_ab() -> bool:
     """A/B the attention/scatter impls inside the full SL step (one modest
     config per impl; compile cache makes reruns cheap)."""
     out_path = os.path.join(REPO, "artifacts", "fullstep_ab_tpu.json")
-    if os.path.exists(out_path):
-        return True
     results = {}
-    for name, env_extra in (
+    if os.path.exists(out_path):
+        # resume: keep landed configs, run only the missing ones (a partial
+        # artifact must not permanently skip the remaining comparisons).
+        # Tolerate a truncated file (kill mid-write) — rebuild from scratch.
+        try:
+            with open(out_path) as f:
+                results = json.load(f).get("configs", {})
+        except (json.JSONDecodeError, OSError):
+            results = {}
+    todo = [
         ("xla", {}),
         ("pallas", {"BENCH_ATTN_IMPL": "pallas", "BENCH_SCATTER_IMPL": "pallas"}),
         # pad-to-bucket entity cap (exact below the cap; PERF.md)
         ("e256", {"BENCH_MAX_ENTITIES": "256"}),
-    ):
+    ]
+    if all(name in results for name, _ in todo):
+        return True
+    for name, env_extra in todo:
+        if name in results:
+            continue
         rc, stdout = _run(
             [sys.executable, "-u", "bench.py", "--run"],
             env_extra={
@@ -170,23 +182,29 @@ def stage_fullstep_ab() -> bool:
         best = _last_json_line(stdout or "")
         if best:
             results[name] = best.get("sl") or best
-    if len(results) < 2:
-        # a one-sided artifact would permanently skip the stage on resume
-        # without ever delivering the comparison — don't persist it
+    if len(results) >= 2:
+        tmp = out_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(
+                {"metric": "full SL step impl A/B (b6xt64)", "configs": results},
+                f,
+                indent=1,
+            )
+        os.replace(tmp, out_path)  # atomic: a kill never leaves a torn file
+    done = all(name in results for name, _ in todo)
+    if not done:
         print(f"[campaign] fullstep-ab incomplete ({sorted(results)}); will retry", flush=True)
-        return False
-    with open(out_path, "w") as f:
-        json.dump(
-            {"metric": "full SL step impl A/B (b6xt64)", "configs": results},
-            f,
-            indent=1,
-        )
-    return True
+    return done
 
 
 def stage_profile() -> bool:
     prof_dir = os.path.join(REPO, "experiments", "profile_sl")
-    if os.path.isdir(prof_dir) and os.listdir(prof_dir):
+    # the trace lands under plugins/profile/<run>/*.xplane.pb — the learner's
+    # own logs/ dir existing (or a plugins dir left by a kill mid-export)
+    # does NOT mean a trace was captured
+    import glob
+
+    if glob.glob(os.path.join(prof_dir, "plugins", "profile", "*", "*.xplane.pb")):
         return True
     code = """
 import os, time, json
@@ -223,6 +241,20 @@ print("PROFILE-OK", prof)
 STOP_FILE = "/tmp/tpu_campaign_stop"
 
 
+def probe_chip(timeout: int = 120) -> bool:
+    """Cheap claimability check: dial the relay in a subprocess and drop the
+    claim immediately. When the chip is contended the dial blocks forever —
+    a fast probe failure lets a retry loop come back in minutes instead of
+    burning a full stage timeout holding nothing."""
+    rc, stdout = _run(
+        [sys.executable, "-c",
+         "import jax; print('CHIP-OK', jax.devices()[0].platform)"],
+        timeout=timeout,
+        log_name="chip-probe",
+    )
+    return rc == 0 and "CHIP-OK" in (stdout or "")
+
+
 def main() -> None:
     p = argparse.ArgumentParser()
     p.add_argument("--deadline", type=int, default=14400,
@@ -233,11 +265,16 @@ def main() -> None:
         # re-claiming the chip (e.g. before the driver's own bench window)
         print("[campaign] stop file present, exiting", flush=True)
         return
+    if not probe_chip():
+        print("[campaign] chip not claimable (relay contended); exiting for retry",
+              flush=True)
+        sys.exit(3)
     ok_bench = stage_bench(args.deadline)
     # only proceed to the extras once the headline number exists — they
     # contend for the same chip claim
     if not ok_bench:
         sys.exit(1)
+    all_ok = True
     for stage in (stage_kernels, stage_fullstep_ab, stage_profile):
         if os.path.exists(STOP_FILE):
             # re-checked between stages: each holds the chip for up to ~40
@@ -245,8 +282,11 @@ def main() -> None:
             print("[campaign] stop file present, halting before "
                   f"{stage.__name__}", flush=True)
             return
-        stage()
-    print("[campaign] done", flush=True)
+        all_ok = stage() and all_ok
+    print(f"[campaign] done (all stages {'complete' if all_ok else 'NOT complete'})",
+          flush=True)
+    if not all_ok:
+        sys.exit(2)  # retry loops: rerun until every artifact has landed
 
 
 if __name__ == "__main__":
