@@ -46,6 +46,13 @@ def main() -> None:
 
     from distar_tpu.learner import SLLearner
 
+    # timing/peak calibration (bench.py's anchor: known-FLOP chained matmul,
+    # guarded so a calibration failure never costs the sweep)
+    from bench import _calibrate_matmul
+
+    calib = _calibrate_matmul(jax)
+    print(f"[memstats] calibration {json.dumps(calib)}", flush=True)
+
     rows = []
     for b in (int(x) for x in args.configs.split(",")):
         cfg = {
@@ -80,9 +87,24 @@ def main() -> None:
             # shardings already applied) — lower exactly what training runs
             lowered = learner._train_step.lower(*fn_args)
             row["trace_s"] = round(time.perf_counter() - t0, 1)
+            try:
+                c = lowered.cost_analysis()
+                row["flops_unoptimized"] = float(c.get("flops", 0.0)) if c else 0.0
+            except Exception:
+                pass
             t0 = time.perf_counter()
             compiled = lowered.compile()
             row["compile_s"] = round(time.perf_counter() - t0, 1)
+            try:
+                # executable-level count: post-optimization, the honest MFU
+                # numerator (the unoptimized-HLO count can overcount)
+                c = compiled.cost_analysis()
+                if isinstance(c, (list, tuple)):
+                    c = c[0] if c else None
+                if c:
+                    row["flops_optimized"] = float(c.get("flops", 0.0))
+            except Exception:
+                pass
             mem = compiled.memory_analysis()
             if mem is not None:
                 for k in (
@@ -103,11 +125,20 @@ def main() -> None:
         rows.append(row)
 
     out = {"metric": "SL step HBM memory analysis", "backend": jax.default_backend(),
-           "rows": rows}
+           "calibration": calib, "rows": rows}
+    # a run where EVERY config errored carries no diagnostic value — exit
+    # nonzero and write nothing, so a campaign retry loop re-attempts it
+    if not any("total_mb" in r or "flops_optimized" in r for r in rows):
+        print("[memstats] no config produced data; not writing artifact", flush=True)
+        sys.exit(1)
     if args.out:
-        os.makedirs(os.path.dirname(args.out), exist_ok=True)
-        with open(args.out, "w") as f:
+        d = os.path.dirname(args.out)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        tmp = args.out + ".tmp"
+        with open(tmp, "w") as f:
             json.dump(out, f, indent=1)
+        os.replace(tmp, args.out)  # atomic: a kill never leaves a torn file
         print(f"[memstats] wrote {args.out}", flush=True)
 
 
