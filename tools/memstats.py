@@ -1,9 +1,10 @@
-"""HBM footprint report for the SL train step across batch sizes.
+"""HBM footprint + timing report for the SL/RL train step across batch sizes.
 
-AOT-lowers and compiles the flagship SL step at each config on the current
+AOT-lowers and compiles the flagship step at each config on the current
 backend and prints XLA's ``memory_analysis()`` (argument/output/temp/total
-bytes) plus compile time — no train steps run, so a chip claim is held only
-for the compiles. This is the diagnostic for the b16/b32 batch-scaling cliff
+bytes), optimized/unoptimized flop counts, compile time, and — unless
+``--steps 0`` — a 16-step chained re-timing (so a chip claim is held for
+the compiles plus ~16 steps/config). This is the diagnostic for the b16/b32 batch-scaling cliff
 seen in BENCH_LOCAL_r05.json (b6: 9.2 ms/step; b16-e256: 645 ms/step;
 b32-e256: compile-helper crash): it separates "spills HBM / falls off the
 fused path" from "remote-compile-helper resource limit".
@@ -28,7 +29,13 @@ def main() -> None:
     p.add_argument("--unroll", type=int, default=64)
     p.add_argument("--cap", type=int, default=0, help="entity cap (0 = off)")
     p.add_argument("--remat", action="store_true")
+    p.add_argument("--steps", type=int, default=16,
+                   help="also TIME this many donated-feedback steps of the "
+                        "compiled executable (0 = compile-only). An "
+                        "independent, longer-window cross-check of bench.py's "
+                        "4-iteration timing.")
     p.add_argument("--out", default="")
+    p.add_argument("--mode", default="sl", choices=("sl", "rl"))
     p.add_argument("--platform", default="",
                    help="override jax platform (e.g. cpu). The image pins the "
                         "axon TPU backend via jax.config at interpreter start, "
@@ -44,7 +51,7 @@ def main() -> None:
         jax.config.update("jax_platforms", args.platform)
     _cc(jax, "/tmp/jax_cache_distar_tpu_bench")
 
-    from distar_tpu.learner import SLLearner
+    from distar_tpu.learner import RLLearner, SLLearner
 
     # timing/peak calibration (bench.py's anchor: known-FLOP chained matmul,
     # guarded so a calibration failure never costs the sweep)
@@ -63,25 +70,37 @@ def main() -> None:
                 "save_freq": 10 ** 9,
                 "log_freq": 10 ** 9,
                 "max_entities": args.cap or None,
+                **({"value_pretrain_iters": -1} if args.mode == "rl" else {}),
             },
             "model": {"dtype": "bfloat16", **({"remat": True} if args.remat else {})},
         }
-        label = f"b{b}xt{args.unroll}" + (f"-e{args.cap}" if args.cap else "") + (
-            "-remat" if args.remat else ""
-        )
+        label = args.mode + f"-b{b}xt{args.unroll}" + (
+            f"-e{args.cap}" if args.cap else "") + ("-remat" if args.remat else "")
         print(f"[memstats] {label}: init", flush=True)
         row = {"config": label, "batch": b, "unroll": args.unroll}
         try:
-            learner = SLLearner(cfg)
-            data = dict(next(learner._dataloader))
-            data.pop("new_episodes", None)
-            data.pop("traj_lens", None)
-            data = learner._cap(data)
-            batch = jax.tree.map(jax.numpy.asarray, data)
-            fn_args = (
-                learner.state["params"], learner.state["opt_state"],
-                batch, learner._hidden,
-            )
+            if args.mode == "rl":
+                import jax.numpy as jnp
+
+                learner = RLLearner(cfg)
+                data = dict(next(learner._dataloader))
+                data.pop("model_last_iter", None)
+                batch = learner.shard_batch(learner._cap(data))
+                fn_args = (
+                    learner.state["params"], learner.state["opt_state"],
+                    batch, jnp.asarray(False),
+                )
+            else:
+                learner = SLLearner(cfg)
+                data = dict(next(learner._dataloader))
+                data.pop("new_episodes", None)
+                data.pop("traj_lens", None)
+                data = learner._cap(data)
+                batch = jax.tree.map(jax.numpy.asarray, data)
+                fn_args = (
+                    learner.state["params"], learner.state["opt_state"],
+                    batch, learner._hidden,
+                )
             t0 = time.perf_counter()
             # _train_step is the learner's jitted step (donation + out
             # shardings already applied) — lower exactly what training runs
@@ -118,17 +137,44 @@ def main() -> None:
                     mem, "argument_size_in_bytes", 0
                 ) + getattr(mem, "output_size_in_bytes", 0)
                 row["total_mb"] = round(tot / 1e6, 1)
+            if args.steps > 0:
+                # chained re-timing at a longer window than bench's 4 iters:
+                # each call consumes the previous call's params/opt (+ the
+                # carried hidden state in SL; RL's 4th arg is a static bool)
+                def _next(out, prev):
+                    carry = out[2] if args.mode == "sl" else prev[3]
+                    return (out[0], out[1], batch, carry)
+
+                out = compiled(*fn_args)
+                jax.block_until_ready(out)
+                t0 = time.perf_counter()
+                cur = _next(out, fn_args)
+                for _ in range(args.steps):
+                    out = compiled(*cur)
+                    cur = _next(out, cur)
+                jax.block_until_ready(out)
+                step_s = (time.perf_counter() - t0) / args.steps
+                row["step_time_s"] = round(step_s, 4)
+                row["frames_per_sec"] = round(b * args.unroll / step_s, 2)
+                if row.get("flops_optimized"):
+                    row["implied_tflops"] = round(
+                        row["flops_optimized"] / step_s / 1e12, 1
+                    )
             del learner, compiled, lowered, batch, fn_args
         except Exception as e:  # keep sweeping: the cliff config may not compile
             row["error"] = repr(e)[:300]
         print(f"[memstats] {json.dumps(row)}", flush=True)
         rows.append(row)
 
-    out = {"metric": "SL step HBM memory analysis", "backend": jax.default_backend(),
+    out = {"metric": f"{args.mode.upper()} step HBM memory analysis + timing",
+           "backend": jax.default_backend(),
            "calibration": calib, "rows": rows}
     # a run where EVERY config errored carries no diagnostic value — exit
-    # nonzero and write nothing, so a campaign retry loop re-attempts it
-    if not any("total_mb" in r or "flops_optimized" in r for r in rows):
+    # nonzero and write nothing, so a campaign retry loop re-attempts it.
+    # Timings alone ARE data (memory/cost introspection can be absent on a
+    # backend); any of the three marks the run useful.
+    if not any(("total_mb" in r or "flops_optimized" in r or "step_time_s" in r)
+               for r in rows):
         print("[memstats] no config produced data; not writing artifact", flush=True)
         sys.exit(1)
     if args.out:

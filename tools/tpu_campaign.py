@@ -109,7 +109,7 @@ def _last_json_line(stdout: str):
 
 def stage_bench(deadline: int) -> bool:
     out_path = os.path.join(REPO, "BENCH_LOCAL_r05.json")
-    if os.path.exists(out_path):
+    if bench_complete():
         print("[campaign] bench: artifact exists, skipping", flush=True)
         return True
     rc, stdout = _run(
@@ -131,32 +131,55 @@ def stage_bench(deadline: int) -> bool:
     return False
 
 
+def bench_complete() -> bool:
+    return os.path.exists(os.path.join(REPO, "BENCH_LOCAL_r05.json"))
+
+
+def kernels_complete() -> bool:
+    return os.path.exists(os.path.join(REPO, "artifacts", "pallas_microbench_tpu.json"))
+
+
 def stage_kernels() -> bool:
-    out_path = os.path.join(REPO, "artifacts", "pallas_microbench_tpu.json")
-    if os.path.exists(out_path):
+    if kernels_complete():
         return True
+    out_path = os.path.join(REPO, "artifacts", "pallas_microbench_tpu.json")
     rc, _ = _run(
         [sys.executable, "tools/bench_kernels.py", "--out", out_path],
         timeout=2400,
         log_name="kernel-microbench",
     )
-    return rc == 0 and os.path.exists(out_path)
+    return rc == 0 and kernels_complete()
+
+
+_MEMSTATS_RUNS = (
+    ("sl", "6,12,16,32", "memstats_tpu.json"),
+    ("rl", "6,12", "memstats_rl_tpu.json"),
+)
+
+
+def memstats_complete() -> bool:
+    return all(
+        os.path.exists(os.path.join(REPO, "artifacts", fname))
+        for _, _, fname in _MEMSTATS_RUNS
+    )
 
 
 def stage_memstats() -> bool:
-    """HBM memory_analysis + flop counts + matmul timing calibration per
-    batch size — the b16/b32 cliff diagnosis and the MFU numerator
-    (compile-only chip hold; see tools/memstats.py)."""
-    out_path = os.path.join(REPO, "artifacts", "memstats_tpu.json")
-    if os.path.exists(out_path):
-        return True
-    rc, _ = _run(
-        [sys.executable, "-u", "tools/memstats.py",
-         "--configs", "6,12,16,32", "--out", out_path],
-        timeout=2400,
-        log_name="memstats",
-    )
-    return rc == 0 and os.path.exists(out_path)
+    """HBM memory_analysis + flop counts + matmul calibration + 16-step
+    re-timing per batch size — the b16/b32 (SL) and b12 (RL) cliff
+    diagnosis and the MFU numerator (chip held for compiles + ~16
+    steps/config; see tools/memstats.py)."""
+    for mode, configs, fname in _MEMSTATS_RUNS:
+        out_path = os.path.join(REPO, "artifacts", fname)
+        if os.path.exists(out_path):
+            continue
+        _run(
+            [sys.executable, "-u", "tools/memstats.py", "--mode", mode,
+             "--configs", configs, "--out", out_path],
+            timeout=2400,
+            log_name=f"memstats-{mode}",
+        )
+    return memstats_complete()
 
 
 _AB_CONFIGS = [
@@ -224,14 +247,18 @@ def stage_fullstep_ab() -> bool:
     return done
 
 
-def stage_profile() -> bool:
-    prof_dir = os.path.join(REPO, "experiments", "profile_sl")
+def profile_complete() -> bool:
     # the trace lands under plugins/profile/<run>/*.xplane.pb — the learner's
     # own logs/ dir existing (or a plugins dir left by a kill mid-export)
     # does NOT mean a trace was captured
     import glob
 
-    if glob.glob(os.path.join(prof_dir, "plugins", "profile", "*", "*.xplane.pb")):
+    return bool(glob.glob(os.path.join(
+        REPO, "experiments", "profile_sl", "plugins", "profile", "*", "*.xplane.pb")))
+
+
+def stage_profile() -> bool:
+    if profile_complete():
         return True
     code = """
 import os, time, json
@@ -293,16 +320,14 @@ def main() -> None:
         print("[campaign] stop file present, exiting", flush=True)
         return
     # a fully-landed campaign must report done WITHOUT touching the chip —
-    # cheap artifact checks first, claim probe only when work remains
-    import glob as _glob
-
+    # cheap artifact checks first (the SAME predicates the stage functions
+    # short-circuit on), claim probe only when work remains
     pending = [
-        not os.path.exists(os.path.join(REPO, "BENCH_LOCAL_r05.json")),
-        not os.path.exists(os.path.join(REPO, "artifacts", "pallas_microbench_tpu.json")),
-        not os.path.exists(os.path.join(REPO, "artifacts", "memstats_tpu.json")),
+        not bench_complete(),
+        not kernels_complete(),
+        not memstats_complete(),
         not _fullstep_ab_complete(),
-        not _glob.glob(os.path.join(REPO, "experiments", "profile_sl",
-                                    "plugins", "profile", "*", "*.xplane.pb")),
+        not profile_complete(),
     ]
     if not any(pending):
         print("[campaign] done (all stages complete)", flush=True)
