@@ -13,12 +13,16 @@ attention):
   into the flattened (H*W, D) map via a fori_loop of dynamic row updates
   (entity count is static at 512; padding rows write via a validity mask to
   row 0 with zero weight).
+* ``scatter_add_onehot`` — the same scatter-add as a chunked one-hot
+  matmul: the [N, chunk] one-hot tile is built in VMEM (iota-compare) and
+  consumed by the MXU, replacing the loop kernel's serial row updates.
 
-Both run under ``interpret=True`` on CPU (tests compare against the jnp
+All run under ``interpret=True`` on CPU (tests compare against the jnp
 reference implementations) and lower natively on TPU. Enable via
 ``attn_impl='pallas'`` on ops.Transformer (model config key
-``encoder.entity.attention_impl``) and ``impl='pallas'`` on
-ops.scatter_connection.
+``encoder.entity.attention_impl``) and ``impl='pallas'|'pallas_onehot'``
+on ops.scatter_connection; defaults should follow
+``tools/bench_kernels.py``'s on-silicon table.
 """
 from __future__ import annotations
 
@@ -183,3 +187,70 @@ def _scatter_add_vjp_bwd(hw, interpret, flat_idx, dout):
 
 
 scatter_add_connection.defvjp(_scatter_add_vjp_fwd, _scatter_add_vjp_bwd)
+
+
+# ------------------------------------------------- scatter via one-hot matmul
+def _scatter_onehot_kernel(emb_ref, idx_ref, out_ref, *, chunk: int):
+    # out[cells] = onehot(idx)^T @ emb for this (batch, cell-chunk) tile.
+    # The one-hot tile is BUILT IN VMEM (iota-compare) and immediately
+    # consumed by the MXU — it never touches HBM, which is what makes this
+    # formulation beat a serial row-update loop on TPU.
+    c = pl.program_id(1)
+    idx = idx_ref[0, 0, :]  # [N] int32
+    emb = emb_ref[0]  # [N, D]
+    n = idx.shape[0]
+    col = jax.lax.broadcasted_iota(jnp.int32, (n, chunk), 1) + c * chunk
+    onehot = (idx[:, None] == col).astype(emb.dtype)  # [N, chunk]
+    out_ref[0] = jax.lax.dot_general(
+        onehot, emb, dimension_numbers=(((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ).astype(out_ref.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def scatter_add_onehot(
+    embeddings: jnp.ndarray,  # [B, N, D] (invalid entities must be zeroed)
+    flat_idx: jnp.ndarray,  # [B, N] int32 cell index in [0, H*W)
+    hw: int,
+    interpret: Optional[bool] = None,
+) -> jnp.ndarray:
+    """Per-batch scatter-add as a chunked one-hot matmul ([B, hw, D]).
+    Same semantics as ``scatter_add_connection`` for in-range indices
+    (callers clip — ``scatter_connection`` does); out-of-range indices are
+    DROPPED here where the loop kernel's ``pl.ds`` clamps them. Trades
+    `2*N*hw*D` MXU FLOPs for the serial dynamic-row updates of the loop
+    kernel. Same gather backward."""
+    return _scatter_onehot_fwd_kernel(embeddings, flat_idx, hw, interpret)
+
+
+def _scatter_onehot_fwd_kernel(embeddings, flat_idx, hw, interpret):
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    B, N, D = embeddings.shape
+    # cell chunk per program: big enough to amortise the emb reload, small
+    # enough that the [N, chunk] one-hot tile stays comfortably in VMEM
+    # (512x2048 bf16 = 2 MiB). Lane-dim tiles want multiples of 128.
+    chunk = min(hw, 2048)
+    if chunk % 128:
+        chunk = -(-chunk // 128) * 128  # round up: one partially-used tile
+    grid = (B, -(-hw // chunk))
+
+    return pl.pallas_call(
+        functools.partial(_scatter_onehot_kernel, chunk=chunk),
+        out_shape=jax.ShapeDtypeStruct((B, hw, D), embeddings.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, N, D), lambda b, c: (b, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, N), lambda b, c: (b, 0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, chunk, D), lambda b, c: (b, c, 0),
+                               memory_space=pltpu.VMEM),
+        interpret=interpret,
+    )(embeddings, flat_idx.astype(jnp.int32)[:, None, :])
+
+
+def _scatter_onehot_vjp_fwd(embeddings, flat_idx, hw, interpret):
+    return _scatter_onehot_fwd_kernel(embeddings, flat_idx, hw, interpret), flat_idx
+
+
+scatter_add_onehot.defvjp(_scatter_onehot_vjp_fwd, _scatter_add_vjp_bwd)

@@ -58,6 +58,36 @@ def test_scatter_add_collisions(rng):
     assert float(jnp.abs(out[0, 1:]).sum()) == 0.0
 
 
+def test_scatter_onehot_matches_loop_variant(rng):
+    """MXU one-hot formulation == loop formulation (incl. collisions), fwd
+    and grad, also at an hw that does NOT divide the cell chunk."""
+    from distar_tpu.ops.pallas_kernels import scatter_add_onehot
+
+    B, N, D, H, W = 2, 16, 8, 9, 7  # hw=63: exercises the padded last chunk
+    emb = jnp.asarray(rng.standard_normal((B, N, D)).astype(np.float32))
+    flat = jnp.asarray(rng.integers(0, H * W, (B, N))).astype(jnp.int32)
+    flat = flat.at[0, :4].set(0)  # forced collisions
+    want = scatter_add_connection(emb, flat, H * W, interpret=True)
+    got = scatter_add_onehot(emb, flat, H * W, interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-5)
+
+    g1 = jax.grad(lambda e: jnp.sum(scatter_add_onehot(e, flat, H * W, True) ** 2))(emb)
+    g2 = jax.grad(lambda e: jnp.sum(scatter_add_connection(e, flat, H * W, True) ** 2))(emb)
+    np.testing.assert_allclose(np.asarray(g1), np.asarray(g2), rtol=1e-5, atol=1e-5)
+
+
+def test_scatter_impl_switch_onehot(rng):
+    """scatter_connection(impl='pallas_onehot') routes and matches XLA."""
+    B, N, D, H, W = 2, 12, 4, 8, 8
+    emb = jnp.asarray(rng.standard_normal((B, N, D)).astype(np.float32))
+    x = jnp.asarray(rng.integers(0, W, (B, N)))
+    y = jnp.asarray(rng.integers(0, H, (B, N)))
+    want = scatter_connection(emb, jnp.stack([x, y], -1), (H, W), "add")
+    got = scatter_connection(emb, jnp.stack([x, y], -1), (H, W), "add",
+                             impl="pallas_onehot")
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-5)
+
+
 def test_masked_attention_vjp_matches_reference(rng):
     """Trainable kernel: pallas forward, XLA-recompute backward — gradients
     must match the dense reference's exactly."""

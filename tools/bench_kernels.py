@@ -51,6 +51,7 @@ def run(platform: str | None = None, iters: int = 30) -> dict:
         masked_attention,
         masked_attention_reference,
         scatter_add_connection,
+        scatter_add_onehot,
     )
 
     backend = jax.default_backend()
@@ -123,6 +124,7 @@ def run(platform: str | None = None, iters: int = 30) -> dict:
 
         impls = {
             "pallas": jax.jit(lambda e, i: scatter_add_connection(e, i, Hm * Wm, interpret)),
+            "pallas_onehot": jax.jit(lambda e, i: scatter_add_onehot(e, i, Hm * Wm, interpret)),
             "xla": jax.jit(lambda e, i: _xla_scatter(e, i, Hm * Wm)),
         }
 
@@ -142,6 +144,7 @@ def run(platform: str | None = None, iters: int = 30) -> dict:
 
         grads = {
             "pallas": jax.jit(jax.grad(lambda e: jnp.sum(scatter_add_connection(e, idx, Hm * Wm, interpret) ** 2))),
+            "pallas_onehot": jax.jit(jax.grad(lambda e: jnp.sum(scatter_add_onehot(e, idx, Hm * Wm, interpret) ** 2))),
             "xla": jax.jit(jax.grad(lambda e: jnp.sum(_xla_scatter(e, idx, Hm * Wm) ** 2))),
         }
         bwd_us = {name: _time(g, (emb,), max(iters // 3, 5)) for name, g in grads.items()}
