@@ -78,9 +78,11 @@ def _stage(name: str) -> None:
 
 
 _HEARTBEAT_STARTED = []
+_HEARTBEAT_STOP = threading.Event()
 
 
 def _start_heartbeat() -> None:
+    _HEARTBEAT_STOP.clear()
     if _HEARTBEAT_STARTED:  # once per process: in-process callers (tests)
         return              # must not accumulate immortal printer threads
     _HEARTBEAT_STARTED.append(True)
@@ -89,6 +91,10 @@ def _start_heartbeat() -> None:
         t0 = time.time()
         while True:
             time.sleep(20)
+            if _HEARTBEAT_STOP.is_set():
+                # an in-process bench (tests) finished: stay quiet instead of
+                # stamping unrelated later output with stale BENCH-STAGE lines
+                continue
             print(
                 f"BENCH-STAGE {_CURRENT_STAGE[0]} (heartbeat +{time.time() - t0:.0f}s)",
                 file=sys.stderr,
@@ -96,6 +102,10 @@ def _start_heartbeat() -> None:
             )
 
     threading.Thread(target=beat, daemon=True).start()
+
+
+def _stop_heartbeat() -> None:
+    _HEARTBEAT_STOP.set()
 
 
 def _calibrate_matmul(jax):
@@ -478,6 +488,13 @@ def run_child():
     if os.environ.get("BENCH_SIMULATE"):
         _run_child_simulated(os.environ["BENCH_SIMULATE"])
         return
+    try:
+        _run_child_real()
+    finally:
+        _stop_heartbeat()
+
+
+def _run_child_real():
     _start_heartbeat()
     _stage("import-jax")
     import jax
@@ -584,17 +601,25 @@ def run_child():
             ("sl_real", 6, 64),
             # push batch toward the HBM limit (bucketed: bigger batches fit)
             ("sl", 16, 64, 256),
+            # remat A/B at the same shape: if b16's ~0.65s/step cliff is
+            # activation spill, recompute should step around it
+            ("sl", 16, 64, 256, True),
             ("sl", 32, 64, 256),
             ("rl", 12, 64),
         ]
         if _env_entity_cap() is not None:
             # an explicit BENCH_MAX_ENTITIES governs every config: drop the
-            # plan's own buckets (they would duplicate whole compiles)
+            # plan's own buckets (they would duplicate whole compiles). The
+            # remat flag stays part of the identity — remat compiles differ.
             seen = set()
-            plan = [
-                p[:3] for p in plan
-                if p[:3] not in seen and not seen.add(p[:3])
-            ]
+            deduped = []
+            for p in plan:
+                key = (p[0], p[1], p[2], bool(p[4]) if len(p) > 4 else False)
+                if key in seen:
+                    continue
+                seen.add(key)
+                deduped.append((p[0], p[1], p[2], None, key[3]))
+            plan = deduped
         if mode in fns:
             plan = [p for p in plan if p[0] == mode]
 
@@ -605,17 +630,23 @@ def run_child():
     for entry in plan:
         kind, b, t = entry[:3]
         cap = entry[3] if len(entry) > 3 else None
+        plan_remat = bool(entry[4]) if len(entry) > 4 else False
         if out_of_budget():
             break
         try:
-            point = fns[kind](b, t, peak, cap=cap)
+            kwargs = {"cap": cap}
+            if plan_remat and kind == "sl":
+                kwargs["remat"] = True
+            point = fns[kind](b, t, peak, **kwargs)
         except Exception as e:  # OOM at the top of the sweep is expected
             err = {"batch": b, "unroll": t, "error": repr(e)[:300]}
             if cap:
                 err["max_entities"] = cap
+            if plan_remat:
+                err["remat"] = True
             state[f"{kind}_sweep"].append(err)
             print(f"BENCH-STAGE {kind}-failed b{b}xt{t}: {e!r}"[:400], file=sys.stderr, flush=True)
-            already_remat = _env_truthy("BENCH_REMAT")
+            already_remat = _env_truthy("BENCH_REMAT") or plan_remat
             if (
                 kind == "sl"
                 and "RESOURCE_EXHAUSTED" in repr(e)

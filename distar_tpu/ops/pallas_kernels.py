@@ -217,9 +217,10 @@ def scatter_add_onehot(
     """Per-batch scatter-add as a chunked one-hot matmul ([B, hw, D]).
     Same semantics as ``scatter_add_connection`` for in-range indices
     (callers clip — ``scatter_connection`` does); out-of-range indices are
-    DROPPED here where the loop kernel's ``pl.ds`` clamps them. Trades
+    DROPPED here where the loop kernel's ``pl.ds`` clamps them (the
+    backward zeroes those entities' gradients to match). Trades
     `2*N*hw*D` MXU FLOPs for the serial dynamic-row updates of the loop
-    kernel. Same gather backward."""
+    kernel; gather backward."""
     return _scatter_onehot_fwd_kernel(embeddings, flat_idx, hw, interpret)
 
 
@@ -253,4 +254,13 @@ def _scatter_onehot_vjp_fwd(embeddings, flat_idx, hw, interpret):
     return _scatter_onehot_fwd_kernel(embeddings, flat_idx, hw, interpret), flat_idx
 
 
-scatter_add_onehot.defvjp(_scatter_onehot_vjp_fwd, _scatter_add_vjp_bwd)
+def _scatter_onehot_vjp_bwd(hw, interpret, flat_idx, dout):
+    # the one-hot forward DROPS out-of-range indices (no clamp), so their
+    # gradient must be zero — unlike the loop kernel's clamped backward
+    idx = flat_idx.astype(jnp.int32)
+    in_range = (idx >= 0) & (idx < hw)
+    demb = jnp.take_along_axis(dout, idx[..., None].clip(0, hw - 1), axis=1)
+    return jnp.where(in_range[..., None], demb, 0), None
+
+
+scatter_add_onehot.defvjp(_scatter_onehot_vjp_fwd, _scatter_onehot_vjp_bwd)

@@ -76,6 +76,21 @@ def test_scatter_onehot_matches_loop_variant(rng):
     np.testing.assert_allclose(np.asarray(g1), np.asarray(g2), rtol=1e-5, atol=1e-5)
 
 
+def test_scatter_onehot_oob_dropped_fwd_and_bwd(rng):
+    """Out-of-range indices: the one-hot forward drops them, so their
+    entities must also get ZERO gradient (not the clamped cell's)."""
+    from distar_tpu.ops.pallas_kernels import scatter_add_onehot
+
+    B, N, D, hw = 1, 4, 2, 8
+    emb = jnp.ones((B, N, D))
+    flat = jnp.asarray([[0, 3, hw, hw + 5]], jnp.int32)  # last two OOB
+    out = scatter_add_onehot(emb, flat, hw, interpret=True)
+    np.testing.assert_allclose(np.asarray(out).sum(), 4.0)  # 2 entities x D
+    g = jax.grad(lambda e: jnp.sum(scatter_add_onehot(e, flat, hw, True) ** 2))(emb)
+    assert float(jnp.abs(g[0, 2:]).sum()) == 0.0  # OOB entities: zero grad
+    assert float(jnp.abs(g[0, :2]).sum()) > 0.0
+
+
 def test_scatter_impl_switch_onehot(rng):
     """scatter_connection(impl='pallas_onehot') routes and matches XLA."""
     B, N, D, H, W = 2, 12, 4, 8, 8
