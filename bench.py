@@ -183,13 +183,17 @@ def _measure(kind, label, train_step, args, feedback, frames, peak, iters=4):
     _stage(f"{kind}-warmup {label}")
     out = compiled(*args)
     jax.block_until_ready(out)
+    def timed(n):
+        nonlocal args, out
+        t0 = time.perf_counter()
+        for _ in range(n):
+            args = feedback(args, out)
+            out = compiled(*args)
+        jax.block_until_ready(out)
+        return (time.perf_counter() - t0) / n
+
     _stage(f"{kind}-steps {label}")
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        args = feedback(args, out)
-        out = compiled(*args)
-    jax.block_until_ready(out)
-    step_time = (time.perf_counter() - t0) / iters
+    step_time = timed(iters)
     point = {
         "frames_per_sec": round(frames / step_time, 2),
         "step_time_s": round(step_time, 4),
@@ -200,6 +204,22 @@ def _measure(kind, label, train_step, args, feedback, frames, peak, iters=4):
         point["flops_per_step"] = flops
         point["implied_tflops"] = round(flops / step_time / 1e12, 1)
         if peak:
+            point["mfu"] = round(flops / step_time / peak, 4)
+        if peak and flops / step_time > 1.1 * peak:
+            # physically impossible number: the flop count says this step
+            # cannot run this fast on this chip. Re-time over an 8x longer
+            # window and make THAT the point's headline numbers — a timing
+            # the code itself disproved must not win best-point selection.
+            # The short window stays in the JSON as evidence.
+            _stage(f"{kind}-steps-recheck {label}")
+            long_time = timed(iters * 8)
+            point["step_time_short_s"] = point["step_time_s"]
+            point["implied_tflops_short"] = point["implied_tflops"]
+            point["suspect_timing"] = bool(flops / long_time > 1.1 * peak)
+            step_time = long_time
+            point["step_time_s"] = round(step_time, 4)
+            point["frames_per_sec"] = round(frames / step_time, 2)
+            point["implied_tflops"] = round(flops / step_time / 1e12, 1)
             point["mfu"] = round(flops / step_time / peak, 4)
     return point
 
@@ -218,7 +238,8 @@ def _env_entity_cap():
 def _bench_model_cfg():
     """Flagship model config for the bench: bf16 on the MXU, with the hot-op
     implementations switchable for on-silicon A/B
-    (BENCH_ATTN_IMPL=pallas|xla|ring, BENCH_SCATTER_IMPL=pallas|xla)."""
+    (BENCH_ATTN_IMPL=pallas|xla|ring,
+    BENCH_SCATTER_IMPL=pallas|pallas_onehot|xla)."""
     cfg = {"dtype": "bfloat16"}
     if _env_truthy("BENCH_REMAT"):
         cfg["remat"] = True  # trade recompute for HBM: bigger batches fit

@@ -185,6 +185,9 @@ def stage_memstats() -> bool:
 _AB_CONFIGS = [
     ("xla", {}),
     ("pallas", {"BENCH_ATTN_IMPL": "pallas", "BENCH_SCATTER_IMPL": "pallas"}),
+    # MXU one-hot scatter instead of the serial-row-update loop kernel
+    ("pallas_onehot", {"BENCH_ATTN_IMPL": "pallas",
+                       "BENCH_SCATTER_IMPL": "pallas_onehot"}),
     # pad-to-bucket entity cap (exact below the cap; PERF.md)
     ("e256", {"BENCH_MAX_ENTITIES": "256"}),
 ]
