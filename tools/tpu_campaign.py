@@ -208,6 +208,41 @@ def _fullstep_ab_complete() -> bool:
     return all(name in have for name, _ in _AB_CONFIGS)
 
 
+def stage_bench_recheck() -> bool:
+    """Cross-examine the landed headline against memstats' independent
+    16-step re-timing at the same config (sl b6xt64). If they disagree by
+    >2x, the landed artifact is set aside as *_suspect.json and the bench
+    re-runs — bench.py now re-times physically-impossible points over a
+    longer window itself, so the re-land is trustworthy."""
+    bench_path = os.path.join(REPO, "BENCH_LOCAL_r05.json")
+    mem_path = os.path.join(REPO, "artifacts", "memstats_tpu.json")
+    try:
+        with open(bench_path) as f:
+            bench = json.load(f)
+        with open(mem_path) as f:
+            mem = json.load(f)
+    except (FileNotFoundError, json.JSONDecodeError, OSError):
+        return True  # nothing to cross-examine
+    sl = bench.get("sl") or {}
+    mem_row = next(
+        (r for r in mem.get("rows", [])
+         if r.get("batch") == sl.get("batch") and r.get("unroll") == sl.get("unroll")
+         and "step_time_s" in r),
+        None,
+    )
+    if not mem_row or not sl.get("step_time_s"):
+        return True
+    ratio = mem_row["step_time_s"] / sl["step_time_s"]
+    if 0.5 <= ratio <= 2.0:
+        print(f"[campaign] bench-recheck: headline confirmed "
+              f"(memstats/bench step-time ratio {ratio:.2f})", flush=True)
+        return True
+    print(f"[campaign] bench-recheck: DISAGREEMENT x{ratio:.1f} — setting the "
+          f"landed artifact aside and re-running the sweep", flush=True)
+    os.replace(bench_path, bench_path.replace(".json", "_suspect.json"))
+    return stage_bench(int(os.environ.get("BENCH_RECHECK_DEADLINE", "3600")))
+
+
 def stage_fullstep_ab() -> bool:
     """A/B the attention/scatter impls inside the full SL step (one modest
     config per impl; compile cache makes reruns cheap)."""
@@ -345,7 +380,8 @@ def main() -> None:
     if not ok_bench:
         sys.exit(1)
     all_ok = True
-    for stage in (stage_kernels, stage_memstats, stage_fullstep_ab, stage_profile):
+    for stage in (stage_kernels, stage_memstats, stage_bench_recheck,
+                  stage_fullstep_ab, stage_profile):
         if os.path.exists(STOP_FILE):
             # re-checked between stages: each holds the chip for up to ~40
             # min, and the switch must also halt an in-flight campaign
