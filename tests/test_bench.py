@@ -259,7 +259,7 @@ def test_env_cap_governs_whole_sweep(monkeypatch, capsys):
 
     def fake(kind):
         def fn(b, t, peak, iters=4, remat=False, cap=None):
-            calls.append((kind, b, t, cap))
+            calls.append((kind, b, t, cap, remat))
             point = _fake_point(b, t)
             if kind == "rl":
                 point["steps_per_sec"] = 1.0
@@ -272,7 +272,10 @@ def test_env_cap_governs_whole_sweep(monkeypatch, capsys):
     monkeypatch.setattr(bench, "_bench_sl_real", fake("sl_real"))
     bench.run_child()
 
-    assert all(cap is None for _, _, _, cap in calls)  # env governs via fns
-    configs = [(k, b, t) for k, b, t, _ in calls]
+    assert all(cap is None for _, _, _, cap, _ in calls)  # env governs via fns
+    # remat is part of a config's identity: the b16-remat A/B entry is NOT a
+    # duplicate of plain b16 (their compiles differ)
+    configs = [(k, b, t, remat) for k, b, t, _, remat in calls]
     assert len(configs) == len(set(configs))  # duplicates deduped
-    assert ("sl", 6, 64) in configs and ("rl", 6, 64) in configs
+    assert ("sl", 6, 64, False) in configs and ("rl", 6, 64, False) in configs
+    assert ("sl", 16, 64, True) in configs  # the remat A/B point survives
