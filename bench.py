@@ -228,11 +228,15 @@ def _env_truthy(name):
     return os.environ.get(name, "").lower() in ("1", "true", "yes")
 
 
-def _env_entity_cap():
+def _env_int(name):
     try:
-        return int(os.environ.get("BENCH_MAX_ENTITIES", 0)) or None
+        return int(os.environ.get(name, 0))
     except ValueError:  # exported-but-empty / junk: degrade, don't abort
-        return None
+        return 0
+
+
+def _env_entity_cap():
+    return _env_int("BENCH_MAX_ENTITIES") or None
 
 
 def _bench_model_cfg():
@@ -250,6 +254,10 @@ def _bench_model_cfg():
         enc["entity"] = {"attention_impl": attn}
     if scatter:
         enc["scatter"] = {"impl": scatter}
+    if _env_int("BENCH_LSTM_UNROLL") > 1:
+        # fuse N timesteps per scan iteration: the 64-step core-LSTM loop's
+        # per-step matmuls are too small to fill the MXU at batch ~6
+        enc["core_lstm"] = {"scan_unroll": _env_int("BENCH_LSTM_UNROLL")}
     if enc:
         cfg["encoder"] = enc
     return cfg

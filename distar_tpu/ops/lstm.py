@@ -81,6 +81,12 @@ class StackedLSTM(nn.Module):
     num_layers: int
     norm: str = "LN"  # 'LN' -> LayerNormLSTMCell, 'none' -> PlainLSTMCell
     dtype: Dtype = jnp.float32
+    # lax.scan unroll factor: >1 fuses that many timesteps per loop
+    # iteration — fewer loop boundaries for the 64-step unrolls whose
+    # per-step matmuls are far too small to fill the MXU at batch ~6.
+    # Measured, not assumed: bench BENCH_LSTM_UNROLL / config
+    # encoder.core_lstm.scan_unroll
+    scan_unroll: int = 1
 
     def setup(self):
         cell_cls = LayerNormLSTMCell if self.norm == "LN" else PlainLSTMCell
@@ -115,5 +121,6 @@ class StackedLSTM(nn.Module):
             lambda mdl, carry, x: mdl._step(carry, x),
             variable_broadcast="params",
             split_rngs={"params": False},
+            unroll=self.scan_unroll,
         )(self, states, xs)
         return ys, final

@@ -101,6 +101,21 @@ def test_lstm_cell_and_stack():
     np.testing.assert_allclose(np.asarray(jnp.concatenate([ys_a, ys_b], 0)), np.asarray(ys), atol=1e-5)
 
 
+def test_lstm_scan_unroll_equivalence():
+    """scan_unroll is a pure scheduling knob: same params, same outputs —
+    including a T that the unroll factor does not divide."""
+    T, B, D, H = 7, 2, 12, 16
+    xs = jnp.asarray(np.random.default_rng(1).standard_normal((T, B, D)), dtype=jnp.float32)
+    base = StackedLSTM(hidden_size=H, num_layers=2)
+    params = base.init(jax.random.PRNGKey(0), xs)
+    ys0, fin0 = base.apply(params, xs)
+    for u in (4, 8):
+        mod = StackedLSTM(hidden_size=H, num_layers=2, scan_unroll=u)
+        ys, fin = mod.apply(params, xs)
+        np.testing.assert_allclose(np.asarray(ys), np.asarray(ys0), atol=1e-6)
+        np.testing.assert_allclose(np.asarray(fin[1][1]), np.asarray(fin0[1][1]), atol=1e-6)
+
+
 def test_scatter_connection_add():
     B, N, D, H, W = 2, 4, 3, 5, 6
     emb = jnp.ones((B, N, D))

@@ -190,6 +190,8 @@ _AB_CONFIGS = [
                        "BENCH_SCATTER_IMPL": "pallas_onehot"}),
     # pad-to-bucket entity cap (exact below the cap; PERF.md)
     ("e256", {"BENCH_MAX_ENTITIES": "256"}),
+    # fuse 8 timesteps per core-LSTM scan iteration (serial-scan overhead A/B)
+    ("unroll8", {"BENCH_LSTM_UNROLL": "8"}),
 ]
 
 
