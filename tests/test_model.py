@@ -256,6 +256,36 @@ def test_su_head_parallel_matches_scan(small_cfg, model_and_params):
         )
 
 
+def test_scan_unroll_knobs_preserve_numerics(small_cfg, model_and_params):
+    """core_lstm/selected_units_head scan_unroll are pure scheduling knobs:
+    sample-mode outputs on identical params must match the defaults."""
+    from distar_tpu.utils import deep_merge_dicts
+
+    model, params = model_and_params
+    unrolled = Model(deep_merge_dicts(
+        small_cfg,
+        {"encoder": {"core_lstm": {"scan_unroll": 4}},
+         "policy": {"selected_units_head": {"scan_unroll": 8}}},
+    ))
+    data = _batch_obs(B)
+    outs = {}
+    for name, m in (("base", model), ("unrolled", unrolled)):
+        outs[name] = m.apply(
+            params, data["spatial_info"], data["entity_info"], data["scalar_info"],
+            data["entity_num"], _hidden(small_cfg, B), jax.random.PRNGKey(3),
+            method=m.sample_action,
+        )
+    for head, a in outs["base"]["logit"].items():
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(outs["unrolled"]["logit"][head]),
+            rtol=2e-5, atol=2e-5, err_msg=head,
+        )
+    np.testing.assert_array_equal(
+        np.asarray(outs["base"]["action_info"]["selected_units"]),
+        np.asarray(outs["unrolled"]["action_info"]["selected_units"]),
+    )
+
+
 def test_remat_preserves_numerics(rng):
     """cfg.remat wraps the activation-heavy blocks in jax.checkpoint: the
     HBM-for-FLOPs knob must not change forward or gradient numerics."""
