@@ -2,11 +2,14 @@
 
 Fills the role of the reference's TorchScript LN-LSTM core
 (reference: distar/agent/default/model/lstm.py: LSTMCell :69-93,
-LayerNormLSTMCell :120+, StackedLSTM). TPU-first design: the time loop is a
-single `jax.lax.scan` whose body is one fused cell step per layer — XLA
-unrolls nothing, compiles once for any T, and the 4*hidden gate matmul lands
-on the MXU. State layout is a tuple of (h, c) pairs, one per layer, each
-[B, hidden].
+LayerNormLSTMCell :120+, StackedLSTM). TPU-first design: execution is
+LAYER-MAJOR — per layer, the input projection for ALL timesteps is one
+big [T*B, D] x [D, 4H] matmul on the MXU (the cuDNN-style split), and
+only the small recurrent [B, H] x [H, 4H] matmul + gate pointwise stays
+inside the `lax.scan` over time. Identical parameters and numerics to the
+step-per-layer formulation (equivalence-tested); `layer_major=False`
+restores the time-major scan. State layout is a tuple of (h, c) pairs,
+one per layer, each [B, hidden].
 """
 from __future__ import annotations
 
@@ -26,12 +29,18 @@ class PlainLSTMCell(nn.Module):
     hidden_size: int
     dtype: Dtype = jnp.float32
 
-    @nn.compact
-    def __call__(self, x, state: LSTMState) -> Tuple[jnp.ndarray, LSTMState]:
+    def setup(self):
+        self.ih = nn.Dense(4 * self.hidden_size, dtype=self.dtype)
+        self.hh = nn.Dense(4 * self.hidden_size, dtype=self.dtype)
+
+    def input_proj(self, x):
+        """The x-dependent half of the gates; batched over any leading dims
+        (one MXU matmul for a whole [T, B, D] sequence)."""
+        return self.ih(x)
+
+    def step_from_proj(self, ih, state: LSTMState) -> Tuple[jnp.ndarray, LSTMState]:
         h, c = state
-        gates = nn.Dense(4 * self.hidden_size, dtype=self.dtype, name="ih")(x) + nn.Dense(
-            4 * self.hidden_size, dtype=self.dtype, name="hh"
-        )(h)
+        gates = ih + self.hh(h)
         i, f, g, o = jnp.split(gates, 4, axis=-1)
         c_new = jax.nn.sigmoid(f) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
         h_new = jax.nn.sigmoid(o) * jnp.tanh(c_new)
@@ -40,6 +49,9 @@ class PlainLSTMCell(nn.Module):
         h_new = h_new.astype(h.dtype)
         c_new = c_new.astype(c.dtype)
         return h_new, (h_new, c_new)
+
+    def __call__(self, x, state: LSTMState) -> Tuple[jnp.ndarray, LSTMState]:
+        return self.step_from_proj(self.input_proj(x), state)
 
 
 class LayerNormLSTMCell(nn.Module):
@@ -50,18 +62,23 @@ class LayerNormLSTMCell(nn.Module):
     hidden_size: int
     dtype: Dtype = jnp.float32
 
-    @nn.compact
-    def __call__(self, x, state: LSTMState) -> Tuple[jnp.ndarray, LSTMState]:
+    def setup(self):
+        self.ih = nn.Dense(4 * self.hidden_size, use_bias=False, dtype=self.dtype)
+        self.ln_ih = nn.LayerNorm(epsilon=1e-5, dtype=self.dtype)
+        self.hh = nn.Dense(4 * self.hidden_size, use_bias=False, dtype=self.dtype)
+        self.ln_hh = nn.LayerNorm(epsilon=1e-5, dtype=self.dtype)
+        self.ln_c = nn.LayerNorm(epsilon=1e-5, dtype=self.dtype)
+
+    def input_proj(self, x):
+        """LN(x W_ih); LayerNorm is per-row, so batching the whole [T, B, D]
+        sequence through one matmul is numerically identical to per-step."""
+        return self.ln_ih(self.ih(x))
+
+    def step_from_proj(self, ih, state: LSTMState) -> Tuple[jnp.ndarray, LSTMState]:
         h, c = state
-        ih = nn.LayerNorm(epsilon=1e-5, dtype=self.dtype, name="ln_ih")(
-            nn.Dense(4 * self.hidden_size, use_bias=False, dtype=self.dtype, name="ih")(x)
-        )
-        hh = nn.LayerNorm(epsilon=1e-5, dtype=self.dtype, name="ln_hh")(
-            nn.Dense(4 * self.hidden_size, use_bias=False, dtype=self.dtype, name="hh")(h)
-        )
-        gates = ih + hh
+        gates = ih + self.ln_hh(self.hh(h))
         i, f, g, o = jnp.split(gates, 4, axis=-1)
-        c_new = nn.LayerNorm(epsilon=1e-5, dtype=self.dtype, name="ln_c")(
+        c_new = self.ln_c(
             jax.nn.sigmoid(f) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
         )
         h_new = jax.nn.sigmoid(o) * jnp.tanh(c_new)
@@ -69,12 +86,17 @@ class LayerNormLSTMCell(nn.Module):
         c_new = c_new.astype(c.dtype)
         return h_new, (h_new, c_new)
 
+    def __call__(self, x, state: LSTMState) -> Tuple[jnp.ndarray, LSTMState]:
+        return self.step_from_proj(self.input_proj(x), state)
+
 
 class StackedLSTM(nn.Module):
-    """N stacked cells scanned over time.
+    """N stacked cells over time.
 
-    Input [T, B, D] -> output [T, B, H] plus final per-layer states. The scan
-    carries all layer states; per step each layer feeds the next.
+    Input [T, B, D] -> output [T, B, H] plus final per-layer states.
+    Layer-major by default: each layer hoists its input projection out of
+    the time scan (see module docstring); `layer_major=False` scans
+    time-major with all layer states in one carry.
     """
 
     hidden_size: int
@@ -87,6 +109,7 @@ class StackedLSTM(nn.Module):
     # Measured, not assumed: bench BENCH_LSTM_UNROLL / config
     # encoder.core_lstm.scan_unroll
     scan_unroll: int = 1
+    layer_major: bool = True
 
     def setup(self):
         cell_cls = LayerNormLSTMCell if self.norm == "LN" else PlainLSTMCell
@@ -117,10 +140,24 @@ class StackedLSTM(nn.Module):
             final, y = self._step(states, xs[0])
             ys = jnp.broadcast_to(y[None], (xs.shape[0],) + y.shape)
             return ys, final
-        final, ys = nn.transforms.scan(
-            lambda mdl, carry, x: mdl._step(carry, x),
-            variable_broadcast="params",
-            split_rngs={"params": False},
-            unroll=self.scan_unroll,
-        )(self, states, xs)
-        return ys, final
+        if not self.layer_major:
+            final, ys = nn.transforms.scan(
+                lambda mdl, carry, x: mdl._step(carry, x),
+                variable_broadcast="params",
+                split_rngs={"params": False},
+                unroll=self.scan_unroll,
+            )(self, states, xs)
+            return ys, final
+        # layer-major: hoist each layer's input projection out of the scan
+        h_seq = xs
+        new_states = []
+        for cell, st in zip(self.cells, states):
+            proj = cell.input_proj(h_seq)  # [T, B, 4H]: ONE MXU matmul
+            st, h_seq = nn.transforms.scan(
+                lambda mdl, carry, p: tuple(reversed(mdl.step_from_proj(p, carry))),
+                variable_broadcast="params",
+                split_rngs={"params": False},
+                unroll=self.scan_unroll,
+            )(cell, st, proj)
+            new_states.append(st)
+        return h_seq, tuple(new_states)
