@@ -254,10 +254,15 @@ def _bench_model_cfg():
         enc["entity"] = {"attention_impl": attn}
     if scatter:
         enc["scatter"] = {"impl": scatter}
+    core_lstm = {}
     if _env_int("BENCH_LSTM_UNROLL") > 1:
         # fuse N timesteps per scan iteration: the 64-step core-LSTM loop's
         # per-step matmuls are too small to fill the MXU at batch ~6
-        enc["core_lstm"] = {"scan_unroll": _env_int("BENCH_LSTM_UNROLL")}
+        core_lstm["scan_unroll"] = _env_int("BENCH_LSTM_UNROLL")
+    if os.environ.get("BENCH_LSTM_LAYER_MAJOR", "") == "0":
+        core_lstm["layer_major"] = False  # A/B the hoisted-projection split
+    if core_lstm:
+        enc["core_lstm"] = core_lstm
     if enc:
         cfg["encoder"] = enc
     return cfg

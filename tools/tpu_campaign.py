@@ -192,6 +192,9 @@ _AB_CONFIGS = [
     ("e256", {"BENCH_MAX_ENTITIES": "256"}),
     # fuse 8 timesteps per core-LSTM scan iteration (serial-scan overhead A/B)
     ("unroll8", {"BENCH_LSTM_UNROLL": "8"}),
+    # time-major LSTM fallback: attributes the layer-major (hoisted
+    # projection) win inside the full step
+    ("timemajor", {"BENCH_LSTM_LAYER_MAJOR": "0"}),
 ]
 
 
