@@ -51,7 +51,12 @@ def _attention_kernel(q_ref, k_ref, v_ref, mask_ref, out_ref, *, scale: float):
     score = score - jnp.max(score, axis=-1, keepdims=True)
     p = jnp.exp(score)
     p = p / jnp.sum(p, axis=-1, keepdims=True)
-    out_ref[0, 0] = jnp.dot(p, v, preferred_element_type=jnp.float32)
+    # f32 accumulation throughout; the write narrows to the output dtype
+    # (bf16 under mixed precision — halves the HBM write, matches the XLA
+    # path's einsum output dtype)
+    out_ref[0, 0] = jnp.dot(
+        p, v, preferred_element_type=jnp.float32
+    ).astype(out_ref.dtype)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
@@ -84,7 +89,7 @@ def _masked_attention_fwd_kernel(q, k, v, mask, interpret):
 
     return pl.pallas_call(
         functools.partial(_attention_kernel, scale=scale),
-        out_shape=jax.ShapeDtypeStruct((B, H, N, Dh), jnp.float32),
+        out_shape=jax.ShapeDtypeStruct((B, H, N, Dh), q.dtype),
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, 1, N, Dh), idx, memory_space=pltpu.VMEM),
@@ -103,7 +108,12 @@ def _masked_attention_vjp_fwd(q, k, v, mask, interpret):
 
 
 def _masked_attention_vjp_bwd(interpret, res, dout):
-    q, k, v, mask = res
+    # recompute in f32 regardless of the primal dtype: the forward kernel
+    # accumulates in f32, and a bf16 softmax recompute here would
+    # differentiate a visibly different p than the forward computed
+    q0, k0, v0, mask = res
+    q, k, v = (t.astype(jnp.float32) for t in (q0, k0, v0))
+    dout = dout.astype(jnp.float32)
     scale = 1.0 / (q.shape[-1] ** 0.5)
     score = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
     score = jnp.where(mask[:, None, None, :], score, NEG_INF)
@@ -113,7 +123,7 @@ def _masked_attention_vjp_bwd(interpret, res, dout):
     ds = p * (dp - jnp.sum(dp * p, axis=-1, keepdims=True))
     dq = jnp.einsum("bhqk,bhkd->bhqd", ds, k) * scale
     dk = jnp.einsum("bhqk,bhqd->bhkd", ds, q) * scale
-    return dq, dk, dv, None
+    return dq.astype(q0.dtype), dk.astype(k0.dtype), dv.astype(v0.dtype), None
 
 
 masked_attention.defvjp(_masked_attention_vjp_fwd, _masked_attention_vjp_bwd)

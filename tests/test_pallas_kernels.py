@@ -37,6 +37,25 @@ def test_masked_attention_padding_invariance(rng):
     np.testing.assert_allclose(np.asarray(out1), np.asarray(out2), atol=1e-4)
 
 
+def test_masked_attention_bf16_out_dtype(rng):
+    """bf16 inputs produce a bf16 output (matching the XLA path's einsum
+    dtype under mixed precision) with f32 accumulation inside."""
+    B, H, N, Dh = 1, 2, 16, 8
+    q, k, v = (
+        jnp.asarray(rng.standard_normal((B, H, N, Dh)), jnp.bfloat16)
+        for _ in range(3)
+    )
+    mask = jnp.ones((B, N), bool)
+    out = masked_attention(q, k, v, mask, interpret=True)
+    assert out.dtype == jnp.bfloat16
+    want = masked_attention_reference(
+        q.astype(jnp.float32), k.astype(jnp.float32), v.astype(jnp.float32), mask
+    )
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(want), rtol=0.05, atol=0.05
+    )
+
+
 def test_scatter_add_matches_jnp(rng):
     B, N, D, H, W = 2, 16, 8, 8, 8
     emb = jnp.asarray(rng.standard_normal((B, N, D)).astype(np.float32))

@@ -68,7 +68,9 @@ def run(platform: str | None = None, iters: int = 30) -> dict:
         batches = (2,)
     else:
         H, N, Dh = 2, 512, 128
-        batches = (8, 64)
+        # B=8 ~ actor lockstep fleet, B=64 ~ a learner microbatch,
+        # B=384 = the learner step's actual b6 x t64 flattened batch
+        batches = (8, 64, 384)
     for B in batches:
         q, k, v = (
             jnp.asarray(rng.standard_normal((B, H, N, Dh)), jnp.float32)
