@@ -223,7 +223,7 @@ def test_parent_extends_attempt_past_compile(tmp_path):
         tmp_path,
         # margins are sleeps, not compiles: load-independent
         "stage:backend-init (chip claim):0,stage:sl-compile b2xt4:20,result:123.0",
-        attempt_timeout=8, deadline=120, timeout=150,
+        attempt_timeout=8, deadline=300, timeout=360,
     )
     assert final["value"] == 123.0, final
     assert attempts <= 4, f"{attempts} attempts: extend logic not engaging"
@@ -239,7 +239,7 @@ def test_parent_kills_stuck_claim_and_retries(tmp_path):
         # later attempts claim instantly and land
         "stage:backend-init (chip claim):90;"
         "stage:backend-init (chip claim):0,stage:devices-ok cpu:0,result:55.5",
-        attempt_timeout=8, deadline=120, timeout=150,
+        attempt_timeout=8, deadline=300, timeout=360,
     )
     assert final["value"] == 55.5, final
     assert attempts >= 2, "stuck first attempt was never killed"
