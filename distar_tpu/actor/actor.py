@@ -28,6 +28,7 @@ from ..comm import Adapter
 from ..envs import BaseEnv, MockEnv
 from ..league import League
 from ..model import Model, default_model_config
+from ..obs import get_registry, start_trace
 from ..utils import Config, deep_merge_dicts
 from .agent import Agent, sample_fake_z
 from .inference import BatchedInference, decollate
@@ -121,6 +122,10 @@ class Actor:
         self.model_iter_highwater[player_id] = max(
             self.model_iter_highwater.get(player_id, 0), it
         )
+        get_registry().gauge(
+            "distar_actor_model_iter", "freshest learner iteration received",
+            player=player_id,
+        ).set(self.model_iter_highwater[player_id])
 
     def _sample_z(
         self,
@@ -532,4 +537,16 @@ class Actor:
         # forward)
         hidden_backup[(e, side)] = infer[side].hidden_for_slot(e)
         if self.adapter is not None and ag.player_id in job["send_data_players"]:
-            self.adapter.push(f"{ag.player_id}traj", traj, timeout_ms=120_000)
+            # mint the pipeline span here, where the trajectory is born: the
+            # context rides the payload through shuttle/adapter into the
+            # learner, and the span id is ALSO stamped into the trajectory
+            # itself so the learner can attribute per-trajectory staleness
+            trace = start_trace("trajectory", player=ag.player_id)
+            traj[0]["trace"] = trace
+            get_registry().counter(
+                "distar_actor_traj_pushed_total", "trajectories shipped to the learner",
+                player=ag.player_id,
+            ).inc()
+            self.adapter.push(
+                f"{ag.player_id}traj", traj, timeout_ms=120_000, trace=trace
+            )

@@ -16,7 +16,10 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from typing import Callable, List, Optional, Tuple
+
+from ..obs import get_registry
 
 RESET = "reset"
 STEP = "step"
@@ -30,6 +33,17 @@ class EnvWorkerPool:
         self._out: queue.Queue = queue.Queue()
         self._epoch = [0] * self.num
         self._threads = []
+        # instrument handles resolved once (workers hammer these per step);
+        # the registry's own locks make the updates thread-safe
+        reg = get_registry()
+        self._m_steps = reg.counter("distar_env_steps_total", "env steps completed")
+        self._m_resets = reg.counter("distar_env_resets_total", "env episode resets")
+        self._m_errors = reg.counter("distar_env_errors_total", "env worker exceptions")
+        self._m_step_time = reg.histogram("distar_env_step_seconds", "single env.step latency")
+        self._m_rate = reg.gauge(
+            "distar_actor_env_step_rate", "pool-wide env steps per second since start"
+        )
+        self._t0 = time.monotonic()
         for e, fn in enumerate(env_fns):
             t = threading.Thread(
                 target=self._worker, args=(e, fn), daemon=True, name=f"env-worker-{e}"
@@ -48,11 +62,19 @@ class EnvWorkerPool:
                 try:
                     if cmd == RESET:
                         obs = env.reset()
+                        self._m_resets.inc()
                         self._out.put((e, epoch, RESET, obs))
                     else:
+                        t_start = time.perf_counter()
                         result = env.step(payload)
+                        self._m_step_time.observe(time.perf_counter() - t_start)
+                        self._m_steps.inc()
+                        elapsed = time.monotonic() - self._t0
+                        if elapsed > 0:
+                            self._m_rate.set(self._m_steps.value / elapsed)
                         self._out.put((e, epoch, STEP, result))
                 except Exception as err:
+                    self._m_errors.inc()
                     self._out.put((e, epoch, "error", err))
         finally:
             try:

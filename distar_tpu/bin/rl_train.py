@@ -91,6 +91,19 @@ def _learner_cfg(args, model_cfg: dict, load_path: str = "") -> dict:
     }
 
 
+def _maybe_serve_metrics(args, coordinator=None):
+    """Start an HTTP server exposing GET /metrics for this process's registry
+    when --metrics-port is given (CoordinatorServer doubles as the exporter;
+    for non-broker roles its POST routes simply go unused). Returns the
+    server or None."""
+    if args.metrics_port is None:
+        return None
+    server = CoordinatorServer(coordinator=coordinator, port=args.metrics_port)
+    server.start()
+    print(f"metrics on http://{server.host}:{server.port}/metrics", flush=True)
+    return server
+
+
 def run_all(args) -> None:
     """Single-process league-RL loop on the mock env (the small-scale config
     path; swaps to the real SC2 env behind the same interfaces)."""
@@ -98,6 +111,7 @@ def run_all(args) -> None:
     model_cfg = _model_cfg(args)
     league = League(user_cfg)
     co = Coordinator()
+    _maybe_serve_metrics(args, coordinator=co)
     actor_adapter = Adapter(coordinator=co)
     learner_adapter = Adapter(coordinator=co)
 
@@ -158,6 +172,7 @@ def run_learner(args) -> None:
     )
     league = RemoteLeague(*_addr(args.league_addr)) if args.league_addr else None
     adapter = Adapter(coordinator_addr=_addr(args.coordinator_addr))
+    _maybe_serve_metrics(args)
     model_cfg = _model_cfg(args)
     load_path = ""
     if league is not None:
@@ -182,6 +197,7 @@ def run_actor(args) -> None:
 
     league = RemoteLeague(*_addr(args.league_addr))
     adapter = Adapter(coordinator_addr=_addr(args.coordinator_addr))
+    _maybe_serve_metrics(args)
     model_cfg = _model_cfg(args)
     actor = Actor(
         cfg={"actor": {"env_num": args.env_num, "traj_len": args.traj_len}},
@@ -208,6 +224,9 @@ def main() -> None:
     p.add_argument("--smoke-model", action="store_true", default=True)
     p.add_argument("--full-model", dest="smoke_model", action="store_false")
     p.add_argument("--port", type=int, default=0)
+    p.add_argument("--metrics-port", type=int, default=None,
+                   help="serve GET /metrics (Prometheus text) on this port; "
+                        "the coordinator role serves it on --port already")
     p.add_argument("--league-addr", default="", help="host:port of the league server")
     p.add_argument("--coordinator-addr", default="", help="host:port of the coordinator")
     p.add_argument("--player-id", default="MP0")

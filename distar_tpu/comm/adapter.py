@@ -17,6 +17,7 @@ from typing import Any, Callable, Optional
 from . import shuttle
 from .coordinator import Coordinator, coordinator_request
 from .serializer import dumps, loads
+from ..obs import finish_trace, mark_hop, unwrap_payload, wrap_payload
 
 
 class Adapter:
@@ -59,51 +60,96 @@ class Adapter:
             coordinator_request(*self._co_addr, "strike", {"ip": ip, "port": port})
 
     # ------------------------------------------------------------------- api
-    def push(self, token: str, data: Any, accept_count: int = 1, timeout_ms: int = 60_000) -> int:
-        """Serve ``data`` to ``accept_count`` consumers; returns the port."""
-        blob = dumps(data, compress=self._compress)
+    def push(
+        self,
+        token: str,
+        data: Any,
+        accept_count: int = 1,
+        timeout_ms: int = 60_000,
+        trace: Optional[dict] = None,
+    ) -> int:
+        """Serve ``data`` to ``accept_count`` consumers; returns the port.
+
+        A ``trace`` context (obs.start_trace) rides the payload in a
+        transparent envelope: the pull side unwraps it, records the
+        comm-hop latency, and hands consumers the bare payload."""
+        if trace is not None:
+            mark_hop(trace, "adapter_push")
+        blob = dumps(wrap_payload(data, trace), compress=self._compress)
         port = shuttle.serve(blob, accept_count=accept_count, timeout_ms=timeout_ms)
         self._register(token, port)
         return port
 
-    def pull(self, token: str, block: bool = True, timeout: float = 60.0, poll_s: float = 0.05):
-        """Fetch one payload for ``token``; None when non-blocking and empty."""
+    def pull(
+        self,
+        token: str,
+        block: bool = True,
+        timeout: float = 60.0,
+        poll_s: float = 0.05,
+        with_trace: bool = False,
+    ):
+        """Fetch one payload for ``token``; None when non-blocking and empty.
+        ``with_trace=True`` returns ``(payload, trace_ctx_or_None)`` so
+        consumers (dataloader) can carry the span onward; otherwise the
+        envelope is stripped and the comm hop recorded here."""
         deadline = time.time() + timeout
         while True:
             rec = self._ask(token)
             if rec is not None:
                 try:
                     blob = shuttle.fetch(rec["ip"], rec["port"], timeout_ms=int(timeout * 1000))
-                    return loads(blob)
                 except (OSError, ConnectionError):
                     self._strike(rec["ip"], rec["port"])
                     continue
+                payload, trace = unwrap_payload(loads(blob))
+                if trace is not None:
+                    mark_hop(trace, "adapter_pull")
+                if with_trace:
+                    return (payload, trace)
+                if trace is not None:
+                    # no downstream carrier: this hop terminates the span
+                    finish_trace(trace, hop="consumed")
+                return payload
             if not block:
-                return None
+                return (None, None) if with_trace else None
             if time.time() > deadline:
                 raise TimeoutError(f"pull({token}) timed out")
             time.sleep(poll_s)
 
-    def start_pull_loop(self, token: str, maxlen: int = 8) -> deque:
+    def start_pull_loop(self, token: str, maxlen: int = 8, keep_trace: bool = False) -> deque:
         """Background loop keeping a bounded cache of payloads for ``token``.
         Backpressure: when the cache is full the loop pauses (payload stays
-        with the producer until its serve window expires)."""
+        with the producer until its serve window expires). With
+        ``keep_trace`` the cache holds ``(payload, trace_ctx)`` tuples so the
+        consumer can continue the span (dataloader -> learner)."""
+        from ..obs import get_registry
+
         cache: deque = deque(maxlen=maxlen)
         self._caches[token] = cache
+        depth_gauge = get_registry().gauge(
+            "distar_adapter_cache_depth", "pull-loop cache occupancy", token=token
+        )
 
         def run():
             while not self._stop.is_set():
+                depth_gauge.set(len(cache))
                 if len(cache) >= maxlen:
                     time.sleep(0.02)
                     continue
                 try:
-                    data = self.pull(token, block=False)
+                    data, trace = self.pull(token, block=False, with_trace=True)
                 except (TimeoutError, OSError):
-                    data = None
+                    data, trace = None, None
                 if data is None:
                     time.sleep(0.02)
                 else:
-                    cache.append(data)
+                    if keep_trace:
+                        cache.append((data, trace))
+                    else:
+                        if trace is not None:
+                            finish_trace(trace, hop="consumed")
+                        cache.append(data)
+                    depth_gauge.set(len(cache))
 
         t = threading.Thread(target=run, daemon=True)
         t.start()

@@ -20,8 +20,12 @@ from ..utils import Config
 
 
 class Coordinator:
-    def __init__(self, maxlen_per_token: int = 512):
+    def __init__(self, maxlen_per_token: int = 512, max_age_s: Optional[float] = None):
+        """``max_age_s``: default serve-window age filter applied by BOTH
+        ``depth()`` and ``stats()`` (records older than the producers' serve
+        window are loss, not backlog). None = no filtering."""
         self._maxlen = maxlen_per_token
+        self._max_age_s = max_age_s
         self._records: Dict[str, deque] = defaultdict(lambda: deque(maxlen=self._maxlen))
         self._strikes: Dict[str, int] = defaultdict(int)
         self._lock = threading.RLock()
@@ -41,21 +45,30 @@ class Coordinator:
                 return None
             return q.popleft()
 
-    def depth(self, token: str, max_age_s: Optional[float] = None) -> int:
+    _UNSET = object()  # sentinel: "use the instance default max_age_s"
+
+    @staticmethod
+    def _filtered_len(q, max_age_s: Optional[float]) -> int:
+        if max_age_s is None:
+            return len(q)
+        cutoff = time.time() - max_age_s
+        return sum(1 for r in q if r.get("ts", 0) >= cutoff)
+
+    def depth(self, token: str, max_age_s=_UNSET) -> int:
         """Registered-but-unconsumed records for a token — the broker-side
         backlog (payloads wait in producer serve windows until fetched), the
         queue hop that client-cache occupancy can't see. ``max_age_s``
         excludes records older than the producers' serve window: those
         payloads expired and will never be consumed, so they are loss, not
-        backlog (stats() gives the raw per-token lengths)."""
+        backlog. Defaults to the instance-wide ``max_age_s`` so depth(),
+        stats() and the /metrics gauges all agree on one filter."""
+        if max_age_s is Coordinator._UNSET:
+            max_age_s = self._max_age_s
         with self._lock:
             q = self._records.get(token)
             if not q:
                 return 0
-            if max_age_s is None:
-                return len(q)
-            cutoff = time.time() - max_age_s
-            return sum(1 for r in q if r.get("ts", 0) >= cutoff)
+            return self._filtered_len(q, max_age_s)
 
     def strike(self, ip: str, port: int) -> None:
         """Report a dead producer endpoint; 5 strikes purges its records."""
@@ -69,9 +82,36 @@ class Coordinator:
                         q.remove(r)
                 self._strikes.pop(key)
 
-    def stats(self) -> dict:
+    def stats(self, max_age_s=_UNSET) -> dict:
+        """Per-token depth with the SAME age filter as ``depth()`` (they used
+        to disagree: stats counted raw lengths, so /metrics and ask-side
+        accounting drifted whenever serve windows expired). Pass
+        ``max_age_s=None`` explicitly for raw unfiltered lengths."""
+        if max_age_s is Coordinator._UNSET:
+            max_age_s = self._max_age_s
         with self._lock:
-            return {token: len(q) for token, q in self._records.items()}
+            return {
+                token: self._filtered_len(q, max_age_s)
+                for token, q in self._records.items()
+            }
+
+    def publish_metrics(self, registry=None) -> None:
+        """Refresh ``distar_coordinator_queue_depth{token=...}`` gauges (and
+        the strike gauge) — called by the /metrics route at scrape time."""
+        from ..obs import get_registry
+
+        reg = registry or get_registry()
+        for token, depth in self.stats().items():
+            reg.gauge(
+                "distar_coordinator_queue_depth",
+                "broker backlog per token (age-filtered)",
+                token=token,
+            ).set(depth)
+        with self._lock:
+            strikes = sum(self._strikes.values())
+        reg.gauge(
+            "distar_coordinator_endpoint_strikes", "outstanding dead-endpoint strikes"
+        ).set(strikes)
 
 
 class CoordinatorServer:
@@ -86,13 +126,44 @@ class CoordinatorServer:
             "register": lambda b: co.register(**b),
             "ask": lambda b: co.ask(b["token"]),
             "strike": lambda b: co.strike(b["ip"], b["port"]),
-            "stats": lambda b: co.stats(),
-            "depth": lambda b: co.depth(b["token"], b.get("max_age_s")),
+            # absent max_age_s -> the coordinator's own default filter, so
+            # HTTP callers and in-process callers see identical accounting
+            "stats": lambda b: (
+                co.stats(b["max_age_s"]) if "max_age_s" in b else co.stats()
+            ),
+            "depth": lambda b: (
+                co.depth(b["token"], b["max_age_s"])
+                if "max_age_s" in b
+                else co.depth(b["token"])
+            ),
         }
 
         class Handler(BaseHTTPRequestHandler):
             def log_message(self, *args):
                 pass
+
+            def do_GET(self):
+                """GET /metrics: Prometheus text exposition of the process
+                registry (queue-depth gauges refreshed at scrape time)."""
+                if self.path.rstrip("/") != "/metrics":
+                    self.send_response(404)
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+                    return
+                from ..obs import PROMETHEUS_CONTENT_TYPE, render_prometheus
+
+                try:
+                    co.publish_metrics()
+                    data = render_prometheus().encode()
+                    status, ctype = 200, PROMETHEUS_CONTENT_TYPE
+                except Exception as e:  # scrape must never wedge the broker
+                    data = repr(e).encode()
+                    status, ctype = 500, "text/plain"
+                self.send_response(status)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
 
             def do_POST(self):
                 name = self.path.strip("/").split("/")[-1]

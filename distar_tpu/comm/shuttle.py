@@ -160,9 +160,18 @@ def _py_lz_decompress(blob: bytes, decompressed_len: int) -> bytes:
     return bytes(out)
 
 
+def _metrics():
+    from ..obs import get_registry
+
+    return get_registry()
+
+
 def serve(payload: bytes, accept_count: int = 1, timeout_ms: int = 30_000) -> int:
     """Serve ``payload`` (framed) on an ephemeral port to up to
     ``accept_count`` connections; returns the port."""
+    reg = _metrics()
+    reg.counter("distar_shuttle_serves_total", "serve windows opened").inc()
+    reg.counter("distar_shuttle_tx_bytes_total", "payload bytes offered").inc(len(payload))
     lib = _load()
     if lib is not None:
         buf = (ctypes.c_uint8 * len(payload)).from_buffer_copy(payload)
@@ -175,20 +184,29 @@ def serve(payload: bytes, accept_count: int = 1, timeout_ms: int = 30_000) -> in
 
 def fetch(host: str, port: int, timeout_ms: int = 30_000) -> bytes:
     """Fetch one framed payload from host:port."""
+    reg = _metrics()
     lib = _load()
-    if lib is not None:
-        out = ctypes.POINTER(ctypes.c_uint8)()
-        out_len = ctypes.c_uint64()
-        rc = lib.shuttle_fetch(
-            host.encode(), port, timeout_ms, ctypes.byref(out), ctypes.byref(out_len)
-        )
-        if rc != 0:
-            raise OSError(f"shuttle_fetch failed: {rc}")
-        try:
-            return ctypes.string_at(out, out_len.value)
-        finally:
-            lib.shuttle_free(out)
-    return _py_fetch(host, port, timeout_ms)
+    try:
+        if lib is not None:
+            out = ctypes.POINTER(ctypes.c_uint8)()
+            out_len = ctypes.c_uint64()
+            rc = lib.shuttle_fetch(
+                host.encode(), port, timeout_ms, ctypes.byref(out), ctypes.byref(out_len)
+            )
+            if rc != 0:
+                raise OSError(f"shuttle_fetch failed: {rc}")
+            try:
+                blob = ctypes.string_at(out, out_len.value)
+            finally:
+                lib.shuttle_free(out)
+        else:
+            blob = _py_fetch(host, port, timeout_ms)
+    except (OSError, ConnectionError):
+        reg.counter("distar_shuttle_fetch_errors_total", "failed fetches").inc()
+        raise
+    reg.counter("distar_shuttle_fetches_total", "payloads fetched").inc()
+    reg.counter("distar_shuttle_rx_bytes_total", "payload bytes received").inc(len(blob))
+    return blob
 
 
 # ------------------------------------------------------------ python fallback
@@ -200,8 +218,11 @@ def _py_serve(payload: bytes, accept_count: int, timeout_ms: int) -> int:
     listener.settimeout(timeout_ms / 1000.0)
     port = listener.getsockname()[1]
     framed = struct.pack(">Q", len(payload)) + payload
+    reg = _metrics()
+    reg.gauge("distar_shuttle_active_serves", "serve windows currently open").inc()
 
     def run():
+        served = 0
         try:
             for _ in range(accept_count):
                 try:
@@ -210,8 +231,16 @@ def _py_serve(payload: bytes, accept_count: int, timeout_ms: int) -> int:
                     break
                 with conn:
                     conn.sendall(framed)
+                served += 1
         finally:
             listener.close()
+            reg.gauge("distar_shuttle_active_serves").dec()
+            if served < accept_count:
+                # expired serve window: the payload copies nobody fetched
+                # are drops, the loss side of broker-depth accounting
+                reg.counter(
+                    "distar_shuttle_drops_total", "serve-window expiries (unfetched payloads)"
+                ).inc(accept_count - served)
 
     threading.Thread(target=run, daemon=True).start()
     return port

@@ -37,6 +37,7 @@ import numpy as np
 
 from ..lib import actions as ACT
 from ..lib import features as F
+from ..obs import get_registry
 from .features import ProtoFeatures, extract_z
 
 RESULT_DICT = {1: "W", 2: "L", 3: "D", 4: "U"}
@@ -263,12 +264,28 @@ class ReplayDecoder:
             data = self._parse_replay(replay_path, player_index, info)
             if data is None or len(data) < self._minimum_action_length:
                 return None
+            elapsed = time.time() - start_time
+            reg = get_registry()
+            reg.counter("distar_replay_decoded_total", "replays decoded").inc()
+            reg.counter(
+                "distar_replay_decoded_steps_total", "training steps emitted"
+            ).inc(len(data))
+            reg.histogram(
+                "distar_replay_decode_seconds", "wall time per decoded replay"
+            ).observe(elapsed)
+            if elapsed > 0:
+                reg.gauge(
+                    "distar_replay_decode_steps_per_s", "decode throughput (last replay)"
+                ).set(len(data) / elapsed)
             logging.info(
                 "decoded %s player %d: %d steps in %.1fs",
-                replay_path, player_index, len(data), time.time() - start_time,
+                replay_path, player_index, len(data), elapsed,
             )
             return data
         except Exception as e:
+            get_registry().counter(
+                "distar_replay_decode_errors_total", "replay decode failures"
+            ).inc()
             logging.error("parse replay error %r\n%s", e, traceback.format_exc())
             self.close()
             self._version = None

@@ -575,8 +575,11 @@ def main(argv=None) -> None:
             i += 2
         else:
             i += 1  # -dataDir/-tempDir/-dataVersion etc: accepted, ignored
+    import logging
+
+    logging.basicConfig(level=logging.INFO, format="%(message)s")
     server = FakeSC2Server(port=port, host=host)
-    print(f"fake_sc2 listening on {server.host}:{server.port}", flush=True)
+    logging.info("fake_sc2 listening on %s:%s", server.host, server.port)
     try:
         while True:
             time.sleep(3600)

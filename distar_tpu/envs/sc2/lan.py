@@ -69,8 +69,7 @@ def host_lan_game(
 
     ``controller``/``ports`` injectable for tests (fake server).
     """
-    import portpicker
-
+    from . import portpicker_compat as portpicker
     from . import maps as map_registry
 
     if run_config is None and controller is None:

@@ -22,8 +22,7 @@ import threading
 import time
 from typing import Callable, List, Optional, Sequence
 
-import portpicker
-
+from . import portpicker_compat as portpicker
 from ..features import ProtoFeatures
 from ..sc2_env import SC2Env
 from . import maps as map_registry

@@ -17,8 +17,7 @@ import tempfile
 import time
 from typing import Optional
 
-import portpicker
-
+from . import portpicker_compat as portpicker
 from . import remote_controller
 
 # the role of the reference's --sc2_port flag: connect to an already-running

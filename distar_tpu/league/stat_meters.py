@@ -17,6 +17,7 @@ from collections import defaultdict
 from functools import partial
 from typing import Dict
 
+from ..obs import get_registry
 from .stats import EmaMeter
 
 
@@ -25,11 +26,19 @@ def _meter_dict(decay: float, warm_up_size: int):
 
 
 class RaceMeterGrid:
-    """race -> metric-name -> EmaMeter."""
+    """race -> metric-name -> EmaMeter.
 
-    def __init__(self, decay: float = 0.995, warm_up_size: int = 1000):
+    Every update is mirrored into the process metrics registry
+    (``distar_league_stat{grid=,race=,metric=}`` gauges), so the race grids
+    are scrapeable from /metrics instead of living only in a private dict.
+    ``grid`` is the subclass name; metric keys come from a bounded vocabulary
+    (stat slot/unit names), keeping label cardinality finite."""
+
+    def __init__(self, decay: float = 0.995, warm_up_size: int = 1000,
+                 publish: bool = True):
         self._decay = decay
         self._warm_up = warm_up_size
+        self._publish = publish
         self._grid: Dict[str, Dict[str, EmaMeter]] = defaultdict(
             partial(_meter_dict, decay, warm_up_size)
         )
@@ -37,11 +46,25 @@ class RaceMeterGrid:
 
     def update(self, race: str, info: Dict[str, float]) -> None:
         self.game_count[race] += 1
+        # getattr: resume pickles from before the registry mirror lack _publish
+        reg = get_registry() if getattr(self, "_publish", True) else None
+        grid_label = type(self).__name__.lower()
+        if reg is not None:
+            reg.counter(
+                "distar_league_games_total", "game results folded into race grids",
+                grid=grid_label, race=race,
+            ).inc()
         for k, v in info.items():
             try:
-                self._grid[race][k].update(float(v))
+                meter = self._grid[race][k]
+                meter.update(float(v))
             except (TypeError, ValueError):
                 continue
+            if reg is not None:
+                reg.gauge(
+                    "distar_league_stat", "per-race EMA stat grids",
+                    grid=grid_label, race=race, metric=k,
+                ).set(meter.val)
 
     @property
     def stat_info_dict(self) -> Dict[str, Dict[str, float]]:

@@ -15,7 +15,9 @@ from typing import Any, Dict, Iterator, Optional
 
 import jax
 
+from ..obs import get_registry, record_step_phases
 from ..utils import Config, EasyTimer, build_logger, deep_merge_dicts
+from ..utils.timing import sw as global_stopwatch
 from ..utils.checkpoint import (
     AsyncCheckpointer,
     CountVar,
@@ -36,6 +38,9 @@ DEFAULT_LEARNER_CONFIG = Config(
             "load_path": "",
             "max_iterations": 10 ** 9,
             "grad_clip": {"type": "none", "threshold": 1.0},
+            # device profiler hook: every profile.freq iters capture
+            # profile.duration iters of jax.profiler trace (0 = disabled)
+            "profile": {"freq": 0, "duration": 2, "logdir": ""},
         },
     }
 )
@@ -56,8 +61,15 @@ class BaseLearner:
         self.last_iter = CountVar(0)
         self._checkpointer = AsyncCheckpointer()
         self.log_buffer: Dict[str, Any] = {}
+        self.metrics = get_registry()
+        prof = self.cfg.learner.get("profile", {})
         self.hooks: HookRegistry = default_hooks(
-            save_freq=self.cfg.learner.save_freq, log_freq=self.cfg.learner.log_freq
+            save_freq=self.cfg.learner.save_freq,
+            log_freq=self.cfg.learner.log_freq,
+            profile_freq=int(prof.get("freq", 0)),
+            profile_duration=int(prof.get("duration", 2)),
+            profile_logdir=prof.get("logdir", "")
+            or os.path.join(root, "profiles"),
         )
         self._state = None  # TrainState pytree (params, opt_state, step)
         self._dataloader: Optional[Iterator] = None
@@ -139,21 +151,51 @@ class BaseLearner:
         self._maybe_enable_prefetch()
 
         # crash path writes synchronously: the process may be about to die
+        iters_total = self.metrics.counter(
+            "distar_learner_iterations_total", "optimisation steps completed"
+        )
+        step_time = self.metrics.histogram(
+            "distar_learner_step_seconds", "device train-step wall time"
+        )
+        data_wait = self.metrics.histogram(
+            "distar_learner_data_wait_seconds", "dataloader wait per iteration"
+        )
+
         @auto_checkpoint(lambda: self.save(self.checkpoint_path(), sync=True))
         def _run():
             self.hooks.call("before_run", self)
             while self.last_iter.val < max_iterations:
                 with self.timer:
                     data = next(self._dataloader)
-                self.log_buffer["data_time"] = self.timer.value
+                t_data = self.timer.value
+                self.log_buffer["data_time"] = t_data
                 self.hooks.call("before_iter", self)
                 with self.timer:
                     log_vars = self._train(data)
-                self.log_buffer["train_time"] = self.timer.value
+                t_train = self.timer.value
+                self.log_buffer["train_time"] = t_train
                 self.log_buffer.update(log_vars)
                 self.last_iter.add(1)
-                self.hooks.call("after_iter", self)
+                # host-callback phase = everything after the device step:
+                # hook pass (log reduction, checkpoint scheduling, weight
+                # publication) — the third leg of the step breakdown
+                with self.timer:
+                    self.hooks.call("after_iter", self)
+                iters_total.inc()
+                step_time.observe(t_train)
+                data_wait.observe(t_data)
+                record_step_phases(
+                    {
+                        "data_wait": t_data,
+                        "device_step": t_train,
+                        "host_callback": self.timer.value,
+                    },
+                    registry=self.metrics,
+                )
             self.hooks.call("after_run", self)
 
         _run()
+        # drain per-region stopwatch samples into the registry (decorated
+        # regions anywhere in the process accumulate between reports)
+        global_stopwatch.report(registry=self.metrics)
         self._checkpointer.wait()  # drain the async writer before returning

@@ -119,11 +119,75 @@ def _is_scalar(v) -> bool:
         return False
 
 
-def default_hooks(save_freq: int = 1000, log_freq: int = 100) -> HookRegistry:
+class MetricsExportHook(Hook):
+    """after_iter (freq): dump the process metrics registry to the JSONL
+    scalar stream under the learner's log dir (always-on export — the
+    Prometheus /metrics route is pull-based and may have no scraper)."""
+
+    def __init__(self, priority=85, freq=100):
+        super().__init__("metrics_export", "after_iter", priority, freq)
+
+    def __call__(self, learner) -> None:
+        if learner.rank != 0:
+            return
+        exporter = getattr(learner, "_obs_exporter", None)
+        if exporter is None:
+            from ..obs import JsonlExporter
+
+            exporter = JsonlExporter(
+                os.path.join(learner.save_dir, "logs", "obs"),
+                registry=getattr(learner, "metrics", None),
+            )
+            learner._obs_exporter = exporter
+        exporter.export(step=learner.last_iter.val)
+
+
+class ProfilerHook(Hook):
+    """after_iter: freq-gated jax.profiler capture (like SaveCkptHook's
+    cadence): every ``freq`` iterations start a device trace, stop it
+    ``duration`` iterations later. Runs at every iteration (registry freq=1)
+    because the stop edge falls between gate points; the start gate is
+    internal. Rank-0 only; profiler failures are logged, never fatal."""
+
+    def __init__(self, logdir: str, freq: int = 1000, duration: int = 2,
+                 priority: int = 90, profiler=None):
+        super().__init__("profiler", "after_iter", priority, freq=1)
+        assert freq > 0 and duration > 0
+        self._freq = freq
+        self._duration = duration
+        self._stop_at = None
+        from ..obs import ProfilerSession
+
+        self.session = ProfilerSession(logdir, profiler=profiler)
+
+    def __call__(self, learner) -> None:
+        if learner.rank != 0:
+            return
+        it = learner.last_iter.val
+        if self.session.active:
+            if it >= self._stop_at and self.session.stop():
+                learner.logger.info(
+                    f"profiler trace captured -> {self.session.logdir}"
+                )
+        elif it % self._freq == 0:
+            if self.session.start():
+                self._stop_at = it + self._duration
+
+
+def default_hooks(
+    save_freq: int = 1000,
+    log_freq: int = 100,
+    profile_freq: int = 0,
+    profile_duration: int = 2,
+    profile_logdir: str = "",
+) -> HookRegistry:
     reg = HookRegistry()
     reg.add(LoadCkptHook())
     reg.add(SaveCkptHook(freq=save_freq))
     reg.add(SaveCkptHook(position="after_run"))
     reg.add(LogReduceHook())
     reg.add(LogShowHook(freq=log_freq))
+    reg.add(MetricsExportHook(freq=log_freq))
+    if profile_freq > 0:
+        reg.add(ProfilerHook(profile_logdir, freq=profile_freq, duration=profile_duration))
     return reg

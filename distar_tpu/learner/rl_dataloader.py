@@ -16,6 +16,7 @@ import numpy as np
 
 from ..comm import Adapter
 from ..lib import features as F
+from ..obs import finish_trace, get_registry, is_trace
 
 
 def collate_trajectories(trajs: List[list]) -> Dict:
@@ -91,7 +92,18 @@ class RLDataLoader:
         self._token = f"{player_id}{token_suffix}"
         self._batch_size = batch_size
         self._cache_size = cache_size
-        self._cache = adapter.start_pull_loop(self._token, maxlen=cache_size)
+        # keep_trace: the loop leaves spans open so THIS consumer records the
+        # terminal hop (cache entries are (traj, trace_ctx) tuples)
+        self._cache = adapter.start_pull_loop(
+            self._token, maxlen=cache_size, keep_trace=True
+        )
+        reg = get_registry()
+        self._m_batches = reg.counter(
+            "distar_dataloader_batches_total", "collated batches yielded", token=self._token
+        )
+        self._m_occupancy = reg.gauge(
+            "distar_dataloader_occupancy", "pull-cache fill fraction", token=self._token
+        )
 
     @property
     def token(self) -> str:
@@ -114,9 +126,29 @@ class RLDataLoader:
 
     def __next__(self) -> Dict:
         trajs: List[list] = []
+        traces: List[Optional[dict]] = []
         while len(trajs) < self._batch_size:
             if self._cache:
-                trajs.append(self._cache.popleft())
+                traj, ctx = self._cache.popleft()
+                trajs.append(traj)
+                traces.append(ctx)
             else:
                 time.sleep(0.005)
-        return collate_trajectories(trajs)
+        # close out the actor-minted pipeline spans: the batch reaching the
+        # learner is the terminal hop, and its age (actor env-step ->
+        # learner consume) is the wall-clock half of staleness. Span ids and
+        # ages ride the batch as host-side fields for the learner's log.
+        span_ids, ages = [], []
+        for traj, ctx in zip(trajs, traces):
+            if isinstance(traj[0], dict):
+                ctx = traj[0].pop("trace", ctx)  # same object when both exist
+            if is_trace(ctx):
+                ages.append(finish_trace(ctx, hop="learner_collate"))
+                span_ids.append(ctx["span_id"])
+        batch = collate_trajectories(trajs)
+        if span_ids:
+            batch["trace_span_ids"] = span_ids
+            batch["trace_age_s"] = np.asarray(ages, np.float32)
+        self._m_batches.inc()
+        self._m_occupancy.set(self.occupancy())
+        return batch

@@ -288,11 +288,16 @@ class RLLearner(BaseLearner):
 
     def _place_batch(self, batch):
         """Prefetch placement: everything device-put ahead of time except the
-        host-side staleness field."""
+        host-side staleness/trace fields."""
         batch = self._cap(dict(batch))
         model_last_iter = np.asarray(batch.pop("model_last_iter"))
+        span_ids = batch.pop("trace_span_ids", None)
+        trace_age = batch.pop("trace_age_s", None)
         out = self.shard_batch(batch)
         out["model_last_iter"] = model_last_iter
+        if span_ids is not None:
+            out["trace_span_ids"] = span_ids
+            out["trace_age_s"] = trace_age
         out["_on_device"] = True
         return out
 
@@ -440,6 +445,9 @@ class RLLearner(BaseLearner):
         on_device = data.pop("_on_device", False)
         model_last_iter = np.asarray(data.pop("model_last_iter"))
         staleness = self.last_iter.val - model_last_iter
+        # pipeline-span fields minted in the actor (host-side: never sharded)
+        span_ids = data.pop("trace_span_ids", None)
+        trace_age = data.pop("trace_age_s", None)
         if not on_device:
             data = self.shard_batch(self._cap(data))
         params, opt_state, info = self._train_step(
@@ -453,5 +461,12 @@ class RLLearner(BaseLearner):
         log["staleness/mean"] = float(staleness.mean())
         log["staleness/max"] = float(staleness.max())
         log["staleness/std"] = float(staleness.std())
+        if trace_age is not None and len(trace_age):
+            # wall-clock counterpart of iteration staleness: seconds from the
+            # trajectory's birth in the actor to this train step (span ids in
+            # trace_span_ids attribute outliers to specific trajectories)
+            log["trace/age_s_mean"] = float(np.mean(trace_age))
+            log["trace/age_s_max"] = float(np.max(trace_age))
+            self._last_span_ids = list(span_ids or [])
         self._apply_admin_requests()
         return log

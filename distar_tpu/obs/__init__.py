@@ -1,0 +1,52 @@
+"""Unified telemetry layer: metrics registry, trace spans, profiler hooks.
+
+One process-wide ``MetricsRegistry`` (``get_registry()``) that every layer —
+actor, env pool, comm shuttle/coordinator, learner, league — publishes into;
+two exporters (Prometheus text served from the coordinator's ``/metrics``
+route, JSONL composing with the utils.log scalar sink); explicit-context
+trace spans that ride payloads actor→comm→learner. See docs/observability.md.
+"""
+from .registry import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    get_registry,
+    set_registry,
+)
+from .exporters import PROMETHEUS_CONTENT_TYPE, JsonlExporter, render_prometheus
+from .trace import (
+    Span,
+    finish_trace,
+    hop_names,
+    is_trace,
+    mark_hop,
+    mint_span_id,
+    start_trace,
+    unwrap_payload,
+    wrap_payload,
+)
+from .profiler import ProfilerSession, record_step_phases
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "get_registry",
+    "set_registry",
+    "PROMETHEUS_CONTENT_TYPE",
+    "JsonlExporter",
+    "render_prometheus",
+    "Span",
+    "finish_trace",
+    "hop_names",
+    "is_trace",
+    "mark_hop",
+    "mint_span_id",
+    "start_trace",
+    "unwrap_payload",
+    "wrap_payload",
+    "ProfilerSession",
+    "record_step_phases",
+]

@@ -1,0 +1,84 @@
+"""Registry exporters: Prometheus text exposition + JSONL scalar dump.
+
+Prometheus rendering follows the text exposition format (v0.0.4): counters
+and gauges render one sample per labelset; histograms render as summaries
+(quantile-labelled samples + ``_sum``/``_count``), which matches their
+bounded-reservoir semantics. The JSONL exporter composes with the existing
+fallback sink in ``utils/log.py`` (ScalarSink) so registry snapshots land in
+the same ``scalars.jsonl`` stream training metrics already use.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from .registry import MetricsRegistry, get_registry
+
+PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+_QUANTILES = (0.5, 0.9, 0.99)
+
+
+def _escape_help(s: str) -> str:
+    return s.replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _escape_label(s: str) -> str:
+    return s.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _fmt(v: float) -> str:
+    if v == int(v) and abs(v) < 1e15:
+        return str(int(v))
+    return repr(float(v))
+
+
+def _labels_text(key, extra: str = "") -> str:
+    parts = [f'{k}="{_escape_label(v)}"' for k, v in key]
+    if extra:
+        parts.append(extra)
+    return "{" + ",".join(parts) + "}" if parts else ""
+
+
+def render_prometheus(registry: Optional[MetricsRegistry] = None) -> str:
+    """Render the registry in Prometheus text exposition format."""
+    registry = registry or get_registry()
+    lines = []
+    for fam in registry.collect():
+        name, kind = fam["name"], fam["type"]
+        prom_type = {"counter": "counter", "gauge": "gauge", "histogram": "summary"}[kind]
+        if fam["help"]:
+            lines.append(f"# HELP {name} {_escape_help(fam['help'])}")
+        lines.append(f"# TYPE {name} {prom_type}")
+        for key, inst in fam["series"]:
+            if kind == "histogram":
+                qs = inst.quantiles(_QUANTILES)
+                for q in _QUANTILES:
+                    qlabel = 'quantile="%s"' % q
+                    lines.append(f"{name}{_labels_text(key, qlabel)} {_fmt(qs[q])}")
+                lines.append(f"{name}_sum{_labels_text(key)} {_fmt(inst.sum)}")
+                lines.append(f"{name}_count{_labels_text(key)} {_fmt(inst.count)}")
+            else:
+                lines.append(f"{name}{_labels_text(key)} {_fmt(inst.value)}")
+    return "\n".join(lines) + "\n" if lines else ""
+
+
+class JsonlExporter:
+    """Periodic registry snapshots into the JSONL scalar stream.
+
+    Wraps ``utils.log.ScalarSink`` (the always-on fallback sink): each
+    ``export(step)`` writes one line per scalar in the flattened snapshot,
+    so ops tooling that already tails ``scalars.jsonl`` sees registry series
+    with zero new plumbing."""
+
+    def __init__(self, path: str, registry: Optional[MetricsRegistry] = None):
+        from ..utils.log import ScalarSink
+
+        self._sink = ScalarSink(path, force_jsonl=True)
+        self._registry = registry
+
+    def export(self, step: int = 0) -> int:
+        """Dump the current snapshot; returns the number of scalars written."""
+        registry = self._registry or get_registry()
+        snap = registry.snapshot()
+        self._sink.add_scalars(snap, global_step=step)
+        return len(snap)

@@ -1,0 +1,75 @@
+"""jax.profiler session wrapper + step-phase breakdown publication.
+
+``ProfilerSession`` guards ``jax.profiler.start_trace``/``stop_trace`` behind
+availability checks (profiling is best-effort telemetry: a missing/broken
+profiler must never take down training) and counts sessions in the registry.
+``record_step_phases`` is the single choke point the learner run loop uses to
+publish its data-wait / device-step / host-callback breakdown.
+"""
+from __future__ import annotations
+
+import logging
+from typing import Dict, Optional
+
+from .registry import MetricsRegistry, get_registry
+
+
+class ProfilerSession:
+    """Start/stop wrapper for the device profiler.
+
+    ``profiler`` is injectable (tests pass a stub); the default resolves
+    ``jax.profiler`` lazily so importing obs never imports jax."""
+
+    def __init__(self, logdir: str, profiler=None, registry: Optional[MetricsRegistry] = None):
+        self.logdir = logdir
+        self.active = False
+        self._profiler = profiler
+        self._registry = registry
+
+    def _resolve(self):
+        if self._profiler is None:
+            import jax
+
+            self._profiler = jax.profiler
+        return self._profiler
+
+    def start(self) -> bool:
+        if self.active:
+            return True
+        try:
+            self._resolve().start_trace(self.logdir)
+        except Exception as e:  # best-effort: never kill training over a trace
+            logging.warning("profiler start_trace failed: %r", e)
+            return False
+        self.active = True
+        reg = self._registry or get_registry()
+        reg.counter("distar_profiler_sessions_total", "profiler traces started").inc()
+        return True
+
+    def stop(self) -> bool:
+        if not self.active:
+            return False
+        self.active = False
+        try:
+            self._resolve().stop_trace()
+        except Exception as e:
+            logging.warning("profiler stop_trace failed: %r", e)
+            return False
+        return True
+
+
+_PHASES = ("data_wait", "device_step", "host_callback")
+
+
+def record_step_phases(
+    phases: Dict[str, float], registry: Optional[MetricsRegistry] = None
+) -> None:
+    """Publish one train iteration's phase breakdown (seconds) into
+    ``distar_learner_step_phase_seconds{phase=...}`` histograms."""
+    reg = registry or get_registry()
+    for phase, seconds in phases.items():
+        reg.histogram(
+            "distar_learner_step_phase_seconds",
+            "learner step time by phase",
+            phase=str(phase),
+        ).observe(float(seconds))

@@ -1,0 +1,131 @@
+"""Pipeline trace spans with explicit context propagation.
+
+A trace context is a plain picklable dict minted at the head of the pipeline
+(the actor, when a trajectory is born) that rides the payload through every
+hop — adapter push, shuttle transfer, adapter pull, dataloader collation —
+into the learner. Each ``mark_hop`` records the hop-to-hop latency into the
+registry (``distar_trace_hop_seconds{hop=...}``); ``finish`` records the
+end-to-end age (``distar_trace_e2e_seconds{name=...}``), which for
+trajectories IS the data-plane half of staleness: wall-clock from the
+actor's last env step to the learner consuming the batch.
+
+Explicit-context (dict in the payload) rather than implicit (contextvars)
+because the pipeline crosses process and host boundaries through pickled
+payloads — the context must serialize with the data it describes.
+"""
+from __future__ import annotations
+
+import os
+import time
+from typing import Dict, List, Optional
+
+from .registry import MetricsRegistry, get_registry
+
+
+def mint_span_id() -> str:
+    """64-bit random hex span/trace id (w3c-traceparent-sized)."""
+    return os.urandom(8).hex()
+
+
+def start_trace(name: str, registry: Optional[MetricsRegistry] = None, **attrs) -> dict:
+    """Mint a new trace context. ``attrs`` are free-form, low-cardinality
+    annotations (player id, token) carried for debugging, not used as labels."""
+    now = time.time()
+    ctx = {
+        "name": str(name),
+        "trace_id": mint_span_id(),
+        "span_id": mint_span_id(),
+        "t_start": now,
+        "hops": [{"hop": "start", "ts": now}],
+    }
+    if attrs:
+        ctx["attrs"] = {k: str(v) for k, v in attrs.items()}
+    return ctx
+
+
+def is_trace(ctx) -> bool:
+    return (
+        isinstance(ctx, dict)
+        and "trace_id" in ctx
+        and "span_id" in ctx
+        and isinstance(ctx.get("hops"), list)
+    )
+
+
+def mark_hop(ctx: dict, hop: str, registry: Optional[MetricsRegistry] = None) -> float:
+    """Append a hop to the context and record the latency since the previous
+    hop into ``distar_trace_hop_seconds{hop=...}``. Returns that latency."""
+    if not is_trace(ctx):
+        return 0.0
+    now = time.time()
+    prev_ts = ctx["hops"][-1]["ts"] if ctx["hops"] else ctx["t_start"]
+    dt = max(0.0, now - prev_ts)
+    ctx["hops"].append({"hop": str(hop), "ts": now})
+    reg = registry or get_registry()
+    reg.histogram(
+        "distar_trace_hop_seconds", "per-hop pipeline latency", hop=str(hop)
+    ).observe(dt)
+    return dt
+
+
+def finish_trace(ctx: dict, hop: str = "end", registry: Optional[MetricsRegistry] = None) -> float:
+    """Terminal hop: records the hop latency plus the end-to-end trace age
+    (``distar_trace_e2e_seconds{name=...}``). Returns the e2e age."""
+    if not is_trace(ctx):
+        return 0.0
+    mark_hop(ctx, hop, registry=registry)
+    age = max(0.0, ctx["hops"][-1]["ts"] - ctx["t_start"])
+    reg = registry or get_registry()
+    reg.histogram(
+        "distar_trace_e2e_seconds", "end-to-end pipeline trace age", span=ctx["name"]
+    ).observe(age)
+    return age
+
+
+def hop_names(ctx: dict) -> List[str]:
+    return [h["hop"] for h in ctx.get("hops", [])] if is_trace(ctx) else []
+
+
+class Span:
+    """In-process timed region publishing ``distar_span_seconds{name=...}``.
+
+    ``with Span("collate"): ...`` — the lightweight sibling of the
+    cross-process trace context, for regions that never leave the process."""
+
+    def __init__(self, name: str, registry: Optional[MetricsRegistry] = None):
+        self.name = name
+        self.span_id = mint_span_id()
+        self._registry = registry
+        self._start = 0.0
+        self.elapsed = 0.0
+
+    def __enter__(self):
+        self._start = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self.elapsed = time.perf_counter() - self._start
+        reg = self._registry or get_registry()
+        reg.histogram(
+            "distar_span_seconds", "in-process span duration", span=self.name
+        ).observe(self.elapsed)
+        return False
+
+
+# ------------------------------------------------------- payload envelope
+# The adapter wraps payloads carrying a trace in this envelope; the receive
+# side unwraps transparently so non-instrumented consumers see plain data.
+_ENVELOPE_KEY = "__distar_trace__"
+
+
+def wrap_payload(data, ctx: Optional[dict]):
+    if ctx is None:
+        return data
+    return {_ENVELOPE_KEY: ctx, "payload": data}
+
+
+def unwrap_payload(data):
+    """Returns (payload, ctx_or_None)."""
+    if isinstance(data, dict) and _ENVELOPE_KEY in data:
+        return data.get("payload"), data[_ENVELOPE_KEY]
+    return data, None

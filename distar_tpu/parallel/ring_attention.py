@@ -88,7 +88,10 @@ def ring_self_attention(
 ) -> jnp.ndarray:
     """Convenience wrapper: shard the sequence over the mesh's sp axis and
     run ring attention; output sharded like q."""
-    from jax import shard_map
+    try:  # top-level export landed in newer jax; this image predates it
+        from jax import shard_map
+    except ImportError:
+        from jax.experimental.shard_map import shard_map
 
     sp = mesh.shape["sp"]
     assert q.shape[2] % sp == 0, f"sequence {q.shape[2]} not divisible by sp={sp}"

@@ -35,28 +35,39 @@ class AverageMeter:
 
 
 class EMAMeter:
-    """Exponential moving average meter with debias at startup."""
+    """Exponential moving average meter with debias at startup.
+
+    The raw EMA accumulates from zero, so after ``n`` updates it underweights
+    by a factor ``1 - alpha**n``; ``avg`` divides that factor back out (Adam-
+    style bias correction), making early reads unbiased estimates instead of
+    zero-dragged ones."""
 
     def __init__(self, alpha: float = 0.99):
+        assert 0.0 < alpha < 1.0
         self._alpha = alpha
-        self._ema: Optional[float] = None
+        self._ema = 0.0
+        self._count = 0
         self._last = 0.0
 
     def update(self, value) -> None:
         value = float(value)
         self._last = value
-        if self._ema is None:
-            self._ema = value
-        else:
-            self._ema = self._alpha * self._ema + (1.0 - self._alpha) * value
+        self._count += 1
+        self._ema = self._alpha * self._ema + (1.0 - self._alpha) * value
 
     @property
     def val(self) -> float:
         return self._last
 
     @property
+    def count(self) -> int:
+        return self._count
+
+    @property
     def avg(self) -> float:
-        return self._ema if self._ema is not None else 0.0
+        if self._count == 0:
+            return 0.0
+        return self._ema / (1.0 - self._alpha ** self._count)
 
 
 class VariableRecord:
@@ -122,16 +133,23 @@ class TextLogger:
 
 
 class ScalarSink:
-    """Scalar metric sink: tensorboardX when available, else JSONL."""
+    """Scalar metric sink: tensorboardX when available, else JSONL.
 
-    def __init__(self, path: str):
+    ``force_jsonl`` pins the JSONL backend regardless of tensorboardX —
+    used by the metrics-registry exporter (obs.JsonlExporter), whose
+    output feeds line-oriented ops tooling, not TB."""
+
+    def __init__(self, path: str, force_jsonl: bool = False):
         os.makedirs(path, exist_ok=True)
         self._tb = None
-        try:  # pragma: no cover - depends on optional dep
-            from tensorboardX import SummaryWriter
+        if not force_jsonl:
+            try:  # pragma: no cover - depends on optional dep
+                from tensorboardX import SummaryWriter
 
-            self._tb = SummaryWriter(path)
-        except Exception:
+                self._tb = SummaryWriter(path)
+            except Exception:
+                pass
+        if self._tb is None:
             self._file = open(os.path.join(path, "scalars.jsonl"), "a")
 
     def add_scalar(self, name: str, value: float, global_step: int) -> None:

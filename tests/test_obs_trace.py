@@ -1,0 +1,180 @@
+"""Trace spans: context minting, hop recording, and span-id propagation
+actor→adapter→(mock shuttle)→dataloader→learner fields."""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from distar_tpu.comm import Adapter, Coordinator
+from distar_tpu.comm import shuttle as shuttle_mod
+from distar_tpu.obs import (
+    MetricsRegistry,
+    Span,
+    finish_trace,
+    hop_names,
+    is_trace,
+    mark_hop,
+    mint_span_id,
+    set_registry,
+    start_trace,
+    unwrap_payload,
+    wrap_payload,
+)
+
+
+@pytest.fixture
+def registry():
+    reg = MetricsRegistry()
+    prev = set_registry(reg)
+    yield reg
+    set_registry(prev)
+
+
+@pytest.fixture
+def mock_shuttle(monkeypatch):
+    """In-memory shuttle: serve banks the blob under a fake port, fetch pops
+    it — the adapter's real serialize/envelope path minus the sockets."""
+    store = {}
+    ports = iter(range(40_000, 50_000))
+
+    def serve(payload, accept_count=1, timeout_ms=0):
+        port = next(ports)
+        store[port] = bytes(payload)
+        return port
+
+    def fetch(host, port, timeout_ms=0):
+        if port not in store:
+            raise ConnectionError(f"no payload at {host}:{port}")
+        return store.pop(port)
+
+    monkeypatch.setattr(shuttle_mod, "serve", serve)
+    monkeypatch.setattr(shuttle_mod, "fetch", fetch)
+    return store
+
+
+# ----------------------------------------------------------------- context
+def test_span_ids_unique_and_hex():
+    ids = {mint_span_id() for _ in range(100)}
+    assert len(ids) == 100
+    assert all(len(i) == 16 and int(i, 16) >= 0 for i in ids)
+
+
+def test_trace_lifecycle_records_hops(registry):
+    ctx = start_trace("trajectory", player="MP0")
+    assert is_trace(ctx)
+    assert ctx["attrs"] == {"player": "MP0"}
+    dt = mark_hop(ctx, "adapter_push", registry=registry)
+    assert dt >= 0
+    age = finish_trace(ctx, hop="learner_collate", registry=registry)
+    assert age >= dt
+    assert hop_names(ctx) == ["start", "adapter_push", "learner_collate"]
+    assert registry.histogram("distar_trace_hop_seconds", hop="adapter_push").count == 1
+    assert registry.histogram("distar_trace_e2e_seconds", span="trajectory").count == 1
+
+
+def test_non_trace_inputs_are_noops(registry):
+    assert mark_hop({"random": 1}, "x", registry=registry) == 0.0
+    assert finish_trace(None, registry=registry) == 0.0
+    assert hop_names("nope") == []
+
+
+def test_wrap_unwrap_envelope():
+    ctx = start_trace("t")
+    data = {"payload": [1, 2]}  # consumer data that itself has a 'payload' key
+    assert unwrap_payload(data) == (data, None)  # no envelope, returned as-is
+    wrapped = wrap_payload(data, ctx)
+    payload, got = unwrap_payload(wrapped)
+    assert payload is data and got is ctx
+    assert wrap_payload(data, None) is data  # no ctx -> no envelope
+
+
+def test_span_context_manager_publishes(registry):
+    with Span("collate", registry=registry) as sp:
+        time.sleep(0.002)
+    assert sp.elapsed >= 0.002
+    assert registry.histogram("distar_span_seconds", span="collate").count == 1
+
+
+# ------------------------------------------- mock shuttle round-trip
+def test_span_id_rides_mock_shuttle_roundtrip(registry, mock_shuttle):
+    co = Coordinator()
+    adapter = Adapter(coordinator=co)
+    ctx = start_trace("trajectory", player="MP0")
+    traj = [{"step": 0, "trace": ctx}, {"step": 1}]
+    adapter.push("MP0traj", traj, trace=ctx)
+    payload, trace = adapter.pull("MP0traj", with_trace=True, timeout=5)
+    assert trace["span_id"] == ctx["span_id"]
+    assert trace["trace_id"] == ctx["trace_id"]
+    assert hop_names(trace) == ["start", "adapter_push", "adapter_pull"]
+    # the envelope ctx and the ctx stamped into the trajectory are the SAME
+    # object after unpickling (pickle preserves identity within a payload),
+    # so downstream consumers see the full hop history either way
+    assert payload[0]["trace"] is trace
+    assert registry.histogram("distar_trace_hop_seconds", hop="adapter_pull").count == 1
+
+
+def test_plain_pull_terminates_span(registry, mock_shuttle):
+    co = Coordinator()
+    adapter = Adapter(coordinator=co)
+    ctx = start_trace("model")
+    adapter.push("m", {"w": 1}, trace=ctx)
+    out = adapter.pull("m", timeout=5)
+    assert out == {"w": 1}  # envelope stripped transparently
+    assert registry.histogram("distar_trace_e2e_seconds", span="model").count == 1
+
+
+def test_untraced_push_unchanged(registry, mock_shuttle):
+    co = Coordinator()
+    adapter = Adapter(coordinator=co)
+    adapter.push("m", {"w": 2})
+    payload, trace = adapter.pull("m", with_trace=True, timeout=5)
+    assert payload == {"w": 2} and trace is None
+
+
+def test_pull_loop_keep_trace_hands_tuple(registry, mock_shuttle):
+    co = Coordinator()
+    adapter = Adapter(coordinator=co)
+    cache = adapter.start_pull_loop("MP0traj", maxlen=4, keep_trace=True)
+    ctx = start_trace("trajectory")
+    adapter.push("MP0traj", [{"trace": ctx}], trace=ctx)
+    deadline = time.time() + 10
+    while not cache and time.time() < deadline:
+        time.sleep(0.01)
+    adapter.stop()
+    assert cache, "pull loop never delivered"
+    payload, trace = cache.popleft()
+    assert trace["span_id"] == ctx["span_id"]
+    # span left open for the consumer: no e2e recorded yet
+    assert registry.histogram("distar_trace_e2e_seconds", span="trajectory").count == 0
+
+
+# ------------------------------------ dataloader -> learner propagation
+def test_rl_dataloader_closes_spans_into_batch_fields(registry, mock_shuttle, monkeypatch):
+    from distar_tpu.learner import rl_dataloader
+
+    # stub the (schema-heavy) collation: trace handling happens around it
+    monkeypatch.setattr(
+        rl_dataloader,
+        "collate_trajectories",
+        lambda trajs: {"model_last_iter": np.zeros(len(trajs), np.float32)},
+    )
+    co = Coordinator()
+    adapter = Adapter(coordinator=co)
+    loader = rl_dataloader.RLDataLoader(adapter, "MP0", batch_size=2)
+    ids = []
+    for i in range(2):
+        ctx = start_trace("trajectory", player="MP0")
+        ids.append(ctx["span_id"])
+        traj = [{"step": i, "trace": ctx}]
+        adapter.push("MP0traj", traj, trace=ctx)
+    batch = next(loader)
+    adapter.stop()
+    assert batch["trace_span_ids"] == ids  # FIFO order, ids intact end to end
+    assert batch["trace_age_s"].shape == (2,)
+    assert (batch["trace_age_s"] >= 0).all()
+    e2e = registry.histogram("distar_trace_e2e_seconds", span="trajectory")
+    assert e2e.count == 2  # exactly once per trajectory: no double-finish
+    assert registry.histogram(
+        "distar_trace_hop_seconds", hop="learner_collate"
+    ).count == 2

@@ -11,6 +11,7 @@ from distar_tpu.learner.hooks import (
     HookRegistry,
     LambdaHook,
     LoadCkptHook,
+    ProfilerHook,
     SaveCkptHook,
 )
 from distar_tpu.utils.timing import EasyTimer, StopWatch
@@ -111,3 +112,83 @@ def test_load_hook_ignores_missing_path(tmp_path):
     )
     learner.restore = lambda p: (_ for _ in ()).throw(AssertionError("called"))
     LoadCkptHook()(learner)  # missing file: no restore attempt
+
+
+# ---------------------------------------------------------------- profiler
+class _FakeProfiler:
+    """jax.profiler stand-in recording start/stop edges."""
+
+    def __init__(self, fail=False):
+        self.events = []
+        self.fail = fail
+
+    def start_trace(self, logdir):
+        if self.fail:
+            raise RuntimeError("no profiler backend")
+        self.events.append(("start", logdir))
+
+    def stop_trace(self):
+        self.events.append(("stop",))
+
+
+def _profiled_learner():
+    learner = _fake_learner()
+    learner.rank = 0
+    learner.logger = types.SimpleNamespace(info=lambda *a, **k: None)
+    return learner
+
+
+def test_profiler_hook_freq_gated_capture_window(tmp_path):
+    """Every ``freq`` iterations the hook opens a trace and closes it
+    ``duration`` iterations later — one bounded capture per gate point."""
+    prof = _FakeProfiler()
+    hook = ProfilerHook(str(tmp_path), freq=4, duration=2, profiler=prof)
+    learner = _profiled_learner()
+    for it in range(1, 11):
+        learner.last_iter.val = it
+        hook(learner)
+    # gates at 4 and 8; stops at 6 and 10
+    assert prof.events == [
+        ("start", str(tmp_path)), ("stop",),
+        ("start", str(tmp_path)), ("stop",),
+    ]
+    assert not hook.session.active
+
+
+def test_profiler_hook_rank_gated(tmp_path):
+    prof = _FakeProfiler()
+    hook = ProfilerHook(str(tmp_path), freq=1, duration=1, profiler=prof)
+    learner = _profiled_learner()
+    learner.rank = 1
+    for it in range(1, 5):
+        learner.last_iter.val = it
+        hook(learner)
+    assert prof.events == []  # only rank 0 profiles
+
+
+def test_profiler_hook_survives_broken_profiler(tmp_path):
+    """A missing/broken profiler backend must never take down training."""
+    prof = _FakeProfiler(fail=True)
+    hook = ProfilerHook(str(tmp_path), freq=2, duration=1, profiler=prof)
+    learner = _profiled_learner()
+    for it in range(1, 7):
+        learner.last_iter.val = it
+        hook(learner)  # no raise
+    assert not hook.session.active
+
+
+def test_profiler_sessions_counted_in_registry(tmp_path):
+    from distar_tpu.obs import MetricsRegistry, set_registry
+
+    reg = MetricsRegistry()
+    prev = set_registry(reg)
+    try:
+        prof = _FakeProfiler()
+        hook = ProfilerHook(str(tmp_path), freq=3, duration=1, profiler=prof)
+        learner = _profiled_learner()
+        for it in range(1, 8):
+            learner.last_iter.val = it
+            hook(learner)
+        assert reg.counter("distar_profiler_sessions_total").value == 2  # it=3, it=6
+    finally:
+        set_registry(prev)

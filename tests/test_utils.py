@@ -49,7 +49,9 @@ def test_meters():
     e = EMAMeter(alpha=0.5)
     e.update(0.0)
     e.update(1.0)
-    assert e.avg == pytest.approx(0.5)
+    # bias-corrected: weighted mean (alpha*0 + 1*1)/(alpha + 1), not the raw
+    # EMA 0.5 (debias semantics, tests/test_obs_metrics.py)
+    assert e.avg == pytest.approx(2.0 / 3.0)
 
 
 def test_variable_record():
