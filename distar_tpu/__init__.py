@@ -12,6 +12,9 @@ Layer map (mirrors reference layers, see SURVEY.md §1):
   distar_tpu.league    control plane: players, PFSP, payoff, ELO
   distar_tpu.learner   training runtime: hook-driven learners on pjit meshes
   distar_tpu.actor     CPU actor fleet + batched jitted inference
+  distar_tpu.serve     inference gateway: micro-batching, sticky sessions,
+                       versioned hot-swap registry, HTTP/TCP frontends
+  distar_tpu.obs       metrics registry, exporters, trace spans, profiler
   distar_tpu.model     Flax policy/value network (encoders, LSTM core, heads)
   distar_tpu.ops       TPU compute primitives (pallas kernels, scan RNN, rl ops)
   distar_tpu.losses    RL and SL losses as pure jnp functions

@@ -55,6 +55,28 @@ class BatchedInference:
         z = jnp.zeros((self.num_slots, self._hidden_size))
         return tuple((z, z) for _ in range(self._num_layers))
 
+    def set_params(self, params) -> None:
+        """Install new weights (serve-plane hot swap). The pytree structure
+        and leaf shapes must match the old params, so the jitted forward is
+        reused — a swap never recompiles. A forward already executing keeps
+        the params reference it was called with; the swap takes effect from
+        the next ``sample``."""
+        self.params = params
+
+    def warmup(self, template_obs: dict, params=None) -> None:
+        """One throwaway batched forward on scratch hidden state.
+
+        Compiles (first call) or exercises the jitted ``sample_action``
+        without touching ``self.params``, ``self.hidden`` or the RNG — safe
+        to run concurrently with serving traffic, which is the point: the
+        registry warms a freshly loaded checkpoint off the serving path
+        before atomically swapping it in."""
+        batch = jax.tree.map(jnp.asarray, F.batch_tree([template_obs] * self.num_slots))
+        self._sample(
+            params if params is not None else self.params,
+            batch, self._zero_hidden(), jax.random.PRNGKey(0),
+        )
+
     def reset_slot(self, idx: int) -> None:
         """Zero one slot's hidden state (episode boundary)."""
         self.hidden = tuple(

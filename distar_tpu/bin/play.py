@@ -47,7 +47,9 @@ def load_params(path: str, model_cfg):
         from ..model.ref_convert import convert_model
 
         return convert_model(sd, model_cfg)
-    return load_checkpoint(path)["state"].get("params")
+    from ..utils.checkpoint import load_params as load_native_params
+
+    return load_native_params(path)
 
 
 def side_name(path: str, default: str) -> str:

@@ -1,0 +1,108 @@
+"""Serve entrypoint: stand up the inference gateway + both frontends.
+
+``python -m distar_tpu.bin.serve --mock`` runs the full serving stack on
+the CPU mock engine (smoke/deploy-shape checks, loadgen targets);
+``--checkpoint <storage url>`` serves a real model — the checkpoint loads
+through the versioned registry, warms up (one compiled ``sample_action``
+batch), and activates before the frontends accept traffic. At runtime new
+versions hot-swap through POST /serve/load + /serve/swap (or the TCP
+``load``/``swap`` ops) with zero downtime.
+
+Shutdown (SIGTERM/SIGINT) is drain-then-stop: frontends stop accepting,
+admitted requests flush, then the process exits.
+"""
+from __future__ import annotations
+
+import argparse
+import signal
+import threading
+
+from ..utils.log import TextLogger
+
+
+def build_engine(args):
+    """Engine + (optional) registry load_fn for the chosen model."""
+    from ..serve import BatchedInferenceEngine, MockModelEngine
+
+    if args.mock:
+        return MockModelEngine(args.slots, delay_s=args.mock_delay_s), None
+    import jax
+
+    from ..actor.inference import BatchedInference
+    from ..model import Model, default_model_config
+    from ..serve.registry import default_load_fn
+    from ..utils import deep_merge_dicts, read_config
+
+    model_cfg = default_model_config()
+    if args.config:
+        model_cfg = deep_merge_dicts(model_cfg, read_config(args.config).get("model", {}))
+    model = Model(model_cfg)
+    params = default_load_fn(args.checkpoint)
+    infer = BatchedInference(model, params, args.slots, seed=args.seed)
+    return BatchedInferenceEngine(infer), default_load_fn
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--http-port", type=int, default=8000)
+    p.add_argument("--tcp-port", type=int, default=8001)
+    p.add_argument("--slots", type=int, default=32, help="batch lanes = max live sessions")
+    p.add_argument("--max-delay-ms", type=float, default=5.0, help="flush deadline")
+    p.add_argument("--queue-capacity", type=int, default=256)
+    p.add_argument("--idle-ttl-s", type=float, default=300.0, help="session idle eviction")
+    p.add_argument("--checkpoint", help="storage URL of the checkpoint to serve")
+    p.add_argument("--version", default="v1", help="registry name for --checkpoint")
+    p.add_argument("--config", help="yaml with a model: section (must match the checkpoint)")
+    p.add_argument("--mock", action="store_true", help="CPU mock engine (no jax/model)")
+    p.add_argument("--mock-delay-s", type=float, default=0.0)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--drain-timeout-s", type=float, default=30.0)
+    args = p.parse_args()
+    if not args.mock and not args.checkpoint:
+        p.error("--checkpoint is required unless --mock")
+
+    logger = TextLogger("./experiments/serve", "serve")
+    engine, load_fn = build_engine(args)
+
+    from ..serve import InferenceGateway, ModelRegistry, ServeHTTPServer, ServeTCPServer
+
+    gateway = InferenceGateway(
+        engine,
+        max_batch=args.slots,
+        max_delay_s=args.max_delay_ms / 1000.0,
+        queue_capacity=args.queue_capacity,
+        idle_ttl_s=args.idle_ttl_s,
+    )
+    if load_fn is not None:
+        # re-register the checkpoint through the registry so later hot-swaps
+        # and the already-loaded boot version share one version table
+        gateway.registry = ModelRegistry(load_fn=load_fn, warmup_fn=gateway._warmup)
+        gateway.load_version(args.version, source=args.checkpoint, activate=True)
+    gateway.start()
+
+    http = ServeHTTPServer(gateway, host=args.host, port=args.http_port).start()
+    tcp = ServeTCPServer(gateway, host=args.host, port=args.tcp_port).start()
+    logger.info(
+        f"serving: http={http.host}:{http.port} tcp={tcp.host}:{tcp.port} "
+        f"slots={args.slots} max_delay={args.max_delay_ms}ms "
+        f"{'mock' if args.mock else args.checkpoint}"
+    )
+
+    done = threading.Event()
+
+    def _shutdown(sig, frame):
+        logger.info(f"signal {sig}: draining")
+        done.set()
+
+    signal.signal(signal.SIGTERM, _shutdown)
+    signal.signal(signal.SIGINT, _shutdown)
+    done.wait()
+    http.stop()
+    tcp.stop()
+    gateway.drain_and_stop(args.drain_timeout_s)
+    logger.info("drained; bye")
+
+
+if __name__ == "__main__":
+    main()

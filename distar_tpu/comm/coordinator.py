@@ -150,20 +150,9 @@ class CoordinatorServer:
                     self.send_header("Content-Length", "0")
                     self.end_headers()
                     return
-                from ..obs import PROMETHEUS_CONTENT_TYPE, render_prometheus
+                from ..obs import write_scrape_response
 
-                try:
-                    co.publish_metrics()
-                    data = render_prometheus().encode()
-                    status, ctype = 200, PROMETHEUS_CONTENT_TYPE
-                except Exception as e:  # scrape must never wedge the broker
-                    data = repr(e).encode()
-                    status, ctype = 500, "text/plain"
-                self.send_response(status)
-                self.send_header("Content-Type", ctype)
-                self.send_header("Content-Length", str(len(data)))
-                self.end_headers()
-                self.wfile.write(data)
+                write_scrape_response(self, refresh=co.publish_metrics)
 
             def do_POST(self):
                 name = self.path.strip("/").split("/")[-1]

@@ -70,13 +70,49 @@ def load_payload(path: str) -> Any:
     return loads(storage.read_bytes(path))
 
 
+# a frame header larger than this is garbage (a peer speaking another
+# protocol, or stream desync), not a legitimate payload: fail typed instead
+# of attempting a multi-GiB allocation
+DEFAULT_MAX_FRAME = 1 << 32  # 4 GiB
+
+
 def frame(blob: bytes) -> bytes:
     """Length-prefix a payload (8-byte big-endian), the adapter wire format
     (role of the reference's length-prefixed frames, adapter.py:140-151)."""
     return struct.pack(">Q", len(blob)) + blob
 
 
-def read_frame(recv_exact) -> bytes:
-    """Read one frame via a ``recv_exact(n) -> bytes`` callable."""
+def read_frame(recv_exact, max_frame_bytes: int = DEFAULT_MAX_FRAME) -> bytes:
+    """Read one frame via a ``recv_exact(n) -> bytes`` callable. Raises
+    ``ValueError`` on an implausible header (see DEFAULT_MAX_FRAME)."""
     (n,) = struct.unpack(">Q", recv_exact(8))
+    if n > max_frame_bytes:
+        raise ValueError(f"implausible frame length {n} (max {max_frame_bytes})")
     return recv_exact(n)
+
+
+# ----------------------------------------------------- socket framing helpers
+# The serve-plane TCP frontend and any actor-grade caller share these, so
+# both ends agree on one framing + codec stack (frame/read_frame + dumps/
+# loads) instead of growing per-surface wire formats.
+def sock_recv_exact(sock, n: int) -> bytes:
+    """Read exactly ``n`` bytes from a connected socket; ``ConnectionError``
+    on EOF mid-frame (the truncated-frame error path)."""
+    chunks = []
+    while n > 0:
+        chunk = sock.recv(min(n, 1 << 20))
+        if not chunk:
+            raise ConnectionError("connection closed mid-frame")
+        chunks.append(chunk)
+        n -= len(chunk)
+    return b"".join(chunks)
+
+
+def send_msg(sock, obj: Any, compress: bool = True) -> None:
+    """Serialize + frame + send one message on a connected socket."""
+    sock.sendall(frame(dumps(obj, compress=compress)))
+
+
+def recv_msg(sock, max_frame_bytes: int = DEFAULT_MAX_FRAME) -> Any:
+    """Receive + deserialize one framed message from a connected socket."""
+    return loads(read_frame(lambda n: sock_recv_exact(sock, n), max_frame_bytes))

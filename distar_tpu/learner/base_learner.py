@@ -114,8 +114,45 @@ class BaseLearner:
     def restore(self, path: str) -> None:
         self._checkpointer.wait()  # the path may still be being written
         out = load_checkpoint(path, target=self._state)
-        self._state = out["state"]
+        self._state = self._place_state(out["state"])
         self.last_iter.update(out["metadata"].get("last_iter", 0))
+
+    def _place_state(self, state):
+        """Re-place restored host leaves onto this instance's compiled
+        shardings. The donated train step's executable pairs each donated
+        input buffer with a same-shaped output; uncommitted host arrays let
+        the compiler choose input shardings on the next call, and its choice
+        can disagree with the donation aliasing (observed: a replicated
+        f32[8] output aliased to an input placed as f32[1] dp-shards ->
+        XlaRuntimeError INTERNAL). Committing the state per-instance, to the
+        exact shardings its train step was compiled for, removes the
+        compiler's freedom to disagree."""
+        shardings = getattr(self, "_shardings", None)
+        if not shardings:
+            return state
+
+        def put(tree, sh):
+            # materialize through a jitted add-0 rather than device_put: the
+            # outputs are freshly XLA-allocated buffers pinned to ``sh``.
+            # device_put of host numpy can be ZERO-COPY on the CPU backend,
+            # and the train step DONATES these buffers — XLA reusing/freeing
+            # memory that numpy's allocator owns is heap corruption
+            # (observed: "corrupted double-linked list" aborts on the second
+            # post-restore iteration), the runtime sibling of the hazard
+            # checkpoint._host_snapshot documents
+            place = jax.jit(
+                lambda t: jax.tree.map(
+                    lambda a: a + 0 if hasattr(a, "shape") else a, t
+                ),
+                out_shardings=sh,
+            )
+            return place(tree)
+
+        state = dict(state)
+        for key, sh_key in (("params", "param"), ("opt_state", "opt")):
+            if key in state and sh_key in shardings:
+                state[key] = put(state[key], shardings[sh_key])
+        return state
 
     # -------------------------------------------------------------- abstract
     def _setup_state(self) -> None:  # pragma: no cover - abstract

@@ -245,6 +245,7 @@ class RLLearner(BaseLearner):
         self._shardings = dict(
             repl=repl,
             param=param_sh,
+            opt=opt_sh,  # restore() re-places host state onto param/opt
             batch=time_batch_sharding(self.mesh),  # [T(,+1), B, ...]
             batch_nosp=NamedSharding(self.mesh, P(None, dp_axes(self.mesh))),
             flat=batch_sharding(self.mesh),  # [B]-leading leaves
@@ -396,6 +397,7 @@ class RLLearner(BaseLearner):
             opt_sh = fsdp_param_sharding(
                 self.mesh, jax.eval_shape(self.optimizer.init, self._state["params"])
             )
+            self._shardings["opt"] = opt_sh
             self._state["opt_state"] = jax.jit(self.optimizer.init, out_shardings=opt_sh)(
                 self._state["params"]
             )

@@ -161,7 +161,7 @@ class SLLearner(BaseLearner):
             "opt_state": jax.jit(self.optimizer.init, out_shardings=opt_sh)(params),
         }
         flat_sh = batch_sharding(self.mesh)
-        self._shardings = dict(repl=repl, param=param_sh, flat=flat_sh)
+        self._shardings = dict(repl=repl, param=param_sh, opt=opt_sh, flat=flat_sh)
         self._train_step = jax.jit(
             make_sl_train_step(
                 self.model, self.loss_cfg, self.optimizer, B,

@@ -14,7 +14,12 @@ from .registry import (
     get_registry,
     set_registry,
 )
-from .exporters import PROMETHEUS_CONTENT_TYPE, JsonlExporter, render_prometheus
+from .exporters import (
+    PROMETHEUS_CONTENT_TYPE,
+    JsonlExporter,
+    render_prometheus,
+    write_scrape_response,
+)
 from .trace import (
     Span,
     finish_trace,
@@ -38,6 +43,7 @@ __all__ = [
     "PROMETHEUS_CONTENT_TYPE",
     "JsonlExporter",
     "render_prometheus",
+    "write_scrape_response",
     "Span",
     "finish_trace",
     "hop_names",

@@ -62,6 +62,28 @@ def render_prometheus(registry: Optional[MetricsRegistry] = None) -> str:
     return "\n".join(lines) + "\n" if lines else ""
 
 
+def write_scrape_response(handler, refresh=None, registry: Optional[MetricsRegistry] = None) -> None:
+    """Answer a ``GET /metrics`` scrape on a ``BaseHTTPRequestHandler``.
+
+    The one scrape route every HTTP surface shares (coordinator broker,
+    serve gateway): run ``refresh()`` (scrape-time gauge publication), render
+    the registry, write the response. A failing refresh/render answers 500
+    with the repr — a scrape must never wedge the serving process."""
+    try:
+        if refresh is not None:
+            refresh()
+        data = render_prometheus(registry).encode()
+        status, ctype = 200, PROMETHEUS_CONTENT_TYPE
+    except Exception as e:
+        data = repr(e).encode()
+        status, ctype = 500, "text/plain"
+    handler.send_response(status)
+    handler.send_header("Content-Type", ctype)
+    handler.send_header("Content-Length", str(len(data)))
+    handler.end_headers()
+    handler.wfile.write(data)
+
+
 class JsonlExporter:
     """Periodic registry snapshots into the JSONL scalar stream.
 

@@ -1,0 +1,66 @@
+"""Dynamic-batching TPU inference gateway with a versioned hot-swap registry.
+
+The standalone serving subsystem the trained league faces traffic through
+(ROADMAP north star): ad-hoc ``sample_action`` requests from play services,
+ladder bots, eval farms and human showmatches are coalesced into the same
+fixed-shape jitted batch the actor fleet uses (``actor.inference.
+BatchedInference`` — one compiled forward, pad-to-bucket), instead of the
+actor's lockstep trajectory loop. The shape follows Podracer's Sebulba
+split (arxiv 2104.06272: a central batched inference server decoupled from
+its callers) with RLAX-style versioned weight swaps (arxiv 2512.06392).
+
+Pieces:
+  * ``MicroBatcher``     — deadline-aware request coalescing (flush on
+                           batch-full or oldest-request deadline; per-request
+                           timeouts shed with typed errors)
+  * ``SessionTable``     — sticky sessions: server-side LSTM carry slots
+                           with idle eviction
+  * ``ModelRegistry``    — versioned params, warm-up off the serving path,
+                           atomic zero-downtime swap
+  * ``InferenceGateway`` — ties the above around an engine; admission
+                           control, drain-then-stop shutdown
+  * ``ServeHTTPServer``  — stdlib HTTP/JSON control + light data plane
+  * ``ServeTCPServer`` / ``ServeClient`` — framed-TCP data plane on the
+                           comm.serializer wire format (actor-grade callers)
+
+Everything publishes into the process ``obs`` registry
+(``distar_serve_*`` — see docs/serving.md for the full metric table).
+"""
+from .errors import (
+    CapacityError,
+    DeadlineExceededError,
+    DrainingError,
+    QueueFullError,
+    ServeError,
+    ShedError,
+    UnknownVersionError,
+    error_from_wire,
+)
+from .engine import BatchedInferenceEngine, MockModelEngine
+from .batcher import MicroBatcher, PendingRequest
+from .sessions import SessionTable
+from .registry import ModelRegistry
+from .gateway import InferenceGateway
+from .http_frontend import ServeHTTPServer
+from .tcp_frontend import ServeClient, ServeTCPServer
+
+__all__ = [
+    "BatchedInferenceEngine",
+    "CapacityError",
+    "DeadlineExceededError",
+    "DrainingError",
+    "InferenceGateway",
+    "MicroBatcher",
+    "MockModelEngine",
+    "ModelRegistry",
+    "PendingRequest",
+    "QueueFullError",
+    "ServeClient",
+    "ServeError",
+    "ServeHTTPServer",
+    "ServeTCPServer",
+    "SessionTable",
+    "ShedError",
+    "UnknownVersionError",
+    "error_from_wire",
+]

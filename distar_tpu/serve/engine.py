@@ -1,0 +1,111 @@
+"""Engines: the fixed-shape batched forward the gateway flushes into.
+
+An engine owns ``num_slots`` lanes of recurrent state and exposes exactly
+the surface the batcher needs:
+
+  * ``forward(prepared, active)`` — one batched step over all slots;
+    inactive lanes are padding (their outputs are discarded and their
+    hidden state must not advance)
+  * ``reset_slot(idx)``           — zero one lane's carry (episode reset)
+  * ``set_params(params)``        — install new weights (hot swap); must be
+    shape-stable so the compiled forward is reused, not recompiled
+
+``BatchedInferenceEngine`` adapts ``actor.inference.BatchedInference`` — the
+serving path reuses the actor fleet's compiled ``sample_action`` verbatim.
+``MockModelEngine`` is a CPU stand-in with observable per-slot dynamics for
+tests and ``tools/loadgen.py``.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import List, Optional
+
+import numpy as np
+
+
+class BatchedInferenceEngine:
+    """Serve-side adapter over one ``BatchedInference`` (one player model)."""
+
+    def __init__(self, infer):
+        self._infer = infer
+
+    @property
+    def num_slots(self) -> int:
+        return self._infer.num_slots
+
+    def forward(self, prepared: List[dict], active: List[bool]) -> List[dict]:
+        return self._infer.sample(prepared, active)
+
+    def reset_slot(self, idx: int) -> None:
+        self._infer.reset_slot(idx)
+
+    def set_params(self, params) -> None:
+        self._infer.set_params(params)
+
+    def warmup(self, template_obs: dict, params=None) -> float:
+        """Compile/execute the batched forward off the serving path: one
+        throwaway step on zeroed scratch hidden state that touches neither
+        the live params nor any slot's carry (safe concurrently with
+        serving flushes). Returns wall seconds — dominated by XLA
+        compilation the first time, ~one device step after."""
+        t0 = time.perf_counter()
+        self._infer.warmup(template_obs, params=params)
+        return time.perf_counter() - t0
+
+
+class MockModelEngine:
+    """Deterministic mock with real engine semantics, no jax.
+
+    Per-slot "hidden state" is a step counter that only advances on active
+    lanes — sticky-session and reset bugs show up as wrong counters. Outputs
+    echo the serving version (from params) so hot-swap tests can assert
+    which weights served each request. ``delay_s`` models device time; the
+    sleep releases the GIL like a real device dispatch, so concurrent
+    submitters pile up behind it exactly as they would behind a TPU step.
+    """
+
+    def __init__(self, num_slots: int, params: Optional[dict] = None, delay_s: float = 0.0):
+        self.num_slots = num_slots
+        self.params = dict(params or {"version": "v0", "bias": 0.0})
+        self.delay_s = delay_s
+        self.steps = np.zeros(num_slots, dtype=np.int64)
+        self.forward_calls = 0
+        self.warmup_calls = 0
+        self._lock = threading.Lock()
+
+    def warmup(self, template_obs: dict, params=None) -> float:
+        if self.delay_s:
+            time.sleep(self.delay_s)
+        with self._lock:
+            self.warmup_calls += 1
+        return self.delay_s
+
+    def set_params(self, params) -> None:
+        with self._lock:
+            self.params = dict(params)
+
+    def reset_slot(self, idx: int) -> None:
+        with self._lock:
+            self.steps[idx] = 0
+
+    def forward(self, prepared: List[dict], active: List[bool]) -> List[dict]:
+        assert len(prepared) == self.num_slots and len(active) == self.num_slots
+        if self.delay_s:
+            time.sleep(self.delay_s)
+        with self._lock:
+            self.forward_calls += 1
+            params = dict(self.params)
+            outs = []
+            for i in range(self.num_slots):
+                if active[i]:
+                    self.steps[i] += 1
+                x = prepared[i].get("x", 0.0)
+                outs.append(
+                    {
+                        "action": np.asarray(np.sum(x) + params.get("bias", 0.0)),
+                        "step": int(self.steps[i]),
+                        "version": params.get("version"),
+                    }
+                )
+            return outs

@@ -1,0 +1,196 @@
+"""The inference gateway: sessions + batcher + registry around one engine.
+
+Request path (caller thread): resolve/allocate the sticky session slot,
+mint a trace context, enqueue into the micro-batcher, block on the
+rendezvous with the caller's timeout. Flush path (batcher thread): apply
+any pending version swap at the flush boundary (in-flight forwards finish
+on the old params — the zero-downtime half of the hot-swap protocol), pad
+the fixed-shape batch with the zero template on inactive lanes, run ONE
+engine forward, decollate and deliver per-request.
+
+Shutdown is drain-then-stop: admissions shed with ``DrainingError`` while
+everything already admitted flushes and completes.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ..obs import Span, finish_trace, get_registry, mark_hop, start_trace
+from .batcher import MicroBatcher, PendingRequest
+from .errors import ServeError, ShedError
+from .registry import ModelRegistry
+from .sessions import SessionTable
+
+
+def _zeros_like_tree(t):
+    """Pure-host zero template with the request's exact structure/dtypes
+    (no jax: the gateway never touches the device outside the engine)."""
+    if isinstance(t, dict):
+        return {k: _zeros_like_tree(v) for k, v in t.items()}
+    if isinstance(t, (list, tuple)):
+        return type(t)(_zeros_like_tree(v) for v in t)
+    return np.zeros_like(np.asarray(t))
+
+
+class InferenceGateway:
+    def __init__(
+        self,
+        engine,
+        registry: Optional[ModelRegistry] = None,
+        max_batch: Optional[int] = None,
+        max_delay_s: float = 0.005,
+        queue_capacity: int = 256,
+        idle_ttl_s: float = 300.0,
+        default_timeout_s: float = 10.0,
+    ):
+        self.engine = engine
+        self.registry = registry if registry is not None else ModelRegistry(
+            warmup_fn=self._warmup
+        )
+        self.sessions = SessionTable(
+            engine.num_slots, idle_ttl_s=idle_ttl_s, on_alloc=engine.reset_slot
+        )
+        self.batcher = MicroBatcher(
+            self._flush,
+            max_batch=min(max_batch or engine.num_slots, engine.num_slots),
+            max_delay_s=max_delay_s,
+            capacity=queue_capacity,
+        )
+        self.default_timeout_s = default_timeout_s
+        self._template = None
+        self._template_lock = threading.Lock()
+        self._applied_generation = 0
+        self._served_version: Optional[str] = None
+        self._draining = False
+        reg = get_registry()
+        self._c_req = {
+            outcome: reg.counter(
+                "distar_serve_requests_total", "requests by outcome", outcome=outcome
+            )
+            for outcome in ("ok", "shed", "error", "timeout")
+        }
+        self._h_latency = reg.histogram(
+            "distar_serve_request_latency_seconds", "submit-to-response latency"
+        )
+        self._g_inflight = reg.gauge(
+            "distar_serve_inflight", "requests admitted and not yet completed"
+        )
+
+    def _warmup(self, params) -> None:
+        """Default registry warm-up: one scratch forward, needs a template
+        observation — skipped before the first request taught us the shape
+        (cold start compiles on the first real flush instead)."""
+        template = self._template
+        if template is not None and hasattr(self.engine, "warmup"):
+            self.engine.warmup(template, params=params)
+
+    # ------------------------------------------------------------- lifecycle
+    def start(self) -> "InferenceGateway":
+        self.batcher.start()
+        return self
+
+    def drain_and_stop(self, timeout: Optional[float] = 30.0) -> None:
+        """Stop admissions, serve out the queue, stop the batcher thread."""
+        self._draining = True
+        self.batcher.drain_and_stop(timeout)
+
+    # ----------------------------------------------------------- client API
+    def act(self, session_id: str, obs: Dict[str, Any], timeout_s: Optional[float] = None):
+        """One agent step: returns the engine's per-slot output dict plus
+        ``model_version``. Raises a typed ``ServeError`` (``ShedError``
+        subclasses are retryable load sheds)."""
+        timeout_s = self.default_timeout_s if timeout_s is None else timeout_s
+        t0 = time.perf_counter()
+        ctx = start_trace("serve_request", session=session_id)
+        try:
+            slot = self.sessions.acquire(session_id)
+        except ShedError:  # CapacityError: no slot, nothing idle to evict
+            self._c_req["shed"].inc()
+            raise
+        self._g_inflight.inc()
+        try:
+            with self._template_lock:
+                if self._template is None:
+                    self._template = _zeros_like_tree(obs)
+            req = PendingRequest(
+                session_id, slot, obs,
+                deadline_ts=time.time() + timeout_s, ctx=ctx,
+            )
+            try:
+                self.batcher.submit(req)  # QueueFull/Draining shed here
+            except ShedError:
+                self._c_req["shed"].inc()
+                raise
+            if not req.wait(timeout_s + 0.25):
+                # rendezvous never fired (flush wedged past the grace):
+                # abandon so a late delivery is discarded
+                if req.abandon():
+                    self._c_req["timeout"].inc()
+                    raise ServeError(f"no response within {timeout_s}s")
+            if req.error is not None:
+                self._c_req["shed" if req.error.shed else "error"].inc()
+                raise req.error
+            self._c_req["ok"].inc()
+            self._h_latency.observe(time.perf_counter() - t0)
+            return req.result
+        finally:
+            self._g_inflight.dec()
+            self.sessions.release(session_id)
+
+    def reset_session(self, session_id: str) -> bool:
+        """Episode boundary: zero the session's LSTM carry, keep the slot."""
+        slot = self.sessions.slot_of(session_id)
+        if slot is None:
+            return False
+        self.engine.reset_slot(slot)
+        return True
+
+    def end_session(self, session_id: str) -> bool:
+        return self.sessions.end(session_id)
+
+    # ---------------------------------------------------------------- admin
+    def load_version(self, version: str, source: Optional[str] = None, params=None,
+                     activate: bool = False) -> dict:
+        return self.registry.load(version, source=source, params=params, activate=activate)
+
+    def activate_version(self, version: str) -> int:
+        return self.registry.activate(version)
+
+    def status(self) -> dict:
+        return {
+            "draining": self._draining,
+            "queue_depth": self.batcher.depth,
+            "served_version": self._served_version,
+            "sessions": self.sessions.stats(),
+            "registry": self.registry.status(),
+        }
+
+    # ---------------------------------------------------------------- flush
+    def _flush(self, batch: List[PendingRequest], reason: str) -> None:
+        generation, version, params = self.registry.current()
+        if params is not None and generation != self._applied_generation:
+            # the swap boundary: the previous flush (and anything still
+            # executing) used the old params reference; from here on the
+            # engine serves the new generation
+            self.engine.set_params(params)
+            self._applied_generation = generation
+            self._served_version = version
+            self.registry.swap_applied(generation)
+        template = self._template
+        prepared: List[dict] = [template] * self.engine.num_slots
+        active = [False] * self.engine.num_slots
+        for r in batch:
+            prepared[r.slot] = r.obs
+            active[r.slot] = True
+            mark_hop(r.ctx, "serve_flush")
+        with Span("serve_forward"):
+            outs = self.engine.forward(prepared, active)
+        for r in batch:
+            out = dict(outs[r.slot])
+            out["model_version"] = self._served_version
+            finish_trace(r.ctx, "serve_done")
+            r.complete(result=out)

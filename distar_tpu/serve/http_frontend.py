@@ -1,0 +1,128 @@
+"""Stdlib HTTP/JSON frontend: control plane + light data plane.
+
+Follows the ``CoordinatorServer`` conventions (ThreadingHTTPServer, POST
+routes answering ``{"code": 0, "info": ...}`` with HTTP 200, errors in the
+``code`` field) so operators drive one curl-able surface across the stack.
+Serve errors answer their typed wire dict (``{"code": "shed_queue_full",
+"shed": true, ...}``). Observation arrays JSON-ify as nested lists — fine
+for showmatch/eval callers; actor-grade traffic belongs on the framed-TCP
+data plane (``tcp_frontend``), which carries real numpy.
+
+Routes:
+  POST /serve/act     {session_id, obs, timeout_s?}
+  POST /serve/reset   {session_id}
+  POST /serve/end     {session_id}
+  POST /serve/load    {version, source, activate?}
+  POST /serve/swap    {version}
+  POST /serve/status  {}
+  GET  /metrics       Prometheus scrape (shared obs helper)
+"""
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+import numpy as np
+
+from .errors import ServeError
+
+
+def jsonable(obj):
+    """numpy trees -> plain JSON types (arrays to nested lists)."""
+    if isinstance(obj, dict):
+        return {k: jsonable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [jsonable(v) for v in obj]
+    if isinstance(obj, np.ndarray):
+        return obj.tolist()
+    if isinstance(obj, (np.integer,)):
+        return int(obj)
+    if isinstance(obj, (np.floating,)):
+        return float(obj)
+    return obj
+
+
+def arrayify(obj):
+    """JSON obs -> numpy trees (lists/scalars to arrays; dicts recurse)."""
+    if isinstance(obj, dict):
+        return {k: arrayify(v) for k, v in obj.items()}
+    if isinstance(obj, (list, int, float)):
+        return np.asarray(obj)
+    return obj
+
+
+class ServeHTTPServer:
+    def __init__(self, gateway, host: str = "127.0.0.1", port: int = 0):
+        gw = gateway
+
+        def routes(name: str, body: dict):
+            if name == "act":
+                out = gw.act(
+                    body["session_id"], arrayify(body["obs"]), body.get("timeout_s")
+                )
+                return jsonable(out)
+            if name == "reset":
+                return {"reset": gw.reset_session(body["session_id"])}
+            if name == "end":
+                return {"ended": gw.end_session(body["session_id"])}
+            if name == "load":
+                return gw.load_version(
+                    body["version"], source=body["source"],
+                    activate=bool(body.get("activate", False)),
+                )
+            if name == "swap":
+                return {"generation": gw.activate_version(body["version"])}
+            if name == "status":
+                return gw.status()
+            return None
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):
+                pass
+
+            def do_GET(self):
+                if self.path.rstrip("/") != "/metrics":
+                    self.send_response(404)
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+                    return
+                from ..obs import write_scrape_response
+
+                write_scrape_response(self)
+
+            def do_POST(self):
+                name = self.path.strip("/").split("/")[-1]
+                length = int(self.headers.get("Content-Length", 0))
+                try:
+                    body = json.loads(self.rfile.read(length) or b"{}")
+                    info = routes(name, body)
+                    payload = (
+                        {"code": 404, "info": f"no route {name}"}
+                        if info is None
+                        else {"code": 0, "info": info}
+                    )
+                except ServeError as e:
+                    payload = e.to_wire()
+                except Exception as e:
+                    payload = {"code": 1, "info": repr(e)}
+                data = json.dumps(payload, default=str).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self.host, self.port = self._server.server_address
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "ServeHTTPServer":
+        self._thread = threading.Thread(target=self._server.serve_forever, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()

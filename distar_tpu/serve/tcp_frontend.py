@@ -1,0 +1,192 @@
+"""Framed-TCP data plane for actor-grade serve callers.
+
+Wire format = ``comm.serializer``: 8-byte big-endian length prefix around a
+pickled (+compressed) payload — the same stack the actor fleet's shuttle
+speaks, so obs trees with real numpy arrays round-trip losslessly and fast
+(no JSON float inflation). One request/response pair per frame; a
+connection is a session's natural home but nothing enforces it — the
+``session_id`` field is authoritative, so a pool of connections can front
+many sessions.
+
+Requests are ``{"op": ..., ...}`` dicts:
+  act    {session_id, obs, timeout_s?}     -> {code: 0, outputs}
+  reset  {session_id}                      -> {code: 0, reset: bool}
+  end    {session_id}                      -> {code: 0, ended: bool}
+  load   {version, source|params, activate?} -> {code: 0, info}
+  swap   {version}                         -> {code: 0, generation}
+  status {}                                -> {code: 0, status}
+  ping   {}                                -> {code: 0, pong: True}
+
+Serve errors answer ``{code: <wire code>, error, shed}`` (errors.to_wire);
+the client rehydrates them into the typed exceptions.
+"""
+from __future__ import annotations
+
+import socket
+import threading
+from typing import Optional
+
+from ..comm.serializer import recv_msg, send_msg
+from ..obs import get_registry
+from .errors import ServeError, error_from_wire
+
+
+class ServeTCPServer:
+    def __init__(self, gateway, host: str = "127.0.0.1", port: int = 0):
+        self.gateway = gateway
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((host, port))
+        self._listener.listen(128)
+        self.host, self.port = self._listener.getsockname()
+        self._stop = threading.Event()
+        self._accept_thread: Optional[threading.Thread] = None
+        reg = get_registry()
+        self._g_conns = reg.gauge(
+            "distar_serve_tcp_connections", "open data-plane connections"
+        )
+        self._c_frames = reg.counter(
+            "distar_serve_tcp_frames_total", "request frames handled"
+        )
+
+    def start(self) -> "ServeTCPServer":
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="serve-tcp-accept", daemon=True
+        )
+        self._accept_thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        t = self._accept_thread
+        if t is not None:
+            t.join(5.0)
+            self._accept_thread = None
+
+    # ------------------------------------------------------------------ loop
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._listener.accept()
+            except OSError:  # listener closed by stop()
+                return
+            threading.Thread(
+                target=self._serve_conn, args=(conn,), name="serve-tcp-conn", daemon=True
+            ).start()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        self._g_conns.inc()
+        try:
+            with conn:
+                while not self._stop.is_set():
+                    try:
+                        req = recv_msg(conn)
+                    except (ConnectionError, OSError):
+                        return  # peer closed (possibly mid-frame)
+                    except ValueError as e:
+                        # garbage frame header/codec: answer typed, then
+                        # close — the stream can no longer be trusted
+                        send_msg(conn, {"code": "bad_frame", "error": repr(e), "shed": False})
+                        return
+                    self._c_frames.inc()
+                    try:
+                        send_msg(conn, self._dispatch(req))
+                    except (ConnectionError, OSError):
+                        return
+        finally:
+            self._g_conns.dec()
+
+    def _dispatch(self, req) -> dict:
+        if not isinstance(req, dict) or "op" not in req:
+            return {"code": "bad_request", "error": f"not a request dict: {type(req)}",
+                    "shed": False}
+        op = req["op"]
+        gw = self.gateway
+        try:
+            if op == "act":
+                out = gw.act(req["session_id"], req["obs"], req.get("timeout_s"))
+                return {"code": 0, "outputs": out}
+            if op == "reset":
+                return {"code": 0, "reset": gw.reset_session(req["session_id"])}
+            if op == "end":
+                return {"code": 0, "ended": gw.end_session(req["session_id"])}
+            if op == "load":
+                info = gw.load_version(
+                    req["version"], source=req.get("source"), params=req.get("params"),
+                    activate=bool(req.get("activate", False)),
+                )
+                return {"code": 0, "info": info}
+            if op == "swap":
+                return {"code": 0, "generation": gw.activate_version(req["version"])}
+            if op == "status":
+                return {"code": 0, "status": gw.status()}
+            if op == "ping":
+                return {"code": 0, "pong": True}
+            return {"code": "bad_request", "error": f"unknown op {op!r}", "shed": False}
+        except ServeError as e:
+            return e.to_wire()
+        except Exception as e:  # a handler bug must not kill the connection
+            return {"code": "serve_error", "error": repr(e), "shed": False}
+
+
+class ServeClient:
+    """Blocking data-plane client: one connection, one request in flight
+    (callers wanting pipelining open one client per worker thread)."""
+
+    def __init__(self, host: str, port: int, timeout_s: float = 30.0):
+        self._sock = socket.create_connection((host, port), timeout=timeout_s)
+        self._sock.settimeout(timeout_s)
+        self._lock = threading.Lock()
+
+    def _call(self, req: dict) -> dict:
+        with self._lock:
+            send_msg(self._sock, req)
+            resp = recv_msg(self._sock)
+        if resp.get("code") != 0:
+            raise error_from_wire(resp)
+        return resp
+
+    def act(self, session_id: str, obs, timeout_s: Optional[float] = None) -> dict:
+        req = {"op": "act", "session_id": session_id, "obs": obs}
+        if timeout_s is not None:
+            req["timeout_s"] = timeout_s
+        return self._call(req)["outputs"]
+
+    def reset(self, session_id: str) -> bool:
+        return self._call({"op": "reset", "session_id": session_id})["reset"]
+
+    def end(self, session_id: str) -> bool:
+        return self._call({"op": "end", "session_id": session_id})["ended"]
+
+    def load(self, version: str, source: Optional[str] = None, params=None,
+             activate: bool = False) -> dict:
+        return self._call(
+            {"op": "load", "version": version, "source": source, "params": params,
+             "activate": activate}
+        )["info"]
+
+    def swap(self, version: str) -> int:
+        return self._call({"op": "swap", "version": version})["generation"]
+
+    def status(self) -> dict:
+        return self._call({"op": "status"})["status"]
+
+    def ping(self) -> bool:
+        return self._call({"op": "ping"})["pong"]
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

@@ -124,6 +124,20 @@ def load_checkpoint(path: str, target: Any = None) -> Dict:
     return {"state": state, "metadata": payload.get("metadata", {})}
 
 
+def load_params(path: str) -> Any:
+    """Inference-side load: checkpoint -> bare model params.
+
+    Learner checkpoints carry ``{"params", "opt_state"}``; the optimizer
+    state is dead weight for serving/eval, so it is dropped here. Bare
+    param pytrees (e.g. converted reference checkpoints) pass through.
+    One choke point for every params-only consumer (serve registry,
+    play/eval loaders) instead of per-caller ``["state"].get("params")``."""
+    state = load_checkpoint(path)["state"]
+    if isinstance(state, dict) and "params" in state and "opt_state" in state:
+        return state["params"]
+    return state
+
+
 def _to_serialisable(tree):
     if isinstance(tree, dict):
         return {str(k): _to_serialisable(v) for k, v in tree.items()}

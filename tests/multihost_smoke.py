@@ -120,7 +120,10 @@ def worker(rank: int, port: int) -> None:
 
 
 def main() -> int:
-    import portpicker
+    # via the compat shim: the image doesn't ship portpicker (a bare import
+    # here made collection/launch die on such images; the shim falls back to
+    # a bind-port-0 stdlib pick)
+    from distar_tpu.envs.sc2 import portpicker_compat as portpicker
 
     port = portpicker.pick_unused_port()
     env = dict(os.environ)

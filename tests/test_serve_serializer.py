@@ -1,0 +1,135 @@
+"""comm/serializer coverage for serve-plane frames: request/response dict
+round-trips (real numpy obs, >1 MiB payloads), every codec magic, and the
+truncated/garbage-frame error paths both the framing and the socket helpers
+must answer typed (ValueError/ConnectionError, never IndexError or a
+multi-GiB allocation)."""
+import socket
+import struct
+import threading
+
+import numpy as np
+import pytest
+
+from distar_tpu.comm import serializer
+
+
+def roundtrip(obj, compress=True):
+    return serializer.loads(serializer.dumps(obj, compress=compress))
+
+
+def assert_tree_equal(a, b):
+    assert type(a) is type(b)
+    if isinstance(a, dict):
+        assert a.keys() == b.keys()
+        for k in a:
+            assert_tree_equal(a[k], b[k])
+    elif isinstance(a, np.ndarray):
+        assert a.dtype == b.dtype and a.shape == b.shape
+        np.testing.assert_array_equal(a, b)
+    else:
+        assert a == b
+
+
+def serve_act_request(n=16):
+    return {
+        "op": "act",
+        "session_id": "ladder-bot-1",
+        "timeout_s": 0.5,
+        "obs": {
+            "spatial_info": np.random.default_rng(0).random((n, n), np.float32),
+            "entity_info": {"flat": np.arange(64, dtype=np.int32)},
+            "entity_num": np.int32(7),
+        },
+    }
+
+
+def serve_act_response():
+    return {
+        "code": 0,
+        "outputs": {
+            "action": np.asarray(3.5, np.float32),
+            "logits": np.linspace(0, 1, 327, dtype=np.float32),
+            "model_version": "v3",
+        },
+    }
+
+
+@pytest.mark.parametrize("compress", [True, False])
+def test_serve_frames_round_trip(compress):
+    for obj in (serve_act_request(), serve_act_response()):
+        assert_tree_equal(roundtrip(obj, compress=compress), obj)
+
+
+def test_large_payload_round_trip_over_1mib():
+    req = serve_act_request()
+    # incompressible >1 MiB observation: exercises the lz/zlib fallback and
+    # the 8-byte length framing well past small-buffer paths
+    req["obs"]["replay_blob"] = np.random.default_rng(1).integers(
+        0, 255, size=2_000_000, dtype=np.uint8
+    )
+    blob = serializer.dumps(req)
+    assert len(blob) > 1 << 20
+    assert_tree_equal(serializer.loads(blob), req)
+    framed = serializer.frame(blob)
+    (n,) = struct.unpack(">Q", framed[:8])
+    assert n == len(blob)
+
+
+def test_socket_helpers_round_trip_serve_frames():
+    a, b = socket.socketpair()
+    try:
+        req = serve_act_request()
+        req["obs"]["big"] = np.zeros(300_000, np.float32)
+        out = {}
+
+        def rx():
+            out["msg"] = serializer.recv_msg(b)
+
+        t = threading.Thread(target=rx)
+        t.start()
+        serializer.send_msg(a, req)
+        t.join(10)
+        assert not t.is_alive()
+        assert_tree_equal(out["msg"], req)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_truncated_frame_raises_connection_error():
+    a, b = socket.socketpair()
+    try:
+        blob = serializer.dumps(serve_act_response())
+        a.sendall(serializer.frame(blob)[: 8 + len(blob) // 2])  # half a frame
+        a.close()  # peer dies mid-frame
+        with pytest.raises(ConnectionError):
+            serializer.recv_msg(b)
+    finally:
+        b.close()
+
+
+def test_garbage_frame_header_rejected_before_allocation():
+    # 8 bytes of 0xff = an 18-exabyte "length": must fail typed, not OOM
+    def recv_exact(n, _data=[b"\xff" * 8]):
+        d, _data[0] = _data[0][:n], _data[0][n:]
+        return d
+
+    with pytest.raises(ValueError, match="implausible frame length"):
+        serializer.read_frame(recv_exact)
+
+
+def test_garbage_payload_magic_rejected():
+    with pytest.raises(ValueError, match="unknown payload magic"):
+        serializer.loads(b"NOPE" + b"junk")
+
+
+def test_truncated_lz_header_rejected():
+    with pytest.raises(ValueError, match="truncated lz payload header"):
+        serializer.loads(serializer.MAGIC_LZ + b"\x01\x02")
+
+
+def test_hostile_lz_decompressed_size_rejected():
+    # header claims a decompressed size far beyond lz4's possible expansion
+    body = struct.pack("<Q", 1 << 40) + b"\x00" * 16
+    with pytest.raises(ValueError, match="implausible decompressed size"):
+        serializer.loads(serializer.MAGIC_LZ + body)

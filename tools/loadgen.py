@@ -1,0 +1,304 @@
+"""Serve-plane load generator: closed/open loop, throughput + latency tails.
+
+Drives an ``InferenceGateway`` through any of three targets:
+
+  * in-process (default) — a mock-engine gateway built right here; measures
+    the batching/session machinery itself with zero network
+  * ``--tcp host:port``  — the framed-TCP data plane of a running
+    ``bin/serve.py``
+  * ``--http host:port`` — the JSON frontend (expect float-inflation
+    overhead; this is the showmatch path, not the actor path)
+
+Modes (the two canonical load-test shapes):
+  * closed — ``--clients N`` workers each issue the next request the moment
+    the previous returns (think-time 0): measures saturated throughput and
+    the batch coalescing under full load.
+  * open   — requests arrive at ``--rate R`` per second on a fixed schedule
+    regardless of completions: measures latency at a given offered load and
+    shed behaviour past saturation.
+
+Output: bench.py-style JSON result lines on stdout (the LAST line is the
+summary), optionally mirrored to ``--artifact <path>``. A mid-run hot swap
+(``--swap-at <frac>``) exercises the registry under load and reports swap
+duration + any in-flight disruption (there must be none).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import threading
+import time
+from typing import List, Optional
+
+import numpy as np
+
+sys.path.insert(0, ".")  # runnable as `python tools/loadgen.py` from repo root
+
+from distar_tpu.obs import get_registry  # noqa: E402
+from distar_tpu.serve import (  # noqa: E402
+    InferenceGateway,
+    MockModelEngine,
+    ServeClient,
+    ShedError,
+)
+
+
+class _Stats:
+    def __init__(self):
+        self.lat: List[float] = []
+        self.ok = 0
+        self.shed = 0
+        self.errors = 0
+        self._lock = threading.Lock()
+
+    def record(self, dt: Optional[float], kind: str) -> None:
+        with self._lock:
+            if kind == "ok":
+                self.ok += 1
+                self.lat.append(dt)
+            elif kind == "shed":
+                self.shed += 1
+            else:
+                self.errors += 1
+
+    def quantile(self, q: float) -> float:
+        with self._lock:
+            if not self.lat:
+                return 0.0
+            return float(np.quantile(np.asarray(self.lat), q))
+
+
+def _make_obs(i: int) -> dict:
+    return {"x": np.full((4, 4), float(i % 7), dtype=np.float32)}
+
+
+class _InprocTarget:
+    def __init__(self, slots: int, delay_s: float, max_delay_s: float, capacity: int):
+        self.engine = MockModelEngine(slots, params={"version": "v1", "bias": 0.0},
+                                      delay_s=delay_s)
+        self.gateway = InferenceGateway(
+            self.engine, max_delay_s=max_delay_s, queue_capacity=capacity,
+        ).start()
+        self.gateway.load_version("v1", params={"version": "v1", "bias": 0.0},
+                                  activate=True)
+
+    def act(self, session: str, obs, timeout_s: float):
+        return self.gateway.act(session, obs, timeout_s)
+
+    def swap(self) -> None:
+        self.gateway.load_version("v2", params={"version": "v2", "bias": 1.0},
+                                  activate=True)
+
+    def close(self) -> None:
+        self.gateway.drain_and_stop()
+
+
+class _TcpTarget:
+    def __init__(self, addr: str):
+        host, port = addr.rsplit(":", 1)
+        self._mk = lambda: ServeClient(host, int(port))
+        self._local = threading.local()
+
+    def _client(self) -> ServeClient:
+        c = getattr(self._local, "c", None)
+        if c is None:
+            c = self._local.c = self._mk()
+        return c
+
+    def act(self, session: str, obs, timeout_s: float):
+        return self._client().act(session, obs, timeout_s)
+
+    def swap(self) -> None:
+        self._client().load("loadgen-swap", params={"version": "loadgen-swap"},
+                            activate=True)
+
+    def close(self) -> None:
+        pass
+
+
+class _HttpTarget:
+    def __init__(self, addr: str):
+        self._base = f"http://{addr}/serve"
+
+    def _post(self, route: str, body: dict) -> dict:
+        import urllib.request
+
+        req = urllib.request.Request(
+            f"{self._base}/{route}", data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"}, method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            out = json.loads(resp.read())
+        if out.get("code") != 0:
+            if out.get("shed"):
+                raise ShedError(out.get("error", ""))
+            raise RuntimeError(out.get("error") or out.get("info"))
+        return out["info"]
+
+    def act(self, session: str, obs, timeout_s: float):
+        return self._post("act", {
+            "session_id": session,
+            "obs": {k: np.asarray(v).tolist() for k, v in obs.items()},
+            "timeout_s": timeout_s,
+        })
+
+    def swap(self) -> None:
+        raise RuntimeError("hot swap over HTTP needs a checkpoint source; use --tcp")
+
+    def close(self) -> None:
+        pass
+
+
+def emit(line: dict, artifact_lines: List[dict]) -> None:
+    print(json.dumps(line), flush=True)
+    artifact_lines.append(line)
+
+
+def run_loadgen(
+    mode: str = "closed",
+    clients: int = 8,
+    rate: float = 200.0,
+    duration_s: float = 5.0,
+    requests_per_client: int = 0,
+    slots: int = 8,
+    mock_delay_s: float = 0.002,
+    max_delay_s: float = 0.005,
+    queue_capacity: int = 256,
+    timeout_s: float = 5.0,
+    swap_at: float = 0.0,
+    tcp: Optional[str] = None,
+    http: Optional[str] = None,
+    artifact: Optional[str] = None,
+) -> dict:
+    """Importable driver (the slow soak test calls this). Returns the
+    summary dict that is also the last stdout JSON line."""
+    assert mode in ("closed", "open")
+    if tcp:
+        target = _TcpTarget(tcp)
+    elif http:
+        target = _HttpTarget(http)
+    else:
+        target = _InprocTarget(slots, mock_delay_s, max_delay_s, queue_capacity)
+    stats = _Stats()
+    artifact_lines: List[dict] = []
+    stop_at = time.perf_counter() + duration_s
+    swapped = threading.Event()
+
+    def one(session: str, i: int) -> None:
+        t0 = time.perf_counter()
+        try:
+            target.act(session, _make_obs(i), timeout_s)
+            stats.record(time.perf_counter() - t0, "ok")
+        except ShedError:
+            stats.record(None, "shed")
+        except Exception:
+            stats.record(None, "error")
+
+    def maybe_swap(done_frac: float) -> None:
+        if swap_at and done_frac >= swap_at and not swapped.is_set():
+            swapped.set()
+            t0 = time.perf_counter()
+            target.swap()
+            emit({"metric": "serve_swap_issue", "value": time.perf_counter() - t0,
+                  "unit": "s"}, artifact_lines)
+
+    t_start = time.perf_counter()
+    if mode == "closed":
+        def worker(w: int) -> None:
+            session = f"loadgen-{w}"
+            i = 0
+            while time.perf_counter() < stop_at or (
+                requests_per_client and i < requests_per_client
+            ):
+                if requests_per_client and i >= requests_per_client:
+                    break
+                one(session, i)
+                i += 1
+                maybe_swap((time.perf_counter() - t_start) / duration_s)
+                if not requests_per_client and time.perf_counter() >= stop_at:
+                    break
+
+        threads = [threading.Thread(target=worker, args=(w,)) for w in range(clients)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    else:  # open loop: fixed arrival schedule, unbounded worker threads
+        period = 1.0 / max(rate, 1e-9)
+        threads = []
+        i = 0
+        next_fire = time.perf_counter()
+        while time.perf_counter() < stop_at:
+            now = time.perf_counter()
+            if now < next_fire:
+                time.sleep(min(next_fire - now, 0.01))
+                continue
+            session = f"loadgen-{i % max(slots, 1)}"
+            t = threading.Thread(target=one, args=(session, i))
+            t.start()
+            threads.append(t)
+            i += 1
+            next_fire += period
+            maybe_swap((now - t_start) / duration_s)
+        for t in threads:
+            t.join(timeout_s + 1.0)
+    elapsed = time.perf_counter() - t_start
+    target.close()
+
+    total = stats.ok + stats.shed + stats.errors
+    summary = {
+        "metric": "serve_throughput",
+        "value": round(stats.ok / max(elapsed, 1e-9), 2),
+        "unit": "req/s",
+        "mode": mode,
+        "ok": stats.ok,
+        "shed": stats.shed,
+        "errors": stats.errors,
+        "total": total,
+        "elapsed_s": round(elapsed, 3),
+        "latency_p50_s": round(stats.quantile(0.5), 6),
+        "latency_p99_s": round(stats.quantile(0.99), 6),
+    }
+    if tcp is None and http is None:
+        # in-process: the serve metrics live in OUR registry — report the
+        # coalescing the acceptance criteria care about
+        snap = get_registry().snapshot()
+        occ_count = snap.get("distar_serve_batch_occupancy_count", 0.0)
+        occ_sum = snap.get("distar_serve_batch_occupancy_sum", 0.0)
+        summary["mean_batch_occupancy"] = round(occ_sum / occ_count, 3) if occ_count else 0.0
+        summary["swap_p99_s"] = snap.get("distar_serve_swap_duration_seconds_p99", 0.0)
+    for q, name in ((0.5, "serve_latency_p50"), (0.99, "serve_latency_p99")):
+        emit({"metric": name, "value": stats.quantile(q), "unit": "s"}, artifact_lines)
+    emit(summary, artifact_lines)
+    if artifact:
+        with open(artifact, "w") as f:
+            for line in artifact_lines:
+                f.write(json.dumps(line) + "\n")
+    return summary
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--mode", choices=("closed", "open"), default="closed")
+    p.add_argument("--clients", type=int, default=8, help="closed-loop workers")
+    p.add_argument("--rate", type=float, default=200.0, help="open-loop arrivals/s")
+    p.add_argument("--duration-s", type=float, default=5.0)
+    p.add_argument("--requests-per-client", type=int, default=0,
+                   help="closed loop: stop after N requests instead of duration")
+    p.add_argument("--slots", type=int, default=8, help="in-process mock slots")
+    p.add_argument("--mock-delay-s", type=float, default=0.002)
+    p.add_argument("--max-delay-s", type=float, default=0.005)
+    p.add_argument("--queue-capacity", type=int, default=256)
+    p.add_argument("--timeout-s", type=float, default=5.0)
+    p.add_argument("--swap-at", type=float, default=0.0,
+                   help="hot-swap when this fraction of the run has elapsed (0=off)")
+    p.add_argument("--tcp", help="host:port of a running serve TCP frontend")
+    p.add_argument("--http", help="host:port of a running serve HTTP frontend")
+    p.add_argument("--artifact", help="also write the JSON lines to this path")
+    args = p.parse_args()
+    run_loadgen(**{k.replace("-", "_"): v for k, v in vars(args).items()})
+
+
+if __name__ == "__main__":
+    main()
