@@ -160,26 +160,32 @@ def _measure(kind, label, train_step, args, feedback, frames, peak, iters=4):
     t0 = time.perf_counter()
     lowered = train_step.lower(*args)
     trace_s = time.perf_counter() - t0
-    flops = 0.0
+    flops_unoptimized = 0.0
     try:
         cost = lowered.cost_analysis()
-        flops = float(cost.get("flops", 0.0)) if cost else 0.0
+        flops_unoptimized = float(cost.get("flops", 0.0)) if cost else 0.0
     except Exception as e:
         print(f"BENCH-STAGE {kind}-cost-analysis-failed {e!r}"[:300], file=sys.stderr, flush=True)
     _stage(f"{kind}-compile {label}")
     t0 = time.perf_counter()
     compiled = lowered.compile()
     compile_s = time.perf_counter() - t0
+    flops_optimized = 0.0
     try:
         # post-optimization executable-level count, when the backend offers it
         cost = compiled.cost_analysis()
         if isinstance(cost, (list, tuple)):
             cost = cost[0] if cost else None
-        opt_flops = float(cost.get("flops", 0.0)) if cost else 0.0
-        if opt_flops:
-            flops = opt_flops
+        flops_optimized = float(cost.get("flops", 0.0)) if cost else 0.0
     except Exception:
         pass
+    # MFU numerator: the optimized executable count when present (honest —
+    # what actually runs), else the HLO count. The impossible-timing check
+    # below uses the MAX of the two: a backend reporting an erroneously low
+    # optimized count must not be able to both deflate MFU and defeat the
+    # physics recheck, and both counts land in the JSON as evidence.
+    flops = flops_optimized or flops_unoptimized
+    check_flops = max(flops_optimized, flops_unoptimized)
     _stage(f"{kind}-warmup {label}")
     out = compiled(*args)
     jax.block_until_ready(out)
@@ -202,10 +208,14 @@ def _measure(kind, label, train_step, args, feedback, frames, peak, iters=4):
     }
     if flops:
         point["flops_per_step"] = flops
+        if flops_unoptimized:
+            point["flops_unoptimized"] = flops_unoptimized
+        if flops_optimized:
+            point["flops_optimized"] = flops_optimized
         point["implied_tflops"] = round(flops / step_time / 1e12, 1)
         if peak:
             point["mfu"] = round(flops / step_time / peak, 4)
-        if peak and flops / step_time > 1.1 * peak:
+        if peak and check_flops / step_time > 1.1 * peak:
             # physically impossible number: the flop count says this step
             # cannot run this fast on this chip. Re-time over an 8x longer
             # window and make THAT the point's headline numbers — a timing
@@ -215,7 +225,7 @@ def _measure(kind, label, train_step, args, feedback, frames, peak, iters=4):
             long_time = timed(iters * 8)
             point["step_time_short_s"] = point["step_time_s"]
             point["implied_tflops_short"] = point["implied_tflops"]
-            point["suspect_timing"] = bool(flops / long_time > 1.1 * peak)
+            point["suspect_timing"] = bool(check_flops / long_time > 1.1 * peak)
             step_time = long_time
             point["step_time_s"] = round(step_time, 4)
             point["frames_per_sec"] = round(frames / step_time, 2)

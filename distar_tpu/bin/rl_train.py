@@ -14,6 +14,7 @@ Usage:
 from __future__ import annotations
 
 import argparse
+import os
 import threading
 import time
 
@@ -91,6 +92,34 @@ def _learner_cfg(args, model_cfg: dict, load_path: str = "") -> dict:
     }
 
 
+def _init_health(args, roles, source="local", shipper_addr=None):
+    """Stand up the fleet-health subsystem for this process: TSDB sampler +
+    the default rulebook for the roles it hosts + the crash flight recorder
+    (bundles land under <experiment>/flight). With ``shipper_addr`` the
+    process additionally ships registry snapshots to the coordinator so the
+    broker-side rulebook sees the whole fleet. Disable with --no-health."""
+    if getattr(args, "no_health", False):
+        return None
+    from ..obs import TelemetryShipper, default_rulebook, init_fleet_health
+
+    fleet = init_fleet_health(
+        rules=default_rulebook(roles),
+        sample_interval_s=getattr(args, "health_sample_s", 1.0),
+        eval_interval_s=getattr(args, "health_eval_s", 2.0),
+        source=source,
+    )
+    artifact_dir = os.path.join(
+        os.getcwd(), "experiments", getattr(args, "experiment_name", "run"), "flight"
+    )
+    fleet.recorder.install_crash_hook(artifact_dir, config=vars(args))
+    if shipper_addr is not None:
+        TelemetryShipper(
+            source, coordinator_addr=shipper_addr,
+            interval_s=getattr(args, "telemetry_interval_s", 5.0),
+        ).start()
+    return fleet
+
+
 def _maybe_serve_metrics(args, coordinator=None):
     """Start an HTTP server exposing GET /metrics for this process's registry
     when --metrics-port is given (CoordinatorServer doubles as the exporter;
@@ -111,6 +140,8 @@ def run_all(args) -> None:
     model_cfg = _model_cfg(args)
     league = League(user_cfg)
     co = Coordinator()
+    # one process hosts every role, so the full rulebook applies locally
+    _init_health(args, roles=("learner", "actor", "coordinator", "trace"))
     _maybe_serve_metrics(args, coordinator=co)
     actor_adapter = Adapter(coordinator=co)
     learner_adapter = Adapter(coordinator=co)
@@ -172,6 +203,11 @@ def run_learner(args) -> None:
     )
     league = RemoteLeague(*_addr(args.league_addr)) if args.league_addr else None
     adapter = Adapter(coordinator_addr=_addr(args.coordinator_addr))
+    _init_health(
+        args, roles=("learner", "trace"),
+        source=f"learner:{args.player_id}:{info['rank']}",
+        shipper_addr=_addr(args.coordinator_addr),
+    )
     _maybe_serve_metrics(args)
     model_cfg = _model_cfg(args)
     load_path = ""
@@ -197,6 +233,10 @@ def run_actor(args) -> None:
 
     league = RemoteLeague(*_addr(args.league_addr))
     adapter = Adapter(coordinator_addr=_addr(args.coordinator_addr))
+    _init_health(
+        args, roles=("actor", "trace"), source=f"actor:{os.getpid()}",
+        shipper_addr=_addr(args.coordinator_addr),
+    )
     _maybe_serve_metrics(args)
     model_cfg = _model_cfg(args)
     actor = Actor(
@@ -226,7 +266,18 @@ def main() -> None:
     p.add_argument("--port", type=int, default=0)
     p.add_argument("--metrics-port", type=int, default=None,
                    help="serve GET /metrics (Prometheus text) on this port; "
-                        "the coordinator role serves it on --port already")
+                        "the coordinator role serves it on --port already "
+                        "(plus /healthz, /alerts, /timeseries)")
+    p.add_argument("--no-health", action="store_true",
+                   help="disable the fleet-health subsystem (TSDB sampler, "
+                        "watchdog rules, telemetry shipping, crash recorder)")
+    p.add_argument("--health-sample-s", type=float, default=1.0,
+                   help="registry->TSDB sampling cadence")
+    p.add_argument("--health-eval-s", type=float, default=2.0,
+                   help="health rulebook evaluation cadence")
+    p.add_argument("--telemetry-interval-s", type=float, default=5.0,
+                   help="snapshot shipping cadence to the coordinator "
+                        "(learner/actor roles)")
     p.add_argument("--league-addr", default="", help="host:port of the league server")
     p.add_argument("--coordinator-addr", default="", help="host:port of the coordinator")
     p.add_argument("--player-id", default="MP0")
@@ -271,6 +322,10 @@ def main() -> None:
         while True:
             time.sleep(3600)
     elif args.type == "coordinator":
+        # the broker evaluates the FULL rulebook: shipped telemetry gives it
+        # per-source learner/actor/serve series for the whole fleet
+        _init_health(args, roles=("learner", "actor", "coordinator", "trace", "serve"),
+                     source="coordinator")
         server = CoordinatorServer(port=args.port)
         server.start()
         print(f"coordinator serving on {server.host}:{server.port}", flush=True)

@@ -58,11 +58,27 @@ def main() -> None:
     p.add_argument("--mock-delay-s", type=float, default=0.0)
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--drain-timeout-s", type=float, default=30.0)
+    p.add_argument("--no-health", action="store_true",
+                   help="disable the fleet-health subsystem (watchdog rules, "
+                        "TSDB, crash recorder)")
     args = p.parse_args()
     if not args.mock and not args.checkpoint:
         p.error("--checkpoint is required unless --mock")
 
     logger = TextLogger("./experiments/serve", "serve")
+
+    # fleet health: serve rulebook (shed-rate + request-trace SLO), TSDB
+    # behind GET /healthz /alerts /timeseries on the HTTP frontend, crash
+    # flight recorder bundling to the experiment dir
+    if not args.no_health:
+        from ..obs import default_rulebook, init_fleet_health
+
+        fleet = init_fleet_health(rules=default_rulebook(("serve", "trace")),
+                                  source="serve")
+        fleet.recorder.install_crash_hook(
+            "./experiments/serve/flight", config=vars(args)
+        )
+
     engine, load_fn = build_engine(args)
 
     from ..serve import InferenceGateway, ModelRegistry, ServeHTTPServer, ServeTCPServer

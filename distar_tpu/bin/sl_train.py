@@ -18,12 +18,16 @@ import argparse
 
 from .. import plugins
 from ..utils import read_config
-from .rl_train import _addr
+from .rl_train import _addr, _init_health
 
 
 def _learner(args) -> None:
     from .rl_train import SMOKE_MODEL
 
+    _init_health(
+        args, roles=("learner", "trace"), source="sl_learner",
+        shipper_addr=_addr(args.coordinator_addr) if args.remote else None,
+    )
     user_cfg = read_config(args.config) if args.config else {}
     model_cfg = user_cfg.get("model", SMOKE_MODEL if args.smoke_model else {})
     learner = plugins.load_component(args.pipeline, "SLLearner")(
@@ -95,9 +99,15 @@ def _learner(args) -> None:
 
 
 def _replay_actor(args) -> None:
+    import os
+
     from ..comm import Adapter
     from ..learner.replay_actor import ReplayActor
 
+    # no replay-specific rules yet, but shipping makes decode throughput
+    # visible in the broker's fleet view (/timeseries per source)
+    _init_health(args, roles=("trace",), source=f"replay_actor:{os.getpid()}",
+                 shipper_addr=_addr(args.coordinator_addr))
     decoder_cls = plugins.load_component(args.pipeline, "ReplayDecoder")
     coordinator = _addr(args.coordinator_addr)
     ReplayActor(
@@ -114,6 +124,9 @@ def _coordinator(args) -> None:
 
     from ..comm import CoordinatorServer
 
+    # broker-side rulebook over shipped telemetry (the fleet view)
+    _init_health(args, roles=("learner", "actor", "coordinator", "trace"),
+                 source="coordinator")
     server = CoordinatorServer(port=_addr(args.coordinator_addr)[1])
     server.start()
     print(f"coordinator serving on {server.host}:{server.port}")
@@ -150,6 +163,9 @@ def main() -> None:
                    help="learner implementation: 'default' or an importable "
                         "custom-pipeline module (plugins.py)")
     p.add_argument("--replays", default="", help="replay list file or directory")
+    p.add_argument("--no-health", action="store_true",
+                   help="disable the fleet-health subsystem (watchdog rules, "
+                        "telemetry shipping, crash recorder)")
     p.add_argument("--num-workers", type=int, default=1)
     p.add_argument("--epochs", type=int, default=1)
     p.add_argument("--platform", default="auto", choices=("auto", "cpu", "tpu"),

@@ -115,13 +115,23 @@ class Coordinator:
 
 
 class CoordinatorServer:
-    """HTTP wrapper: POST /coordinator/<register|ask|strike|stats>."""
+    """HTTP wrapper: POST /coordinator/<register|ask|strike|stats|telemetry>,
+    GET /metrics (Prometheus scrape) + the fleet-health routes
+    /healthz, /alerts, /timeseries (obs.handle_health_get)."""
 
     def __init__(self, coordinator: Optional[Coordinator] = None, host="127.0.0.1", port=0):
         from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
         self.coordinator = coordinator or Coordinator()
         co = self.coordinator
+
+        def _ingest_telemetry(msg: dict) -> int:
+            # fold shipped snapshots into the process fleet store: the broker
+            # is the one place that sees every actor/learner/serve source
+            from ..obs import get_fleet_health
+
+            return get_fleet_health().ingest.ingest(msg)
+
         routes = {
             "register": lambda b: co.register(**b),
             "ask": lambda b: co.ask(b["token"]),
@@ -136,6 +146,7 @@ class CoordinatorServer:
                 if "max_age_s" in b
                 else co.depth(b["token"])
             ),
+            "telemetry": _ingest_telemetry,
         }
 
         class Handler(BaseHTTPRequestHandler):
@@ -144,21 +155,35 @@ class CoordinatorServer:
 
             def do_GET(self):
                 """GET /metrics: Prometheus text exposition of the process
-                registry (queue-depth gauges refreshed at scrape time)."""
-                if self.path.rstrip("/") != "/metrics":
-                    self.send_response(404)
-                    self.send_header("Content-Length", "0")
-                    self.end_headers()
-                    return
-                from ..obs import write_scrape_response
+                registry (queue-depth gauges refreshed at scrape time);
+                GET /healthz, /alerts, /timeseries: fleet-health JSON."""
+                from ..obs import handle_health_get, write_scrape_response
 
-                write_scrape_response(self, refresh=co.publish_metrics)
+                if self.path.rstrip("/") == "/metrics":
+                    write_scrape_response(self, refresh=co.publish_metrics)
+                    return
+                if handle_health_get(self, self.path):
+                    return
+                self.send_response(404)
+                self.send_header("Content-Length", "0")
+                self.end_headers()
 
             def do_POST(self):
                 name = self.path.strip("/").split("/")[-1]
                 length = int(self.headers.get("Content-Length", 0))
                 try:
-                    body = json.loads(self.rfile.read(length) or b"{}")
+                    raw = self.rfile.read(length)
+                    ctype = self.headers.get("Content-Type", "")
+                    if name == "telemetry" and ctype.startswith(
+                        "application/x-distar"
+                    ):
+                        # shipped snapshots ride the comm serializer codec
+                        # (pickle+LZ), not JSON — same stack as the data plane
+                        from .serializer import loads as _loads
+
+                        body = _loads(raw)
+                    else:
+                        body = json.loads(raw or b"{}")
                     fn = routes.get(name)
                     payload = (
                         {"code": 404, "info": f"no route {name}"}

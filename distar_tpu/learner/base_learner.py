@@ -197,6 +197,11 @@ class BaseLearner:
         data_wait = self.metrics.histogram(
             "distar_learner_data_wait_seconds", "dataloader wait per iteration"
         )
+        # a gauge (not histogram) on purpose: the NaN/Inf health rule needs
+        # the raw last value — a reservoir quantile would mask non-finites
+        loss_gauge = self.metrics.gauge(
+            "distar_learner_loss", "last total_loss (NaN/Inf watchdog input)"
+        )
 
         @auto_checkpoint(lambda: self.save(self.checkpoint_path(), sync=True))
         def _run():
@@ -212,6 +217,12 @@ class BaseLearner:
                 t_train = self.timer.value
                 self.log_buffer["train_time"] = t_train
                 self.log_buffer.update(log_vars)
+                loss = log_vars.get("total_loss")
+                if loss is not None:
+                    try:
+                        loss_gauge.set(float(loss))
+                    except (TypeError, ValueError):
+                        pass
                 self.last_iter.add(1)
                 # host-callback phase = everything after the device step:
                 # hook pass (log reduction, checkpoint scheduling, weight

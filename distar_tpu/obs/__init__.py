@@ -1,10 +1,19 @@
-"""Unified telemetry layer: metrics registry, trace spans, profiler hooks.
+"""Unified telemetry + fleet health layer.
 
-One process-wide ``MetricsRegistry`` (``get_registry()``) that every layer —
-actor, env pool, comm shuttle/coordinator, learner, league — publishes into;
-two exporters (Prometheus text served from the coordinator's ``/metrics``
-route, JSONL composing with the utils.log scalar sink); explicit-context
-trace spans that ride payloads actor→comm→learner. See docs/observability.md.
+Instrumentation side (PR 1): one process-wide ``MetricsRegistry``
+(``get_registry()``) that every layer — actor, env pool, comm
+shuttle/coordinator, learner, league, serve — publishes into; Prometheus
+text + JSONL exporters; explicit-context trace spans that ride payloads
+actor→comm→learner; freq-gated profiler hooks.
+
+Consumption side (this package's fleet-health subsystem): a bounded
+ring-buffer ``TimeSeriesStore`` fed by a ``RegistrySampler``; a
+``TelemetryShipper`` pushing compact snapshots from every fleet process to
+the coordinator's ``TelemetryIngest``; a declarative ``HealthRule`` engine
+with a debounced ok→warning→firing state machine (``HealthEvaluator``,
+``default_rulebook``); and a ``FlightRecorder`` crash bundle. Surfaced via
+``GET /healthz``, ``/alerts``, ``/timeseries`` on the coordinator and serve
+HTTP frontends, and ``tools/opsctl.py``. See docs/observability.md.
 """
 from .registry import (
     Counter,
@@ -17,7 +26,9 @@ from .registry import (
 from .exporters import (
     PROMETHEUS_CONTENT_TYPE,
     JsonlExporter,
+    handle_health_get,
     render_prometheus,
+    write_json_response,
     write_scrape_response,
 )
 from .trace import (
@@ -32,6 +43,18 @@ from .trace import (
     wrap_payload,
 )
 from .profiler import ProfilerSession, record_step_phases
+from .timeseries import RegistrySampler, TimeSeriesStore
+from .shipper import SERIALIZED_CONTENT_TYPE, TelemetryIngest, TelemetryShipper
+from .flightrecorder import FlightRecorder, get_flight_recorder, set_flight_recorder
+from .health import (
+    FleetHealth,
+    HealthEvaluator,
+    HealthRule,
+    default_rulebook,
+    get_fleet_health,
+    init_fleet_health,
+    set_fleet_health,
+)
 
 __all__ = [
     "Counter",
@@ -42,7 +65,9 @@ __all__ = [
     "set_registry",
     "PROMETHEUS_CONTENT_TYPE",
     "JsonlExporter",
+    "handle_health_get",
     "render_prometheus",
+    "write_json_response",
     "write_scrape_response",
     "Span",
     "finish_trace",
@@ -55,4 +80,19 @@ __all__ = [
     "wrap_payload",
     "ProfilerSession",
     "record_step_phases",
+    "RegistrySampler",
+    "TimeSeriesStore",
+    "SERIALIZED_CONTENT_TYPE",
+    "TelemetryIngest",
+    "TelemetryShipper",
+    "FlightRecorder",
+    "get_flight_recorder",
+    "set_flight_recorder",
+    "FleetHealth",
+    "HealthEvaluator",
+    "HealthRule",
+    "default_rulebook",
+    "get_fleet_health",
+    "init_fleet_health",
+    "set_fleet_health",
 ]

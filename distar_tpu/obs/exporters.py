@@ -9,6 +9,8 @@ the same ``scalars.jsonl`` stream training metrics already use.
 """
 from __future__ import annotations
 
+import json
+import math
 from typing import Optional
 
 from .registry import MetricsRegistry, get_registry
@@ -27,9 +29,16 @@ def _escape_label(s: str) -> str:
 
 
 def _fmt(v: float) -> str:
+    v = float(v)
+    # non-finite values per the Prometheus text format: "NaN", "+Inf",
+    # "-Inf" — repr() would emit "nan"/"inf", which scrapers reject
+    if math.isnan(v):
+        return "NaN"
+    if math.isinf(v):
+        return "+Inf" if v > 0 else "-Inf"
     if v == int(v) and abs(v) < 1e15:
         return str(int(v))
-    return repr(float(v))
+    return repr(v)
 
 
 def _labels_text(key, extra: str = "") -> str:
@@ -82,6 +91,69 @@ def write_scrape_response(handler, refresh=None, registry: Optional[MetricsRegis
     handler.send_header("Content-Length", str(len(data)))
     handler.end_headers()
     handler.wfile.write(data)
+
+
+def write_json_response(handler, obj, status: int = 200) -> None:
+    """Answer a GET with a JSON body on a ``BaseHTTPRequestHandler``."""
+    data = json.dumps(obj, default=str).encode()
+    handler.send_response(status)
+    handler.send_header("Content-Type", "application/json")
+    handler.send_header("Content-Length", str(len(data)))
+    handler.end_headers()
+    handler.wfile.write(data)
+
+
+def handle_health_get(handler, path: str) -> bool:
+    """Answer the fleet-health GET routes shared by every HTTP surface
+    (coordinator broker, serve gateway):
+
+      GET /healthz                           overall state + per-source staleness
+                                             (HTTP 503 while any rule is firing)
+      GET /alerts                            per-rule states + transition history
+      GET /timeseries?name=&window_s=&source=  windowed stats + raw points
+
+    Returns False when ``path`` is not a health route (caller 404s). Route
+    failures answer 500 — an ops probe must never wedge the serving process."""
+    from urllib.parse import parse_qs, urlparse
+
+    parsed = urlparse(path)
+    route = parsed.path.rstrip("/")
+    if route not in ("/healthz", "/alerts", "/timeseries"):
+        return False
+    try:
+        from .health import get_fleet_health
+
+        fleet = get_fleet_health()
+        if route == "/healthz":
+            body = fleet.healthz()
+            write_json_response(handler, body,
+                                status=503 if body["status"] == "firing" else 200)
+        elif route == "/alerts":
+            write_json_response(handler, fleet.evaluator.alerts())
+        else:
+            q = parse_qs(parsed.query)
+            name = (q.get("name") or [""])[0]
+            if not name:
+                write_json_response(
+                    handler, {"error": "query parameter 'name' is required"}, status=400
+                )
+                return True
+            window_s = float((q.get("window_s") or ["300"])[0])
+            source = (q.get("source") or [None])[0]
+            points = fleet.store.points(name, window_s=window_s, source=source)
+            stats = {
+                s: fleet.store.query(name, window_s=window_s, source=s)
+                for s in points
+            }
+            write_json_response(handler, {
+                "name": name,
+                "window_s": window_s,
+                "stats": stats,
+                "points": points,
+            })
+    except Exception as e:
+        write_json_response(handler, {"error": repr(e)}, status=500)
+    return True
 
 
 class JsonlExporter:

@@ -1,0 +1,152 @@
+"""Telemetry shipping: fleet processes push registry snapshots to the broker.
+
+The Prometheus ``/metrics`` route is pull-based and per-process — an operator
+watching a 100-actor league would need 100 scrape targets. Following the
+centralized-actor-telemetry design of SEED RL (PAPERS.md), every fleet
+process instead runs a ``TelemetryShipper``: a background thread that
+periodically snapshots the local ``MetricsRegistry`` and pushes the compact
+flat dict to the coordinator over the existing comm serializer (the same
+pickle+LZ codec the data plane speaks; ``POST /coordinator/telemetry`` with
+an ``application/x-distar-serialized`` body). The coordinator's
+``TelemetryIngest`` folds each message into the shared ``TimeSeriesStore``
+as per-source series with last-seen/staleness tracking — one place that
+sees the whole fleet, which is what the rules engine (``obs/health.py``)
+evaluates.
+
+Both ends also work in-process (``ingest=`` instead of an address) so the
+all-in-one launcher and tests exercise the identical path minus the socket.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional, Tuple
+
+from .registry import MetricsRegistry, get_registry
+from .timeseries import TimeSeriesStore
+
+SERIALIZED_CONTENT_TYPE = "application/x-distar-serialized"
+
+
+class TelemetryIngest:
+    """Coordinator-side sink: fold shipped snapshots into the fleet store."""
+
+    def __init__(self, store: TimeSeriesStore, registry: Optional[MetricsRegistry] = None):
+        self.store = store
+        self._registry = registry
+
+    def ingest(self, msg: dict) -> int:
+        """Fold one shipped message ``{source, ts, snapshot, interval_s?}``
+        into per-source series; returns the number of scalars recorded."""
+        if not isinstance(msg, dict) or not isinstance(msg.get("snapshot"), dict):
+            raise ValueError("telemetry message must be {source, ts, snapshot}")
+        source = str(msg.get("source") or "unknown")
+        ts = float(msg.get("ts") or time.time())
+        n = self.store.record_snapshot(msg["snapshot"], ts=ts, source=source)
+        reg = self._registry or get_registry()
+        reg.counter(
+            "distar_telemetry_ingest_total", "shipped snapshots ingested", source=source
+        ).inc()
+        return n
+
+    def sources(self) -> dict:
+        return self.store.sources()
+
+
+class TelemetryShipper:
+    """Background thread pushing registry snapshots to the coordinator.
+
+    ``coordinator_addr=(host, port)`` ships over HTTP with the comm
+    serializer as the body codec; ``ingest=TelemetryIngest`` short-circuits
+    in-process. Shipping is best-effort: a dead broker counts an error and
+    the loop keeps going — telemetry must never take the fleet down with it.
+    """
+
+    def __init__(self, source: str,
+                 coordinator_addr: Optional[Tuple[str, int]] = None,
+                 ingest: Optional[TelemetryIngest] = None,
+                 interval_s: float = 5.0,
+                 registry: Optional[MetricsRegistry] = None,
+                 timeout_s: float = 5.0):
+        assert (coordinator_addr is None) != (ingest is None), \
+            "exactly one of coordinator_addr / ingest"
+        assert interval_s > 0
+        self.source = str(source)
+        self.interval_s = interval_s
+        self._addr = coordinator_addr
+        self._ingest = ingest
+        self._registry = registry
+        self._timeout_s = timeout_s
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------------- wire
+    def _message(self) -> dict:
+        reg = self._registry or get_registry()
+        return {
+            "source": self.source,
+            "ts": time.time(),
+            "interval_s": self.interval_s,
+            "snapshot": reg.snapshot(),
+        }
+
+    def ship_once(self) -> int:
+        """Snapshot + push one message; returns scalars shipped. Raises on
+        transport failure (the loop catches; direct callers see the error)."""
+        msg = self._message()
+        reg = self._registry or get_registry()
+        if self._ingest is not None:
+            n = self._ingest.ingest(msg)
+        else:
+            # lazy comm import: obs must stay importable without the comm
+            # package fully initialised (comm itself imports obs)
+            import urllib.request
+
+            from ..comm import serializer
+
+            host, port = self._addr
+            req = urllib.request.Request(
+                f"http://{host}:{port}/coordinator/telemetry",
+                data=serializer.dumps(msg),
+                headers={"Content-Type": SERIALIZED_CONTENT_TYPE},
+                method="POST",
+            )
+            with urllib.request.urlopen(req, timeout=self._timeout_s) as resp:
+                reply = resp.read()
+            import json
+
+            decoded = json.loads(reply)
+            if decoded.get("code") != 0:
+                raise RuntimeError(f"telemetry ingest rejected: {decoded!r}")
+            n = int(decoded.get("info") or 0)
+        reg.counter(
+            "distar_telemetry_ships_total", "snapshots shipped to the coordinator"
+        ).inc()
+        return n
+
+    # ---------------------------------------------------------------- control
+    def start(self) -> "TelemetryShipper":
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+
+        def run():
+            reg = self._registry or get_registry()
+            errors = reg.counter(
+                "distar_telemetry_ship_errors_total", "failed telemetry pushes"
+            )
+            while not self._stop.wait(self.interval_s):
+                try:
+                    self.ship_once()
+                except Exception:
+                    errors.inc()
+
+        self._thread = threading.Thread(target=run, daemon=True, name="obs-shipper")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None

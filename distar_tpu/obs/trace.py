@@ -79,6 +79,13 @@ def finish_trace(ctx: dict, hop: str = "end", registry: Optional[MetricsRegistry
     reg.histogram(
         "distar_trace_e2e_seconds", "end-to-end pipeline trace age", span=ctx["name"]
     ).observe(age)
+    # span completions land in the crash flight recorder's bounded ring —
+    # "what was the pipeline doing in the last minute" forensics
+    from .flightrecorder import get_flight_recorder
+
+    get_flight_recorder().record(
+        "span", name=ctx["name"], age_s=round(age, 4), hops=hop_names(ctx)
+    )
     return age
 
 

@@ -153,16 +153,27 @@ def _scatter_kernel(emb_ref, idx_ref, out_ref, *, n_entities: int):
     jax.lax.fori_loop(0, n_entities, body, 0)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
 def scatter_add_connection(
     embeddings: jnp.ndarray,  # [B, N, D] (invalid entities must be zeroed)
-    flat_idx: jnp.ndarray,  # [B, N] int32 cell index in [0, H*W)
+    flat_idx: jnp.ndarray,  # [B, N] int cell index (clipped to [0, H*W))
     hw: int,
     interpret: Optional[bool] = None,  # None: native on TPU, interpret elsewhere
 ) -> jnp.ndarray:
     """Per-batch scatter-add; returns [B, H*W, D]. Differentiable: the
     scatter-add's VJP w.r.t. embeddings is a plain gather of the output
-    cotangent at the same indices (XLA backward)."""
+    cotangent at the same indices (XLA backward).
+
+    Out-of-range indices are CLIPPED to [0, hw-1] here, in the public
+    wrapper — identical semantics to ``scatter_add_onehot`` by construction,
+    so switching ``impl`` strings can never silently change forward or
+    gradient behaviour (the kernels themselves used to disagree: ``pl.ds``
+    clamped where the one-hot matmul dropped)."""
+    flat_idx = jnp.clip(flat_idx.astype(jnp.int32), 0, hw - 1)
+    return _scatter_add_connection_core(embeddings, flat_idx, hw, interpret)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def _scatter_add_connection_core(embeddings, flat_idx, hw, interpret):
     return _scatter_add_fwd_kernel(embeddings, flat_idx, hw, interpret)
 
 
@@ -189,14 +200,14 @@ def _scatter_add_vjp_fwd(embeddings, flat_idx, hw, interpret):
 
 
 def _scatter_add_vjp_bwd(hw, interpret, flat_idx, dout):
-    # d(embeddings)[b, n] = dout[b, idx[b, n]]
+    # d(embeddings)[b, n] = dout[b, idx[b, n]] (idx pre-clipped by the wrapper)
     demb = jnp.take_along_axis(
         dout, flat_idx.astype(jnp.int32)[..., None].clip(0, hw - 1), axis=1
     )
     return demb, None
 
 
-scatter_add_connection.defvjp(_scatter_add_vjp_fwd, _scatter_add_vjp_bwd)
+_scatter_add_connection_core.defvjp(_scatter_add_vjp_fwd, _scatter_add_vjp_bwd)
 
 
 # ------------------------------------------------- scatter via one-hot matmul
@@ -217,20 +228,25 @@ def _scatter_onehot_kernel(emb_ref, idx_ref, out_ref, *, chunk: int):
     ).astype(out_ref.dtype)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
 def scatter_add_onehot(
     embeddings: jnp.ndarray,  # [B, N, D] (invalid entities must be zeroed)
-    flat_idx: jnp.ndarray,  # [B, N] int32 cell index in [0, H*W)
+    flat_idx: jnp.ndarray,  # [B, N] int cell index (clipped to [0, H*W))
     hw: int,
     interpret: Optional[bool] = None,
 ) -> jnp.ndarray:
     """Per-batch scatter-add as a chunked one-hot matmul ([B, hw, D]).
-    Same semantics as ``scatter_add_connection`` for in-range indices
-    (callers clip — ``scatter_connection`` does); out-of-range indices are
-    DROPPED here where the loop kernel's ``pl.ds`` clamps them (the
-    backward zeroes those entities' gradients to match). Trades
-    `2*N*hw*D` MXU FLOPs for the serial dynamic-row updates of the loop
-    kernel; gather backward."""
+    Out-of-range indices are CLIPPED to [0, hw-1] in this public wrapper —
+    the same clamp as ``scatter_add_connection``, so forward AND gradient
+    semantics are identical across ``impl`` strings (the raw one-hot kernel
+    would otherwise DROP out-of-range rows where the loop kernel clamps).
+    Trades `2*N*hw*D` MXU FLOPs for the serial dynamic-row updates of the
+    loop kernel; gather backward."""
+    flat_idx = jnp.clip(flat_idx.astype(jnp.int32), 0, hw - 1)
+    return _scatter_add_onehot_core(embeddings, flat_idx, hw, interpret)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def _scatter_add_onehot_core(embeddings, flat_idx, hw, interpret):
     return _scatter_onehot_fwd_kernel(embeddings, flat_idx, hw, interpret)
 
 
@@ -244,6 +260,10 @@ def _scatter_onehot_fwd_kernel(embeddings, flat_idx, hw, interpret):
     chunk = min(hw, 2048)
     if chunk % 128:
         chunk = -(-chunk // 128) * 128  # round up: one partially-used tile
+    # ...but never past hw itself: a small unaligned grid (hw=63 -> 128)
+    # would otherwise hand the kernel an out-of-bounds output block and rely
+    # on the backend's block padding for correctness
+    chunk = min(chunk, hw)
     grid = (B, -(-hw // chunk))
 
     return pl.pallas_call(
@@ -265,12 +285,13 @@ def _scatter_onehot_vjp_fwd(embeddings, flat_idx, hw, interpret):
 
 
 def _scatter_onehot_vjp_bwd(hw, interpret, flat_idx, dout):
-    # the one-hot forward DROPS out-of-range indices (no clamp), so their
-    # gradient must be zero — unlike the loop kernel's clamped backward
+    # indices reach the core pre-clipped by the public wrapper, so the
+    # gather backward matches the loop kernel's exactly; the in_range guard
+    # stays for direct core callers
     idx = flat_idx.astype(jnp.int32)
     in_range = (idx >= 0) & (idx < hw)
     demb = jnp.take_along_axis(dout, idx[..., None].clip(0, hw - 1), axis=1)
     return jnp.where(in_range[..., None], demb, 0), None
 
 
-scatter_add_onehot.defvjp(_scatter_onehot_vjp_fwd, _scatter_onehot_vjp_bwd)
+_scatter_add_onehot_core.defvjp(_scatter_onehot_vjp_fwd, _scatter_onehot_vjp_bwd)

@@ -16,6 +16,7 @@ Routes:
   POST /serve/swap    {version}
   POST /serve/status  {}
   GET  /metrics       Prometheus scrape (shared obs helper)
+  GET  /healthz /alerts /timeseries   fleet-health JSON (shared obs helper)
 """
 from __future__ import annotations
 
@@ -83,14 +84,16 @@ class ServeHTTPServer:
                 pass
 
             def do_GET(self):
-                if self.path.rstrip("/") != "/metrics":
-                    self.send_response(404)
-                    self.send_header("Content-Length", "0")
-                    self.end_headers()
-                    return
-                from ..obs import write_scrape_response
+                from ..obs import handle_health_get, write_scrape_response
 
-                write_scrape_response(self)
+                if self.path.rstrip("/") == "/metrics":
+                    write_scrape_response(self)
+                    return
+                if handle_health_get(self, self.path):
+                    return
+                self.send_response(404)
+                self.send_header("Content-Length", "0")
+                self.end_headers()
 
             def do_POST(self):
                 name = self.path.strip("/").split("/")[-1]

@@ -1,0 +1,383 @@
+"""Fleet health layer: TSDB ring store + windowed queries, rule state-machine
+debounce, telemetry shipping round-trip through the comm serializer, flight
+recorder crash bundles, and the /healthz /alerts /timeseries HTTP surfaces
+(the acceptance path: one injected learner stall + one injected NaN loss ->
+exactly one firing alert each via GET /alerts, then a simulated crash dumps
+a bundle carrying the alert history and a registry snapshot)."""
+import json
+import math
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from distar_tpu.obs import (
+    FleetHealth,
+    FlightRecorder,
+    HealthEvaluator,
+    HealthRule,
+    MetricsRegistry,
+    TelemetryIngest,
+    TelemetryShipper,
+    TimeSeriesStore,
+    default_rulebook,
+    set_flight_recorder,
+    set_fleet_health,
+    set_registry,
+)
+
+
+@pytest.fixture
+def registry():
+    reg = MetricsRegistry()
+    prev = set_registry(reg)
+    yield reg
+    set_registry(prev)
+
+
+@pytest.fixture
+def recorder():
+    rec = FlightRecorder()
+    prev = set_flight_recorder(rec)
+    yield rec
+    set_flight_recorder(prev)
+
+
+@pytest.fixture
+def fleet(registry, recorder):
+    """A process fleet-health handle with fast test cadences (not started —
+    tests drive sampling/evaluation deterministically unless they start it)."""
+    # short stall window: a stall is "no counter progress for ~window_s", so
+    # the test's injected stall becomes visible within a second
+    fh = FleetHealth(rules=default_rulebook(stall_window_s=0.6),
+                     registry=registry,
+                     sample_interval_s=0.05, eval_interval_s=0.05,
+                     recorder=recorder)
+    prev = set_fleet_health(fh)
+    yield fh
+    fh.stop()
+    set_fleet_health(prev)
+
+
+# ------------------------------------------------------------------- TSDB
+def test_ring_buffer_wraparound_and_windowed_queries():
+    store = TimeSeriesStore(points_per_series=4)
+    t0 = 1000.0
+    for i in range(10):  # 10 points into a 4-slot ring
+        store.record("distar_x_total", float(i), ts=t0 + i)
+    q = store.query("distar_x_total", window_s=100.0)
+    # wraparound: only the last 4 points survive (values 6..9)
+    assert q["count"] == 4
+    assert q["min"] == 6.0 and q["max"] == 9.0 and q["last"] == 9.0
+    assert q["mean"] == pytest.approx(7.5)
+    assert q["rate"] == pytest.approx(1.0)  # +1 per second
+    # the window filter excludes older points even inside the ring
+    q2 = store.query("distar_x_total", window_s=1.5)
+    assert q2["count"] == 2 and q2["min"] == 8.0
+    # unknown series -> None
+    assert store.query("nope") is None
+
+
+def test_store_window_stats_and_family_matching():
+    store = TimeSeriesStore()
+    t0 = 2000.0
+    for i, v in enumerate([5.0, 1.0, 3.0]):
+        store.record("distar_q_depth{token=a}", v, ts=t0 + i)
+    store.record("distar_q_depth{token=b}", 7.0, ts=t0)
+    store.record("distar_other", 1.0, ts=t0)
+    fam = store.matching_names("distar_q_depth")
+    assert fam == ["distar_q_depth{token=a}", "distar_q_depth{token=b}"]
+    q = store.query("distar_q_depth{token=a}", window_s=100.0)
+    assert (q["last"], q["min"], q["max"]) == (3.0, 1.0, 5.0)
+    # single-point series: no slope to compute
+    assert store.query("distar_q_depth{token=b}", window_s=100.0)["rate"] is None
+
+
+def test_store_series_cap_refuses_new_series_only():
+    store = TimeSeriesStore(points_per_series=8, max_series=2)
+    assert store.record("a", 1.0)
+    assert store.record("b", 1.0)
+    assert not store.record("c", 1.0)  # cap: new series refused
+    assert store.record("a", 2.0)  # existing series still accepts
+    assert store.stats()["dropped_series"] == 1
+
+
+# ----------------------------------------------------------- rules engine
+def _feed(store, name, values, t0=1000.0, dt=1.0):
+    for i, v in enumerate(values):
+        store.record(name, v, ts=t0 + i * dt)
+
+
+def test_rule_state_machine_debounce_nan_loss_and_stall(registry, recorder):
+    """Inject a NaN-loss gauge and a stalled step counter; each rule fires
+    exactly once (debounced), then recovers back to ok."""
+    store = TimeSeriesStore()
+    rules = [
+        HealthRule(name="loss_nan", metric="distar_learner_loss",
+                   op="nonfinite", for_count=2, clear_count=2),
+        # short window: a stall is "no progress for ~window_s" — the window
+        # must slide past the last advance before the rate can read 0
+        HealthRule(name="step_stall", metric="distar_learner_iterations_total",
+                   op="stalled", window_s=10.0, for_count=2, clear_count=2),
+    ]
+    ev = HealthEvaluator(store, rules, recorder=recorder, registry=registry)
+
+    # healthy history: finite loss, advancing counter
+    _feed(store, "distar_learner_loss", [0.5, 0.4, 0.3])
+    _feed(store, "distar_learner_iterations_total", [1, 2, 3])
+    ev.evaluate_once()
+    states = ev.alerts()["rules"]
+    assert states["loss_nan"]["state"] == "ok"
+    assert states["step_stall"]["state"] == "ok"
+
+    # inject: NaN loss + a counter that stopped moving long enough that the
+    # stall window holds only flat samples
+    _feed(store, "distar_learner_loss", [float("nan")], t0=1103.0)
+    _feed(store, "distar_learner_iterations_total", [3, 3, 3], t0=1100.0)
+    ev.evaluate_once()  # first breach: warning, debounce holds firing back
+    states = ev.alerts()["rules"]
+    assert states["loss_nan"]["state"] == "warning"
+    assert states["step_stall"]["state"] == "warning"
+    ev.evaluate_once()  # second consecutive breach: firing
+    ev.evaluate_once()  # still breached: NO second firing event
+    alerts = ev.alerts()
+    assert set(alerts["firing"]) == {"loss_nan", "step_stall"}
+    firing_events = [e for e in alerts["history"] if e["state"] == "firing"]
+    assert sorted(e["rule"] for e in firing_events) == ["loss_nan", "step_stall"]
+    assert alerts["rules"]["loss_nan"]["fired_count"] == 1
+    assert alerts["rules"]["step_stall"]["fired_count"] == 1
+    # NaN rule reports the offending value; stall reports the zero rate
+    assert math.isnan(alerts["rules"]["loss_nan"]["value"])
+    assert alerts["rules"]["step_stall"]["value"] == 0.0
+
+    # recovery: finite loss again, counter advancing again
+    _feed(store, "distar_learner_loss", [0.2, 0.2], t0=1110.0)
+    _feed(store, "distar_learner_iterations_total", [4, 5, 6], t0=1110.0)
+    ev.evaluate_once()
+    assert ev.alerts()["rules"]["loss_nan"]["state"] == "firing"  # clear debounce
+    ev.evaluate_once()
+    states = ev.alerts()["rules"]
+    assert states["loss_nan"]["state"] == "ok"
+    assert states["step_stall"]["state"] == "ok"
+    # alert transitions landed in the flight recorder ring
+    kinds = [e["kind"] for e in recorder.events()]
+    assert kinds.count("alert") == len(ev.alerts()["history"])
+
+
+def test_rule_no_data_is_not_a_breach(registry):
+    store = TimeSeriesStore()
+    ev = HealthEvaluator(store, [HealthRule(
+        name="r", metric="distar_never_registered", op="stalled")],
+        registry=registry)
+    ev.evaluate_once()
+    st = ev.alerts()["rules"]["r"]
+    assert st["state"] == "ok" and st["no_data"]
+
+
+def test_threshold_and_family_rules(registry):
+    """A labelled family breaches when ANY series breaches (worst wins)."""
+    store = TimeSeriesStore()
+    _feed(store, "distar_coordinator_queue_depth{token=a}", [10.0, 10.0])
+    _feed(store, "distar_coordinator_queue_depth{token=b}", [400.0, 401.0])
+    ev = HealthEvaluator(store, [HealthRule(
+        name="sat", metric="distar_coordinator_queue_depth",
+        agg="last", op=">=", threshold=384.0, for_count=1)],
+        registry=registry)
+    ev.evaluate_once()
+    st = ev.alerts()["rules"]["sat"]
+    assert st["state"] == "firing" and st["value"] == 401.0
+    assert st["series"].endswith("{token=b}")
+
+
+# ------------------------------------------------------ telemetry shipping
+def test_shipper_roundtrip_in_process(registry):
+    store = TimeSeriesStore()
+    ingest = TelemetryIngest(store, registry=registry)
+    registry.counter("distar_env_steps_total").inc(7)
+    ship = TelemetryShipper("actor:1", ingest=ingest, interval_s=99,
+                            registry=registry)
+    n = ship.ship_once()
+    assert n >= 1
+    q = store.query("distar_env_steps_total", source="actor:1", window_s=60.0)
+    assert q["last"] == 7.0
+    assert "actor:1" in store.sources()
+
+
+def test_shipper_roundtrip_through_serializer_and_coordinator(registry, fleet):
+    """The wire path: snapshot -> comm serializer -> POST /coordinator/telemetry
+    -> TelemetryIngest -> per-source series with last-seen tracking."""
+    from distar_tpu.comm import CoordinatorServer
+
+    registry.gauge("distar_dataloader_occupancy").set(5.0)
+    srv = CoordinatorServer()
+    srv.start()
+    try:
+        ship = TelemetryShipper(
+            "learner:MP0", coordinator_addr=(srv.host, srv.port),
+            interval_s=99, registry=registry,
+        )
+        n = ship.ship_once()
+        assert n >= 1
+        q = fleet.store.query("distar_dataloader_occupancy",
+                              source="learner:MP0", window_s=60.0)
+        assert q["last"] == 5.0
+        src = fleet.store.sources()["learner:MP0"]
+        assert src["age_s"] < 30.0
+        # ship counter ticked on the sender side
+        assert registry.snapshot()["distar_telemetry_ships_total"] == 1.0
+    finally:
+        srv.stop()
+
+
+# --------------------------------------------------------- flight recorder
+def test_flight_recorder_ring_and_bundle_on_exception(tmp_path, registry, recorder):
+    registry.counter("distar_env_steps_total").inc(3)
+    for i in range(600):  # overflow the default 512-slot ring
+        recorder.record("tick", i=i)
+    assert len(recorder.events()) == 512
+    assert recorder.events()[0]["i"] == 88  # oldest aged out
+
+    recorder.install_crash_hook(str(tmp_path), config={"exp": "t"},
+                                registry=registry, handle_sigterm=False)
+    try:
+        try:
+            raise ValueError("injected crash")
+        except ValueError:
+            # what the interpreter does on the way down for an unhandled
+            # exception — invoke the installed hook directly
+            hook, prev = sys.excepthook, recorder._prev_excepthook
+            recorder._prev_excepthook = lambda *a: None  # silence the chain
+            try:
+                hook(*sys.exc_info())
+            finally:
+                recorder._prev_excepthook = prev
+    finally:
+        recorder.uninstall_crash_hook()
+
+    assert recorder.last_dump_path is not None
+    with open(recorder.last_dump_path) as f:
+        bundle = json.load(f)
+    assert bundle["reason"] == "unhandled:ValueError"
+    assert bundle["config"] == {"exp": "t"}
+    assert bundle["registry_snapshot"]["distar_env_steps_total"] == 3.0
+    assert "python" in bundle["versions"]
+    crash = [e for e in bundle["events"] if e["kind"] == "crash"]
+    assert len(crash) == 1 and "injected crash" in crash[0]["traceback"]
+
+
+# ----------------------------------------------- HTTP surfaces (acceptance)
+def _get(host, port, path):
+    try:
+        with urllib.request.urlopen(f"http://{host}:{port}{path}", timeout=10) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def test_injected_stall_and_nan_fire_exactly_one_alert_each_via_http(
+        registry, recorder, fleet, tmp_path):
+    """ACCEPTANCE: an injected learner stall and an injected NaN loss each
+    produce exactly one firing alert visible via GET /alerts within one
+    evaluation interval; a simulated crash then writes a flight-recorder
+    bundle containing the alert history and a registry snapshot."""
+    from distar_tpu.comm import CoordinatorServer
+
+    srv = CoordinatorServer()
+    srv.start()
+    try:
+        # healthy phase: loss finite, iterations advancing
+        loss = registry.gauge("distar_learner_loss")
+        iters = registry.counter("distar_learner_iterations_total")
+        loss.set(0.5)
+        for _ in range(3):
+            iters.inc()
+            fleet.sampler.sample_once()
+            time.sleep(0.02)
+        fleet.start()  # background sampling + evaluation from here
+
+        # inject BOTH failures: loss goes NaN, the step counter stops
+        loss.set(float("nan"))
+        deadline = time.time() + 20
+        firing = []
+        while time.time() < deadline:
+            _status, alerts = _get(srv.host, srv.port, "/alerts")
+            firing = alerts["firing"]
+            if {"learner_loss_nonfinite", "learner_step_stall"} <= set(firing):
+                break
+            time.sleep(0.05)
+        assert {"learner_loss_nonfinite", "learner_step_stall"} <= set(firing)
+        # exactly ONE firing alert each — debounce holds, no re-fire per tick
+        time.sleep(0.3)  # several more evaluation intervals pass
+        _status, alerts = _get(srv.host, srv.port, "/alerts")
+        for rule in ("learner_loss_nonfinite", "learner_step_stall"):
+            assert alerts["rules"][rule]["fired_count"] == 1
+            events = [e for e in alerts["history"]
+                      if e["rule"] == rule and e["state"] == "firing"]
+            assert len(events) == 1
+
+        # /healthz: firing -> 503 with the failing rules listed
+        status, hz = _get(srv.host, srv.port, "/healthz")
+        assert status == 503 and hz["status"] == "firing"
+
+        # /timeseries serves the offending series' window
+        status, ts = _get(
+            srv.host, srv.port,
+            "/timeseries?name=distar_learner_loss&window_s=60")
+        assert status == 200 and ts["points"]["local"]
+
+        # simulated crash: the bundle carries alert history + snapshot
+        recorder.install_crash_hook(str(tmp_path), registry=registry,
+                                    handle_sigterm=False)
+        try:
+            try:
+                raise RuntimeError("simulated crash")
+            except RuntimeError:
+                prev = recorder._prev_excepthook
+                recorder._prev_excepthook = lambda *a: None
+                try:
+                    sys.excepthook(*sys.exc_info())
+                finally:
+                    recorder._prev_excepthook = prev
+        finally:
+            recorder.uninstall_crash_hook()
+        with open(recorder.last_dump_path) as f:
+            bundle = json.load(f)
+        alert_rules = {e.get("rule") for e in bundle["events"]
+                       if e["kind"] == "alert" and e.get("state") == "firing"}
+        assert {"learner_loss_nonfinite", "learner_step_stall"} <= alert_rules
+        assert "distar_learner_iterations_total" in bundle["registry_snapshot"]
+    finally:
+        srv.stop()
+
+
+def test_serve_frontend_answers_health_routes(registry, fleet):
+    """The serve HTTP frontend shares the same health surface."""
+    from distar_tpu.serve import InferenceGateway, MockModelEngine, ServeHTTPServer
+
+    gw = InferenceGateway(MockModelEngine(2), max_delay_s=0.001)
+    gw.start()
+    http = ServeHTTPServer(gw).start()
+    try:
+        fleet.sampler.sample_once()
+        status, hz = _get(http.host, http.port, "/healthz")
+        assert status == 200 and hz["status"] == "ok"
+        status, alerts = _get(http.host, http.port, "/alerts")
+        assert status == 200 and "rules" in alerts
+        status, err = _get(http.host, http.port, "/timeseries")
+        assert status == 400  # name is required
+    finally:
+        http.stop()
+        gw.drain_and_stop(5.0)
+
+
+def test_healthz_sources_staleness(registry, fleet):
+    fleet.stale_after_s = 0.05
+    fleet.ingest.ingest({"source": "actor:9", "ts": time.time() - 10.0,
+                         "snapshot": {"distar_env_steps_total": 1.0}})
+    hz = fleet.healthz()
+    assert hz["sources"]["actor:9"]["stale"] is True
+    fleet.ingest.ingest({"source": "actor:9", "ts": time.time(),
+                         "snapshot": {"distar_env_steps_total": 2.0}})
+    assert fleet.healthz()["sources"]["actor:9"]["stale"] is False

@@ -331,3 +331,36 @@ def test_no_bare_prints_in_library_code():
     spec.loader.exec_module(mod)
     offences = mod.find_bare_prints(os.path.join(root, "distar_tpu"))
     assert offences == [], f"bare print() in library code: {offences}"
+
+
+# ------------------------------------------------------- metric-name lint
+def test_metric_names_follow_convention_and_are_documented():
+    """Every metric registered in the tree matches distar_<subsystem>_<name>
+    and appears in the docs/observability.md metric table (lint_metric_names
+    mirrors lint_no_print: importable from tests, runnable standalone)."""
+    import importlib.util
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "lint_metric_names", os.path.join(root, "tools", "lint_metric_names.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    problems = mod.lint(
+        os.path.join(root, "distar_tpu"),
+        os.path.join(root, "docs", "observability.md"),
+    )
+    assert problems == [], "\n".join(problems)
+
+
+def test_prometheus_nonfinite_rendering(registry):
+    """Non-finite values render per the text format (NaN/+Inf/-Inf) —
+    repr() would emit 'nan'/'inf', which scrapers reject."""
+    registry.gauge("distar_a").set(float("nan"))
+    registry.gauge("distar_b").set(float("inf"))
+    registry.gauge("distar_c").set(float("-inf"))
+    text = render_prometheus(registry)
+    assert "distar_a NaN" in text
+    assert "distar_b +Inf" in text
+    assert "distar_c -Inf" in text
+    assert "nan" not in text and "inf" not in text  # no repr() leakage

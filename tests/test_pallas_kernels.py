@@ -95,19 +95,30 @@ def test_scatter_onehot_matches_loop_variant(rng):
     np.testing.assert_allclose(np.asarray(g1), np.asarray(g2), rtol=1e-5, atol=1e-5)
 
 
-def test_scatter_onehot_oob_dropped_fwd_and_bwd(rng):
-    """Out-of-range indices: the one-hot forward drops them, so their
-    entities must also get ZERO gradient (not the clamped cell's)."""
+def test_scatter_oob_clipped_identically_in_both_wrappers(rng):
+    """Out-of-range indices are clipped to [0, hw-1] in BOTH public wrappers:
+    switching impl strings can never silently change forward or gradient
+    semantics (the raw one-hot kernel would drop what the loop kernel
+    clamps — the wrappers unify on clamp)."""
     from distar_tpu.ops.pallas_kernels import scatter_add_onehot
 
     B, N, D, hw = 1, 4, 2, 8
-    emb = jnp.ones((B, N, D))
-    flat = jnp.asarray([[0, 3, hw, hw + 5]], jnp.int32)  # last two OOB
-    out = scatter_add_onehot(emb, flat, hw, interpret=True)
-    np.testing.assert_allclose(np.asarray(out).sum(), 4.0)  # 2 entities x D
-    g = jax.grad(lambda e: jnp.sum(scatter_add_onehot(e, flat, hw, True) ** 2))(emb)
-    assert float(jnp.abs(g[0, 2:]).sum()) == 0.0  # OOB entities: zero grad
-    assert float(jnp.abs(g[0, :2]).sum()) > 0.0
+    emb = jnp.asarray(rng.standard_normal((B, N, D)).astype(np.float32))
+    flat = jnp.asarray([[0, 3, -2, hw + 5]], jnp.int32)  # last two OOB
+    out_loop = scatter_add_connection(emb, flat, hw, interpret=True)
+    out_onehot = scatter_add_onehot(emb, flat, hw, interpret=True)
+    np.testing.assert_allclose(np.asarray(out_loop), np.asarray(out_onehot),
+                               rtol=1e-5, atol=1e-5)
+    # clamp semantics: the OOB entities landed on cells 0 and hw-1
+    np.testing.assert_allclose(np.asarray(out_loop[0, 0]),
+                               np.asarray(emb[0, 0] + emb[0, 2]), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(out_loop[0, hw - 1]),
+                               np.asarray(emb[0, 3]), rtol=1e-5)
+    # gradients agree too, and flow THROUGH the clamped cells (not zeroed)
+    g1 = jax.grad(lambda e: jnp.sum(scatter_add_onehot(e, flat, hw, True) ** 2))(emb)
+    g2 = jax.grad(lambda e: jnp.sum(scatter_add_connection(e, flat, hw, True) ** 2))(emb)
+    np.testing.assert_allclose(np.asarray(g1), np.asarray(g2), rtol=1e-5, atol=1e-5)
+    assert float(jnp.abs(g1[0, 2:]).sum()) > 0.0  # clamped, so grads flow
 
 
 def test_scatter_impl_switch_onehot(rng):

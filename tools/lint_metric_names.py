@@ -1,0 +1,141 @@
+"""Metric-name lint: every registered metric follows the naming convention
+and is documented.
+
+Walks ``distar_tpu/**.py`` for ``.counter( / .gauge( / .histogram(`` calls
+and checks every string-literal metric name against the
+``distar_<subsystem>_<name>[_<unit>]`` convention (docs/observability.md)
+AND against the metric table in docs/observability.md — an undocumented
+metric is invisible to operators, which defeats the registry. Dynamically
+named registrations (f-strings) must be declared in ``DYNAMIC_ALLOW`` with
+the names they can produce, so new dynamic families can't dodge the lint.
+
+Invoked from the test suite (tests/test_obs_metrics.py) and runnable
+standalone: ``python tools/lint_metric_names.py``.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+import sys
+from typing import Dict, List, Set, Tuple
+
+NAME_RE = re.compile(r"^distar_[a-z][a-z0-9_]*$")
+REGISTER_METHODS = ("counter", "gauge", "histogram")
+
+# files allowed to register dynamically-built names, with every name their
+# dynamic path can produce (which must itself be documented)
+DYNAMIC_ALLOW: Dict[str, List[str]] = {
+    os.path.join("utils", "timing.py"): ["distar_stopwatch_seconds"],
+}
+
+SKIP_DIRS = {"__pycache__", "_proto_gen"}
+
+
+def _doc_metric_names(docs_path: str) -> Set[str]:
+    """Backticked metric names in docs/observability.md (the metric table +
+    prose both count — operators read the whole page)."""
+    with open(docs_path) as f:
+        text = f.read()
+    names = set()
+    for token in re.findall(r"`([^`\n]+)`", text):
+        m = re.match(r"(distar_[a-z0-9_]+)", token)
+        if m:
+            names.add(m.group(1))
+    return names
+
+
+def find_registrations(pkg_root: str) -> Tuple[List[tuple], List[tuple]]:
+    """Returns (literal, dynamic) registration sites:
+    literal: (relpath, lineno, name); dynamic: (relpath, lineno)."""
+    literal, dynamic = [], []
+    for dirpath, dirnames, filenames in os.walk(pkg_root):
+        dirnames[:] = [d for d in dirnames if d not in SKIP_DIRS]
+        for fn in sorted(filenames):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            relpath = os.path.relpath(path, pkg_root)
+            with open(path, "rb") as f:
+                try:
+                    tree = ast.parse(f.read())
+                except SyntaxError:
+                    continue
+            for node in ast.walk(tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                func = node.func
+                if not (isinstance(func, ast.Attribute) and func.attr in REGISTER_METHODS):
+                    continue
+                if not node.args:
+                    continue  # registry-internal plumbing, not a registration
+                first = node.args[0]
+                if isinstance(first, ast.Constant) and isinstance(first.value, str):
+                    literal.append((relpath, node.lineno, first.value))
+                else:
+                    dynamic.append((relpath, node.lineno))
+    return literal, dynamic
+
+
+def lint(pkg_root: str, docs_path: str) -> List[str]:
+    problems: List[str] = []
+    documented = _doc_metric_names(docs_path)
+    literal, dynamic = find_registrations(pkg_root)
+    for relpath, lineno, name in literal:
+        if not NAME_RE.match(name):
+            problems.append(
+                f"{relpath}:{lineno}: metric {name!r} violates the "
+                f"distar_<subsystem>_<name> convention"
+            )
+        elif name not in documented:
+            problems.append(
+                f"{relpath}:{lineno}: metric {name!r} missing from the "
+                f"docs/observability.md metric table"
+            )
+    for relpath, lineno in dynamic:
+        allowed = DYNAMIC_ALLOW.get(relpath)
+        if allowed is None:
+            problems.append(
+                f"{relpath}:{lineno}: dynamically-named metric registration — "
+                f"declare its names in tools/lint_metric_names.py DYNAMIC_ALLOW"
+            )
+            continue
+        for name in allowed:
+            if name not in documented:
+                problems.append(
+                    f"{relpath}:{lineno}: dynamic metric {name!r} missing from "
+                    f"the docs/observability.md metric table"
+                )
+    return problems
+
+
+def registered_names(pkg_root: str) -> Set[str]:
+    """Every statically-known metric name in the tree (for doc generation)."""
+    literal, _dynamic = find_registrations(pkg_root)
+    names = {name for (_p, _l, name) in literal}
+    for extra in DYNAMIC_ALLOW.values():
+        names.update(extra)
+    return names
+
+
+def main() -> int:
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    pkg_root = os.path.join(repo, "distar_tpu")
+    docs_path = os.path.join(repo, "docs", "observability.md")
+    problems = lint(pkg_root, docs_path)
+    for p in problems:
+        sys.stderr.write(p + "\n")
+    if problems:
+        sys.stderr.write(
+            f"{len(problems)} offence(s); metric names must match "
+            "distar_<subsystem>_<name> and appear in docs/observability.md\n"
+        )
+        return 1
+    if "--list" in sys.argv:
+        for name in sorted(registered_names(pkg_root)):
+            sys.stdout.write(name + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

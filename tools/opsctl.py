@@ -1,0 +1,154 @@
+#!/usr/bin/env python
+"""opsctl: fleet-health CLI over the /healthz, /alerts, /timeseries routes.
+
+Any process serving the obs health surfaces answers (coordinator broker,
+serve HTTP frontend, or a training role started with --metrics-port):
+
+  python tools/opsctl.py status       --addr 127.0.0.1:8423
+  python tools/opsctl.py tail-alerts  --addr 127.0.0.1:8423 [--interval 2]
+  python tools/opsctl.py query        --addr 127.0.0.1:8423 \\
+        --name distar_learner_step_seconds_p50 [--window 300] [--source local]
+
+``status`` exits 0 when healthy, 1 when any rule is warning, 2 when firing —
+scriptable for cron probes. ``tail-alerts`` follows the transition history
+(one line per ok/warning/firing edge, deduped by event sequence).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+import urllib.error
+import urllib.parse
+import urllib.request
+
+
+def _get(addr: str, path: str, timeout: float = 10.0) -> dict:
+    url = f"http://{addr}{path}"
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as resp:
+            return json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        # /healthz answers 503 while firing — that body is still the payload
+        try:
+            return json.loads(e.read())
+        except Exception:
+            raise SystemExit(f"GET {url} -> HTTP {e.code}")
+    except OSError as e:
+        raise SystemExit(f"GET {url} failed: {e}")
+
+
+def _fmt_ts(ts) -> str:
+    try:
+        return time.strftime("%H:%M:%S", time.localtime(float(ts)))
+    except (TypeError, ValueError):
+        return "--:--:--"
+
+
+def cmd_status(args) -> int:
+    body = _get(args.addr, "/healthz")
+    status = body.get("status", "unknown")
+    print(f"status: {status}   (started={body.get('started')})")
+    rules = body.get("rules", {})
+    if rules:
+        width = max(len(n) for n in rules)
+        for name in sorted(rules):
+            print(f"  {name:<{width}}  {rules[name]}")
+    sources = body.get("sources", {})
+    if sources:
+        print("sources:")
+        for name in sorted(sources):
+            s = sources[name]
+            stale = "  STALE" if s.get("stale") else ""
+            print(f"  {name:<24} age={s.get('age_s', 0):7.1f}s "
+                  f"series={s.get('series', 0)}{stale}")
+    tsdb = body.get("tsdb", {})
+    if tsdb:
+        print(f"tsdb: {tsdb.get('series')} series "
+              f"(cap {tsdb.get('max_series')} x {tsdb.get('points_per_series')} pts, "
+              f"{tsdb.get('dropped_series')} dropped)")
+    return {"ok": 0, "warning": 1}.get(status, 2)
+
+
+def _print_event(e: dict) -> None:
+    print(f"{_fmt_ts(e.get('ts'))}  {e.get('state', '?'):<8} {e.get('rule', '?')}  "
+          f"value={e.get('value')}  series={e.get('series')}  "
+          f"[{e.get('severity', '')}] {e.get('summary', '')}")
+
+
+def cmd_tail_alerts(args) -> int:
+    seen = -1
+    try:
+        while True:
+            body = _get(args.addr, "/alerts")
+            history = body.get("history", [])
+            # the evaluator doesn't stamp seq; dedupe on (ts, rule, state)
+            fresh = [e for i, e in enumerate(history) if i > seen or args.once]
+            if seen < 0 and not args.once:
+                # first poll: show current context, then follow
+                for e in history[-10:]:
+                    _print_event(e)
+            else:
+                for e in fresh:
+                    _print_event(e)
+            seen = len(history) - 1
+            firing = body.get("firing", [])
+            if args.once:
+                if firing:
+                    print(f"firing: {', '.join(firing)}")
+                return 2 if firing else 0
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
+
+
+def cmd_query(args) -> int:
+    path = f"/timeseries?name={urllib.parse.quote(args.name)}&window_s={args.window}"
+    if args.source:
+        path += f"&source={urllib.parse.quote(args.source)}"
+    body = _get(args.addr, path)
+    stats = body.get("stats") or {}
+    points = body.get("points") or {}
+    if not points:
+        print(f"no data for {args.name!r} in the last {args.window}s")
+        return 1
+    if args.json:
+        print(json.dumps(body, indent=1))
+        return 0
+    for source in sorted(points):
+        st = stats.get(source) or {}
+        print(f"{args.name} @ {source}: n={st.get('count')} last={st.get('last')} "
+              f"mean={st.get('mean')} min={st.get('min')} max={st.get('max')} "
+              f"rate={st.get('rate')}")
+        for ts, v in points[source][-args.tail:]:
+            print(f"  {_fmt_ts(ts)}  {v}")
+    return 0
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(description=__doc__,
+                                formatter_class=argparse.RawDescriptionHelpFormatter)
+    p.add_argument("command", choices=("status", "tail-alerts", "query"))
+    p.add_argument("--addr", default="127.0.0.1:8423", help="host:port of a health surface")
+    p.add_argument("--interval", type=float, default=2.0, help="tail-alerts poll cadence")
+    p.add_argument("--once", action="store_true",
+                   help="tail-alerts: print the history once and exit "
+                        "(exit 2 when anything is firing)")
+    p.add_argument("--name", default="", help="query: flattened series name")
+    p.add_argument("--window", type=float, default=300.0, help="query window seconds")
+    p.add_argument("--source", default="", help="query: restrict to one source")
+    p.add_argument("--tail", type=int, default=10, help="query: points to print per source")
+    p.add_argument("--json", action="store_true", help="query: raw JSON output")
+    args = p.parse_args()
+    if args.command == "status":
+        return cmd_status(args)
+    if args.command == "tail-alerts":
+        return cmd_tail_alerts(args)
+    if not args.name:
+        p.error("query requires --name")
+    return cmd_query(args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
