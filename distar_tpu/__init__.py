@@ -15,6 +15,8 @@ Layer map (mirrors reference layers, see SURVEY.md §1):
   distar_tpu.serve     inference gateway: micro-batching, sticky sessions,
                        versioned hot-swap registry, HTTP/TCP frontends
   distar_tpu.obs       metrics registry, exporters, trace spans, profiler
+  distar_tpu.resilience retry/backoff fabric, circuit breakers, role
+                       supervision + crash-resume, chaos injection
   distar_tpu.model     Flax policy/value network (encoders, LSTM core, heads)
   distar_tpu.ops       TPU compute primitives (pallas kernels, scan RNN, rl ops)
   distar_tpu.losses    RL and SL losses as pure jnp functions

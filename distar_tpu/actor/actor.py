@@ -411,7 +411,19 @@ class Actor:
                 }
             results.append(result)
             if self.league is not None:
-                self.league.actor_send_result(result)
+                from ..resilience import CommError
+
+                try:
+                    self.league.actor_send_result(result)
+                except CommError as e:
+                    # result delivery already retried inside RemoteLeague;
+                    # losing one matchmaking sample must not kill the job
+                    # loop mid-episode — log and keep rolling
+                    logging.warning(f"actor: result delivery dropped: {e}")
+                    get_registry().counter(
+                        "distar_actor_result_send_failures_total",
+                        "league result deliveries dropped after retries",
+                    ).inc()
             reset_slot(e)
 
         for e in range(n_env):

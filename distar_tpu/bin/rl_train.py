@@ -15,7 +15,6 @@ from __future__ import annotations
 
 import argparse
 import os
-import threading
 import time
 
 from ..actor import Actor
@@ -24,6 +23,7 @@ from ..envs import MockEnv
 from ..league import League, LeagueAPIServer
 from .. import plugins
 from ..learner.rl_dataloader import RLDataLoader
+from ..resilience import AlertRemediator, RestartPolicy, Supervisor, supervise_call
 from ..utils import read_config
 
 SMOKE_MODEL = {
@@ -120,6 +120,33 @@ def _init_health(args, roles, source="local", shipper_addr=None):
     return fleet
 
 
+def _restart_policy(args) -> RestartPolicy:
+    return RestartPolicy(
+        max_restarts=getattr(args, "restart_max", 5),
+        window_s=getattr(args, "restart_window_s", 300.0),
+    )
+
+
+def _run_learner_supervised(args, learner, iters) -> None:
+    """Foreground crash-resume for the learner role: a crash restores from
+    the durable ``latest`` pointer (corrupt newest generation falls back a
+    checkpoint) and re-enters the run loop, bounded by the restart budget.
+    The final failure still dies loudly (flight bundle + raise)."""
+    if getattr(args, "no_supervise", False):
+        learner.run(max_iterations=iters)
+        return
+
+    def resume(error):
+        path = learner.resume_latest()
+        print(f"learner restart after {error!r}: "
+              f"resume={path or 'cold'} iter={learner.last_iter.val}", flush=True)
+
+    supervise_call(
+        lambda: learner.run(max_iterations=iters),
+        op="learner", policy=_restart_policy(args), on_restart=resume,
+    )
+
+
 def _maybe_serve_metrics(args, coordinator=None):
     """Start an HTTP server exposing GET /metrics for this process's registry
     when --metrics-port is given (CoordinatorServer doubles as the exporter;
@@ -141,7 +168,7 @@ def run_all(args) -> None:
     league = League(user_cfg)
     co = Coordinator()
     # one process hosts every role, so the full rulebook applies locally
-    _init_health(args, roles=("learner", "actor", "coordinator", "trace"))
+    fleet = _init_health(args, roles=("learner", "actor", "coordinator", "trace"))
     _maybe_serve_metrics(args, coordinator=co)
     actor_adapter = Adapter(coordinator=co)
     learner_adapter = Adapter(coordinator=co)
@@ -156,25 +183,30 @@ def run_all(args) -> None:
         env_fn=_env_fn(args),
     )
 
-    stop = threading.Event()
+    supervisor = Supervisor(policy=_restart_policy(args))
 
-    def actor_loop():
-        while not stop.is_set():
+    def actor_loop(ctx):
+        while not ctx.should_exit:
             actor.run_job(episodes=1)
 
-    t = threading.Thread(target=actor_loop, daemon=True)
-    t.start()
+    supervisor.add("actor", actor_loop)
+    supervisor.start()
+    if fleet is not None and not getattr(args, "no_supervise", False):
+        # detect -> remediate: a firing env-starvation alert bounces the
+        # actor loop instead of waiting for a human
+        AlertRemediator(
+            supervisor, {"actor_env_starvation": "actor"}
+        ).attach(fleet.evaluator)
 
     learner = plugins.load_component(args.pipeline, "RLLearner")(
         _learner_cfg(args, model_cfg))
     learner.set_dataloader(RLDataLoader(learner_adapter, player_id, args.batch_size))
     learner.attach_comm(learner_adapter, player_id, league=league,
                         send_model_freq=4, send_train_info_freq=4)
-    learner.run(max_iterations=args.iters)
-    stop.set()
+    _run_learner_supervised(args, learner, args.iters)
     # let the actor finish its in-flight job: a daemon thread killed inside a
     # jitted computation aborts the interpreter teardown
-    t.join(timeout=120)
+    supervisor.stop(timeout=120)
     print(
         f"rl_train done: {learner.last_iter.val} iters, "
         f"loss={learner.variable_record.get('total_loss').avg:.4f}, "
@@ -221,9 +253,13 @@ def run_learner(args) -> None:
             load_path = ckpt
     learner = plugins.load_component(args.pipeline, "RLLearner")(
         _learner_cfg(args, model_cfg, load_path=load_path))
+    if not load_path and not getattr(args, "no_supervise", False):
+        # a restarted learner process (k8s/systemd) picks up its own durable
+        # latest pointer before cold-starting — zero manual intervention
+        learner.resume_latest()
     learner.set_dataloader(RLDataLoader(adapter, args.player_id, args.batch_size))
     learner.attach_comm(adapter, args.player_id, league=league)
-    learner.run(max_iterations=args.iters)
+    _run_learner_supervised(args, learner, args.iters)
     print(f"learner done: {learner.last_iter.val} iters")
 
 
@@ -246,8 +282,17 @@ def run_actor(args) -> None:
         model_cfg=model_cfg,
         env_fn=_env_fn(args),
     )
-    while True:
-        actor.run_job(episodes=1)
+
+    def job_loop():
+        while True:
+            actor.run_job(episodes=1)
+
+    if getattr(args, "no_supervise", False):
+        job_loop()
+    else:
+        # a crashed job loop (league blip, env death) restarts with backoff
+        # instead of retiring the whole actor host
+        supervise_call(job_loop, op="actor", policy=_restart_policy(args))
 
 
 def main() -> None:
@@ -278,6 +323,24 @@ def main() -> None:
     p.add_argument("--telemetry-interval-s", type=float, default=5.0,
                    help="snapshot shipping cadence to the coordinator "
                         "(learner/actor roles)")
+    p.add_argument("--no-supervise", action="store_true",
+                   help="disable the resilience layer's role supervision "
+                        "(crash-restart of actor loops, learner auto-resume "
+                        "from the latest checkpoint pointer)")
+    p.add_argument("--restart-max", type=int, default=5,
+                   help="restart budget per role within --restart-window-s")
+    p.add_argument("--restart-window-s", type=float, default=300.0,
+                   help="sliding window for the restart budget")
+    p.add_argument("--league-resume", default="",
+                   help="league role: resume-journal path; loaded on start "
+                        "when present, then autosaved periodically")
+    p.add_argument("--league-autosave-s", type=float, default=30.0,
+                   help="league resume-journal cadence (0 = league config "
+                        "save_resume_freq_s)")
+    p.add_argument("--lease-s", type=float, default=0.0,
+                   help="coordinator role: lease TTL for registrations; "
+                        "endpoints that stop heartbeating are evicted "
+                        "(0 = leases disabled)")
     p.add_argument("--league-addr", default="", help="host:port of the league server")
     p.add_argument("--coordinator-addr", default="", help="host:port of the coordinator")
     p.add_argument("--player-id", default="MP0")
@@ -315,8 +378,15 @@ def main() -> None:
     if args.type == "all":
         run_all(args)
     elif args.type == "league":
-        server = LeagueAPIServer(League(read_config(args.config) if args.config else {}),
-                                 port=args.port)
+        league = League(read_config(args.config) if args.config else {})
+        if args.league_resume:
+            # pick the league up where the last journal left it — a broker
+            # restart must not reset all payoff/ELO state
+            if os.path.exists(args.league_resume):
+                league.load_resume(args.league_resume)
+            league.start_autosave(args.league_resume,
+                                  interval_s=args.league_autosave_s or None)
+        server = LeagueAPIServer(league, port=args.port)
         server.start()
         print(f"league serving on {server.host}:{server.port}", flush=True)
         while True:
@@ -326,7 +396,10 @@ def main() -> None:
         # per-source learner/actor/serve series for the whole fleet
         _init_health(args, roles=("learner", "actor", "coordinator", "trace", "serve"),
                      source="coordinator")
-        server = CoordinatorServer(port=args.port)
+        server = CoordinatorServer(
+            coordinator=Coordinator(default_lease_s=args.lease_s or None),
+            port=args.port,
+        )
         server.start()
         print(f"coordinator serving on {server.host}:{server.port}", flush=True)
         while True:

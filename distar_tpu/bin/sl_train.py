@@ -18,7 +18,7 @@ import argparse
 
 from .. import plugins
 from ..utils import read_config
-from .rl_train import _addr, _init_health
+from .rl_train import _addr, _init_health, _restart_policy, _run_learner_supervised
 
 
 def _learner(args) -> None:
@@ -90,7 +90,10 @@ def _learner(args) -> None:
 
         learner.hooks.add(LambdaHook("holdout_eval", "after_iter", _eval,
                                      freq=eval_freq))
-    learner.run(max_iterations=args.iters)
+    if not getattr(args, "no_supervise", False):
+        # restarted SL learner processes resume from their durable pointer
+        learner.resume_latest()
+    _run_learner_supervised(args, learner, args.iters)
     print(
         f"sl_train done: {learner.last_iter.val} iters, "
         f"loss={learner.variable_record.get('total_loss').avg:.4f}, "
@@ -110,13 +113,22 @@ def _replay_actor(args) -> None:
                  shipper_addr=_addr(args.coordinator_addr))
     decoder_cls = plugins.load_component(args.pipeline, "ReplayDecoder")
     coordinator = _addr(args.coordinator_addr)
-    ReplayActor(
-        replays=args.replays,
-        adapter_factory=lambda: Adapter(coordinator_addr=coordinator),
-        decoder_factory=lambda: decoder_cls(cfg={}),
-        num_workers=args.num_workers,
-        epochs=args.epochs,
-    ).run()
+
+    def run_actor():
+        ReplayActor(
+            replays=args.replays,
+            adapter_factory=lambda: Adapter(coordinator_addr=coordinator),
+            decoder_factory=lambda: decoder_cls(cfg={}),
+            num_workers=args.num_workers,
+            epochs=args.epochs,
+        ).run()
+
+    if getattr(args, "no_supervise", False):
+        run_actor()
+    else:
+        from ..resilience import supervise_call
+
+        supervise_call(run_actor, op="replay_actor", policy=_restart_policy(args))
 
 
 def _coordinator(args) -> None:
@@ -166,6 +178,13 @@ def main() -> None:
     p.add_argument("--no-health", action="store_true",
                    help="disable the fleet-health subsystem (watchdog rules, "
                         "telemetry shipping, crash recorder)")
+    p.add_argument("--no-supervise", action="store_true",
+                   help="disable crash-restart supervision and learner "
+                        "auto-resume from the latest checkpoint pointer")
+    p.add_argument("--restart-max", type=int, default=5,
+                   help="restart budget per role within --restart-window-s")
+    p.add_argument("--restart-window-s", type=float, default=300.0,
+                   help="sliding window for the restart budget")
     p.add_argument("--num-workers", type=int, default=1)
     p.add_argument("--epochs", type=int, default=1)
     p.add_argument("--platform", default="auto", choices=("auto", "cpu", "tpu"),

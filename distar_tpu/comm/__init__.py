@@ -2,9 +2,11 @@ from .adapter import Adapter
 from .coordinator import Coordinator, CoordinatorServer, coordinator_request
 from .serializer import dumps, loads
 from . import shuttle
+from ..resilience import CommError  # typed transport error raised by this package
 
 __all__ = [
     "Adapter",
+    "CommError",
     "Coordinator",
     "CoordinatorServer",
     "coordinator_request",

@@ -18,6 +18,14 @@ from . import shuttle
 from .coordinator import Coordinator, coordinator_request
 from .serializer import dumps, loads
 from ..obs import finish_trace, mark_hop, unwrap_payload, wrap_payload
+from ..resilience import RetryPolicy, retry_call
+
+# one extra attempt before a fetch failure strikes the endpoint: a listen
+# backlog burst / transient RST shouldn't count toward producer death
+_FETCH_POLICY = RetryPolicy(max_attempts=2, backoff_base_s=0.05, backoff_max_s=0.2)
+# a serve window is local resource allocation (bind/listen): brief ephemeral
+# port exhaustion is transient, anything else fails fast
+_SERVE_POLICY = RetryPolicy(max_attempts=3, backoff_base_s=0.1, backoff_max_s=1.0)
 
 
 class Adapter:
@@ -27,14 +35,21 @@ class Adapter:
         coordinator_addr: Optional[tuple] = None,
         my_ip: str = "127.0.0.1",
         compress: bool = True,
+        lease_s: Optional[float] = None,
+        request_policy: Optional[RetryPolicy] = None,
     ):
         """Either a local Coordinator object (in-process wiring) or
-        (host, port) of a CoordinatorServer."""
+        (host, port) of a CoordinatorServer. ``lease_s`` attaches a lease
+        TTL to every registration (heartbeat to keep alive); a None
+        ``request_policy`` uses the resilience default (broker RPCs retry
+        through a restart)."""
         assert (coordinator is None) != (coordinator_addr is None)
         self._co = coordinator
         self._co_addr = coordinator_addr
         self._my_ip = my_ip
         self._compress = compress
+        self._lease_s = lease_s
+        self._policy = request_policy
         self._caches: dict = {}
         self._pull_threads: dict = {}
         self._stop = threading.Event()
@@ -42,22 +57,41 @@ class Adapter:
     # -------------------------------------------------------------- plumbing
     def _register(self, token: str, port: int) -> None:
         if self._co is not None:
-            self._co.register(token, self._my_ip, port)
+            self._co.register(token, self._my_ip, port, lease_s=self._lease_s)
         else:
-            coordinator_request(
-                *self._co_addr, "register", {"token": token, "ip": self._my_ip, "port": port}
-            )
+            body = {"token": token, "ip": self._my_ip, "port": port}
+            if self._lease_s is not None:
+                body["lease_s"] = self._lease_s
+            coordinator_request(*self._co_addr, "register", body, policy=self._policy)
 
     def _ask(self, token: str) -> Optional[dict]:
         if self._co is not None:
             return self._co.ask(token)
-        return coordinator_request(*self._co_addr, "ask", {"token": token})["info"]
+        return coordinator_request(
+            *self._co_addr, "ask", {"token": token}, policy=self._policy
+        )["info"]
 
     def _strike(self, ip: str, port: int) -> None:
         if self._co is not None:
             self._co.strike(ip, port)
         else:
-            coordinator_request(*self._co_addr, "strike", {"ip": ip, "port": port})
+            coordinator_request(
+                *self._co_addr, "strike", {"ip": ip, "port": port}, policy=self._policy
+            )
+
+    def heartbeat(self, port: int) -> bool:
+        """Refresh this endpoint's lease on the broker; False means the
+        broker no longer knows us (restart/eviction) — re-register."""
+        if self._co is not None:
+            return self._co.heartbeat(self._my_ip, port, lease_s=self._lease_s)
+        body = {"ip": self._my_ip, "port": port}
+        if self._lease_s is not None:
+            body["lease_s"] = self._lease_s
+        return bool(
+            coordinator_request(
+                *self._co_addr, "heartbeat", body, policy=self._policy
+            )["info"]
+        )
 
     # ------------------------------------------------------------------- api
     def push(
@@ -76,7 +110,10 @@ class Adapter:
         if trace is not None:
             mark_hop(trace, "adapter_push")
         blob = dumps(wrap_payload(data, trace), compress=self._compress)
-        port = shuttle.serve(blob, accept_count=accept_count, timeout_ms=timeout_ms)
+        port = retry_call(
+            shuttle.serve, blob, accept_count=accept_count, timeout_ms=timeout_ms,
+            op="shuttle_serve", policy=_SERVE_POLICY,
+        )
         self._register(token, port)
         return port
 
@@ -97,7 +134,11 @@ class Adapter:
             rec = self._ask(token)
             if rec is not None:
                 try:
-                    blob = shuttle.fetch(rec["ip"], rec["port"], timeout_ms=int(timeout * 1000))
+                    blob = retry_call(
+                        shuttle.fetch, rec["ip"], rec["port"],
+                        timeout_ms=int(timeout * 1000),
+                        op="shuttle_fetch", policy=_FETCH_POLICY,
+                    )
                 except (OSError, ConnectionError):
                     self._strike(rec["ip"], rec["port"])
                     continue

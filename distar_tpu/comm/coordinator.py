@@ -20,26 +20,92 @@ from ..utils import Config
 
 
 class Coordinator:
-    def __init__(self, maxlen_per_token: int = 512, max_age_s: Optional[float] = None):
+    def __init__(self, maxlen_per_token: int = 512, max_age_s: Optional[float] = None,
+                 default_lease_s: Optional[float] = None):
         """``max_age_s``: default serve-window age filter applied by BOTH
         ``depth()`` and ``stats()`` (records older than the producers' serve
-        window are loss, not backlog). None = no filtering."""
+        window are loss, not backlog). None = no filtering.
+        ``default_lease_s``: lease TTL applied to every registration that
+        doesn't pass its own ``lease_s`` — endpoints that stop heartbeating
+        are evicted wholesale (the liveness complement to per-fetch strikes).
+        None = registrations never expire by lease."""
         self._maxlen = maxlen_per_token
         self._max_age_s = max_age_s
+        self._default_lease_s = default_lease_s
         self._records: Dict[str, deque] = defaultdict(lambda: deque(maxlen=self._maxlen))
         self._strikes: Dict[str, int] = defaultdict(int)
+        self._leases: Dict[str, float] = {}  # "ip:port" -> expiry ts
+        self._last_sweep = 0.0
         self._lock = threading.RLock()
 
-    def register(self, token: str, ip: str, port: int, meta: Optional[dict] = None) -> bool:
+    def register(self, token: str, ip: str, port: int, meta: Optional[dict] = None,
+                 lease_s: Optional[float] = None) -> bool:
+        lease_s = self._default_lease_s if lease_s is None else lease_s
         with self._lock:
             self._records[token].append(
                 {"ip": ip, "port": port, "meta": meta or {}, "ts": time.time()}
             )
+            if lease_s is not None:
+                self._leases[f"{ip}:{port}"] = time.time() + lease_s
             return True
+
+    def heartbeat(self, ip: str, port: int, lease_s: Optional[float] = None) -> bool:
+        """Refresh an endpoint's lease. Returns True when the broker still
+        holds records for that endpoint — False tells a producer its state
+        is gone (broker restarted or evicted) and it must re-register."""
+        lease_s = self._default_lease_s if lease_s is None else lease_s
+        key = f"{ip}:{port}"
+        with self._lock:
+            self._sweep_leases()
+            if lease_s is not None:
+                self._leases[key] = time.time() + lease_s
+            from ..obs import get_registry
+
+            get_registry().counter(
+                "distar_coordinator_heartbeats_total", "endpoint lease refreshes"
+            ).inc()
+            return any(
+                f"{r['ip']}:{r['port']}" == key for q in self._records.values() for r in q
+            )
+
+    def _purge_endpoint(self, key: str) -> int:
+        """Drop every record registered by ``key`` ("ip:port"); the shared
+        removal path behind strikes AND lease eviction. Caller holds lock."""
+        removed = 0
+        for q in self._records.values():
+            dead = [r for r in q if f"{r['ip']}:{r['port']}" == key]
+            for r in dead:
+                q.remove(r)
+            removed += len(dead)
+        self._strikes.pop(key, None)
+        self._leases.pop(key, None)
+        return removed
+
+    def _sweep_leases(self, min_interval_s: float = 1.0) -> None:
+        """Evict endpoints whose lease expired (at most once per
+        ``min_interval_s`` — called from the hot read paths). Caller holds
+        lock."""
+        now = time.time()
+        if now - self._last_sweep < min_interval_s:
+            return
+        self._last_sweep = now
+        expired = [k for k, exp in self._leases.items() if exp < now]
+        if not expired:
+            return
+        from ..obs import get_registry
+
+        evictions = get_registry().counter(
+            "distar_coordinator_evictions_total",
+            "endpoints evicted on lease expiry",
+        )
+        for key in expired:
+            self._purge_endpoint(key)
+            evictions.inc()
 
     def ask(self, token: str) -> Optional[dict]:
         """Pop the oldest ready record for a token (None when empty)."""
         with self._lock:
+            self._sweep_leases()
             q = self._records.get(token)
             if not q:
                 return None
@@ -65,6 +131,7 @@ class Coordinator:
         if max_age_s is Coordinator._UNSET:
             max_age_s = self._max_age_s
         with self._lock:
+            self._sweep_leases()
             q = self._records.get(token)
             if not q:
                 return 0
@@ -76,11 +143,7 @@ class Coordinator:
         with self._lock:
             self._strikes[key] += 1
             if self._strikes[key] >= 5:
-                for q in self._records.values():
-                    dead = [r for r in q if f"{r['ip']}:{r['port']}" == key]
-                    for r in dead:
-                        q.remove(r)
-                self._strikes.pop(key)
+                self._purge_endpoint(key)
 
     def stats(self, max_age_s=_UNSET) -> dict:
         """Per-token depth with the SAME age filter as ``depth()`` (they used
@@ -90,6 +153,7 @@ class Coordinator:
         if max_age_s is Coordinator._UNSET:
             max_age_s = self._max_age_s
         with self._lock:
+            self._sweep_leases()
             return {
                 token: self._filtered_len(q, max_age_s)
                 for token, q in self._records.items()
@@ -136,6 +200,7 @@ class CoordinatorServer:
             "register": lambda b: co.register(**b),
             "ask": lambda b: co.ask(b["token"]),
             "strike": lambda b: co.strike(b["ip"], b["port"]),
+            "heartbeat": lambda b: co.heartbeat(**b),
             # absent max_age_s -> the coordinator's own default filter, so
             # HTTP callers and in-process callers see identical accounting
             "stats": lambda b: (
@@ -212,14 +277,44 @@ class CoordinatorServer:
         self._server.server_close()
 
 
-def coordinator_request(host: str, port: int, route: str, body: Optional[dict] = None, timeout=10.0):
+def _coordinator_request_once(host: str, port: int, route: str,
+                              body: Optional[dict], timeout: float) -> dict:
+    """One transport attempt; raises a typed ``CommError`` instead of
+    leaking ``URLError``/timeout/JSON-decode exceptions to call sites."""
+    import urllib.error
     import urllib.request
 
+    from ..resilience import CommError
+
+    op = f"coordinator:{route}"
     req = urllib.request.Request(
         f"http://{host}:{port}/coordinator/{route}",
         data=json.dumps(body or {}).encode(),
         headers={"Content-Type": "application/json"},
         method="POST",
     )
-    with urllib.request.urlopen(req, timeout=timeout) as resp:
-        return json.loads(resp.read())
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return json.loads(resp.read())
+    except (urllib.error.URLError, ConnectionError, TimeoutError, OSError,
+            ValueError) as e:
+        # ValueError covers a truncated/garbage JSON body from a peer dying
+        # mid-response — as transient as the connection reset it really is
+        raise CommError(f"{op} @ {host}:{port} failed: {e!r}", op=op, cause=e) from e
+
+
+def coordinator_request(host: str, port: int, route: str, body: Optional[dict] = None,
+                        timeout=10.0, policy=None):
+    """Broker RPC under the resilience retry fabric.
+
+    Default policy rides through a several-second broker restart
+    (``resilience.DEFAULT_COMM_POLICY``); pass ``resilience.NO_RETRY`` for a
+    single attempt. Raises ``resilience.CommError`` (a ``ConnectionError``
+    subclass, so legacy ``except OSError`` sites still catch it) once the
+    policy is exhausted."""
+    from ..resilience import DEFAULT_COMM_POLICY, retry_call
+
+    return retry_call(
+        _coordinator_request_once, host, port, route, body, timeout,
+        op=f"coordinator:{route}", policy=policy or DEFAULT_COMM_POLICY,
+    )

@@ -13,6 +13,7 @@ import socket
 import struct
 import subprocess
 import threading
+import time
 from typing import Optional, Tuple
 
 _DIR = os.path.dirname(__file__)
@@ -229,9 +230,17 @@ def _py_serve(payload: bytes, accept_count: int, timeout_ms: int) -> int:
                     conn, _ = listener.accept()
                 except socket.timeout:
                     break
-                with conn:
-                    conn.sendall(framed)
-                served += 1
+                # accepted sockets do NOT inherit the listener timeout
+                # (always-blocking since py3.4): without this, one consumer
+                # that connects and never reads parks sendall forever and
+                # the serve window never expires
+                conn.settimeout(timeout_ms / 1000.0)
+                try:
+                    with conn:
+                        conn.sendall(framed)
+                    served += 1
+                except OSError:
+                    continue  # hung/reset consumer: window stays open for others
         finally:
             listener.close()
             reg.gauge("distar_shuttle_active_serves").dec()
@@ -247,12 +256,19 @@ def _py_serve(payload: bytes, accept_count: int, timeout_ms: int) -> int:
 
 
 def _py_fetch(host: str, port: int, timeout_ms: int) -> bytes:
+    # timeout_ms is a DEADLINE over the whole fetch (connect + every recv),
+    # not a per-recv idle timeout: a peer trickling one byte per timeout
+    # window used to hold the fetch open indefinitely
+    deadline = time.monotonic() + timeout_ms / 1000.0
     with socket.create_connection((host, port), timeout=timeout_ms / 1000.0) as s:
-        s.settimeout(timeout_ms / 1000.0)
 
         def recv_exact(n: int) -> bytes:
             chunks = []
             while n > 0:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise socket.timeout(f"fetch deadline ({timeout_ms}ms) exceeded")
+                s.settimeout(remaining)
                 chunk = s.recv(min(n, 1 << 20))
                 if not chunk:
                     raise ConnectionError("short read")

@@ -85,8 +85,13 @@ class LeagueAPIServer:
 
 
 def league_request(host: str, port: int, route: str, body: Optional[dict] = None, timeout=10.0):
-    """Client helper used by learner/actor comm."""
+    """Client helper used by learner/actor comm. Raises the typed
+    ``resilience.CommError`` on any transport fault (never a raw
+    URLError/timeout); retries belong to the caller (``RemoteLeague``)."""
+    import urllib.error
     import urllib.request
+
+    from ..resilience import CommError
 
     req = urllib.request.Request(
         f"http://{host}:{port}/league/{route}",
@@ -94,5 +99,12 @@ def league_request(host: str, port: int, route: str, body: Optional[dict] = None
         headers={"Content-Type": "application/json"},
         method="POST",
     )
-    with urllib.request.urlopen(req, timeout=timeout) as resp:
-        return json.loads(resp.read())
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return json.loads(resp.read())
+    except (urllib.error.URLError, ConnectionError, TimeoutError, OSError,
+            ValueError) as e:
+        raise CommError(
+            f"league:{route} @ {host}:{port} failed: {e!r}",
+            op=f"league:{route}", cause=e,
+        ) from e

@@ -366,22 +366,28 @@ class League:
 
     # ---------------------------------------------------------------- resume
     def save_resume(self, path: str) -> str:
-        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
-        with self._lock, open(path, "wb") as f:
-            pickle.dump(
+        """Journal the full league state (players, payoff, ratings) to
+        ``path``. Atomic via the storage layer (tmp+fsync+rename): a
+        coordinator killed mid-journal leaves the previous journal intact —
+        the durability contract the autosave loop depends on."""
+        from ..utils import storage
+
+        with self._lock:
+            blob = pickle.dumps(
                 {
                     "active_players": self.active_players,
                     "historical_players": self.historical_players,
                     "elo": self.elo,
                     "trueskill": self.trueskill,
-                },
-                f,
+                }
             )
+        storage.write_bytes(path, blob)
         return path
 
     def load_resume(self, path: str) -> None:
-        with open(path, "rb") as f:
-            data = pickle.load(f)
+        from ..utils import storage
+
+        data = pickle.loads(storage.read_bytes(path))
         self.active_players = data["active_players"]
         self.historical_players = data["historical_players"]
         self.elo = data["elo"]
@@ -396,3 +402,45 @@ class League:
                 player.cum_stat = CumStat(player.decay, player.warm_up_size)
                 player.unit_num_stat = UnitNumStat(player.decay, player.warm_up_size)
         self._log(f"league resumed from {path}")
+
+    # -------------------------------------------------------------- autosave
+    def start_autosave(self, path: str, interval_s: Optional[float] = None) -> str:
+        """Periodic ``save_resume`` journaling on a daemon thread — the
+        coordinator-durability leg of the fault-tolerance layer: a broker
+        restart with ``load_resume(path)`` picks the league up where the
+        last journal left it instead of resetting all payoff/ELO state.
+        Cadence defaults to ``league.save_resume_freq_s``. Returns ``path``."""
+        interval_s = float(
+            self.cfg.get("save_resume_freq_s", 3600) if interval_s is None else interval_s
+        )
+        assert interval_s > 0
+        self.stop_autosave()
+        self._autosave_stop = threading.Event()
+
+        def run():
+            from ..obs import get_registry
+
+            saves = get_registry().counter(
+                "distar_league_autosaves_total", "league resume journals written"
+            )
+            while not self._autosave_stop.wait(interval_s):
+                try:
+                    self.save_resume(path)
+                    saves.inc()
+                except Exception as e:  # journaling must never kill matchmaking
+                    self._log(f"league autosave failed: {e!r}")
+
+        self._autosave_thread = threading.Thread(
+            target=run, daemon=True, name="league-autosave"
+        )
+        self._autosave_thread.start()
+        return path
+
+    def stop_autosave(self) -> None:
+        stop = getattr(self, "_autosave_stop", None)
+        thread = getattr(self, "_autosave_thread", None)
+        if stop is not None:
+            stop.set()
+        if thread is not None:
+            thread.join(timeout=10.0)
+            self._autosave_thread = None

@@ -2,38 +2,51 @@
 
 Learners and actors on other hosts construct a RemoteLeague with the league
 server's address and use it exactly like an in-process League (the subset of
-methods the worker roles call). Retries with backoff mirror the reference's
-requests retry adapters (reference: distar/ctools/worker/actor/
-actor_comm.py:59-60, adapter.py:56-63).
+methods the worker roles call). Retries ride the shared resilience fabric
+(``resilience.retry_call`` with a per-proxy circuit breaker) instead of the
+hand-rolled loop each transport used to carry — one observable policy for
+every cross-process link (role of the reference's requests retry adapters,
+reference: distar/ctools/worker/actor/actor_comm.py:59-60, adapter.py:56-63).
 """
 from __future__ import annotations
 
-import time
 from typing import Optional
 
+from ..resilience import CircuitBreaker, CommError, FatalError, RetryPolicy, retry_call
 from .api import league_request
 
 
 class RemoteLeague:
     def __init__(self, host: str, port: int, retries: int = 5, backoff_s: float = 0.5,
-                 timeout: float = 30.0):
+                 timeout: float = 30.0, policy: Optional[RetryPolicy] = None,
+                 breaker: Optional[CircuitBreaker] = None):
         self.host, self.port = host, port
-        self._retries = retries
-        self._backoff_s = backoff_s
         self._timeout = timeout
+        self._policy = policy or RetryPolicy(
+            max_attempts=retries, backoff_base_s=backoff_s, backoff_max_s=10.0
+        )
+        # breaker shared across routes: the peer is one process — if jobs
+        # are unreachable, results are too
+        self._breaker = breaker or CircuitBreaker(op="league")
+
+    def _call_once(self, route: str, body: dict):
+        out = league_request(self.host, self.port, route, body, timeout=self._timeout)
+        if out.get("code") == 0:
+            return out["info"]
+        # the server answered: this is an application error, not peer death
+        raise FatalError(f"league {route} error: {out}")
 
     def _call(self, route: str, body: dict):
-        err: Optional[Exception] = None
-        for attempt in range(self._retries):
-            try:
-                out = league_request(self.host, self.port, route, body, timeout=self._timeout)
-                if out.get("code") == 0:
-                    return out["info"]
-                raise RuntimeError(f"league {route} error: {out}")
-            except (OSError, ConnectionError) as e:
-                err = e
-                time.sleep(self._backoff_s * (2 ** attempt))
-        raise ConnectionError(f"league {route} unreachable after {self._retries} tries") from err
+        try:
+            return retry_call(
+                self._call_once, route, body,
+                op=f"league:{route}", policy=self._policy, breaker=self._breaker,
+            )
+        except CommError as e:
+            raise CommError(
+                f"league {route} unreachable after {self._policy.max_attempts} tries",
+                op=e.op, cause=e,
+            ) from e
 
     # --- the League surface used by workers ---
     def register_learner(self, player_id: str, ip: str = "", port: int = 0, rank: int = 0,

@@ -20,6 +20,8 @@ from ..utils import Config, EasyTimer, build_logger, deep_merge_dicts
 from ..utils.timing import sw as global_stopwatch
 from ..utils.checkpoint import (
     AsyncCheckpointer,
+    CheckpointCorruptError,
+    CheckpointManager,
     CountVar,
     auto_checkpoint,
     load_checkpoint,
@@ -60,6 +62,7 @@ class BaseLearner:
         self.timer = EasyTimer()
         self.last_iter = CountVar(0)
         self._checkpointer = AsyncCheckpointer()
+        self._ckpt_manager = CheckpointManager(os.path.join(root, "checkpoints"))
         self.log_buffer: Dict[str, Any] = {}
         self.metrics = get_registry()
         prof = self.cfg.learner.get("profile", {})
@@ -100,22 +103,59 @@ class BaseLearner:
     def checkpoint_path(self) -> str:
         return os.path.join(self.save_dir, "checkpoints", f"iteration_{self.last_iter.val}.ckpt")
 
+    @property
+    def checkpoint_manager(self) -> CheckpointManager:
+        return self._ckpt_manager
+
     def save(self, path: str, sync: bool = False) -> None:
         """Checkpoint the train state. By default (learner.async_save) the
         serialize+write overlaps training on a background thread; ``sync``
-        forces a durable write before returning (crash/debug paths)."""
+        forces a durable write before returning (crash/debug paths). Every
+        save publishes the ``latest`` pointer only AFTER the bytes are
+        durable, so crash-resume never points at a half-written file."""
         meta = {"last_iter": self.last_iter.val}
+        step = self.last_iter.val
         if sync or not self.cfg.learner.get("async_save", True):
             self._checkpointer.wait()  # never race an in-flight async write
             save_checkpoint(path, self._state, metadata=meta)
+            self._ckpt_manager.record(path, step=step)
         else:
-            self._checkpointer.save(path, self._state, metadata=meta)
+            self._checkpointer.save(
+                path, self._state, metadata=meta,
+                on_complete=lambda p, s=step: self._ckpt_manager.record(p, step=s),
+            )
 
     def restore(self, path: str) -> None:
         self._checkpointer.wait()  # the path may still be being written
         out = load_checkpoint(path, target=self._state)
         self._state = self._place_state(out["state"])
         self.last_iter.update(out["metadata"].get("last_iter", 0))
+
+    def resume_latest(self) -> Optional[str]:
+        """Crash-resume: restore from the newest VALID generation behind the
+        durable ``latest`` pointer. A corrupt/truncated newest checkpoint is
+        detected (manifest CRC/size) and skipped in favour of the previous
+        generation. Returns the restored path, or None when nothing usable
+        exists (cold start)."""
+        self._checkpointer.wait()
+        for gen in self._ckpt_manager.generations():
+            try:
+                self.restore(gen["path"])
+            except (CheckpointCorruptError, FileNotFoundError, OSError, ValueError):
+                CheckpointManager._note_fallback(gen["path"])
+                continue
+            self.metrics.counter(
+                "distar_resilience_resumes_total",
+                "learner restarts resumed from the latest pointer",
+            ).inc()
+            from ..obs import get_flight_recorder
+
+            get_flight_recorder().record(
+                "learner_resume", path=gen["path"], step=gen.get("step", 0)
+            )
+            self.logger.info(f"resumed from {gen['path']} (iter {self.last_iter.val})")
+            return gen["path"]
+        return None
 
     def _place_state(self, state):
         """Re-place restored host leaves onto this instance's compiled

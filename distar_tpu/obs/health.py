@@ -124,8 +124,19 @@ class HealthEvaluator:
         self._lock = threading.Lock()
         self._states: Dict[str, _RuleState] = {r.name: _RuleState() for r in self.rules}
         self._history: deque = deque(maxlen=history)
+        self._callbacks: List = []
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+
+    def add_transition_callback(self, fn) -> None:
+        """Subscribe to alert transitions: ``fn(event_dict)`` is invoked for
+        every ok/warning/firing edge, after the evaluation pass, outside the
+        evaluator lock (callbacks may query ``alerts()``). This is the
+        remediation hook the resilience layer's ``AlertRemediator`` attaches
+        to. Exceptions are swallowed: a broken remediator must not kill the
+        watchdog."""
+        with self._lock:
+            self._callbacks.append(fn)
 
     # -------------------------------------------------------------- evaluate
     def _emit(self, rule: HealthRule, st: _RuleState, transition: str,
@@ -194,6 +205,13 @@ class HealthEvaluator:
             reg.counter(
                 "distar_health_evaluations_total", "rulebook evaluation passes"
             ).inc()
+            callbacks = list(self._callbacks)
+        for event in events:  # dispatched OUTSIDE the lock (see add_…)
+            for cb in callbacks:
+                try:
+                    cb(event)
+                except Exception:
+                    pass
         return events
 
     # --------------------------------------------------------------- surface

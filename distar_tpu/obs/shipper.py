@@ -100,9 +100,11 @@ class TelemetryShipper:
         else:
             # lazy comm import: obs must stay importable without the comm
             # package fully initialised (comm itself imports obs)
+            import urllib.error
             import urllib.request
 
             from ..comm import serializer
+            from ..resilience import CommError
 
             host, port = self._addr
             req = urllib.request.Request(
@@ -111,11 +113,18 @@ class TelemetryShipper:
                 headers={"Content-Type": SERIALIZED_CONTENT_TYPE},
                 method="POST",
             )
-            with urllib.request.urlopen(req, timeout=self._timeout_s) as resp:
-                reply = resp.read()
-            import json
+            try:
+                with urllib.request.urlopen(req, timeout=self._timeout_s) as resp:
+                    reply = resp.read()
+                import json
 
-            decoded = json.loads(reply)
+                decoded = json.loads(reply)
+            except (urllib.error.URLError, ConnectionError, TimeoutError,
+                    OSError, ValueError) as e:
+                raise CommError(
+                    f"telemetry ship @ {host}:{port} failed: {e!r}",
+                    op="telemetry_ship", cause=e,
+                ) from e
             if decoded.get("code") != 0:
                 raise RuntimeError(f"telemetry ingest rejected: {decoded!r}")
             n = int(decoded.get("info") or 0)
@@ -131,14 +140,31 @@ class TelemetryShipper:
         self._stop.clear()
 
         def run():
+            from ..resilience import (
+                CircuitBreaker, CircuitOpenError, CommError, RetryPolicy, retry_call,
+            )
+
             reg = self._registry or get_registry()
             errors = reg.counter(
                 "distar_telemetry_ship_errors_total", "failed telemetry pushes"
             )
+            # quick in-tick retry for blips; the breaker turns a dead broker
+            # into cheap fail-fast ticks (no connect timeout per interval)
+            # until it answers again — shipping must never stall the role
+            policy = RetryPolicy(max_attempts=2, backoff_base_s=0.2,
+                                 deadline_s=self._timeout_s)
+            breaker = CircuitBreaker(op="telemetry_ship",
+                                     reset_after_s=4 * self.interval_s)
             while not self._stop.wait(self.interval_s):
                 try:
-                    self.ship_once()
+                    retry_call(self.ship_once, op="telemetry_ship",
+                               policy=policy, breaker=breaker)
+                except (CommError, CircuitOpenError):
+                    errors.inc()
                 except Exception:
+                    # anything else (rejected ingest, codec bug): counted,
+                    # never propagated — telemetry must not take the fleet
+                    # down with it
                     errors.inc()
 
         self._thread = threading.Thread(target=run, daemon=True, name="obs-shipper")

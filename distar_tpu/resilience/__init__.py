@@ -1,0 +1,46 @@
+"""Fault-tolerance layer: retry/backoff fabric, crash-resume supervision,
+and a deterministic chaos-injection harness.
+
+PR 3's health layer detects stalls and NaNs; this package is what survives
+and remediates them — the self-healing half of the fleet. See
+docs/resilience.md for the failure model and defaults.
+"""
+from .policy import (
+    DEFAULT_COMM_POLICY,
+    NO_RETRY,
+    CircuitBreaker,
+    CircuitOpenError,
+    CommError,
+    FatalError,
+    RetryPolicy,
+    RetryableError,
+    retry_call,
+    retryable,
+)
+from .supervisor import (
+    AlertRemediator,
+    RestartPolicy,
+    Supervisor,
+    TaskContext,
+    supervise_call,
+)
+from .chaos import ChaosInjector
+
+__all__ = [
+    "DEFAULT_COMM_POLICY",
+    "NO_RETRY",
+    "CircuitBreaker",
+    "CircuitOpenError",
+    "CommError",
+    "FatalError",
+    "RetryPolicy",
+    "RetryableError",
+    "retry_call",
+    "retryable",
+    "AlertRemediator",
+    "RestartPolicy",
+    "Supervisor",
+    "TaskContext",
+    "supervise_call",
+    "ChaosInjector",
+]

@@ -1,0 +1,339 @@
+"""Role supervision: restart crashed fleet roles instead of losing the run.
+
+The reference survives week-long league runs operationally (systemd/k8s
+restart the worker, the worker resumes from its checkpoint); this module is
+the in-process half of that contract for the threads/loops our launchers
+own:
+
+* ``Supervisor`` — named background tasks (actor loops, dataloader pumps)
+  run on watchdog threads: a crash is recorded, backed off, and restarted,
+  bounded by a ``RestartPolicy`` (max restarts per sliding window, then
+  give up and escalate). Tasks receive a ``TaskContext`` for cooperative
+  stop/restart — remediation can bounce a live-but-stalled loop without
+  killing the process.
+* ``supervise_call`` — foreground supervision for the role that owns the
+  main thread (the learner): run, and on a crash invoke ``on_restart``
+  (checkpoint resume) and run again under the same restart budget.
+* ``AlertRemediator`` — the bridge from the PR 3 health layer: a firing
+  ``stalled``/``nonfinite`` rule triggers a supervised restart of the
+  mapped task, closing the detect -> remediate loop.
+
+Every restart/giveup/remediation is observable (``distar_resilience_*``
+metrics + flight-recorder events).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass
+from typing import Callable, Dict, Mapping, Optional
+
+from .policy import RetryPolicy
+
+
+def _metrics():
+    from ..obs import get_registry
+
+    return get_registry()
+
+
+def _recorder():
+    from ..obs import get_flight_recorder
+
+    return get_flight_recorder()
+
+
+@dataclass(frozen=True)
+class RestartPolicy:
+    """Restart budget: at most ``max_restarts`` within ``window_s`` (sliding),
+    with exponential backoff between restarts."""
+
+    max_restarts: int = 5
+    window_s: float = 300.0
+    backoff_base_s: float = 0.5
+    backoff_multiplier: float = 2.0
+    backoff_max_s: float = 30.0
+
+    def backoff_s(self, restart_no: int) -> float:
+        return min(
+            self.backoff_base_s * (self.backoff_multiplier ** restart_no),
+            self.backoff_max_s,
+        )
+
+
+class TaskContext:
+    """Cooperative control surface handed to every supervised target.
+
+    Long-running targets should poll ``should_exit`` (stop requested OR
+    restart requested) at loop boundaries; returning normally with a
+    pending restart request re-enters the target instead of retiring the
+    task."""
+
+    def __init__(self):
+        self._stop = threading.Event()
+        self._restart = threading.Event()
+        self.restart_reason: Optional[str] = None
+
+    @property
+    def stop_requested(self) -> bool:
+        return self._stop.is_set()
+
+    @property
+    def restart_requested(self) -> bool:
+        return self._restart.is_set()
+
+    @property
+    def should_exit(self) -> bool:
+        return self._stop.is_set() or self._restart.is_set()
+
+    def request_stop(self) -> None:
+        self._stop.set()
+
+    def request_restart(self, reason: str = "") -> None:
+        self.restart_reason = reason or self.restart_reason
+        self._restart.set()
+
+    def sleep(self, seconds: float) -> bool:
+        """Interruptible sleep; returns True when the task should exit."""
+        return self._stop.wait(seconds) or self._restart.is_set()
+
+
+class _Task:
+    def __init__(self, name: str, target: Callable[[TaskContext], None],
+                 policy: RestartPolicy,
+                 on_restart: Optional[Callable[[BaseException], None]],
+                 on_giveup: Optional[Callable[[BaseException], None]]):
+        self.name = name
+        self.target = target
+        self.policy = policy
+        self.on_restart = on_restart
+        self.on_giveup = on_giveup
+        self.ctx = TaskContext()
+        self.thread: Optional[threading.Thread] = None
+        self.restarts = 0
+        self.gave_up = False
+        self.finished = False
+        self.last_error: Optional[str] = None
+        self._restart_times: deque = deque()
+
+
+class Supervisor:
+    """Owns a set of supervised background tasks (one watchdog thread each)."""
+
+    def __init__(self, policy: Optional[RestartPolicy] = None):
+        self.default_policy = policy or RestartPolicy()
+        self._tasks: Dict[str, _Task] = {}
+        self._lock = threading.Lock()
+        self._started = False
+
+    # -------------------------------------------------------------- lifecycle
+    def add(self, name: str, target: Callable[[TaskContext], None],
+            policy: Optional[RestartPolicy] = None,
+            on_restart: Optional[Callable[[BaseException], None]] = None,
+            on_giveup: Optional[Callable[[BaseException], None]] = None) -> "Supervisor":
+        with self._lock:
+            assert name not in self._tasks, f"duplicate task {name!r}"
+            task = _Task(name, target, policy or self.default_policy,
+                         on_restart, on_giveup)
+            self._tasks[name] = task
+            if self._started:
+                self._spawn(task)
+        return self
+
+    def start(self) -> "Supervisor":
+        with self._lock:
+            if self._started:
+                return self
+            self._started = True
+            for task in self._tasks.values():
+                self._spawn(task)
+        return self
+
+    def stop(self, timeout: float = 10.0) -> None:
+        with self._lock:
+            tasks = list(self._tasks.values())
+            self._started = False
+        for task in tasks:
+            task.ctx.request_stop()
+        deadline = time.monotonic() + timeout
+        for task in tasks:
+            t = task.thread
+            if t is not None:
+                t.join(max(0.0, deadline - time.monotonic()))
+
+    def _spawn(self, task: _Task) -> None:
+        task.thread = threading.Thread(
+            target=self._run, args=(task,), name=f"supervised-{task.name}", daemon=True
+        )
+        task.thread.start()
+
+    # ------------------------------------------------------------------- loop
+    def _run(self, task: _Task) -> None:
+        while not task.ctx.stop_requested:
+            task.ctx._restart.clear()
+            error: Optional[BaseException] = None
+            try:
+                task.target(task.ctx)
+            except BaseException as e:
+                error = e
+            if task.ctx.stop_requested:
+                break
+            if error is None and not task.ctx.restart_requested:
+                break  # clean retirement
+            reason = (
+                repr(error) if error is not None
+                else f"remediation:{task.ctx.restart_reason or 'requested'}"
+            )
+            if not self._budget_ok(task):
+                task.gave_up = True
+                task.last_error = reason
+                _metrics().counter(
+                    "distar_resilience_task_giveups_total",
+                    "supervised tasks abandoned (restart budget exhausted)",
+                    task=task.name,
+                ).inc()
+                _recorder().record("task_giveup", task=task.name, reason=reason,
+                                   restarts=task.restarts)
+                if task.on_giveup is not None:
+                    try:
+                        task.on_giveup(error if error is not None
+                                       else RuntimeError(reason))
+                    except Exception:
+                        pass
+                break
+            restart_no = task.restarts
+            task.restarts += 1
+            task.last_error = reason
+            _metrics().counter(
+                "distar_resilience_restarts_total", "supervised task restarts",
+                task=task.name,
+            ).inc()
+            _recorder().record("task_restart", task=task.name, reason=reason,
+                               restart_no=task.restarts)
+            if task.on_restart is not None:
+                try:
+                    task.on_restart(error if error is not None
+                                    else RuntimeError(reason))
+                except Exception:
+                    pass
+            if task.ctx._stop.wait(task.policy.backoff_s(restart_no)):
+                break
+        task.finished = True
+
+    def _budget_ok(self, task: _Task) -> bool:
+        now = time.monotonic()
+        window = task._restart_times
+        while window and now - window[0] > task.policy.window_s:
+            window.popleft()
+        if len(window) >= task.policy.max_restarts:
+            return False
+        window.append(now)
+        return True
+
+    # ---------------------------------------------------------------- surface
+    def restart(self, name: str, reason: str = "") -> bool:
+        """Request a cooperative restart of a live task (remediation path)."""
+        with self._lock:
+            task = self._tasks.get(name)
+        if task is None or task.gave_up or task.finished:
+            return False
+        task.ctx.request_restart(reason)
+        return True
+
+    def status(self) -> Dict[str, dict]:
+        with self._lock:
+            return {
+                name: {
+                    "alive": task.thread.is_alive() if task.thread else False,
+                    "restarts": task.restarts,
+                    "gave_up": task.gave_up,
+                    "last_error": task.last_error,
+                }
+                for name, task in self._tasks.items()
+            }
+
+    def join(self, timeout: Optional[float] = None) -> None:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._lock:
+            tasks = list(self._tasks.values())
+        for task in tasks:
+            t = task.thread
+            if t is None:
+                continue
+            t.join(None if deadline is None
+                   else max(0.0, deadline - time.monotonic()))
+
+
+def supervise_call(fn: Callable[[], None], op: str = "main",
+                   policy: Optional[RestartPolicy] = None,
+                   on_restart: Optional[Callable[[BaseException], None]] = None,
+                   sleep: Callable[[float], None] = time.sleep) -> None:
+    """Foreground supervision for the role owning the calling thread (the
+    learner run loop): run ``fn``; on a crash call ``on_restart(error)``
+    (checkpoint resume) and run again, bounded by ``policy``. The final
+    failure re-raises so the process still dies loudly when the budget is
+    exhausted (the flight recorder bundles the history)."""
+    policy = policy or RestartPolicy()
+    window: deque = deque()
+    restart_no = 0
+    while True:
+        try:
+            return fn()
+        except (KeyboardInterrupt, SystemExit):
+            raise
+        except BaseException as e:
+            now = time.monotonic()
+            while window and now - window[0] > policy.window_s:
+                window.popleft()
+            if len(window) >= policy.max_restarts:
+                _metrics().counter(
+                    "distar_resilience_task_giveups_total",
+                    "supervised tasks abandoned (restart budget exhausted)",
+                    task=op,
+                ).inc()
+                _recorder().record("task_giveup", task=op, reason=repr(e),
+                                   restarts=restart_no)
+                raise
+            window.append(now)
+            _metrics().counter(
+                "distar_resilience_restarts_total", "supervised task restarts",
+                task=op,
+            ).inc()
+            _recorder().record("task_restart", task=op, reason=repr(e),
+                               restart_no=restart_no + 1)
+            if on_restart is not None:
+                on_restart(e)
+            sleep(policy.backoff_s(restart_no))
+            restart_no += 1
+
+
+class AlertRemediator:
+    """Bridge PR 3 health alerts into supervised restarts.
+
+    ``mapping`` routes a firing rule name to a supervised task name; when the
+    ``HealthEvaluator`` emits a ``firing`` transition for a mapped rule the
+    remediator requests a cooperative restart of that task (debounce lives in
+    the rules engine — exactly one firing event per incident means exactly
+    one remediation per incident)."""
+
+    def __init__(self, supervisor: Supervisor, mapping: Mapping[str, str]):
+        self.supervisor = supervisor
+        self.mapping = dict(mapping)
+
+    def attach(self, evaluator) -> "AlertRemediator":
+        evaluator.add_transition_callback(self.on_event)
+        return self
+
+    def on_event(self, event: dict) -> None:
+        if event.get("state") != "firing":
+            return
+        task = self.mapping.get(event.get("rule"))
+        if task is None:
+            return
+        if self.supervisor.restart(task, reason=f"alert:{event.get('rule')}"):
+            _metrics().counter(
+                "distar_resilience_remediations_total",
+                "alert-triggered supervised restarts", rule=event.get("rule"),
+            ).inc()
+            _recorder().record("remediation", rule=event.get("rule"), task=task)

@@ -28,6 +28,7 @@ from typing import Optional
 
 from ..comm.serializer import recv_msg, send_msg
 from ..obs import get_registry
+from ..resilience import RetryPolicy, retry_call
 from .errors import ServeError, error_from_wire
 
 
@@ -41,6 +42,8 @@ class ServeTCPServer:
         self.host, self.port = self._listener.getsockname()
         self._stop = threading.Event()
         self._accept_thread: Optional[threading.Thread] = None
+        self._conns: set = set()
+        self._conns_lock = threading.Lock()
         reg = get_registry()
         self._g_conns = reg.gauge(
             "distar_serve_tcp_connections", "open data-plane connections"
@@ -59,9 +62,25 @@ class ServeTCPServer:
     def stop(self) -> None:
         self._stop.set()
         try:
+            # shutdown BEFORE close: closing the fd from this thread does not
+            # wake an accept() blocked in another — the kernel socket (and
+            # the port) would live until a final connection arrived
+            self._listener.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
             self._listener.close()
         except OSError:
             pass
+        # close live connections too: their handler threads otherwise sit in
+        # recv until every peer goes away, pinning the port past stop()
+        with self._conns_lock:
+            conns = list(self._conns)
+        for conn in conns:
+            try:
+                conn.close()
+            except OSError:
+                pass
         t = self._accept_thread
         if t is not None:
             t.join(5.0)
@@ -74,12 +93,18 @@ class ServeTCPServer:
                 conn, _ = self._listener.accept()
             except OSError:  # listener closed by stop()
                 return
+            # REUSEADDR on accepted sockets too: after stop(), lingering
+            # FIN_WAIT conns must not block a restarted gateway from
+            # rebinding the same port (the crash-restart path)
+            conn.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
             threading.Thread(
                 target=self._serve_conn, args=(conn,), name="serve-tcp-conn", daemon=True
             ).start()
 
     def _serve_conn(self, conn: socket.socket) -> None:
         self._g_conns.inc()
+        with self._conns_lock:
+            self._conns.add(conn)
         try:
             with conn:
                 while not self._stop.is_set():
@@ -98,6 +123,8 @@ class ServeTCPServer:
                     except (ConnectionError, OSError):
                         return
         finally:
+            with self._conns_lock:
+                self._conns.discard(conn)
             self._g_conns.dec()
 
     def _dispatch(self, req) -> dict:
@@ -135,20 +162,54 @@ class ServeTCPServer:
 
 class ServeClient:
     """Blocking data-plane client: one connection, one request in flight
-    (callers wanting pipelining open one client per worker thread)."""
+    (callers wanting pipelining open one client per worker thread).
 
-    def __init__(self, host: str, port: int, timeout_s: float = 30.0):
-        self._sock = socket.create_connection((host, port), timeout=timeout_s)
-        self._sock.settimeout(timeout_s)
+    Transport faults reconnect-and-retry under ``retry_policy`` (resilience
+    fabric: a gateway restart is invisible to callers as long as it comes
+    back inside the policy's budget). Typed ``ServeError`` responses — sheds,
+    deadlines — are application answers, never retried here: shed/backoff
+    decisions belong to the caller. NOTE: a retried ``act`` may execute twice
+    on the server (at-least-once); inference is idempotent per (session,
+    obs), so replays are safe for every current op."""
+
+    def __init__(self, host: str, port: int, timeout_s: float = 30.0,
+                 retry_policy: Optional[RetryPolicy] = None):
+        self._addr = (host, port)
+        self._timeout_s = timeout_s
+        self._policy = retry_policy or RetryPolicy(
+            max_attempts=3, backoff_base_s=0.2, backoff_max_s=2.0,
+            deadline_s=4 * timeout_s,
+        )
+        self._sock: Optional[socket.socket] = None
         self._lock = threading.Lock()
+        self._connect()
 
-    def _call(self, req: dict) -> dict:
+    def _connect(self) -> None:
+        self.close()
+        self._sock = socket.create_connection(self._addr, timeout=self._timeout_s)
+        self._sock.settimeout(self._timeout_s)
+
+    def _call_once(self, req: dict) -> dict:
         with self._lock:
-            send_msg(self._sock, req)
-            resp = recv_msg(self._sock)
+            if self._sock is None:
+                self._connect()
+            try:
+                send_msg(self._sock, req)
+                resp = recv_msg(self._sock)
+            except (ConnectionError, OSError, ValueError):
+                # the stream is no longer trustworthy (peer died mid-frame /
+                # garbage header): drop it so the retry dials fresh
+                self.close()
+                raise
         if resp.get("code") != 0:
             raise error_from_wire(resp)
         return resp
+
+    def _call(self, req: dict) -> dict:
+        return retry_call(
+            self._call_once, req, op=f"serve:{req.get('op', '?')}",
+            policy=self._policy,
+        )
 
     def act(self, session_id: str, obs, timeout_s: Optional[float] = None) -> dict:
         req = {"op": "act", "session_id": session_id, "obs": obs}
@@ -179,10 +240,12 @@ class ServeClient:
         return self._call({"op": "ping"})["pong"]
 
     def close(self) -> None:
-        try:
-            self._sock.close()
-        except OSError:
-            pass
+        sock, self._sock = self._sock, None
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
 
     def __enter__(self):
         return self

@@ -8,9 +8,14 @@ otherwise — both produce a single self-contained directory/file per step.
 """
 from __future__ import annotations
 
+import json
+import os
 import signal
+import threading
+import time
 import traceback
-from typing import Any, Callable, Dict, Optional
+import zlib
+from typing import Any, Callable, Dict, List, Optional
 
 import jax
 import numpy as np
@@ -21,6 +26,12 @@ try:
     from flax import serialization
 except Exception:  # pragma: no cover
     serialization = None
+
+
+class CheckpointCorruptError(Exception):
+    """The checkpoint bytes on storage don't match what was written
+    (truncated write, bit rot) or don't decode. Callers holding a
+    ``CheckpointManager`` fall back to the previous generation."""
 
 
 class CountVar:
@@ -50,17 +61,62 @@ def _host_snapshot(state: Any):
     return jax.tree.map(lambda x: np.array(x) if hasattr(x, "shape") else x, state)
 
 
+def _manifest_path(path: str) -> str:
+    return path + ".manifest"
+
+
 def _write_checkpoint(path: str, host_state: Any, metadata: Optional[Dict]) -> str:
     payload = {"state": host_state, "metadata": metadata or {}}
     blob = serialization.msgpack_serialize(_to_serialisable(payload))
     # scheme-routed (utils/storage.py): local fs by default with atomic
-    # tmp+rename and orphan reaping; mem:// / gs:// / custom for pod IO
+    # tmp+fsync+rename and orphan reaping; mem:// / gs:// / custom for pod IO
     storage.write_bytes(path, blob)
+    # integrity sidecar AFTER the blob: a manifest's presence implies the
+    # blob it describes landed; loads verify size+CRC against it so a
+    # truncated/bit-flipped checkpoint is detected instead of half-restored
+    manifest = {
+        "crc32": zlib.crc32(blob) & 0xFFFFFFFF,
+        "size": len(blob),
+        "ts": time.time(),
+        "metadata_keys": sorted((metadata or {}).keys()),
+    }
+    storage.write_bytes(_manifest_path(path), json.dumps(manifest).encode())
     return path
 
 
+def verify_checkpoint(path: str) -> bool:
+    """True when ``path`` exists and its bytes match the manifest (or, for
+    legacy manifest-less checkpoints, merely exists). Never raises."""
+    try:
+        blob = storage.read_bytes(path)
+        _verify_blob(path, blob)
+        return True
+    except (CheckpointCorruptError, OSError, ValueError):
+        return False
+
+
+def _verify_blob(path: str, blob: bytes) -> None:
+    mpath = _manifest_path(path)
+    if not storage.exists(mpath):
+        return  # legacy checkpoint: decode errors still surface typed below
+    try:
+        manifest = json.loads(storage.read_bytes(mpath))
+    except (ValueError, OSError) as e:
+        raise CheckpointCorruptError(f"{path}: unreadable manifest: {e!r}") from e
+    if len(blob) != int(manifest.get("size", -1)):
+        raise CheckpointCorruptError(
+            f"{path}: size {len(blob)} != manifest {manifest.get('size')} (truncated write?)"
+        )
+    crc = zlib.crc32(blob) & 0xFFFFFFFF
+    if crc != int(manifest.get("crc32", -1)):
+        raise CheckpointCorruptError(
+            f"{path}: crc32 {crc:#010x} != manifest {int(manifest.get('crc32', 0)):#010x}"
+        )
+
+
 def save_checkpoint(path: str, state: Any, metadata: Optional[Dict] = None) -> str:
-    """Serialise a pytree (host-transferred) to ``path`` (msgpack)."""
+    """Serialise a pytree (host-transferred) to ``path`` (msgpack) with a
+    CRC/size manifest sidecar (``<path>.manifest``)."""
     return _write_checkpoint(path, _host_snapshot(state), metadata)
 
 
@@ -81,9 +137,8 @@ class AsyncCheckpointer:
         self._thread = None
         self._error: Optional[BaseException] = None
 
-    def save(self, path: str, state: Any, metadata: Optional[Dict] = None) -> str:
-        import threading
-
+    def save(self, path: str, state: Any, metadata: Optional[Dict] = None,
+             on_complete: Optional[Callable[[str], None]] = None) -> str:
         # join BEFORE snapshotting: at most one host copy exists at a time
         # (this also surfaces any previous write failure loudly)
         self.wait()
@@ -92,6 +147,10 @@ class AsyncCheckpointer:
         def _write():
             try:
                 _write_checkpoint(path, host_state, metadata)
+                if on_complete is not None:
+                    # latest-pointer publication rides the writer thread: the
+                    # pointer must never name a checkpoint that isn't durable
+                    on_complete(path)
             except BaseException as e:  # surfaced by the next wait()/save()
                 self._error = e
 
@@ -113,11 +172,22 @@ class AsyncCheckpointer:
             raise RuntimeError("async checkpoint write failed") from err
 
 
-def load_checkpoint(path: str, target: Any = None) -> Dict:
+def load_checkpoint(path: str, target: Any = None, verify: bool = True) -> Dict:
     """Load a checkpoint; when ``target`` is given the state is restored into
     its structure (partial-match: missing leaves keep target values, extra
-    leaves are dropped — the reference's partial-load semantics)."""
-    payload = serialization.msgpack_restore(storage.read_bytes(path))
+    leaves are dropped — the reference's partial-load semantics).
+
+    With ``verify`` (default) the blob is checked against its manifest
+    sidecar, and decode failures are raised as ``CheckpointCorruptError`` —
+    corrupt/truncated checkpoints are DETECTED here, so resume paths can
+    fall back to the previous generation instead of restoring garbage."""
+    blob = storage.read_bytes(path)
+    if verify:
+        _verify_blob(path, blob)
+    try:
+        payload = serialization.msgpack_restore(blob)
+    except Exception as e:
+        raise CheckpointCorruptError(f"{path}: undecodable msgpack: {e!r}") from e
     state = payload["state"]
     if target is not None:
         state = _partial_restore(target, state)
@@ -172,6 +242,92 @@ def _partial_restore(target, state):
             return type(target)(*vals)
         return type(target)(vals)
     return state if state is not None else target
+
+
+class CheckpointManager:
+    """Durable ``latest`` pointer over checkpoint generations.
+
+    A crash-resuming learner needs one answer to "where do I restart from":
+    ``latest.json`` in the checkpoint directory holds the newest-first list
+    of recorded generations, written atomically (storage's tmp+fsync+rename)
+    so a crash mid-update leaves the previous pointer intact.
+    ``resolve_latest`` walks the list and returns the first generation whose
+    bytes still verify — a truncated or bit-flipped newest checkpoint falls
+    back to the previous one (counted in
+    ``distar_resilience_ckpt_fallbacks_total`` + a flight-recorder event).
+    """
+
+    POINTER = "latest.json"
+
+    def __init__(self, directory: str, keep: int = 5):
+        assert keep >= 1
+        self.directory = directory
+        self.keep = keep
+        self._lock = threading.Lock()
+
+    @property
+    def pointer_path(self) -> str:
+        return os.path.join(self.directory, self.POINTER)
+
+    # -------------------------------------------------------------- recording
+    def record(self, path: str, step: int = 0) -> None:
+        """Publish ``path`` as the newest generation. Call only after the
+        checkpoint bytes are durable (sync save return / async on_complete)."""
+        with self._lock:
+            gens = [g for g in self.generations() if g.get("path") != path]
+            gens.insert(0, {"path": path, "step": int(step), "ts": time.time()})
+            gens = gens[: self.keep]
+            storage.write_bytes(
+                self.pointer_path,
+                json.dumps({"generations": gens}, indent=1).encode(),
+            )
+
+    def generations(self) -> List[Dict]:
+        """Recorded generations, newest first ([] when no pointer yet)."""
+        if not storage.exists(self.pointer_path):
+            return []
+        try:
+            data = json.loads(storage.read_bytes(self.pointer_path))
+        except (ValueError, OSError):
+            return []  # torn pointer: treated as no-resume, not a crash
+        gens = data.get("generations", [])
+        return [g for g in gens if isinstance(g, dict) and g.get("path")]
+
+    # -------------------------------------------------------------- resolving
+    def resolve_latest(self) -> Optional[Dict]:
+        """Newest generation whose checkpoint still verifies, or None.
+        Invalid generations are skipped (observably), not deleted — forensics
+        may want the corrupt bytes."""
+        for gen in self.generations():
+            if verify_checkpoint(gen["path"]):
+                return gen
+            self._note_fallback(gen["path"])
+        return None
+
+    @staticmethod
+    def _note_fallback(path: str) -> None:
+        from ..obs import get_flight_recorder, get_registry
+
+        get_registry().counter(
+            "distar_resilience_ckpt_fallbacks_total",
+            "corrupt/missing checkpoint generations skipped on resume",
+        ).inc()
+        get_flight_recorder().record("ckpt_fallback", path=path)
+
+    def load_latest(self, target: Any = None) -> Optional[Dict]:
+        """Load the newest valid generation (manifest-verified); None when no
+        generation survives. The load itself can still race a concurrent
+        corruption — a ``CheckpointCorruptError`` here falls through to the
+        next generation."""
+        for gen in self.generations():
+            try:
+                out = load_checkpoint(gen["path"], target=target, verify=True)
+            except (CheckpointCorruptError, OSError, ValueError):
+                self._note_fallback(gen["path"])
+                continue
+            out["path"] = gen["path"]
+            return out
+        return None
 
 
 def auto_checkpoint(save_fn: Callable[[], None]):

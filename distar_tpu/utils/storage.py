@@ -48,9 +48,13 @@ class StorageBackend:
 
 class LocalBackend(StorageBackend):
     """Local filesystem with the atomic write discipline checkpoints need:
-    unique tmp + os.replace (a crash-path sync save can race an in-flight
-    async writer on the same target; distinct tmps keep both complete), and
-    reaping of orphaned tmps from SIGKILLed writers."""
+    unique tmp + fsync + os.replace (a crash-path sync save can race an
+    in-flight async writer on the same target; distinct tmps keep both
+    complete), and reaping of orphaned tmps from SIGKILLed writers. The
+    fsync matters for crash-resume: without it a machine death after
+    os.replace can surface a zero-length "complete" file — exactly the
+    torn state the checkpoint manifest check exists to catch, but the
+    latest-pointer itself must never be torn."""
 
     def write_bytes(self, path: str, data: bytes) -> None:
         parent = os.path.dirname(os.path.abspath(path))
@@ -59,7 +63,17 @@ class LocalBackend(StorageBackend):
         try:
             with open(tmp, "wb") as f:
                 f.write(data)
+                f.flush()
+                os.fsync(f.fileno())
             os.replace(tmp, path)
+            try:  # durably order the rename itself (best-effort: not all
+                dirfd = os.open(parent, os.O_RDONLY)  # filesystems allow it)
+                try:
+                    os.fsync(dirfd)
+                finally:
+                    os.close(dirfd)
+            except OSError:
+                pass
         except BaseException:
             try:
                 os.unlink(tmp)

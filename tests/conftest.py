@@ -38,6 +38,17 @@ def rng():
     return np.random.default_rng(0)
 
 
+@pytest.fixture
+def chaos():
+    """Seeded fault injector (distar_tpu/resilience/chaos.py); any patches it
+    installed are restored on teardown so faults never leak across tests."""
+    from distar_tpu.resilience.chaos import ChaosInjector
+
+    inj = ChaosInjector(seed=0)
+    yield inj
+    inj.restore()
+
+
 @pytest.fixture(autouse=True, scope="module")
 def _bound_compiled_program_accumulation():
     """Drop compiled-executable caches at each module boundary.

@@ -394,3 +394,26 @@ def test_rl_cap_entities_overflow_semantics():
     assert am["selected_units"][0, 1] == 1.0  # non-overflow sample untouched
     assert out["teacher_logit"]["selected_units"].shape[-1] == 257
     assert out["teacher_logit"]["target_unit"].shape[-1] == 256
+
+
+@pytest.mark.slow
+def test_rl_learner_resume_latest_with_corrupt_fallback(rl_learner, chaos):
+    """The real learner's crash-resume path: save() publishes the durable
+    latest pointer, resume_latest() restores from it, and a truncated
+    newest checkpoint falls back to the previous generation."""
+    learner = rl_learner
+    learner.run(max_iterations=max(learner.last_iter.val, 2))
+    p1 = learner.checkpoint_path()
+    learner.save(p1, sync=True)
+    iter1 = learner.last_iter.val
+    w1 = np.asarray(jax.tree.leaves(learner.state["params"])[0]).copy()
+    learner.run(max_iterations=iter1 + 2)
+    p2 = learner.checkpoint_path()
+    learner.save(p2, sync=True)
+    assert learner.checkpoint_manager.resolve_latest()["path"] == p2
+    chaos.truncate(p2)  # torn newest checkpoint
+    assert learner.resume_latest() == p1  # fell back a generation
+    assert learner.last_iter.val == iter1
+    np.testing.assert_allclose(
+        w1, np.asarray(jax.tree.leaves(learner.state["params"])[0])
+    )

@@ -1,0 +1,110 @@
+#!/usr/bin/env python
+"""chaos: seeded fault-injection CLI for resilience drills (docs/resilience.md).
+
+Operates on the same deterministic injector the chaos test suite uses
+(distar_tpu/resilience/chaos.py), so a drill run on a live fleet replays the
+faults tests already prove survivable:
+
+  python tools/chaos.py corrupt --path exp/checkpoints/iteration_40.ckpt \\
+        --mode truncate [--seed 0] [--frac 0.5] [--flips 8]
+  python tools/chaos.py kill --pid 12345 [--signal TERM|KILL]
+  python tools/chaos.py reset --addr 127.0.0.1:8423 [--count 4]
+  python tools/chaos.py latest --dir exp/checkpoints
+
+``corrupt`` damages a checkpoint in place (the resume path must fall back);
+``kill`` sends a signal to a role process (the supervisor/orchestrator must
+restart it); ``reset`` opens connections to an endpoint and aborts them with
+RST (read paths must survive hard resets); ``latest`` prints the durable
+pointer's generations with per-generation verification status — run it after
+a drill to see the fallback the fleet actually took.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from distar_tpu.resilience.chaos import ChaosInjector  # noqa: E402
+from distar_tpu.utils.checkpoint import CheckpointManager, verify_checkpoint  # noqa: E402
+
+
+def cmd_corrupt(args) -> int:
+    inj = ChaosInjector(seed=args.seed)
+    if args.mode == "truncate":
+        new_size = inj.truncate(args.path, keep_frac=args.frac)
+        print(f"truncated {args.path} -> {new_size} bytes")
+    else:
+        offsets = inj.bitflip(args.path, flips=args.flips)
+        print(f"bit-flipped {args.path} at byte offsets {offsets}")
+    print(f"verify_checkpoint: {verify_checkpoint(args.path)}")
+    return 0
+
+
+def cmd_kill(args) -> int:
+    sig = getattr(signal, f"SIG{args.signal.upper()}")
+    os.kill(args.pid, sig)
+    print(f"sent SIG{args.signal.upper()} to pid {args.pid}")
+    return 0
+
+
+def cmd_reset(args) -> int:
+    host, _, port = args.addr.rpartition(":")
+    inj = ChaosInjector(seed=args.seed)
+    n = inj.reset_connection(host or "127.0.0.1", int(port), count=args.count)
+    print(f"aborted {n}/{args.count} connections to {args.addr} with RST")
+    return 0 if n else 1
+
+
+def cmd_latest(args) -> int:
+    mgr = CheckpointManager(args.dir)
+    gens = mgr.generations()
+    if not gens:
+        print(f"no latest pointer under {args.dir}")
+        return 1
+    for i, gen in enumerate(gens):
+        ok = verify_checkpoint(gen["path"])
+        marker = "LATEST " if i == 0 else "       "
+        print(f"{marker}step={gen.get('step', '?'):>8}  "
+              f"{'ok     ' if ok else 'CORRUPT'}  {gen['path']}")
+    resolved = mgr.resolve_latest()
+    print(json.dumps({"resolves_to": resolved and resolved["path"]}))
+    return 0 if resolved else 2
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(description=__doc__,
+                                formatter_class=argparse.RawDescriptionHelpFormatter)
+    sub = p.add_subparsers(dest="command", required=True)
+
+    c = sub.add_parser("corrupt", help="damage a checkpoint in place")
+    c.add_argument("--path", required=True)
+    c.add_argument("--mode", choices=("truncate", "bitflip"), default="truncate")
+    c.add_argument("--seed", type=int, default=0)
+    c.add_argument("--frac", type=float, default=0.5, help="truncate: fraction kept")
+    c.add_argument("--flips", type=int, default=8, help="bitflip: bits to flip")
+
+    k = sub.add_parser("kill", help="signal a role process")
+    k.add_argument("--pid", type=int, required=True)
+    k.add_argument("--signal", default="TERM", choices=("TERM", "KILL", "INT"))
+
+    r = sub.add_parser("reset", help="RST-abort connections to an endpoint")
+    r.add_argument("--addr", required=True, help="host:port")
+    r.add_argument("--count", type=int, default=1)
+    r.add_argument("--seed", type=int, default=0)
+
+    l = sub.add_parser("latest", help="inspect a durable latest pointer")
+    l.add_argument("--dir", required=True, help="checkpoint directory")
+
+    args = p.parse_args()
+    return {"corrupt": cmd_corrupt, "kill": cmd_kill,
+            "reset": cmd_reset, "latest": cmd_latest}[args.command](args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -17,26 +17,45 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
 import urllib.error
 import urllib.parse
 import urllib.request
 
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from distar_tpu.resilience import CommError  # noqa: E402
+
+
+def _fetch(url: str, timeout: float) -> dict:
+    """One probe; transport faults surface as the typed ``CommError`` (the
+    same taxonomy every fleet call site speaks), never a raw URLError."""
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as resp:
+            return json.loads(resp.read())
+    except urllib.error.HTTPError:
+        raise  # status codes are handled by the caller (503 carries a body)
+    except (urllib.error.URLError, ConnectionError, TimeoutError, OSError,
+            ValueError) as e:
+        raise CommError(f"GET {url} failed: {e!r}", op="opsctl:get", cause=e) from e
+
 
 def _get(addr: str, path: str, timeout: float = 10.0) -> dict:
     url = f"http://{addr}{path}"
     try:
-        with urllib.request.urlopen(url, timeout=timeout) as resp:
-            return json.loads(resp.read())
+        return _fetch(url, timeout)
     except urllib.error.HTTPError as e:
         # /healthz answers 503 while firing — that body is still the payload
         try:
             return json.loads(e.read())
         except Exception:
             raise SystemExit(f"GET {url} -> HTTP {e.code}")
-    except OSError as e:
-        raise SystemExit(f"GET {url} failed: {e}")
+    except CommError as e:
+        raise SystemExit(str(e))
 
 
 def _fmt_ts(ts) -> str:
