@@ -108,6 +108,102 @@ def _stop_heartbeat() -> None:
     _HEARTBEAT_STOP.set()
 
 
+# ------------------------------------------------------------- replay bench
+
+# no external reference number exists for this path; results are normalised
+# against a nominal 1k trajectories/s so vs_baseline stays comparable
+# across rounds of OUR artifacts (BENCH_r* trend, not a paper claim)
+REPLAY_BASELINE_ITEMS = 1000.0
+
+
+def bench_replay() -> dict:
+    """Replay-store insert/sample throughput over the real framed-TCP data
+    plane on loopback (BENCH_MODE=replay; CPU-only — never claims the chip).
+
+    Concurrent writer threads ack inserts while reader threads drain batched
+    samples for BENCH_REPLAY_SECONDS; payloads are BENCH_REPLAY_PAYLOAD_KB
+    of incompressible bytes (the serializer's worst case, like real
+    trajectory tensors). Emits one standard BENCH JSON line."""
+    _stage("replay-setup")
+    from distar_tpu.replay import (
+        InsertClient, ReplayServer, ReplayStore, SampleClient, TableConfig,
+    )
+
+    seconds = float(os.environ.get("BENCH_REPLAY_SECONDS", 5.0))
+    payload_kb = int(os.environ.get("BENCH_REPLAY_PAYLOAD_KB", 64))
+    writers = int(os.environ.get("BENCH_REPLAY_WRITERS", 2))
+    readers = int(os.environ.get("BENCH_REPLAY_READERS", 2))
+    batch = int(os.environ.get("BENCH_REPLAY_BATCH", 4))
+
+    store = ReplayStore(table_factory=lambda name: TableConfig(
+        max_size=4096, sampler="uniform", samples_per_insert=None,
+        min_size_to_sample=batch,
+    ))
+    server = ReplayServer(store, port=0).start()
+    payload = os.urandom(payload_kb * 1024)
+    stop = threading.Event()
+    counts = {"inserted": 0, "sampled": 0}
+    lock = threading.Lock()
+
+    def writer():
+        client = InsertClient(server.host, server.port)
+        n = 0
+        while not stop.is_set():
+            client.insert("bench", payload, timeout_s=5.0)
+            n += 1
+        with lock:
+            counts["inserted"] += n
+        client.close()
+
+    def reader():
+        client = SampleClient(server.host, server.port)
+        n = 0
+        while not stop.is_set():
+            try:
+                items, _info = client.sample("bench", batch_size=batch, timeout_s=1.0)
+                n += len(items)
+            except Exception:
+                continue  # startup races before min_size is reached
+        with lock:
+            counts["sampled"] += n
+        client.close()
+
+    threads = [threading.Thread(target=writer, daemon=True) for _ in range(writers)]
+    threads += [threading.Thread(target=reader, daemon=True) for _ in range(readers)]
+    _stage("replay-run")
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    time.sleep(seconds)
+    stop.set()
+    for t in threads:
+        t.join(10.0)
+    elapsed = time.perf_counter() - t0
+    server.stop()
+    insert_rate = counts["inserted"] / elapsed
+    sample_rate = counts["sampled"] / elapsed
+    mb = payload_kb / 1024.0
+    point = {
+        "metric": "replay-store sample throughput (framed TCP, loopback)",
+        "value": round(sample_rate, 2),
+        "unit": "items/s",
+        "vs_baseline": round(sample_rate / REPLAY_BASELINE_ITEMS, 3),
+        "replay": {
+            "insert_items_per_s": round(insert_rate, 2),
+            "sample_items_per_s": round(sample_rate, 2),
+            "insert_mb_per_s": round(insert_rate * mb, 2),
+            "sample_mb_per_s": round(sample_rate * mb, 2),
+            "payload_kb": payload_kb,
+            "writers": writers,
+            "readers": readers,
+            "batch": batch,
+            "seconds": round(elapsed, 2),
+        },
+    }
+    print(json.dumps(point), flush=True)
+    return point
+
+
 def _calibrate_matmul(jax):
     """Timing/peak sanity anchor: a dependency-chained bf16 matmul of KNOWN
     FLOPs (8 x 4096^3 = 1.1 TFLOP per call). Every model-step timing rides
@@ -531,6 +627,15 @@ def _run_child_simulated(spec: str) -> None:
 def run_child():
     if os.environ.get("BENCH_SIMULATE"):
         _run_child_simulated(os.environ["BENCH_SIMULATE"])
+        return
+    if os.environ.get("BENCH_MODE") == "replay":
+        # pure host-side case: no jax import, no chip claim — the replay
+        # plane is sockets + serializer and must be benchable anywhere
+        _start_heartbeat()
+        try:
+            bench_replay()
+        finally:
+            _stop_heartbeat()
         return
     try:
         _run_child_real()

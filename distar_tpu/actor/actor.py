@@ -47,6 +47,18 @@ ACTOR_DEFAULTS = Config(
             # pad-to-bucket entity cap for inference obs (agents slice in
             # pre_process; matches the learner-side learner.max_entities)
             "max_entities": None,
+            # replay-store push target (config-switched; default off so the
+            # legacy point-to-point shuttle path is untouched). ``addr`` is
+            # "host:port" of a ReplayServer; ``mirror`` additionally keeps
+            # the shuttle push alive (migration/dual-write drills);
+            # ``priority`` seeds the table priority for fresh trajectories.
+            "replay": {
+                "enabled": False,
+                "addr": "",
+                "mirror": False,
+                "priority": 1.0,
+                "timeout_s": 60.0,
+            },
         }
     }
 )
@@ -74,6 +86,7 @@ class Actor:
         self._init_params = init_params
         self._player_params = dict(player_params or {})
         self._rng = np.random.default_rng(self.cfg.seed)
+        self._replay_client = None  # lazily dialed from cfg.actor.replay
         self.results: List[dict] = []
         # highest learner iteration ever received per player — survives
         # across jobs (the per-job _model_iters resets), for freshness
@@ -542,23 +555,72 @@ class Actor:
         self.results.extend(results)
         return results
 
+    # ----------------------------------------------------------- replay push
+    def _replay_cfg(self):
+        return self.cfg.get("replay", {}) or {}
+
+    def _get_replay_client(self):
+        """Dial the replay store once per actor (the client reconnects +
+        retries internally; docs/data_plane.md store path)."""
+        if self._replay_client is None:
+            from ..replay import InsertClient
+
+            addr = str(self._replay_cfg().get("addr", ""))
+            host, _, port = addr.rpartition(":")
+            self._replay_client = InsertClient(host or "127.0.0.1", int(port))
+        return self._replay_client
+
+    def push_trajectory(self, player_id: str, traj) -> None:
+        """Ship one finished trajectory to the configured data plane(s):
+        the replay store when ``actor.replay.enabled``, the legacy shuttle
+        path otherwise (or additionally, with ``replay.mirror``)."""
+        rcfg = self._replay_cfg()
+        use_replay = bool(rcfg.get("enabled", False)) and rcfg.get("addr", "")
+        if use_replay:
+            client = self._get_replay_client()
+            try:
+                client.insert(
+                    player_id, traj,
+                    priority=float(rcfg.get("priority", 1.0)),
+                    timeout_s=float(rcfg.get("timeout_s", 60.0)),
+                )
+                get_registry().counter(
+                    "distar_actor_replay_pushed_total",
+                    "trajectories acked by the replay store", player=player_id,
+                ).inc()
+            except Exception as err:
+                # the client already retried under its policy/breaker; a
+                # store outage past that budget must not kill the job loop
+                # mid-episode (the trajectory is lost, counted, and the
+                # episode keeps rolling — exactly the legacy drop semantics)
+                logging.warning(f"actor: replay push dropped: {err!r}")
+                get_registry().counter(
+                    "distar_actor_replay_push_failures_total",
+                    "replay-store inserts dropped after retries",
+                    player=player_id,
+                ).inc()
+            if not rcfg.get("mirror", False):
+                return
+        if self.adapter is not None:
+            # mint the pipeline span here, where the trajectory is born: the
+            # context rides the payload through shuttle/adapter into the
+            # learner, and the span id is ALSO stamped into the trajectory
+            # itself so the learner can attribute per-trajectory staleness
+            trace = start_trace("trajectory", player=player_id)
+            traj[0]["trace"] = trace
+            get_registry().counter(
+                "distar_actor_traj_pushed_total", "trajectories shipped to the learner",
+                player=player_id,
+            ).inc()
+            self.adapter.push(
+                f"{player_id}traj", traj, timeout_ms=120_000, trace=trace
+            )
+
     def _maybe_push(self, job, ag, traj, infer, hidden_backup, e, side) -> None:
         if traj is None:
             return
         # next trajectory starts from the CURRENT carry (before this cycle's
         # forward)
         hidden_backup[(e, side)] = infer[side].hidden_for_slot(e)
-        if self.adapter is not None and ag.player_id in job["send_data_players"]:
-            # mint the pipeline span here, where the trajectory is born: the
-            # context rides the payload through shuttle/adapter into the
-            # learner, and the span id is ALSO stamped into the trajectory
-            # itself so the learner can attribute per-trajectory staleness
-            trace = start_trace("trajectory", player=ag.player_id)
-            traj[0]["trace"] = trace
-            get_registry().counter(
-                "distar_actor_traj_pushed_total", "trajectories shipped to the learner",
-                player=ag.player_id,
-            ).inc()
-            self.adapter.push(
-                f"{ag.player_id}traj", traj, timeout_ms=120_000, trace=trace
-            )
+        if ag.player_id in job["send_data_players"]:
+            self.push_trajectory(ag.player_id, traj)

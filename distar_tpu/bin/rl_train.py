@@ -147,6 +147,82 @@ def _run_learner_supervised(args, learner, iters) -> None:
     )
 
 
+def _table_config(args):
+    """Per-player replay-table settings from the CLI surface (the replay
+    role's table factory; every player token gets one of these)."""
+    from ..replay import TableConfig
+
+    spi = args.replay_spi
+    return TableConfig(
+        max_size=args.replay_max_size,
+        sampler=args.replay_sampler,
+        samples_per_insert=None if spi <= 0 else spi,
+        # 0 = "the learner batch size": sampling can't start below one batch
+        min_size_to_sample=max(args.replay_min_size or args.batch_size or 1, 1),
+        error_buffer=args.replay_error_buffer,
+        max_staleness_s=args.replay_max_staleness_s or None,
+    )
+
+
+def _build_replay_store(args):
+    """Store + spill for the replay role; recovery runs before serving so
+    acked-but-unsampled trajectories from a crashed generation are resident
+    before the first sample lands."""
+    from ..replay import ReplayStore, SpillRing
+
+    _table_config(args)  # fail fast on invalid combos (e.g. fifo + spi > 1)
+    spill = None
+    if args.replay_spill_dir:
+        spill = SpillRing(args.replay_spill_dir, max_items=args.replay_spill_max)
+    store = ReplayStore(table_factory=lambda name: _table_config(args), spill=spill)
+    recovered = store.recover()
+    if recovered:
+        print(f"replay: recovered {recovered} acked trajectories from spill",
+              flush=True)
+    return store
+
+
+def run_replay(args) -> None:
+    """Standalone replay-store role: framed-TCP data plane on --port, HTTP
+    admin/stats (+ /metrics + health routes) on --metrics-port, crash-restart
+    under the supervisor with spill recovery on every (re)start."""
+    from ..replay import ReplayAdminServer, ReplayServer
+
+    _init_health(
+        args, roles=("replay",), source="replay",
+        shipper_addr=_addr(args.coordinator_addr) if args.coordinator_addr else None,
+    )
+
+    def serve_loop(ctx):
+        store = _build_replay_store(args)
+        server = ReplayServer(store, port=args.port)
+        server.start()
+        admin = None
+        if args.metrics_port is not None:
+            admin = ReplayAdminServer(store, port=args.metrics_port)
+            admin.start()
+            print(f"replay admin on http://{admin.host}:{admin.port}/replay/stats",
+                  flush=True)
+        print(f"replay store serving on {server.host}:{server.port}", flush=True)
+        try:
+            while not ctx.should_exit:
+                ctx.sleep(1.0)
+        finally:
+            server.stop()
+            if admin is not None:
+                admin.stop()
+
+    if getattr(args, "no_supervise", False):
+        from ..resilience import TaskContext
+
+        serve_loop(TaskContext())
+        return
+    supervisor = Supervisor(policy=_restart_policy(args))
+    supervisor.add("replay", serve_loop)
+    supervisor.start()
+    supervisor.join()
+
+
 def _maybe_serve_metrics(args, coordinator=None):
     """Start an HTTP server exposing GET /metrics for this process's registry
     when --metrics-port is given (CoordinatorServer doubles as the exporter;
@@ -168,15 +244,33 @@ def run_all(args) -> None:
     league = League(user_cfg)
     co = Coordinator()
     # one process hosts every role, so the full rulebook applies locally
-    fleet = _init_health(args, roles=("learner", "actor", "coordinator", "trace"))
+    roles = ("learner", "actor", "coordinator", "trace") + (
+        ("replay",) if args.replay else ())
+    fleet = _init_health(args, roles=roles)
     _maybe_serve_metrics(args, coordinator=co)
     actor_adapter = Adapter(coordinator=co)
     learner_adapter = Adapter(coordinator=co)
 
+    # --replay: an in-process store between actor and learner — the smoke
+    # configuration of the store path (real server + clients on loopback)
+    replay_server = None
+    actor_replay_cfg = {}
+    if args.replay:
+        from ..replay import ReplayServer
+
+        replay_server = ReplayServer(_build_replay_store(args), port=0).start()
+        actor_replay_cfg = {
+            "replay": {"enabled": True,
+                       "addr": f"{replay_server.host}:{replay_server.port}"}
+        }
+        print(f"replay store (in-process) on "
+              f"{replay_server.host}:{replay_server.port}", flush=True)
+
     player_id = list(league.active_players.keys())[0]
     traj_len = args.traj_len
     actor = Actor(
-        cfg={"actor": {"env_num": args.env_num, "traj_len": traj_len}},
+        cfg={"actor": {"env_num": args.env_num, "traj_len": traj_len,
+                       **actor_replay_cfg}},
         league=league,
         adapter=actor_adapter,
         model_cfg=model_cfg,
@@ -200,13 +294,24 @@ def run_all(args) -> None:
 
     learner = plugins.load_component(args.pipeline, "RLLearner")(
         _learner_cfg(args, model_cfg))
-    learner.set_dataloader(RLDataLoader(learner_adapter, player_id, args.batch_size))
+    if replay_server is not None:
+        from ..learner.rl_dataloader import ReplayDataLoader
+        from ..replay import SampleClient
+
+        learner.set_dataloader(ReplayDataLoader(
+            SampleClient(replay_server.host, replay_server.port),
+            player_id, args.batch_size,
+        ))
+    else:
+        learner.set_dataloader(RLDataLoader(learner_adapter, player_id, args.batch_size))
     learner.attach_comm(learner_adapter, player_id, league=league,
                         send_model_freq=4, send_train_info_freq=4)
     _run_learner_supervised(args, learner, args.iters)
     # let the actor finish its in-flight job: a daemon thread killed inside a
     # jitted computation aborts the interpreter teardown
     supervisor.stop(timeout=120)
+    if replay_server is not None:
+        replay_server.stop()
     print(
         f"rl_train done: {learner.last_iter.val} iters, "
         f"loss={learner.variable_record.get('total_loss').avg:.4f}, "
@@ -257,7 +362,17 @@ def run_learner(args) -> None:
         # a restarted learner process (k8s/systemd) picks up its own durable
         # latest pointer before cold-starting — zero manual intervention
         learner.resume_latest()
-    learner.set_dataloader(RLDataLoader(adapter, args.player_id, args.batch_size))
+    if args.replay_addr:
+        # store-backed sampling mode: batches come from the replay table
+        # instead of the point-to-point pull cache (docs/data_plane.md)
+        from ..learner.rl_dataloader import ReplayDataLoader
+        from ..replay import SampleClient
+
+        learner.set_dataloader(ReplayDataLoader(
+            SampleClient(*_addr(args.replay_addr)), args.player_id, args.batch_size,
+        ))
+    else:
+        learner.set_dataloader(RLDataLoader(adapter, args.player_id, args.batch_size))
     learner.attach_comm(adapter, args.player_id, league=league)
     _run_learner_supervised(args, learner, args.iters)
     print(f"learner done: {learner.last_iter.val} iters")
@@ -275,8 +390,11 @@ def run_actor(args) -> None:
     )
     _maybe_serve_metrics(args)
     model_cfg = _model_cfg(args)
+    actor_cfg = {"env_num": args.env_num, "traj_len": args.traj_len}
+    if args.replay_addr:
+        actor_cfg["replay"] = {"enabled": True, "addr": args.replay_addr}
     actor = Actor(
-        cfg={"actor": {"env_num": args.env_num, "traj_len": args.traj_len}},
+        cfg={"actor": actor_cfg},
         league=league,
         adapter=adapter,
         model_cfg=model_cfg,
@@ -298,7 +416,8 @@ def run_actor(args) -> None:
 def main() -> None:
     p = argparse.ArgumentParser()
     p.add_argument("--type", default="all",
-                   choices=["all", "league", "coordinator", "learner", "actor"])
+                   choices=["all", "league", "coordinator", "learner", "actor",
+                            "replay"])
     p.add_argument("--config", default="")
     p.add_argument("--iters", type=int, default=4)
     p.add_argument("--batch-size", type=int, default=None)
@@ -343,6 +462,37 @@ def main() -> None:
                         "(0 = leases disabled)")
     p.add_argument("--league-addr", default="", help="host:port of the league server")
     p.add_argument("--coordinator-addr", default="", help="host:port of the coordinator")
+    p.add_argument("--replay", action="store_true",
+                   help="--type all: route trajectories through an "
+                        "in-process replay store (smoke config of the "
+                        "store path) instead of the point-to-point shuttle")
+    p.add_argument("--replay-addr", default="",
+                   help="host:port of a replay store; actors push "
+                        "trajectories there, learners sample from it "
+                        "(default: the legacy shuttle path)")
+    p.add_argument("--replay-max-size", type=int, default=1024,
+                   help="replay role: per-table item cap (FIFO eviction)")
+    p.add_argument("--replay-spi", type=float, default=1.0,
+                   help="replay role: samples-per-insert ratio enforced by "
+                        "the rate limiter (<=0 disables ratio enforcement)")
+    p.add_argument("--replay-min-size", type=int, default=0,
+                   help="replay role: inserts required before sampling "
+                        "starts (0 = the learner batch size)")
+    p.add_argument("--replay-error-buffer", type=float, default=None,
+                   help="replay role: limiter slack in sample units "
+                        "(default max(1, spi))")
+    p.add_argument("--replay-sampler", default="fifo",
+                   choices=("fifo", "uniform", "prioritized"),
+                   help="replay role: table sampler (fifo = consume-once "
+                        "legacy semantics; prioritized = sum-tree PER)")
+    p.add_argument("--replay-spill-dir", default="",
+                   help="replay role: disk-spill directory; acked inserts "
+                        "survive a store crash (empty = no durability)")
+    p.add_argument("--replay-spill-max", type=int, default=4096,
+                   help="replay role: spill ring bound (oldest dropped past it)")
+    p.add_argument("--replay-max-staleness-s", type=float, default=0.0,
+                   help="replay role: evict items older than this "
+                        "(0 = no staleness eviction)")
     p.add_argument("--player-id", default="MP0")
     p.add_argument("--pipeline", default="default",
                    help="learner implementation to run: 'default' or an "
@@ -391,10 +541,13 @@ def main() -> None:
         print(f"league serving on {server.host}:{server.port}", flush=True)
         while True:
             time.sleep(3600)
+    elif args.type == "replay":
+        run_replay(args)
     elif args.type == "coordinator":
         # the broker evaluates the FULL rulebook: shipped telemetry gives it
         # per-source learner/actor/serve series for the whole fleet
-        _init_health(args, roles=("learner", "actor", "coordinator", "trace", "serve"),
+        _init_health(args, roles=("learner", "actor", "coordinator", "trace",
+                                  "serve", "replay"),
                      source="coordinator")
         server = CoordinatorServer(
             coordinator=Coordinator(default_lease_s=args.lease_s or None),

@@ -157,12 +157,15 @@ class Adapter:
                 raise TimeoutError(f"pull({token}) timed out")
             time.sleep(poll_s)
 
-    def start_pull_loop(self, token: str, maxlen: int = 8, keep_trace: bool = False) -> deque:
+    def start_pull_loop(self, token: str, maxlen: int = 8, keep_trace: bool = False,
+                        condition: Optional[threading.Condition] = None) -> deque:
         """Background loop keeping a bounded cache of payloads for ``token``.
         Backpressure: when the cache is full the loop pauses (payload stays
         with the producer until its serve window expires). With
         ``keep_trace`` the cache holds ``(payload, trace_ctx)`` tuples so the
-        consumer can continue the span (dataloader -> learner)."""
+        consumer can continue the span (dataloader -> learner). A
+        ``condition`` is notified on every append, so consumers can block in
+        ``condition.wait`` instead of busy-polling the deque."""
         from ..obs import get_registry
 
         cache: deque = deque(maxlen=maxlen)
@@ -170,6 +173,14 @@ class Adapter:
         depth_gauge = get_registry().gauge(
             "distar_adapter_cache_depth", "pull-loop cache occupancy", token=token
         )
+
+        def append(entry) -> None:
+            if condition is not None:
+                with condition:
+                    cache.append(entry)
+                    condition.notify_all()
+            else:
+                cache.append(entry)
 
         def run():
             while not self._stop.is_set():
@@ -185,11 +196,11 @@ class Adapter:
                     time.sleep(0.02)
                 else:
                     if keep_trace:
-                        cache.append((data, trace))
+                        append((data, trace))
                     else:
                         if trace is not None:
                             finish_trace(trace, hop="consumed")
-                        cache.append(data)
+                        append(data)
                     depth_gauge.set(len(cache))
 
         t = threading.Thread(target=run, daemon=True)

@@ -1,10 +1,15 @@
 from .base_learner import BaseLearner
 from .data import FakeRLDataloader, FakeSLDataloader, fake_rl_batch, fake_sl_batch
 from .hooks import Hook, HookRegistry, LambdaHook, default_hooks
+from .rl_dataloader import CollationError, RLDataLoader, ReplayDataLoader, collate_trajectories
 from .rl_learner import RLLearner, make_rl_train_step
 from .sl_learner import SLLearner, make_sl_train_step
 
 __all__ = [
+    "CollationError",
+    "RLDataLoader",
+    "ReplayDataLoader",
+    "collate_trajectories",
     "BaseLearner",
     "FakeRLDataloader",
     "FakeSLDataloader",

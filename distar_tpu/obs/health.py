@@ -274,7 +274,7 @@ class HealthEvaluator:
 
 # ------------------------------------------------------------ default rules
 def default_rulebook(roles: Iterable[str] = ("learner", "actor", "coordinator",
-                                             "trace", "serve"),
+                                             "trace", "serve", "replay"),
                      slo_e2e_s: float = 30.0,
                      queue_saturation: float = 384.0,
                      shed_rate_per_s: float = 5.0,
@@ -326,6 +326,22 @@ def default_rulebook(roles: Iterable[str] = ("learner", "actor", "coordinator",
             threshold=shed_rate_per_s, window_s=30.0, for_count=3,
             severity="warning",
             summary="gateway shedding load faster than the tolerated rate",
+        ))
+    if "replay" in roles:
+        book.append(HealthRule(
+            name="replay_table_saturation",
+            metric="distar_replay_table_occupancy", agg="last", op=">=",
+            threshold=0.95, window_s=stall_window_s, for_count=3,
+            severity="warning",
+            summary="replay table near max_size — eviction is eating "
+                    "unsampled trajectories",
+        ))
+        book.append(HealthRule(
+            name="replay_sample_stall",
+            metric="distar_replay_samples_total", op="stalled",
+            window_s=stall_window_s, for_count=3,
+            summary="replay store stopped serving samples (learner gone or "
+                    "rate limiter starved of inserts)",
         ))
     return book
 

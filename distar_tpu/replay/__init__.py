@@ -1,0 +1,49 @@
+"""Distributed trajectory store: Reverb-style tables with prioritized
+sampling, samples-per-insert rate control, and fsync'd disk spill so acked
+inserts survive a store crash.
+
+The decoupling layer between the actor fleet and the learner(s): actors
+``InsertClient.insert`` trajectories into per-player tables, learners
+``SampleClient.sample`` batches out, and the ``RateLimiter`` makes the
+reuse ratio (and therefore staleness) a configured invariant instead of an
+accident of queue sizes. See docs/data_plane.md for the shuttle-path vs
+store-path contract.
+"""
+from .client import DEFAULT_REPLAY_POLICY, InsertClient, SampleClient
+from .errors import (
+    ItemCorruptError,
+    RateLimitTimeout,
+    ReplayError,
+    UnknownTableError,
+    error_from_wire,
+)
+from .server import ReplayAdminServer, ReplayServer
+from .spill import SpillRing
+from .store import (
+    RateLimiter,
+    ReplayStore,
+    ReplayTable,
+    SampledItem,
+    SumTree,
+    TableConfig,
+)
+
+__all__ = [
+    "DEFAULT_REPLAY_POLICY",
+    "InsertClient",
+    "SampleClient",
+    "ItemCorruptError",
+    "RateLimitTimeout",
+    "ReplayError",
+    "UnknownTableError",
+    "error_from_wire",
+    "ReplayAdminServer",
+    "ReplayServer",
+    "SpillRing",
+    "RateLimiter",
+    "ReplayStore",
+    "ReplayTable",
+    "SampledItem",
+    "SumTree",
+    "TableConfig",
+]

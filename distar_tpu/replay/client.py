@@ -1,0 +1,137 @@
+"""Replay-store clients: acked inserts and blocking samples over framed TCP.
+
+Both clients follow the ``serve/tcp_frontend`` ServeClient shape — one
+connection, one request in flight, transport faults reconnect-and-retry
+under a ``resilience.RetryPolicy`` behind a per-client ``CircuitBreaker``
+(no connect storms against a dead store). Typed wire errors rehydrate into
+the ``replay.errors`` taxonomy; ``rate_limited`` is *retryable* (the store
+is pacing, not failing), so a default-policy client transparently rides
+through limiter blocks AND store restarts within its deadline budget.
+
+At-least-once note: a retried ``insert`` whose first attempt's ack was lost
+may insert twice. The spill/recovery contract is "no acked item is lost";
+duplicate trajectories are benign for RL training (one extra gradient
+sample), so inserts carry no dedup token.
+"""
+from __future__ import annotations
+
+import socket
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..comm.serializer import recv_msg, send_msg
+from ..resilience import CircuitBreaker, RetryPolicy, retry_call
+from .errors import error_from_wire
+
+#: store RPCs ride through limiter blocks and a several-second store
+#: restart by default; the deadline bounds how long an actor/learner can
+#: be parked before the fault surfaces to its supervisor
+DEFAULT_REPLAY_POLICY = RetryPolicy(
+    max_attempts=6, backoff_base_s=0.2, backoff_max_s=3.0, deadline_s=120.0,
+)
+
+
+class _ReplayClientBase:
+    def __init__(self, host: str, port: int, timeout_s: float = 60.0,
+                 retry_policy: Optional[RetryPolicy] = None,
+                 breaker: Optional[CircuitBreaker] = None,
+                 op_prefix: str = "replay"):
+        self._addr = (host, port)
+        self._timeout_s = timeout_s
+        self._policy = retry_policy or DEFAULT_REPLAY_POLICY
+        self._breaker = breaker or CircuitBreaker(
+            op=f"{op_prefix}:{host}:{port}", failure_threshold=8, reset_after_s=5.0)
+        self._op_prefix = op_prefix
+        self._sock: Optional[socket.socket] = None
+        self._lock = threading.Lock()
+
+    def _connect(self) -> None:
+        self.close()
+        self._sock = socket.create_connection(self._addr, timeout=self._timeout_s)
+        self._sock.settimeout(self._timeout_s)
+
+    def _call_once(self, req: dict) -> dict:
+        with self._lock:
+            if self._sock is None:
+                self._connect()
+            try:
+                send_msg(self._sock, req)
+                resp = recv_msg(self._sock)
+            except (ConnectionError, OSError, ValueError):
+                # stream no longer trustworthy: drop it so the retry dials
+                self.close()
+                raise
+        if resp.get("code") != 0:
+            raise error_from_wire(resp)
+        return resp
+
+    def _call(self, req: dict) -> dict:
+        # NOTE rate_limited subclasses RetryableError, so retry_call backs
+        # off and re-offers; repeated full-timeout blocks eventually open the
+        # breaker, which is the desired fail-fast once a store is truly wedged
+        return retry_call(
+            self._call_once, req, op=f"{self._op_prefix}:{req.get('op', '?')}",
+            policy=self._policy, breaker=self._breaker,
+        )
+
+    def ping(self) -> bool:
+        return self._call({"op": "ping"})["pong"]
+
+    def stats(self) -> dict:
+        return self._call({"op": "stats"})["stats"]
+
+    def tables(self) -> List[str]:
+        return self._call({"op": "tables"})["tables"]
+
+    def close(self) -> None:
+        sock, self._sock = self._sock, None
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+class InsertClient(_ReplayClientBase):
+    """Actor-side writer: ``insert`` returns only once the store acked (item
+    resident + spilled to disk when the store runs with a spill ring)."""
+
+    def __init__(self, host: str, port: int, **kwargs):
+        kwargs.setdefault("op_prefix", "replay_insert")
+        super().__init__(host, port, **kwargs)
+
+    def insert(self, table: str, item: Any, priority: float = 1.0,
+               timeout_s: Optional[float] = None) -> int:
+        req = {"op": "insert", "table": table, "item": item, "priority": priority}
+        if timeout_s is not None:
+            req["timeout_s"] = timeout_s
+        return self._call(req)["seq"]
+
+
+class SampleClient(_ReplayClientBase):
+    """Learner-side reader: blocking batched samples plus the PER
+    priority-refresh hook."""
+
+    def __init__(self, host: str, port: int, **kwargs):
+        kwargs.setdefault("op_prefix", "replay_sample")
+        super().__init__(host, port, **kwargs)
+
+    def sample(self, table: str, batch_size: int = 1,
+               timeout_s: Optional[float] = None) -> Tuple[List[Any], List[dict]]:
+        req = {"op": "sample", "table": table, "batch_size": batch_size}
+        if timeout_s is not None:
+            req["timeout_s"] = timeout_s
+        resp = self._call(req)
+        return resp["items"], resp["info"]
+
+    def update_priorities(self, table: str, updates: Dict[int, float]) -> int:
+        return self._call(
+            {"op": "update_priorities", "table": table, "updates": updates}
+        )["applied"]

@@ -1,0 +1,72 @@
+"""Typed replay-plane errors.
+
+Same contract as the serve plane's error taxonomy (serve/errors.py): every
+failure a client can dispatch on maps to a stable wire dict
+(``to_wire``/``error_from_wire``), so the framed-TCP data plane carries
+typed answers instead of ambiguous empties. ``RateLimitTimeout``
+additionally subclasses ``resilience.RetryableError``: a store that is
+rate-limit-blocked is *pacing* the caller, not failing, so the retry
+fabric backs off and re-offers instead of giving up or striking the peer.
+"""
+from __future__ import annotations
+
+from ..resilience import RetryableError
+
+
+class ReplayError(Exception):
+    """Base replay-store failure. ``code`` is the stable wire identifier."""
+
+    code = "replay_error"
+
+    def to_wire(self) -> dict:
+        return {"code": self.code, "error": str(self)}
+
+
+class UnknownTableError(ReplayError):
+    """Operation referenced a table the store doesn't hold (and the store
+    was configured without an auto-create factory)."""
+
+    code = "unknown_table"
+
+
+class RateLimitTimeout(ReplayError, RetryableError):
+    """The samples-per-insert limiter kept the operation blocked past its
+    timeout. Retryable by construction: no state was created, and the
+    block is the rate control working — inserters wait for the learner,
+    samplers wait for the actors (docs/data_plane.md)."""
+
+    code = "rate_limited"
+
+    def __init__(self, side: str, timeout_s: float, state: dict):
+        super().__init__(
+            f"{side} blocked > {timeout_s:.1f}s by the rate limiter ({state})"
+        )
+        self.side = side
+        self.state = state
+
+
+class ItemCorruptError(ReplayError):
+    """A spilled item failed its CRC check on recovery."""
+
+    code = "item_corrupt"
+
+
+_WIRE_CODES = {
+    cls.code: cls
+    for cls in (ReplayError, UnknownTableError, ItemCorruptError)
+}
+
+
+def error_from_wire(payload: dict) -> ReplayError:
+    """Rehydrate a typed error from its wire dict. ``rate_limited`` needs
+    its own path (the constructor signature differs); unknown codes degrade
+    to the base ``ReplayError`` so old clients survive new server codes."""
+    code = payload.get("code")
+    if code == RateLimitTimeout.code:
+        err = RateLimitTimeout(
+            payload.get("side", "?"), float(payload.get("timeout_s", 0.0)),
+            payload.get("state", {}),
+        )
+        return err
+    cls = _WIRE_CODES.get(code, ReplayError)
+    return cls(payload.get("error", ""))

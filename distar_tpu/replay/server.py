@@ -1,0 +1,217 @@
+"""Replay-store service: framed-TCP data plane + HTTP admin surface.
+
+Wire format = ``comm.serializer`` (8-byte big-endian length prefix around a
+pickled+compressed payload) with the ``serve/tcp_frontend`` conventions:
+one request/response dict pair per frame, ``{"code": 0, ...}`` on success,
+``{"code": <wire code>, "error": ...}`` typed on failure (errors.to_wire).
+
+Requests:
+  insert  {table, item, priority?, timeout_s?}    -> {code: 0, seq}
+  sample  {table, batch_size?, timeout_s?}        -> {code: 0, items, info}
+  update_priorities {table, updates}              -> {code: 0, applied}
+  stats   {}                                      -> {code: 0, stats}
+  tables  {}                                      -> {code: 0, tables}
+  ping    {}                                      -> {code: 0, pong: True}
+
+Blocking semantics live server-side: an insert/sample request parks its
+connection's handler thread in the table's ``RateLimiter`` until the
+operation is admitted or its ``timeout_s`` lapses (then answers the
+retryable ``rate_limited`` wire error). The admin surface
+(``ReplayAdminServer``) follows the CoordinatorServer pattern: GET
+``/metrics`` (Prometheus scrape), the fleet-health routes, and GET
+``/replay/stats`` for opsctl.
+"""
+from __future__ import annotations
+
+import json
+import socket
+import threading
+from typing import Optional
+
+from ..comm.serializer import recv_msg, send_msg
+from ..obs import get_registry
+from .errors import ReplayError
+from .store import ReplayStore
+
+
+class ReplayServer:
+    """Thread-per-connection framed-TCP server over one ``ReplayStore``."""
+
+    def __init__(self, store: ReplayStore, host: str = "127.0.0.1", port: int = 0,
+                 default_timeout_s: float = 30.0):
+        self.store = store
+        self.default_timeout_s = default_timeout_s
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((host, port))
+        self._listener.listen(128)
+        self.host, self.port = self._listener.getsockname()
+        self._stop = threading.Event()
+        self._accept_thread: Optional[threading.Thread] = None
+        self._conns: set = set()
+        self._conns_lock = threading.Lock()
+        reg = get_registry()
+        self._g_conns = reg.gauge(
+            "distar_replay_server_connections", "open replay data-plane connections")
+        self._c_requests = reg.counter(
+            "distar_replay_server_requests_total", "replay request frames handled")
+
+    def start(self) -> "ReplayServer":
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="replay-accept", daemon=True)
+        self._accept_thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            # shutdown BEFORE close: closing the fd does not wake an accept()
+            # blocked in another thread (tcp_frontend.py lesson)
+            self._listener.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        with self._conns_lock:
+            conns = list(self._conns)
+        for conn in conns:
+            try:
+                conn.close()
+            except OSError:
+                pass
+        t = self._accept_thread
+        if t is not None:
+            t.join(5.0)
+            self._accept_thread = None
+
+    # ------------------------------------------------------------------ loop
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._listener.accept()
+            except OSError:  # listener closed by stop()
+                return
+            conn.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            threading.Thread(
+                target=self._serve_conn, args=(conn,), name="replay-conn",
+                daemon=True,
+            ).start()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        self._g_conns.inc()
+        with self._conns_lock:
+            self._conns.add(conn)
+        try:
+            with conn:
+                while not self._stop.is_set():
+                    try:
+                        req = recv_msg(conn)
+                    except (ConnectionError, OSError):
+                        return  # peer closed (possibly mid-frame)
+                    except ValueError as e:
+                        send_msg(conn, {"code": "bad_frame", "error": repr(e)})
+                        return
+                    self._c_requests.inc()
+                    try:
+                        send_msg(conn, self._dispatch(req))
+                    except (ConnectionError, OSError):
+                        return
+        finally:
+            with self._conns_lock:
+                self._conns.discard(conn)
+            self._g_conns.dec()
+
+    def _dispatch(self, req) -> dict:
+        if not isinstance(req, dict) or "op" not in req:
+            return {"code": "bad_request", "error": f"not a request dict: {type(req)}"}
+        op = req["op"]
+        timeout_s = float(req.get("timeout_s", self.default_timeout_s))
+        try:
+            if op == "insert":
+                seq = self.store.insert(
+                    req["table"], req["item"],
+                    priority=float(req.get("priority", 1.0)), timeout_s=timeout_s,
+                )
+                return {"code": 0, "seq": seq}
+            if op == "sample":
+                sampled = self.store.sample(
+                    req["table"], batch_size=int(req.get("batch_size", 1)),
+                    timeout_s=timeout_s,
+                )
+                return {
+                    "code": 0,
+                    "items": [s.data for s in sampled],
+                    "info": [s.info() for s in sampled],
+                }
+            if op == "update_priorities":
+                return {"code": 0,
+                        "applied": self.store.update_priorities(
+                            req["table"], req["updates"])}
+            if op == "stats":
+                return {"code": 0, "stats": self.store.stats()}
+            if op == "tables":
+                return {"code": 0, "tables": self.store.tables()}
+            if op == "ping":
+                return {"code": 0, "pong": True}
+            return {"code": "bad_request", "error": f"unknown op {op!r}"}
+        except ReplayError as e:
+            wire = e.to_wire()
+            if wire.get("code") == "rate_limited":
+                wire.update(side=getattr(e, "side", "?"), timeout_s=timeout_s,
+                            state=getattr(e, "state", {}))
+            return wire
+        except Exception as e:  # a handler bug must not kill the connection
+            return {"code": "replay_error", "error": repr(e)}
+
+
+class ReplayAdminServer:
+    """HTTP admin/stats surface on the CoordinatorServer pattern: GET
+    ``/metrics`` (Prometheus text of this process's registry), the
+    fleet-health routes (``/healthz``, ``/alerts``, ``/timeseries``), and
+    GET ``/replay/stats`` (tables + limiter + spill JSON, the opsctl feed)."""
+
+    def __init__(self, store: ReplayStore, host: str = "127.0.0.1", port: int = 0):
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        self.store = store
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):
+                pass
+
+            def do_GET(self):
+                from ..obs import handle_health_get, write_scrape_response
+
+                path = self.path.split("?", 1)[0].rstrip("/")
+                if path == "/metrics":
+                    write_scrape_response(self)
+                    return
+                if path == "/replay/stats":
+                    data = json.dumps(outer.store.stats(), default=str).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(data)))
+                    self.end_headers()
+                    self.wfile.write(data)
+                    return
+                if handle_health_get(self, self.path):
+                    return
+                self.send_response(404)
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self.host, self.port = self._server.server_address
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "ReplayAdminServer":
+        self._thread = threading.Thread(target=self._server.serve_forever, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()

@@ -147,17 +147,20 @@ class ChaosInjector:
             self.bitflip(path)
 
     # ------------------------------------------------------------------ roles
-    def kill_role(self, role, sig: int = signal.SIGTERM) -> None:
+    def kill_role(self, role, sig: int = signal.SIGTERM, name: str = "") -> None:
         """Kill a role by whatever handle we have: an object with ``stop()``
-        (in-process servers), a Popen (terminate), or a pid (os.kill)."""
+        (in-process servers — coordinator, serve gateway, replay store), a
+        Popen (terminate), or a pid (os.kill). ``name`` tags the event for
+        post-mortems ("replay", "coordinator", ...) when the handle's class
+        name alone is ambiguous."""
         if hasattr(role, "stop"):
-            self._log("kill_role", role=type(role).__name__)
+            self._log("kill_role", role=name or type(role).__name__)
             role.stop()
         elif hasattr(role, "terminate"):
-            self._log("kill_role", pid=getattr(role, "pid", None))
+            self._log("kill_role", role=name, pid=getattr(role, "pid", None))
             role.terminate()
         else:
-            self._log("kill_role", pid=int(role), signal=int(sig))
+            self._log("kill_role", role=name, pid=int(role), signal=int(sig))
             os.kill(int(role), sig)
 
     def poison_loss(self, learner, n: int = 1, value: float = float("nan")) -> None:

@@ -10,13 +10,21 @@ faults tests already prove survivable:
   python tools/chaos.py kill --pid 12345 [--signal TERM|KILL]
   python tools/chaos.py reset --addr 127.0.0.1:8423 [--count 4]
   python tools/chaos.py latest --dir exp/checkpoints
+  python tools/chaos.py replay-drill --dir /tmp/replay_spill [--items 50] \\
+        [--no-spill] [--seed 0]
 
 ``corrupt`` damages a checkpoint in place (the resume path must fall back);
 ``kill`` sends a signal to a role process (the supervisor/orchestrator must
 restart it); ``reset`` opens connections to an endpoint and aborts them with
 RST (read paths must survive hard resets); ``latest`` prints the durable
 pointer's generations with per-generation verification status — run it after
-a drill to see the fallback the fleet actually took.
+a drill to see the fallback the fleet actually took; ``replay-drill`` stands
+up a real replay store + clients on loopback, kills the store mid-run
+(``ChaosInjector.kill_role`` with the replay role), restarts it from the
+spill directory and reports whether every acked insert survived (exit 0
+only when nothing was lost — or, with ``--no-spill``, when the expected
+loss was demonstrated: the counter-example the durability contract is
+measured against).
 """
 from __future__ import annotations
 
@@ -61,6 +69,61 @@ def cmd_reset(args) -> int:
     return 0 if n else 1
 
 
+def cmd_replay_drill(args) -> int:
+    """Kill-the-store-mid-run drill on a real server + real clients."""
+    from distar_tpu.replay import (
+        InsertClient, ReplayServer, ReplayStore, SampleClient, SpillRing,
+        TableConfig,
+    )
+    from distar_tpu.resilience import RetryPolicy
+
+    def table_cfg(_name):
+        return TableConfig(max_size=max(args.items * 2, 8),
+                           samples_per_insert=None, min_size_to_sample=1)
+
+    def build_store():
+        spill = None if args.no_spill else SpillRing(args.dir, max_items=args.items * 2)
+        store = ReplayStore(table_factory=table_cfg, spill=spill)
+        return store, store.recover()
+
+    inj = ChaosInjector(seed=args.seed)
+    store, _ = build_store()
+    server = ReplayServer(store, port=0).start()
+    inserter = InsertClient(server.host, server.port)
+    acked = [inserter.insert("drill", {"i": i}) for i in range(args.items)]
+    port = server.port
+    # the chaos moment: the store dies with every insert acked, none sampled
+    inj.kill_role(server, name="replay")
+    store2, recovered = build_store()
+    server2 = ReplayServer(store2, host=server.host, port=port).start()
+    sampler = SampleClient(server2.host, server2.port,
+                           retry_policy=RetryPolicy(max_attempts=2, deadline_s=5.0))
+    sampled = 0
+    try:
+        while sampled < len(acked):
+            items, _info = sampler.sample("drill", batch_size=1, timeout_s=0.5)
+            sampled += len(items)
+    except Exception:
+        pass  # a drained (or lossy) store times out — that IS the measurement
+    server2.stop()
+    lost = len(acked) - sampled
+    verdict = {
+        "acked": len(acked), "recovered_from_spill": recovered,
+        "sampled_after_restart": sampled, "lost": lost,
+        "spill": not args.no_spill, "events": [e["kind"] for e in inj.events],
+    }
+    print(json.dumps(verdict))
+    if args.no_spill:
+        # counter-demo: without the spill, acked data MUST be lost — if it
+        # isn't, the drill didn't actually kill anything
+        print("verdict: data loss demonstrated without spill"
+              if lost == len(acked) else "verdict: UNEXPECTED — nothing lost?")
+        return 0 if lost == len(acked) else 1
+    print("verdict: every acked insert survived the kill"
+          if lost == 0 else f"verdict: LOST {lost} acked trajectories")
+    return 0 if lost == 0 else 1
+
+
 def cmd_latest(args) -> int:
     mgr = CheckpointManager(args.dir)
     gens = mgr.generations()
@@ -101,9 +164,18 @@ def main() -> int:
     l = sub.add_parser("latest", help="inspect a durable latest pointer")
     l.add_argument("--dir", required=True, help="checkpoint directory")
 
+    d = sub.add_parser("replay-drill",
+                       help="kill a replay store mid-run; prove spill recovery")
+    d.add_argument("--dir", required=True, help="spill directory")
+    d.add_argument("--items", type=int, default=50, help="acked inserts before the kill")
+    d.add_argument("--no-spill", action="store_true",
+                   help="counter-demo: run without durability and show the loss")
+    d.add_argument("--seed", type=int, default=0)
+
     args = p.parse_args()
     return {"corrupt": cmd_corrupt, "kill": cmd_kill,
-            "reset": cmd_reset, "latest": cmd_latest}[args.command](args)
+            "reset": cmd_reset, "latest": cmd_latest,
+            "replay-drill": cmd_replay_drill}[args.command](args)
 
 
 if __name__ == "__main__":

@@ -11,7 +11,10 @@ serve HTTP frontend, or a training role started with --metrics-port):
 
 ``status`` exits 0 when healthy, 1 when any rule is warning, 2 when firing —
 scriptable for cron probes. ``tail-alerts`` follows the transition history
-(one line per ok/warning/firing edge, deduped by event sequence).
+(one line per ok/warning/firing edge, deduped by event sequence). When the
+probed address is a replay admin surface (``--type replay`` with
+``--metrics-port``), ``status`` additionally prints per-table occupancy and
+rate-limiter state from GET ``/replay/stats``.
 """
 from __future__ import annotations
 
@@ -65,6 +68,41 @@ def _fmt_ts(ts) -> str:
         return "--:--:--"
 
 
+def _try_get(addr: str, path: str, timeout: float = 5.0):
+    """Optional-surface probe: None when the route isn't served here (404)
+    or the peer is unreachable — never exits."""
+    try:
+        return _fetch(f"http://{addr}{path}", timeout)
+    except (urllib.error.HTTPError, CommError, ValueError):
+        return None
+
+
+def _print_replay(stats: dict) -> None:
+    """Replay-store digest for ``status``: per-table occupancy + the rate
+    limiter's live state (the two numbers that say which side of the fleet
+    is behind)."""
+    tables = stats.get("tables", {})
+    if not tables:
+        return
+    print("replay tables:")
+    for name in sorted(tables):
+        t = tables[name]
+        lim = t.get("limiter", {})
+        spi = lim.get("samples_per_insert")
+        blocked = ("insert" if not lim.get("can_insert", True) else
+                   "sample" if not lim.get("can_sample", True) else "-")
+        print(f"  {name:<16} {t.get('size', 0):>6}/{t.get('max_size', 0):<6} "
+              f"occ={t.get('occupancy', 0.0):5.2f}  sampler={t.get('sampler', '?'):<11} "
+              f"spi={spi if spi is not None else 'off':<5} "
+              f"ins={lim.get('inserts', 0)} smp={lim.get('samples', 0)} "
+              f"blocked={blocked} "
+              f"block_s=ins:{lim.get('block_insert_s', 0.0)}/smp:{lim.get('block_sample_s', 0.0)}")
+    spill = stats.get("spill")
+    if spill:
+        print(f"  spill: {spill.get('live')}/{spill.get('max_items')} live "
+              f"({spill.get('root')})")
+
+
 def cmd_status(args) -> int:
     body = _get(args.addr, "/healthz")
     status = body.get("status", "unknown")
@@ -87,6 +125,9 @@ def cmd_status(args) -> int:
         print(f"tsdb: {tsdb.get('series')} series "
               f"(cap {tsdb.get('max_series')} x {tsdb.get('points_per_series')} pts, "
               f"{tsdb.get('dropped_series')} dropped)")
+    replay = _try_get(args.addr, "/replay/stats")
+    if replay:
+        _print_replay(replay)
     return {"ok": 0, "warning": 1}.get(status, 2)
 
 
