@@ -87,6 +87,11 @@ class Actor:
         self._player_params = dict(player_params or {})
         self._rng = np.random.default_rng(self.cfg.seed)
         self._replay_client = None  # lazily dialed from cfg.actor.replay
+        rcfg = self.cfg.get("replay", {}) or {}
+        if rcfg.get("enabled", False) and rcfg.get("addr", ""):
+            # fail fast on a malformed address here, at config time — not
+            # mid-episode at the first push (docs/data_plane.md store path)
+            self._replay_target()
         self.results: List[dict] = []
         # highest learner iteration ever received per player — survives
         # across jobs (the per-job _model_iters resets), for freshness
@@ -559,15 +564,25 @@ class Actor:
     def _replay_cfg(self):
         return self.cfg.get("replay", {}) or {}
 
+    def _replay_target(self):
+        """Validated ``(host, port)`` from ``cfg.actor.replay.addr``; raises
+        a clear config error instead of a bare ``int()`` ValueError."""
+        addr = str(self._replay_cfg().get("addr", ""))
+        host, _, port = addr.rpartition(":")
+        try:
+            return host or "127.0.0.1", int(port)
+        except ValueError:
+            raise ValueError(
+                f"actor.replay.addr must be 'host:port', got {addr!r}"
+            ) from None
+
     def _get_replay_client(self):
         """Dial the replay store once per actor (the client reconnects +
         retries internally; docs/data_plane.md store path)."""
         if self._replay_client is None:
             from ..replay import InsertClient
 
-            addr = str(self._replay_cfg().get("addr", ""))
-            host, _, port = addr.rpartition(":")
-            self._replay_client = InsertClient(host or "127.0.0.1", int(port))
+            self._replay_client = InsertClient(*self._replay_target())
         return self._replay_client
 
     def push_trajectory(self, player_id: str, traj) -> None:
@@ -577,8 +592,11 @@ class Actor:
         rcfg = self._replay_cfg()
         use_replay = bool(rcfg.get("enabled", False)) and rcfg.get("addr", "")
         if use_replay:
-            client = self._get_replay_client()
             try:
+                # inside the try: client construction failing (config rot
+                # after init) must count as a dropped push, not kill the
+                # job loop mid-episode
+                client = self._get_replay_client()
                 client.insert(
                     player_id, traj,
                     priority=float(rcfg.get("priority", 1.0)),

@@ -153,13 +153,21 @@ def _table_config(args):
     from ..replay import TableConfig
 
     spi = args.replay_spi
+    batch = max(args.batch_size or 1, 1)
+    error_buffer = args.replay_error_buffer
+    if error_buffer is None and spi > 0:
+        # batch-aware slack (Reverb sizes its min/max_diff to the batch the
+        # same way): the limiter must be able to admit a whole learner batch
+        # or sampler and inserter deadlock trading timeouts — see
+        # RateLimiter.max_sample_batch
+        error_buffer = max(spi, 1.0) * batch
     return TableConfig(
         max_size=args.replay_max_size,
         sampler=args.replay_sampler,
         samples_per_insert=None if spi <= 0 else spi,
         # 0 = "the learner batch size": sampling can't start below one batch
-        min_size_to_sample=max(args.replay_min_size or args.batch_size or 1, 1),
-        error_buffer=args.replay_error_buffer,
+        min_size_to_sample=max(args.replay_min_size or batch, 1),
+        error_buffer=error_buffer,
         max_staleness_s=args.replay_max_staleness_s or None,
     )
 
@@ -480,7 +488,8 @@ def main() -> None:
                         "starts (0 = the learner batch size)")
     p.add_argument("--replay-error-buffer", type=float, default=None,
                    help="replay role: limiter slack in sample units "
-                        "(default max(1, spi))")
+                        "(default max(1, spi) * batch size, so a whole "
+                        "learner batch is always admissible)")
     p.add_argument("--replay-sampler", default="fifo",
                    choices=("fifo", "uniform", "prioritized"),
                    help="replay role: table sampler (fifo = consume-once "

@@ -11,6 +11,7 @@ store-path contract.
 """
 from .client import DEFAULT_REPLAY_POLICY, InsertClient, SampleClient
 from .errors import (
+    InvalidBatchError,
     ItemCorruptError,
     RateLimitTimeout,
     ReplayError,
@@ -32,6 +33,7 @@ __all__ = [
     "DEFAULT_REPLAY_POLICY",
     "InsertClient",
     "SampleClient",
+    "InvalidBatchError",
     "ItemCorruptError",
     "RateLimitTimeout",
     "ReplayError",

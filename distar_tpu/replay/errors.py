@@ -29,6 +29,16 @@ class UnknownTableError(ReplayError):
     code = "unknown_table"
 
 
+class InvalidBatchError(ReplayError):
+    """The requested sample batch can never be admitted under the table's
+    rate-limiter configuration (``batch_size`` exceeds what the
+    ``error_buffer`` slack allows even with inserters run to their bound).
+    Deliberately NOT retryable: waiting cannot fix a config mismatch, and
+    without this check both sides block forever trading timeouts."""
+
+    code = "invalid_batch"
+
+
 class RateLimitTimeout(ReplayError, RetryableError):
     """The samples-per-insert limiter kept the operation blocked past its
     timeout. Retryable by construction: no state was created, and the
@@ -53,7 +63,7 @@ class ItemCorruptError(ReplayError):
 
 _WIRE_CODES = {
     cls.code: cls
-    for cls in (ReplayError, UnknownTableError, ItemCorruptError)
+    for cls in (ReplayError, UnknownTableError, InvalidBatchError, ItemCorruptError)
 }
 
 

@@ -45,7 +45,8 @@ class SpillRing:
         self._seq = 0
         self._live: Dict[str, int] = {}  # key -> crc32 (insertion-ordered)
         self._ops_since_manifest = 0
-        os.makedirs(root, exist_ok=True)
+        if "://" not in root:  # scheme'd backends (mem://, gs://) need no dir
+            os.makedirs(root, exist_ok=True)
         reg = get_registry()
         self._g_items = reg.gauge(
             "distar_replay_spill_items", "acked-but-unsampled items on disk")
@@ -69,7 +70,8 @@ class SpillRing:
         """Continue the key sequence past any pre-crash files so a restarted
         store never reuses (and silently overwrites) a live key."""
         top = 0
-        for path in storage.resolve(self.root)[0].list(os.path.join(self.root, "")):
+        backend, rest = storage.resolve(self.root)
+        for path in backend.list(os.path.join(rest, "")):
             name = os.path.basename(path)
             if not name.endswith(_SUFFIX):
                 continue

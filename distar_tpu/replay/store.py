@@ -29,17 +29,24 @@ Rate control (``RateLimiter``): with ``spi = samples_per_insert``,
 
 by blocking samplers when actors fall behind and blocking inserters when
 the learner does. ``error_buffer`` is in sample units and is clamped to at
-least ``max(1, spi)`` so single-step progress is always possible.
+least ``max(1, spi)`` so single-step progress is always possible. The
+buffer also bounds the largest admissible sample batch
+(``RateLimiter.max_sample_batch``): a batch the buffer can never admit
+would park sampler AND inserter forever, so ``sample`` rejects it up front
+with the non-retryable ``InvalidBatchError`` — size the buffer to at least
+``max(1, spi) * batch_size`` (Reverb sizes its min/max_diff to the batch
+the same way; the launcher defaults ``--replay-error-buffer`` accordingly).
 """
 from __future__ import annotations
 
+import math
 import threading
 import time
 from dataclasses import dataclass
 from typing import Any, Callable, Dict, List, Optional
 
 from ..obs import get_registry
-from .errors import RateLimitTimeout, UnknownTableError
+from .errors import InvalidBatchError, RateLimitTimeout, UnknownTableError
 
 SAMPLERS = ("prioritized", "uniform", "fifo")
 
@@ -137,6 +144,19 @@ class RateLimiter:
             return True
         adj = self._inserts - self.min_size
         return self._samples + n <= self.spi * adj + self.error_buffer
+
+    def max_sample_batch(self) -> float:
+        """Largest batch a sampler can EVER be admitted with: inserters can
+        run at most ``floor(eb / spi)`` adjusted inserts ahead of a drained
+        sampler before the ratio blocks them, at which point
+        ``can_sample(n)`` needs ``n <= spi * floor(eb / spi) + eb``. A batch
+        above this bound deadlocks both sides — the sampler waits for
+        inserts the limiter will never allow, the inserter waits for samples
+        that can never be drawn — so callers reject it with a config error
+        instead of timing out forever."""
+        if self.spi is None:
+            return float("inf")
+        return self.spi * math.floor(self.error_buffer / self.spi + 1e-9) + self.error_buffer
 
     # -------------------------------------------------------------- waiting
     def await_cond(self, predicate: Callable[[], bool], timeout_s: Optional[float],
@@ -364,6 +384,15 @@ class ReplayTable:
         availability. Prioritized/uniform draw with replacement; fifo pops
         oldest-first (consume-once)."""
         assert batch_size >= 1
+        limit = self.limiter.max_sample_batch()
+        if batch_size > limit:
+            raise InvalidBatchError(
+                f"batch_size={batch_size} can never be admitted by table "
+                f"{self.name!r}: samples_per_insert={self.limiter.spi:g} with "
+                f"error_buffer={self.limiter.error_buffer:g} caps admissible "
+                f"batches at {limit:g}; raise error_buffer to at least "
+                f"max(1, samples_per_insert) * batch_size or shrink the batch"
+            )
         self.limiter.await_cond(
             lambda: self.limiter.can_sample(batch_size) and self._available(batch_size),
             timeout_s, "sample",
@@ -501,17 +530,29 @@ class ReplayStore:
     # ------------------------------------------------------------------ ops
     def insert(self, table: str, item: Any, priority: float = 1.0,
                timeout_s: Optional[float] = 60.0) -> int:
-        """Durable acked insert: the item lands in the table AND (when a
-        spill is attached) on disk — fsync'd, CRC'd — before the seq is
-        returned. An ack therefore survives a store crash."""
+        """Durable acked insert: the item lands on disk — fsync'd, CRC'd —
+        and THEN in the table, before the seq is returned. The spill write
+        must come first: the moment ``tbl.insert`` makes the item live, a
+        concurrent sampler or size eviction can fire ``on_release`` ->
+        ``spill.release(key)``, which must find the blob or it leaks as an
+        orphan (recovered as a duplicate forever, eating the ring bound).
+        A blob whose table insert then fails (rate-limit timeout) is
+        released here — the caller was never acked. A crash between append
+        and insert leaves an unacked blob that recovery re-inserts; the
+        producer's retry makes that the documented at-least-once duplicate,
+        never a loss."""
         tbl = self.table(table)
         spill_key = None
         if self._spill is not None:
             spill_key = self._spill.reserve_key(table)
-        seq = tbl.insert(item, priority=priority, timeout_s=timeout_s,
-                         spill_key=spill_key)
-        if self._spill is not None:
             self._spill.append(spill_key, table, item, priority)
+        try:
+            seq = tbl.insert(item, priority=priority, timeout_s=timeout_s,
+                             spill_key=spill_key)
+        except Exception:
+            if spill_key is not None:
+                self._spill.release(spill_key)
+            raise
         return seq
 
     def sample(self, table: str, batch_size: int = 1,

@@ -104,6 +104,30 @@ def _drain(loader, batches: int, sampled_uids: set, timeout_s: float = 60.0):
     return done
 
 
+def test_malformed_replay_addr_fails_fast_at_config_time():
+    """Regression: a bad actor.replay.addr used to raise from int(port) at
+    the FIRST PUSH, outside the drop-and-count try, killing the job loop
+    mid-episode. It must fail at construction with a clear config error."""
+    for addr in ("localhost", "host:", "host:not-a-port"):
+        with pytest.raises(ValueError, match="host:port"):
+            _make_actor(addr)
+
+
+def test_push_with_unreachable_store_is_dropped_and_counted():
+    """The documented drop semantics: a store outage past the retry budget
+    loses the trajectory (counted), never the episode."""
+    from distar_tpu.obs import get_registry
+
+    actor = _make_actor("127.0.0.1:1")  # nothing listens on port 1
+    actor._get_replay_client()._policy = NO_RETRY
+    drops = get_registry().counter(
+        "distar_actor_replay_push_failures_total",
+        "replay-store inserts dropped after retries", player=PLAYER)
+    before = drops.value
+    actor.push_trajectory(PLAYER, _traj(0))  # must not raise
+    assert drops.value == before + 1
+
+
 def test_toy_fleet_enforces_samples_per_insert(tmp_path):
     """Train-through-the-store with the limiter on: the measured reuse ratio
     lands within +/-10% of the configured samples-per-insert."""

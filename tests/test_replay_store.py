@@ -9,6 +9,7 @@ import pytest
 
 from distar_tpu.replay import (
     InsertClient,
+    InvalidBatchError,
     RateLimitTimeout,
     RateLimiter,
     ReplayAdminServer,
@@ -189,6 +190,51 @@ def test_fifo_rejects_reuse_ratio_above_one():
         TableConfig(sampler="fifo", samples_per_insert=2.0)
 
 
+def test_limiter_max_sample_batch():
+    assert RateLimiter(1.0, 1, error_buffer=1.0).max_sample_batch() == 2.0
+    assert RateLimiter(2.0, 1, error_buffer=2.0).max_sample_batch() == 4.0
+    assert RateLimiter(1.0, 1, error_buffer=4.0).max_sample_batch() == 8.0
+    assert RateLimiter(None, 1).max_sample_batch() == float("inf")
+
+
+def test_inadmissible_batch_raises_config_error_not_timeout():
+    """Regression: spi=1 + error_buffer=1 + batch=4 (the old launcher
+    defaults) deadlocks — can_sample(4) needs inserts the limiter will never
+    admit, so sampler AND inserter block trading timeouts forever. The
+    store must answer with a non-retryable config error instead."""
+    table = ReplayTable("dead", TableConfig(
+        max_size=64, sampler="uniform", samples_per_insert=1.0,
+        min_size_to_sample=4, error_buffer=1.0))
+    table.insert("a", timeout_s=1.0)
+    with pytest.raises(InvalidBatchError, match="error_buffer"):
+        table.sample(batch_size=4, timeout_s=0.2)
+    # an admissible batch on the same table still behaves normally
+    with pytest.raises(RateLimitTimeout):  # below min_size: pacing, retryable
+        table.sample(batch_size=1, timeout_s=0.05)
+
+
+def test_launcher_default_error_buffer_admits_the_learner_batch():
+    """rl_train._table_config sizes the default error_buffer to
+    max(1, spi) * batch_size so `--type replay` + rl_train's default batch
+    can never build a deadlocked table."""
+    import argparse
+
+    from distar_tpu.bin.rl_train import _table_config
+
+    args = argparse.Namespace(
+        replay_spi=1.0, replay_max_size=1024, replay_sampler="uniform",
+        replay_min_size=0, replay_error_buffer=None,
+        replay_max_staleness_s=0.0, batch_size=4)
+    cfg = _table_config(args)
+    assert cfg.error_buffer == 4.0
+    lim = RateLimiter(cfg.samples_per_insert, cfg.min_size_to_sample,
+                      error_buffer=cfg.error_buffer)
+    assert lim.max_sample_batch() >= 4
+    # an explicit CLI value still wins
+    args.replay_error_buffer = 2.5
+    assert _table_config(args).error_buffer == 2.5
+
+
 # --------------------------------------------------------------------- spill
 def test_spill_roundtrip_and_release(tmp_path):
     spill = SpillRing(str(tmp_path), max_items=8)
@@ -226,6 +272,37 @@ def test_spill_skips_corrupt_blobs(tmp_path, chaos):
     fresh = ReplayStore(table_factory=lambda n: _cfg(),
                         spill=SpillRing(str(tmp_path), max_items=8))
     assert fresh.recover() == 2  # the flipped blob failed CRC and was skipped
+
+
+def test_insert_spills_blob_before_ack_and_releases_on_timeout(tmp_path):
+    """Regression: the blob must be on disk BEFORE the item goes live (a
+    concurrent release must find it), and a rate-limited insert must not
+    leak its reserved blob as a forever-recovered orphan."""
+    spill = SpillRing(str(tmp_path), max_items=8)
+    cfg = TableConfig(max_size=16, sampler="uniform", samples_per_insert=1.0,
+                      min_size_to_sample=1, error_buffer=1.0)
+    store = ReplayStore(table_factory=lambda n: cfg, spill=spill)
+    store.insert("T", 0)
+    store.insert("T", 1)
+    # limiter now blocks inserts (2 inserts ahead, 0 samples, buffer 1)
+    with pytest.raises(RateLimitTimeout):
+        store.insert("T", 2, timeout_s=0.05)
+    assert spill.live_count() == 2  # the timed-out blob was released
+    fresh = ReplayStore(table_factory=lambda n: cfg,
+                        spill=SpillRing(str(tmp_path), max_items=8))
+    assert fresh.recover() == 2  # no orphan comes back as a duplicate
+
+
+def test_spill_bootstrap_lists_resolved_root_for_schemed_backend():
+    """Regression: _bootstrap_seq listed the unresolved root, so a scheme'd
+    spill (mem://, gs://) restarted its key sequence at 0 and silently
+    overwrote live blobs."""
+    root = "mem://spill-bootstrap-regression"
+    first = SpillRing(root, max_items=8)
+    first.append(first.reserve_key("T"), "T", {"i": 1}, 1.0)
+    restarted = SpillRing(root, max_items=8)
+    key = restarted.reserve_key("T")
+    assert int(key.rsplit("-", 1)[-1]) >= 1  # never reuses the live key
 
 
 def test_spill_key_sequence_survives_restart(tmp_path):
@@ -282,6 +359,24 @@ def test_server_rate_limit_timeout_is_retryable_wire_error():
         with pytest.raises(RateLimitTimeout) as e:
             sc.sample("MP0", timeout_s=0.05)
         assert e.value.side == "sample"
+        sc.close()
+    finally:
+        server.stop()
+
+
+def test_server_invalid_batch_is_nonretryable_wire_error():
+    """An inadmissible batch must surface immediately as the typed
+    invalid_batch error — not burn the client's whole retry/deadline budget
+    the way the (retryable) rate_limited answer does."""
+    store = ReplayStore(
+        table_factory=lambda n: _cfg(samples_per_insert=1.0, error_buffer=1.0))
+    server = ReplayServer(store, port=0).start()
+    try:
+        sc = SampleClient(server.host, server.port)
+        t0 = time.monotonic()
+        with pytest.raises(InvalidBatchError):
+            sc.sample("MP0", batch_size=8, timeout_s=5.0)
+        assert time.monotonic() - t0 < 2.0  # no retry loop, no server-side park
         sc.close()
     finally:
         server.stop()
