@@ -80,16 +80,50 @@ def _env_fn(args):
 
 def _learner_cfg(args, model_cfg: dict, load_path: str = "") -> dict:
     return {
-        "common": {"experiment_name": args.experiment_name},
+        "common": {"experiment_name": args.experiment_name,
+                   **({"save_path": args.save_path}
+                      if getattr(args, "save_path", "") else {})},
         "learner": {
             "batch_size": args.batch_size,
             "unroll_len": args.traj_len,
             "log_freq": max(args.iters // 4, 1),
             "save_freq": 10 ** 9,
+            # --mesh implies the distributed checkpoint layout (restorable
+            # onto any other mesh shape); --sharded-ckpt/--no-sharded-ckpt
+            # override either way
+            "sharded_ckpt": (
+                bool(getattr(args, "mesh", ""))
+                if getattr(args, "sharded_ckpt", None) is None
+                else bool(args.sharded_ckpt)
+            ),
             **({"load_path": load_path} if load_path else {}),
         },
         "model": model_cfg,
     }
+
+
+def _mesh_from_args(args):
+    """--mesh dp=K,fsdp=M,tp=N,sp=S -> a live jax mesh (None without the
+    flag: learners build their own all-dp default). Typed MeshConfigError
+    when the axes don't factor the devices."""
+    if not getattr(args, "mesh", ""):
+        return None
+    import jax
+
+    from ..parallel import MeshSpec, make_mesh
+
+    spec = MeshSpec.parse(args.mesh)
+    devices = None
+    if spec.dp != -1:
+        # fully explicit spec: claim exactly that many devices (--mesh dp=4
+        # on an 8-device host means a 4-chip mesh, not a config error)
+        devices = jax.devices()[: spec.dp * spec.fsdp * spec.tp * spec.sp]
+    return make_mesh(spec, devices)
+
+
+def _mesh_kwargs(args) -> dict:
+    mesh = _mesh_from_args(args)
+    return {"mesh": mesh} if mesh is not None else {}
 
 
 def _init_health(args, roles, source="local", shipper_addr=None):
@@ -108,8 +142,12 @@ def _init_health(args, roles, source="local", shipper_addr=None):
         eval_interval_s=getattr(args, "health_eval_s", 2.0),
         source=source,
     )
+    from ..learner.base_learner import experiments_root
+
     artifact_dir = os.path.join(
-        os.getcwd(), "experiments", getattr(args, "experiment_name", "run"), "flight"
+        getattr(args, "save_path", "") or os.path.join(
+            experiments_root(), getattr(args, "experiment_name", "run")),
+        "flight",
     )
     fleet.recorder.install_crash_hook(artifact_dir, config=vars(args))
     if shipper_addr is not None:
@@ -301,7 +339,7 @@ def run_all(args) -> None:
         ).attach(fleet.evaluator)
 
     learner = plugins.load_component(args.pipeline, "RLLearner")(
-        _learner_cfg(args, model_cfg))
+        _learner_cfg(args, model_cfg), **_mesh_kwargs(args))
     if replay_server is not None:
         from ..learner.rl_dataloader import ReplayDataLoader
         from ..replay import SampleClient
@@ -365,7 +403,7 @@ def run_learner(args) -> None:
         if ckpt and os.path.exists(ckpt):
             load_path = ckpt
     learner = plugins.load_component(args.pipeline, "RLLearner")(
-        _learner_cfg(args, model_cfg, load_path=load_path))
+        _learner_cfg(args, model_cfg, load_path=load_path), **_mesh_kwargs(args))
     if not load_path and not getattr(args, "no_supervise", False):
         # a restarted learner process (k8s/systemd) picks up its own durable
         # latest pointer before cold-starting — zero manual intervention
@@ -433,6 +471,27 @@ def main() -> None:
     p.add_argument("--env-num", type=int, default=None)
     p.add_argument("--episode-game-loops", type=int, default=300)
     p.add_argument("--experiment-name", default="rl_train")
+    p.add_argument("--save-path", default="",
+                   help="experiment root override (default "
+                        "$DISTAR_EXPERIMENTS_ROOT or ./experiments/<name>); "
+                        "scope smoke runs to tmp dirs so stale checkpoints "
+                        "never poison auto-resume")
+    p.add_argument("--mesh", default="",
+                   help="device-mesh spec for the learner, e.g. "
+                        "'dp=4,fsdp=2,tp=1' — compiles the jitted train "
+                        "step with NamedSharding in/out shardings on the "
+                        "live mesh and turns on sharded checkpoints "
+                        "(docs/parallel.md)")
+    p.add_argument("--host-devices", type=int, default=0,
+                   help="force a virtual n-device CPU platform before jax "
+                        "init (multichip smoke without silicon: "
+                        "--host-devices 8 --mesh dp=4,fsdp=2)")
+    p.add_argument("--sharded-ckpt", action="store_true", default=None,
+                   help="checkpoint as one CRC'd blob per parameter shard "
+                        "+ layout manifest (default: on when --mesh is "
+                        "given, off otherwise)")
+    p.add_argument("--no-sharded-ckpt", dest="sharded_ckpt",
+                   action="store_false")
     p.add_argument("--smoke-model", action="store_true", default=True)
     p.add_argument("--full-model", dest="smoke_model", action="store_false")
     p.add_argument("--port", type=int, default=0)
@@ -517,7 +576,13 @@ def main() -> None:
                         "(this image selects the TPU at interpreter start, "
                         "so JAX_PLATFORMS=cpu alone is too late)")
     args = p.parse_args()
-    if args.platform != "auto":
+    if args.host_devices:
+        # must precede ANY jax backend init (device query) in this process
+        from ..parallel.executor import force_host_devices
+
+        force_host_devices(args.host_devices,
+                           cache_base="/tmp/jax_cache_distar_tpu")
+    elif args.platform != "auto":
         import jax
 
         jax.config.update("jax_platforms", args.platform)

@@ -14,6 +14,7 @@ admitted requests flush, then the process exits.
 from __future__ import annotations
 
 import argparse
+import os
 import signal
 import threading
 
@@ -65,7 +66,10 @@ def main() -> None:
     if not args.mock and not args.checkpoint:
         p.error("--checkpoint is required unless --mock")
 
-    logger = TextLogger("./experiments/serve", "serve")
+    from ..learner.base_learner import experiments_root
+
+    serve_dir = os.path.join(experiments_root(), "serve")
+    logger = TextLogger(serve_dir, "serve")
 
     # fleet health: serve rulebook (shed-rate + request-trace SLO), TSDB
     # behind GET /healthz /alerts /timeseries on the HTTP frontend, crash
@@ -76,7 +80,7 @@ def main() -> None:
         fleet = init_fleet_health(rules=default_rulebook(("serve", "trace")),
                                   source="serve")
         fleet.recorder.install_crash_hook(
-            "./experiments/serve/flight", config=vars(args)
+            os.path.join(serve_dir, "flight"), config=vars(args)
         )
 
     engine, load_fn = build_engine(args)

@@ -18,7 +18,9 @@ import argparse
 
 from .. import plugins
 from ..utils import read_config
-from .rl_train import _addr, _init_health, _restart_policy, _run_learner_supervised
+from .rl_train import (
+    _addr, _init_health, _mesh_kwargs, _restart_policy, _run_learner_supervised,
+)
 
 
 def _learner(args) -> None:
@@ -32,15 +34,22 @@ def _learner(args) -> None:
     model_cfg = user_cfg.get("model", SMOKE_MODEL if args.smoke_model else {})
     learner = plugins.load_component(args.pipeline, "SLLearner")(
         {
-            "common": {"experiment_name": args.experiment_name},
+            "common": {"experiment_name": args.experiment_name,
+                       **({"save_path": args.save_path}
+                          if args.save_path else {})},
             "learner": {
                 "batch_size": args.batch_size,
                 "unroll_len": args.traj_len,
                 "log_freq": max(args.iters // 4, 1),
                 "save_freq": 10 ** 9,
+                "sharded_ckpt": (
+                    bool(args.mesh) if args.sharded_ckpt is None
+                    else bool(args.sharded_ckpt)
+                ),
             },
             "model": model_cfg,
-        }
+        },
+        **_mesh_kwargs(args),
     )
     if args.data:
         from ..learner.sl_dataloader import ReplayDataset, SLDataloader
@@ -158,6 +167,21 @@ def main() -> None:
     p.add_argument("--batch-size", type=int, default=None)
     p.add_argument("--traj-len", type=int, default=None)
     p.add_argument("--experiment-name", default="sl_train")
+    p.add_argument("--save-path", default="",
+                   help="experiment root override (default "
+                        "$DISTAR_EXPERIMENTS_ROOT or ./experiments/<name>)")
+    p.add_argument("--mesh", default="",
+                   help="device-mesh spec, e.g. 'dp=4,fsdp=2' — live-mesh "
+                        "GSPMD train step + sharded checkpoints "
+                        "(docs/parallel.md)")
+    p.add_argument("--host-devices", type=int, default=0,
+                   help="force a virtual n-device CPU platform before jax "
+                        "init (multichip smoke without silicon)")
+    p.add_argument("--sharded-ckpt", action="store_true", default=None,
+                   help="one CRC'd blob per parameter shard + layout "
+                        "manifest (default: on when --mesh is given)")
+    p.add_argument("--no-sharded-ckpt", dest="sharded_ckpt",
+                   action="store_false")
     p.add_argument("--data", default="",
                    help="local ReplayDataset directory (decoded trajectories)")
     p.add_argument("--eval-data", default="",
@@ -192,7 +216,13 @@ def main() -> None:
                         "(this image selects the TPU at interpreter start, "
                         "so JAX_PLATFORMS=cpu alone is too late)")
     args = p.parse_args()
-    if args.platform != "auto":
+    if args.host_devices:
+        # must precede ANY jax backend init (device query) in this process
+        from ..parallel.executor import force_host_devices
+
+        force_host_devices(args.host_devices,
+                           cache_base="/tmp/jax_cache_distar_tpu")
+    elif args.platform != "auto":
         import jax
 
         jax.config.update("jax_platforms", args.platform)

@@ -29,6 +29,17 @@ from ..utils.checkpoint import (
 )
 from .hooks import HookRegistry, default_hooks
 
+
+def experiments_root() -> str:
+    """Default experiment-dir root. ``DISTAR_EXPERIMENTS_ROOT`` overrides the
+    cwd-relative ``experiments/`` — the test harness points it at a tmp dir
+    so a stale ``experiments/`` from a previous run can never poison a later
+    run's auto-resume (the PR 5 tier-1 failure mode)."""
+    return os.environ.get("DISTAR_EXPERIMENTS_ROOT") or os.path.join(
+        os.getcwd(), "experiments"
+    )
+
+
 DEFAULT_LEARNER_CONFIG = Config(
     {
         "common": {"experiment_name": "default_experiment", "save_path": ""},
@@ -40,6 +51,11 @@ DEFAULT_LEARNER_CONFIG = Config(
             "load_path": "",
             "max_iterations": 10 ** 9,
             "grad_clip": {"type": "none", "threshold": 1.0},
+            # sharded checkpoints (parallel/ckpt.py): one CRC'd blob per
+            # parameter shard + layout manifest, restorable onto ANY mesh.
+            # Default off: monolithic .ckpt files stay the single-chip norm;
+            # the --mesh CLI path and the executor turn it on.
+            "sharded_ckpt": False,
             # device profiler hook: every profile.freq iters capture
             # profile.duration iters of jax.profiler trace (0 = disabled)
             "profile": {"freq": 0, "duration": 2, "logdir": ""},
@@ -54,7 +70,7 @@ class BaseLearner:
         self.rank = jax.process_index()
         self.world_size = jax.process_count()
         exp = self.cfg.common.experiment_name
-        root = self.cfg.common.save_path or os.path.join(os.getcwd(), "experiments", exp)
+        root = self.cfg.common.save_path or os.path.join(experiments_root(), exp)
         self.save_dir = root
         self.logger, self.scalar_sink, self.variable_record = build_logger(
             os.path.join(root, "logs"), f"{self.name}_rank{self.rank}", to_console=self.rank == 0
@@ -115,21 +131,79 @@ class BaseLearner:
         durable, so crash-resume never points at a half-written file."""
         meta = {"last_iter": self.last_iter.val}
         step = self.last_iter.val
+        snapshot_fn = write_fn = None
+        if self.cfg.learner.get("sharded_ckpt", False):
+            # distributed mode: per-shard D2H snapshot (sync — donated
+            # buffers), per-shard CRC'd blob writes + layout manifest
+            # (background); generation pointer discipline is identical
+            from ..parallel import ckpt as dist_ckpt
+
+            snapshot_fn = dist_ckpt.snapshot_sharded
+            write_fn = dist_ckpt.write_sharded
         if sync or not self.cfg.learner.get("async_save", True):
             self._checkpointer.wait()  # never race an in-flight async write
-            save_checkpoint(path, self._state, metadata=meta)
+            if write_fn is not None:
+                write_fn(path, snapshot_fn(self._state), meta)
+            else:
+                save_checkpoint(path, self._state, metadata=meta)
             self._ckpt_manager.record(path, step=step)
         else:
             self._checkpointer.save(
                 path, self._state, metadata=meta,
                 on_complete=lambda p, s=step: self._ckpt_manager.record(p, step=s),
+                snapshot_fn=snapshot_fn, write_fn=write_fn,
             )
 
     def restore(self, path: str) -> None:
         self._checkpointer.wait()  # the path may still be being written
         out = load_checkpoint(path, target=self._state)
+        self._validate_restored(path, out["state"])
+        layout = out.get("sharding_layout") or {}
+        saved_mesh = layout.get("mesh_shape")
+        cur_mesh = dict(self.mesh.shape) if getattr(self, "mesh", None) is not None else None
+        if saved_mesh and cur_mesh and dict(saved_mesh) != cur_mesh:
+            # resharding restore: the checkpoint's host-global arrays are
+            # about to be re-pinned onto a DIFFERENT mesh layout
+            self.metrics.counter(
+                "distar_ckpt_reshards_total",
+                "sharded checkpoints restored onto a different mesh shape",
+            ).inc()
+            self.logger.info(
+                f"resharding restore: checkpoint mesh {saved_mesh} -> "
+                f"live mesh {cur_mesh}"
+            )
         self._state = self._place_state(out["state"])
         self.last_iter.update(out["metadata"].get("last_iter", 0))
+
+    def _validate_restored(self, path: str, state) -> None:
+        """Auto-resume guard: a checkpoint whose leaves don't match this
+        learner's state shapes (different model config — typically a stale
+        experiment dir from an unrelated run) must fail TYPED here, so
+        ``resume_latest`` falls back/cold-starts instead of poisoning the
+        run (and a direct ``restore`` fails before the train step does,
+        with the offending leaves named)."""
+        if self._state is None:
+            return
+        from ..utils.checkpoint import CheckpointMismatchError
+
+        cur = jax.tree_util.tree_flatten_with_path(self._state)[0]
+        new = jax.tree_util.tree_flatten_with_path(state)[0]
+        cur_shapes = {
+            jax.tree_util.keystr(p): tuple(getattr(x, "shape", ()) or ())
+            for p, x in cur
+        }
+        mismatched = []
+        for p, x in new:
+            key = jax.tree_util.keystr(p)
+            shape = tuple(getattr(x, "shape", ()) or ())
+            if key in cur_shapes and cur_shapes[key] != shape:
+                mismatched.append(f"{key}: ckpt {shape} != state {cur_shapes[key]}")
+        if mismatched:
+            raise CheckpointMismatchError(
+                f"{path} does not fit this learner "
+                f"({len(mismatched)} mismatched leaves, e.g. "
+                f"{'; '.join(mismatched[:3])}); refusing to resume from it"
+            )
 
     def resume_latest(self) -> Optional[str]:
         """Crash-resume: restore from the newest VALID generation behind the
@@ -210,17 +284,22 @@ class BaseLearner:
         return batch
 
     def _maybe_enable_prefetch(self) -> None:
-        """Wrap the dataloader in a device prefetcher (the reference's async
-        copy process, rl_dataloader.py:113-127): the next batch lands in HBM
-        while the current step trains. Disable with learner.prefetch_depth=0."""
+        """Wrap the dataloader in the sharded batch feeder (the reference's
+        async copy process, rl_dataloader.py:113-127, generalised to a mesh):
+        the next batch is collated on the host and placed — sharded over the
+        live mesh — while the current step trains. Disable with
+        learner.prefetch_depth=0."""
+        from ..parallel.feeder import ShardFeeder
         from .prefetch import DevicePrefetcher
 
         depth = int(self.cfg.learner.get("prefetch_depth", 2))
-        if depth <= 0 or isinstance(self._dataloader, DevicePrefetcher):
+        if depth <= 0 or isinstance(self._dataloader, (ShardFeeder, DevicePrefetcher)):
             return
         if type(self)._place_batch is BaseLearner._place_batch:
             return  # learner doesn't define placement
-        self._dataloader = DevicePrefetcher(self._dataloader, self._place_batch, depth)
+        self._dataloader = ShardFeeder(
+            self._dataloader, self._place_batch, depth=depth, token=self.name
+        )
 
     # ------------------------------------------------------------------ run
     def run(self, max_iterations: Optional[int] = None) -> None:

@@ -8,6 +8,12 @@ streams over PCIe/ICI in the background), so a single thread that PULLS the
 next host batch and ISSUES its placement is enough — the XLA runtime
 overlaps the copy with the in-flight train step, and the bounded queue
 double-buffers without pinning more than ``depth`` batches in HBM.
+
+NOTE: the learner run loop now wraps dataloaders in
+``parallel.feeder.ShardFeeder`` — the mesh-aware superset of this class
+(same double-buffer semantics + per-host global-array assembly +
+``distar_feeder_*`` instrumentation). ``DevicePrefetcher`` stays as the
+dependency-free primitive for host-only pipelines.
 """
 from __future__ import annotations
 

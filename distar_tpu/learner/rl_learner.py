@@ -248,7 +248,9 @@ class RLLearner(BaseLearner):
             opt=opt_sh,  # restore() re-places host state onto param/opt
             batch=time_batch_sharding(self.mesh),  # [T(,+1), B, ...]
             batch_nosp=NamedSharding(self.mesh, P(None, dp_axes(self.mesh))),
-            flat=batch_sharding(self.mesh),  # [B]-leading leaves
+            # batch_size validates here: typed MeshConfigError at compile
+            # time, not an opaque XLA sharding error on the first step
+            flat=batch_sharding(self.mesh, batch_size=B),  # [B]-leading leaves
         )
         self._train_step = jax.jit(
             step_fn,
@@ -263,7 +265,14 @@ class RLLearner(BaseLearner):
         (axis 1 for time-major leaves, axis 0 for hidden_state). On an sp>1
         mesh the time axis additionally shards over sp — per leaf, because
         the batch mixes T+1 (obs/values) and T (reward/mask) leading dims
-        and only sp-divisible ones can shard."""
+        and only sp-divisible ones can shard.
+
+        Placement goes through ``parallel.feeder.assemble_global``: on one
+        host that is an async ``device_put``; on a pod every host
+        contributes its own batch shard and jax assembles the global
+        array (``make_array_from_process_local_data``)."""
+        from ..parallel.feeder import assemble_global
+
         hidden = batch.pop("hidden_state")
         sp = self.mesh.shape["sp"]
         dp_prod = self.mesh.shape["dp"] * self.mesh.shape["fsdp"]
@@ -278,11 +287,11 @@ class RLLearner(BaseLearner):
                 sh = self._shardings["flat"]
             else:
                 sh = self._shardings["repl"]
-            return jax.device_put(x, sh)
+            return assemble_global(x, sh)
 
         out = jax.tree.map(put, batch)
         out["hidden_state"] = jax.tree.map(
-            lambda x: jax.device_put(jnp.asarray(x), self._shardings["flat"]), hidden
+            lambda x: assemble_global(jnp.asarray(x), self._shardings["flat"]), hidden
         )
         batch["hidden_state"] = hidden
         return out

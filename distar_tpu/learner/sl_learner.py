@@ -160,7 +160,9 @@ class SLLearner(BaseLearner):
             "params": params,
             "opt_state": jax.jit(self.optimizer.init, out_shardings=opt_sh)(params),
         }
-        flat_sh = batch_sharding(self.mesh)
+        # batch_size validates here: typed MeshConfigError at compile time,
+        # not an opaque XLA sharding error on the first step
+        flat_sh = batch_sharding(self.mesh, batch_size=B)
         self._shardings = dict(repl=repl, param=param_sh, opt=opt_sh, flat=flat_sh)
         self._train_step = jax.jit(
             make_sl_train_step(
@@ -227,11 +229,15 @@ class SLLearner(BaseLearner):
         return {k: v / max(n, 1) for k, v in sums.items()}
 
     def _place_batch(self, data):
-        """Prefetch placement: device-put ahead of time, host fields kept."""
+        """Prefetch placement: placed (mesh-sharded) ahead of time, host
+        fields kept. Routes through ``assemble_global`` so per-host shards
+        assemble into global arrays on a pod."""
+        from ..parallel.feeder import assemble_global
+
         data = self._cap(dict(data))
         host = {k: np.asarray(data.pop(k)) for k in ("new_episodes", "traj_lens") if k in data}
         out = jax.tree.map(
-            lambda x: jax.device_put(jnp.asarray(x), self._shardings["flat"]), data
+            lambda x: assemble_global(jnp.asarray(x), self._shardings["flat"]), data
         )
         out.update(host)
         out["_on_device"] = True

@@ -1,7 +1,9 @@
 from .mesh import (
+    MeshConfigError,
     MeshSpec,
     make_mesh,
     batch_sharding,
+    check_batch_divisible,
     replicated_sharding,
     param_sharding,
     fsdp_param_sharding,
@@ -11,11 +13,14 @@ from .mesh import (
 from .ring_attention import ring_attention, ring_self_attention
 from .grad_clip import GradClipConfig, build_grad_clip
 from .optimizer import build_optimizer
+from .feeder import ShardFeeder, assemble_global
 
 __all__ = [
+    "MeshConfigError",
     "MeshSpec",
     "make_mesh",
     "batch_sharding",
+    "check_batch_divisible",
     "replicated_sharding",
     "param_sharding",
     "fsdp_param_sharding",
@@ -26,4 +31,6 @@ __all__ = [
     "build_optimizer",
     "ring_attention",
     "ring_self_attention",
+    "ShardFeeder",
+    "assemble_global",
 ]

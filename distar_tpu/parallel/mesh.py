@@ -24,6 +24,14 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 AXES = ("dp", "fsdp", "tp", "sp")
 
+
+class MeshConfigError(ValueError):
+    """A mesh/batch configuration cannot be realised on the available
+    devices (axes don't factor the device count, or a batch doesn't divide
+    the data-parallel extent). Raised at config/compile time with the
+    offending numbers, instead of surfacing later as an opaque XLA
+    sharding error."""
+
 # The mesh model-internal sharded ops (ring attention over sp) resolve at
 # trace time. Modules can't take a Mesh constructor arg without threading it
 # through every config layer, so the learner declares it here before tracing.
@@ -48,11 +56,46 @@ class MeshSpec:
 
     def sizes(self, n_devices: int) -> Sequence[int]:
         fixed = self.fsdp * self.tp * self.sp
+        if fixed <= 0 or (self.dp != -1 and self.dp <= 0):
+            raise MeshConfigError(
+                f"mesh axes must be positive (got dp={self.dp}, "
+                f"fsdp={self.fsdp}, tp={self.tp}, sp={self.sp})"
+            )
         dp = self.dp if self.dp != -1 else n_devices // fixed
-        assert dp * fixed == n_devices, (
-            f"mesh {dp}x{self.fsdp}x{self.tp}x{self.sp} != {n_devices} devices"
-        )
+        if dp * fixed != n_devices:
+            raise MeshConfigError(
+                f"mesh dp={dp} x fsdp={self.fsdp} x tp={self.tp} x "
+                f"sp={self.sp} = {dp * fixed} does not factor the "
+                f"{n_devices} available devices; adjust the axis sizes "
+                f"(--mesh dp=K,fsdp=M,tp=N,sp=S must multiply to "
+                f"{n_devices}, or leave dp unset to absorb the remainder)"
+            )
         return (dp, self.fsdp, self.tp, self.sp)
+
+    @classmethod
+    def parse(cls, spec: str) -> "MeshSpec":
+        """CLI surface: ``"dp=4,fsdp=2,tp=1"`` -> MeshSpec. Unlisted axes
+        default (dp=-1 absorbs the remaining devices). Typed errors on
+        unknown axes / non-integer sizes."""
+        if isinstance(spec, cls):
+            return spec
+        kwargs = {}
+        for part in filter(None, (p.strip() for p in str(spec).split(","))):
+            axis, _, value = part.partition("=")
+            axis = axis.strip()
+            if axis not in AXES:
+                raise MeshConfigError(
+                    f"unknown mesh axis {axis!r} in --mesh {spec!r} "
+                    f"(axes: {', '.join(AXES)})"
+                )
+            try:
+                kwargs[axis] = int(value)
+            except ValueError:
+                raise MeshConfigError(
+                    f"mesh axis {axis} needs an integer size, got {value!r} "
+                    f"(--mesh {spec!r})"
+                ) from None
+        return cls(**kwargs)
 
 
 def make_mesh(spec: MeshSpec = MeshSpec(), devices: Optional[Sequence] = None) -> Mesh:
@@ -69,8 +112,32 @@ def dp_axes(mesh: Mesh):
     return ("dp", "fsdp") if mesh.shape["fsdp"] > 1 else "dp"
 
 
-def batch_sharding(mesh: Mesh, batch_axis: int = 0) -> NamedSharding:
-    """Shard the batch dimension over dp (and fsdp if >1), replicate the rest."""
+def dp_extent(mesh: Mesh) -> int:
+    """Number of ways the batch dimension is split (dp, x fsdp when > 1)."""
+    return mesh.shape["dp"] * mesh.shape["fsdp"]
+
+
+def check_batch_divisible(mesh: Mesh, batch_size: int, what: str = "batch") -> None:
+    """Typed compile-time guard: a batch that doesn't divide the mesh's
+    data-parallel extent would otherwise die deep inside XLA with an opaque
+    sharding error (or worse, silently pad)."""
+    extent = dp_extent(mesh)
+    if batch_size % extent:
+        raise MeshConfigError(
+            f"{what} size {batch_size} is not divisible by the mesh's "
+            f"data-parallel extent dp x fsdp = {mesh.shape['dp']} x "
+            f"{mesh.shape['fsdp']} = {extent}; pick a batch that is a "
+            f"multiple of {extent} or a narrower mesh"
+        )
+
+
+def batch_sharding(mesh: Mesh, batch_axis: int = 0,
+                   batch_size: Optional[int] = None) -> NamedSharding:
+    """Shard the batch dimension over dp (and fsdp if >1), replicate the rest.
+    With ``batch_size`` the divisibility is validated here (typed
+    ``MeshConfigError`` at spec-construction time, not an XLA error later)."""
+    if batch_size is not None:
+        check_batch_divisible(mesh, batch_size)
     spec = [None] * (batch_axis + 1)
     spec[batch_axis] = dp_axes(mesh)
     return NamedSharding(mesh, P(*spec))

@@ -34,6 +34,25 @@ class CheckpointCorruptError(Exception):
     ``CheckpointManager`` fall back to the previous generation."""
 
 
+class CheckpointMismatchError(ValueError):
+    """The checkpoint decodes fine but does not FIT the state it is being
+    restored into (leaf shapes differ — a different model config, or a
+    stale ``experiments/`` dir from an unrelated run poisoning auto-resume).
+    ``resume_latest`` treats it like corruption: skip the generation, fall
+    back, cold-start if nothing fits — never silently train on foreign
+    weights."""
+
+
+def _is_sharded(path: str) -> bool:
+    """Sharded checkpoint DIRECTORIES (parallel/ckpt.py) are detected by
+    their manifest so every monolithic-path consumer (manager pointer,
+    verify, load, learner restore) routes transparently."""
+    try:
+        return storage.exists(path.rstrip("/") + "/sharding.json")
+    except (OSError, ValueError):
+        return False
+
+
 class CountVar:
     """A named persistent counter (reference checkpoint_helper.py:281)."""
 
@@ -86,8 +105,14 @@ def _write_checkpoint(path: str, host_state: Any, metadata: Optional[Dict]) -> s
 
 def verify_checkpoint(path: str) -> bool:
     """True when ``path`` exists and its bytes match the manifest (or, for
-    legacy manifest-less checkpoints, merely exists). Never raises."""
+    legacy manifest-less checkpoints, merely exists). Never raises.
+    Sharded checkpoint directories verify every shard blob's self-CRC."""
     try:
+        if _is_sharded(path):
+            from ..parallel import ckpt as _sharded
+
+            _sharded.verify_sharded(path)
+            return True
         blob = storage.read_bytes(path)
         _verify_blob(path, blob)
         return True
@@ -138,15 +163,21 @@ class AsyncCheckpointer:
         self._error: Optional[BaseException] = None
 
     def save(self, path: str, state: Any, metadata: Optional[Dict] = None,
-             on_complete: Optional[Callable[[str], None]] = None) -> str:
+             on_complete: Optional[Callable[[str], None]] = None,
+             snapshot_fn: Optional[Callable[[Any], Any]] = None,
+             write_fn: Optional[Callable[[str, Any, Optional[Dict]], str]] = None) -> str:
         # join BEFORE snapshotting: at most one host copy exists at a time
         # (this also surfaces any previous write failure loudly)
         self.wait()
-        host_state = _host_snapshot(state)
+        # snapshot/write are pluggable so sharded checkpoints
+        # (parallel/ckpt.py: per-shard D2H, then per-shard blob writes)
+        # reuse the same one-in-flight/durable-pointer discipline
+        host_state = (snapshot_fn or _host_snapshot)(state)
+        writer = write_fn or _write_checkpoint
 
         def _write():
             try:
-                _write_checkpoint(path, host_state, metadata)
+                writer(path, host_state, metadata)
                 if on_complete is not None:
                     # latest-pointer publication rides the writer thread: the
                     # pointer must never name a checkpoint that isn't durable
@@ -180,7 +211,15 @@ def load_checkpoint(path: str, target: Any = None, verify: bool = True) -> Dict:
     With ``verify`` (default) the blob is checked against its manifest
     sidecar, and decode failures are raised as ``CheckpointCorruptError`` —
     corrupt/truncated checkpoints are DETECTED here, so resume paths can
-    fall back to the previous generation instead of restoring garbage."""
+    fall back to the previous generation instead of restoring garbage.
+
+    Sharded checkpoint directories (parallel/ckpt.py) route to the
+    resharding restore — same return shape, plus a ``sharding_layout``
+    key; callers that only read state/metadata don't notice."""
+    if _is_sharded(path):
+        from ..parallel import ckpt as _sharded
+
+        return _sharded.restore_sharded(path, target=target, verify=verify)
     blob = storage.read_bytes(path)
     if verify:
         _verify_blob(path, blob)

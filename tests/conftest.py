@@ -33,6 +33,28 @@ import numpy as np
 import pytest
 
 
+@pytest.fixture(autouse=True, scope="session")
+def _scoped_experiments_root(tmp_path_factory):
+    """Scope every default experiment dir to a fresh tmp root.
+
+    Learners resolve ``experiments/<name>`` relative to
+    ``DISTAR_EXPERIMENTS_ROOT`` (base_learner.experiments_root). Without
+    this, a test that doesn't pass ``save_path`` writes checkpoints into
+    the repo's ``experiments/`` — and a LATER run's auto-resume silently
+    restores that stale state (the PR 5 tier-1 poisoning: sl_train resumed
+    a previous invocation's checkpoint and ran 0 fresh iterations).
+    Subprocesses spawned by tests inherit the env var, so CLI-level tests
+    are scoped too."""
+    root = tmp_path_factory.mktemp("experiments")
+    prev = os.environ.get("DISTAR_EXPERIMENTS_ROOT")
+    os.environ["DISTAR_EXPERIMENTS_ROOT"] = str(root)
+    yield
+    if prev is None:
+        os.environ.pop("DISTAR_EXPERIMENTS_ROOT", None)
+    else:
+        os.environ["DISTAR_EXPERIMENTS_ROOT"] = prev
+
+
 @pytest.fixture
 def rng():
     return np.random.default_rng(0)

@@ -12,6 +12,8 @@ faults tests already prove survivable:
   python tools/chaos.py latest --dir exp/checkpoints
   python tools/chaos.py replay-drill --dir /tmp/replay_spill [--items 50] \\
         [--no-spill] [--seed 0]
+  python tools/chaos.py multichip-drill --dir /tmp/mc_drill \\
+        [--mesh dp=4,fsdp=2] [--resume-mesh dp=8] [--kill-after 2] [--iters 5]
 
 ``corrupt`` damages a checkpoint in place (the resume path must fall back);
 ``kill`` sends a signal to a role process (the supervisor/orchestrator must
@@ -24,7 +26,10 @@ up a real replay store + clients on loopback, kills the store mid-run
 spill directory and reports whether every acked insert survived (exit 0
 only when nothing was lost — or, with ``--no-spill``, when the expected
 loss was demonstrated: the counter-example the durability contract is
-measured against).
+measured against); ``multichip-drill`` kills a sharded-training learner
+right after a sharded checkpoint save and supervises restarts on a
+DIFFERENT mesh shape until the run finishes unassisted (the resharding
+restore under fire).
 """
 from __future__ import annotations
 
@@ -124,6 +129,83 @@ def cmd_replay_drill(args) -> int:
     return 0 if lost == 0 else 1
 
 
+def cmd_multichip_drill(args) -> int:
+    """Kill-the-learner-mid-multichip-run drill with a mesh-shape change.
+
+    Phase 1: a child trains on ``--mesh`` (forced host devices) with
+    per-iteration SHARDED checkpoints and kills itself (``os._exit``) right
+    after the save at ``--kill-after`` — a preempted pod worker. Then the
+    drill supervises restarts (PR 4 RestartPolicy semantics, applied
+    cross-process) on ``--resume-mesh`` — a DIFFERENT topology — until the
+    run reaches ``--iters`` unassisted. Exit 0 only when the resumed run
+    (a) restored from the generation the kill left behind (resharding
+    restore) and (b) finished without human help."""
+    import subprocess
+    import time
+
+    exp_dir = os.path.join(args.dir, "exp")
+    target = args.iters
+
+    def child(mesh, extra):
+        cmd = [
+            sys.executable, "-m", "distar_tpu.parallel.executor",
+            "--mesh", mesh, "--host-devices", str(args.host_devices),
+            "--iters", str(target), "--save-dir", exp_dir,
+            "--experiment-name", "chaos_multichip",
+        ] + extra
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=args.timeout_s, cwd=_REPO)
+        report = None
+        for line in proc.stdout.splitlines():
+            if line.startswith("REPORT "):
+                report = json.loads(line[len("REPORT "):])
+        return proc.returncode, report, proc
+
+    print(f"phase 1: train on --mesh {args.mesh}, kill after iter "
+          f"{args.kill_after} (post-save)")
+    rc, report, proc = child(args.mesh, ["--save-freq", "1",
+                                         "--kill-after", str(args.kill_after)])
+    if rc != 137:
+        print(f"UNEXPECTED: phase-1 child exited {rc} (wanted the 137 kill)\n"
+              f"{proc.stderr[-2000:]}")
+        return 1
+
+    # phase 2: supervised restarts on the OTHER mesh shape until done
+    restarts, resumed_from, final = 0, None, None
+    while restarts < args.restart_max:
+        restarts += 1
+        print(f"phase 2 (attempt {restarts}): resume on --resume-mesh "
+              f"{args.resume_mesh}")
+        rc, report, proc = child(args.resume_mesh, ["--resume"])
+        if rc == 0 and report is not None:
+            resumed_from = report.get("resumed_from")
+            final = report
+            break
+        print(f"restart attempt {restarts} died rc={rc}; retrying\n"
+              f"{proc.stderr[-500:]}")
+        time.sleep(1.0)
+    verdict = {
+        "target_iters": target,
+        "killed_after": args.kill_after,
+        "restarts": restarts,
+        "resumed_from": resumed_from,
+        "final_iters": final and final.get("iters"),
+        "resume_start_iter": final and final.get("start_iter"),
+        "mesh_killed": args.mesh,
+        "mesh_resumed": final and final.get("mesh"),
+    }
+    print(json.dumps(verdict))
+    ok = (
+        final is not None
+        and final.get("iters") == target
+        and final.get("start_iter", 0) >= args.kill_after
+        and resumed_from is not None
+    )
+    print("verdict: resumed on a different mesh and finished unassisted"
+          if ok else "verdict: DRILL FAILED")
+    return 0 if ok else 1
+
+
 def cmd_latest(args) -> int:
     mgr = CheckpointManager(args.dir)
     gens = mgr.generations()
@@ -172,10 +254,28 @@ def main() -> int:
                    help="counter-demo: run without durability and show the loss")
     d.add_argument("--seed", type=int, default=0)
 
+    m = sub.add_parser("multichip-drill",
+                       help="kill a multichip learner after a sharded save; "
+                            "prove resume on a DIFFERENT mesh shape")
+    m.add_argument("--dir", required=True, help="experiment scratch directory")
+    m.add_argument("--mesh", default="dp=4,fsdp=2",
+                   help="mesh the run is killed on")
+    m.add_argument("--resume-mesh", default="dp=8",
+                   help="mesh the run must finish on (resharding restore)")
+    m.add_argument("--host-devices", type=int, default=8)
+    m.add_argument("--iters", type=int, default=5, help="target iterations")
+    m.add_argument("--kill-after", type=int, default=2,
+                   help="kill the learner after this iteration's sharded save")
+    m.add_argument("--restart-max", type=int, default=3,
+                   help="restart budget (PR 4 RestartPolicy semantics)")
+    m.add_argument("--timeout-s", type=float, default=900.0,
+                   help="per-child wall budget")
+
     args = p.parse_args()
     return {"corrupt": cmd_corrupt, "kill": cmd_kill,
             "reset": cmd_reset, "latest": cmd_latest,
-            "replay-drill": cmd_replay_drill}[args.command](args)
+            "replay-drill": cmd_replay_drill,
+            "multichip-drill": cmd_multichip_drill}[args.command](args)
 
 
 if __name__ == "__main__":
