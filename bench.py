@@ -46,25 +46,15 @@ SL_BASELINE_FRAMES = 384.0   # frames/s per A100, reference large-scale SL
 RL_BASELINE_STEPS = 0.67     # learner steps/s, reference large-scale RL
 RL_BASELINE_FRAMES = 256.0   # frames/s per A100 (192*64/1.5s / 32 GPUs)
 
-# peak bf16 matmul throughput per chip, for the MFU estimate
-_PEAK_FLOPS = {
-    "v4": 275e12,
-    "v5 lite": 197e12,
-    "v5e": 197e12,
-    "v5p": 459e12,
-    "v5": 459e12,
-    "v6 lite": 918e12,
-    "v6e": 918e12,
-}
-
-
-def _peak_flops(device_kind: str):
-    kind = device_kind.lower()
-    best = None
-    for name, peak in _PEAK_FLOPS.items():
-        if name in kind and (best is None or len(name) > best[0]):
-            best = (len(name), peak)
-    return best[1] if best else None
+# peak-flops table + cost/memory introspection live in obs/perf.py now —
+# ONE code path shared by bench, tools/memstats.py and the live learner
+# gauges (obs imports no jax, so the parent process stays jax-free)
+from distar_tpu.obs.perf import (  # noqa: E402
+    flops_of_compiled as _flops_of_compiled,
+    flops_of_lowered as _flops_of_lowered,
+    memory_report as _memory_report,
+    peak_flops as _peak_flops,
+)
 
 
 # --------------------------------------------------------------------- child
@@ -256,25 +246,14 @@ def _measure(kind, label, train_step, args, feedback, frames, peak, iters=4):
     t0 = time.perf_counter()
     lowered = train_step.lower(*args)
     trace_s = time.perf_counter() - t0
-    flops_unoptimized = 0.0
-    try:
-        cost = lowered.cost_analysis()
-        flops_unoptimized = float(cost.get("flops", 0.0)) if cost else 0.0
-    except Exception as e:
-        print(f"BENCH-STAGE {kind}-cost-analysis-failed {e!r}"[:300], file=sys.stderr, flush=True)
+    flops_unoptimized = _flops_of_lowered(lowered)
     _stage(f"{kind}-compile {label}")
     t0 = time.perf_counter()
     compiled = lowered.compile()
     compile_s = time.perf_counter() - t0
-    flops_optimized = 0.0
-    try:
-        # post-optimization executable-level count, when the backend offers it
-        cost = compiled.cost_analysis()
-        if isinstance(cost, (list, tuple)):
-            cost = cost[0] if cost else None
-        flops_optimized = float(cost.get("flops", 0.0)) if cost else 0.0
-    except Exception:
-        pass
+    # post-optimization executable-level count, when the backend offers it
+    flops_optimized = _flops_of_compiled(compiled)
+    memory = _memory_report(compiled)
     # MFU numerator: the optimized executable count when present (honest —
     # what actually runs), else the HLO count. The impossible-timing check
     # below uses the MAX of the two: a backend reporting an erroneously low
@@ -302,6 +281,8 @@ def _measure(kind, label, train_step, args, feedback, frames, peak, iters=4):
         "trace_s": round(trace_s, 1),
         "compile_s": round(compile_s, 1),
     }
+    if memory:
+        point["memory"] = memory  # XLA memory_analysis via obs/perf.py
     if flops:
         point["flops_per_step"] = flops
         if flops_unoptimized:

@@ -170,6 +170,12 @@ def _run_learner_supervised(args, learner, iters) -> None:
     the durable ``latest`` pointer (corrupt newest generation falls back a
     checkpoint) and re-enters the run loop, bounded by the restart budget.
     The final failure still dies loudly (flight bundle + raise)."""
+    if getattr(args, "admin_port", None) is not None:
+        # live admin surface: update_config / save_ckpt / status and the
+        # on-demand POST /profile?steps=N capture (opsctl profile)
+        admin = learner.start_admin(port=args.admin_port)
+        print(f"learner admin on http://{admin.host}:{admin.port}/learner/status",
+              flush=True)
     if getattr(args, "no_supervise", False):
         learner.run(max_iterations=iters)
         return
@@ -495,6 +501,12 @@ def main() -> None:
     p.add_argument("--smoke-model", action="store_true", default=True)
     p.add_argument("--full-model", dest="smoke_model", action="store_false")
     p.add_argument("--port", type=int, default=0)
+    p.add_argument("--admin-port", type=int, default=None,
+                   help="serve the learner admin API (status / save_ckpt / "
+                        "update_config and on-demand POST /profile?steps=N "
+                        "trace capture -> ranked bucket report; see "
+                        "`opsctl profile`) on this port (learner-hosting "
+                        "roles)")
     p.add_argument("--metrics-port", type=int, default=None,
                    help="serve GET /metrics (Prometheus text) on this port; "
                         "the coordinator role serves it on --port already "

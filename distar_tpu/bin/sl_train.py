@@ -205,6 +205,10 @@ def main() -> None:
     p.add_argument("--no-supervise", action="store_true",
                    help="disable crash-restart supervision and learner "
                         "auto-resume from the latest checkpoint pointer")
+    p.add_argument("--admin-port", type=int, default=None,
+                   help="serve the learner admin API (status / save_ckpt "
+                        "and on-demand POST /profile?steps=N trace capture; "
+                        "see `opsctl profile`) on this port")
     p.add_argument("--restart-max", type=int, default=5,
                    help="restart budget per role within --restart-window-s")
     p.add_argument("--restart-window-s", type=float, default=300.0,

@@ -7,12 +7,19 @@ the server only sets flags/payloads; the learner applies them at the next
 iteration boundary (jit caches and donated buffers make mid-step mutation
 unsafe, so the boundary is the only correct application point).
 
-POST /learner/<update_config|reset_value|save_ckpt|status>
+POST /learner/<update_config|reset_value|save_ckpt|status|profile>
+
+``POST /profile?steps=N`` is the exception to fire-and-forget: it arms a
+bounded ``jax.profiler`` capture that the run loop starts/stops at
+iteration boundaries, BLOCKS until the trace is analyzed
+(obs/traceview.py), and returns the ranked per-bucket report — the
+`opsctl profile` surface.
 """
 from __future__ import annotations
 
 import json
 import threading
+import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
@@ -21,11 +28,15 @@ class LearnerAdminServer:
     def __init__(self, learner, host: str = "127.0.0.1", port: int = 0):
         self.learner = learner
 
-        def routes(name: str, body: dict):
+        def routes(name: str, body: dict, query: dict):
             if name == "update_config":
+                if not hasattr(learner, "request_update_config"):
+                    return None  # SL learners don't serve config patches
                 learner.request_update_config(body.get("config", {}))
                 return "queued"
             if name == "reset_value":
+                if not hasattr(learner, "request_value_reset"):
+                    return None
                 learner.request_value_reset()
                 return "queued"
             if name == "save_ckpt":
@@ -39,7 +50,16 @@ class LearnerAdminServer:
                     "meters": {
                         k: m.avg for k, m in learner.variable_record.vars().items()
                     },
+                    "perf": learner._perf.snapshot(),
                 }
+            if name == "profile":
+                steps = int(query.get("steps", body.get("steps", 2)))
+                timeout_s = float(
+                    query.get("timeout_s", body.get("timeout_s", 600.0))
+                )
+                # blocks this request thread until the run loop captured the
+                # trace and the analyzer ranked it
+                return learner.request_profile(steps=steps, timeout_s=timeout_s)
             return None
 
         class Handler(BaseHTTPRequestHandler):
@@ -47,11 +67,16 @@ class LearnerAdminServer:
                 pass
 
             def do_POST(self):
-                name = self.path.strip("/").split("/")[-1]
+                parsed = urllib.parse.urlsplit(self.path)
+                name = parsed.path.strip("/").split("/")[-1]
+                query = {
+                    k: v[-1]
+                    for k, v in urllib.parse.parse_qs(parsed.query).items()
+                }
                 length = int(self.headers.get("Content-Length", 0))
                 try:
                     body = json.loads(self.rfile.read(length) or b"{}")
-                    info = routes(name, body)
+                    info = routes(name, body, query)
                     payload = (
                         {"code": 404, "info": f"no route {name}"}
                         if info is None

@@ -10,12 +10,13 @@ over a mesh, rank == jax.process_index().
 from __future__ import annotations
 
 import os
+import threading
 import time
 from typing import Any, Dict, Iterator, Optional
 
 import jax
 
-from ..obs import get_registry, record_step_phases
+from ..obs import PerfMonitor, get_registry, record_step_phases
 from ..utils import Config, EasyTimer, build_logger, deep_merge_dicts
 from ..utils.timing import sw as global_stopwatch
 from ..utils.checkpoint import (
@@ -59,6 +60,15 @@ DEFAULT_LEARNER_CONFIG = Config(
             # device profiler hook: every profile.freq iters capture
             # profile.duration iters of jax.profiler trace (0 = disabled)
             "profile": {"freq": 0, "duration": 2, "logdir": ""},
+            # live perf gauges (obs/perf.py): frames/s + step time always;
+            # perf.aot extracts the step's flop count (MFU numerator) on a
+            # background thread ("auto" = on unless DISTAR_PERF_AOT=0 — the
+            # test harness opts out so dozens of small learners don't each
+            # trace in the background); aot_compile additionally compiles
+            # for the static memory_analysis footprint (cache-served when
+            # the live step already compiled)
+            "perf": {"aot": "auto", "aot_compile": False,
+                     "mem_sample_every": 16},
         },
     }
 )
@@ -90,6 +100,19 @@ class BaseLearner:
             profile_logdir=prof.get("logdir", "")
             or os.path.join(root, "profiles"),
         )
+        pcfg = self.cfg.learner.get("perf", {})
+        aot = pcfg.get("aot", "auto")
+        if aot == "auto":
+            aot = os.environ.get("DISTAR_PERF_AOT", "1").lower() not in ("0", "false")
+        self._perf_aot = bool(aot)
+        self._perf = PerfMonitor(
+            token=self.name,
+            registry=self.metrics,
+            aot_compile=bool(pcfg.get("aot_compile", False)),
+            mem_sample_every=int(pcfg.get("mem_sample_every", 16)),
+        )
+        self._profile_lock = threading.Lock()
+        self._profile_req: Optional[Dict[str, Any]] = None
         self._state = None  # TrainState pytree (params, opt_state, step)
         self._dataloader: Optional[Iterator] = None
         self._setup_dataloader()
@@ -301,6 +324,115 @@ class BaseLearner:
             self._dataloader, self._place_batch, depth=depth, token=self.name
         )
 
+    # ----------------------------------------------------------------- perf
+    def _perf_note_step_args(self, jitted, *args) -> None:
+        """Subclass ``_train`` calls this with the jitted step + its live
+        call args on every iteration; the monitor snapshots shape specs once
+        and extracts the flop count (MFU numerator) in the background."""
+        if self._perf_aot:
+            self._perf.note_step_args(jitted, *args)
+
+    # ---------------------------------------------------------------- admin
+    def start_admin(self, port: int = 0):
+        """Serve the live admin API (status / save_ckpt / profile, plus
+        update_config / reset_value on learners that implement them);
+        requests apply at iteration boundaries."""
+        from .admin import LearnerAdminServer
+
+        self._admin = LearnerAdminServer(self, port=port)
+        self._admin.start()
+        self.logger.info(f"admin API on {self._admin.host}:{self._admin.port}")
+        return self._admin
+
+    def request_save(self) -> None:
+        self._pending_save = True
+
+    def request_stop(self) -> None:
+        """Cooperative run-loop exit at the next iteration boundary (admin /
+        test harness surface): after_run hooks (final checkpoint) still
+        run. The next ``run()`` call starts fresh."""
+        self._stop_requested = True
+
+    # -------------------------------------------------------------- profile
+    def request_profile(self, steps: int = 2, timeout_s: float = 600.0) -> dict:
+        """On-demand bounded capture (admin ``POST /profile?steps=N``):
+        arm a profiler session that the RUN LOOP starts/stops at iteration
+        boundaries (mid-step capture would split device steps), then block
+        this (admin-thread) caller until the trace is analyzed. Returns the
+        ranked bucket report; raises on timeout / profiler failure."""
+        req = {
+            "steps": max(1, int(steps)),
+            "event": threading.Event(),
+            "session": None,
+            "stop_at": None,
+            "report": None,
+            "error": None,
+        }
+        with self._profile_lock:
+            pending = self._profile_req
+            if pending is not None and not pending["event"].is_set():
+                raise RuntimeError("a profile capture is already in flight")
+            self._profile_req = req
+        if not req["event"].wait(timeout_s):
+            with self._profile_lock:
+                if self._profile_req is req:
+                    self._profile_req = None  # abandoned: unblock later arms
+            raise TimeoutError(
+                f"profile did not complete within {timeout_s}s "
+                f"(is the learner's run loop advancing?)"
+            )
+        if req["error"]:
+            raise RuntimeError(req["error"])
+        return req["report"]
+
+    def _profile_tick(self) -> None:
+        """Run-loop leg of on-demand profiling: start the armed session at
+        this boundary, stop+analyze once the requested steps elapsed."""
+        req = self._profile_req
+        if req is None or req["event"].is_set():
+            return
+        if req["session"] is None:
+            from ..obs import ProfilerSession
+
+            logdir = os.path.join(
+                self.save_dir, "profiles", f"ondemand_{self.last_iter.val}"
+            )
+            session = ProfilerSession(logdir, registry=self.metrics)
+            if not session.start():
+                req["error"] = f"profiler start failed (logdir {logdir!r})"
+                self._finish_profile(req)
+                return
+            req["session"] = session
+            req["stop_at"] = self.last_iter.val + req["steps"]
+            return
+        if self.last_iter.val < req["stop_at"]:
+            return
+        session = req["session"]
+        if not session.stop():
+            req["error"] = "profiler stop failed"
+            self._finish_profile(req)
+            return
+        try:
+            from ..obs import analyze_trace, render_markdown
+
+            report = analyze_trace(
+                session.last_profile_path or session.logdir, steps=req["steps"]
+            )
+            report["markdown"] = render_markdown(report)
+            report["captured_steps"] = req["steps"]
+            report["last_iter"] = self.last_iter.val
+            report["perf"] = self._perf.snapshot()
+            req["report"] = report
+        except Exception as e:
+            req["error"] = f"trace analysis failed: {e!r}"
+        self._finish_profile(req)
+
+    def _finish_profile(self, req) -> None:
+        with self._profile_lock:
+            if self._profile_req is req:
+                self._profile_req = None
+        req["event"].set()
+
     # ------------------------------------------------------------------ run
     def run(self, max_iterations: Optional[int] = None) -> None:
         max_iterations = max_iterations or self.cfg.learner.max_iterations
@@ -322,10 +454,17 @@ class BaseLearner:
             "distar_learner_loss", "last total_loss (NaN/Inf watchdog input)"
         )
 
+        frames_per_iter = float(
+            (self.cfg.learner.get("batch_size") or 0)
+            * (self.cfg.learner.get("unroll_len") or 0)
+        )
+
+        self._stop_requested = False
+
         @auto_checkpoint(lambda: self.save(self.checkpoint_path(), sync=True))
         def _run():
             self.hooks.call("before_run", self)
-            while self.last_iter.val < max_iterations:
+            while self.last_iter.val < max_iterations and not self._stop_requested:
                 with self.timer:
                     data = next(self._dataloader)
                 t_data = self.timer.value
@@ -359,9 +498,21 @@ class BaseLearner:
                     },
                     registry=self.metrics,
                 )
+                self._perf.on_step(t_train, frames_per_iter)
+                self._profile_tick()
             self.hooks.call("after_run", self)
 
-        _run()
+        try:
+            _run()
+        finally:
+            # a profile armed while we were the run loop must not strand its
+            # admin-thread waiter once no more iterations will happen
+            req = self._profile_req
+            if req is not None and not req["event"].is_set():
+                if req.get("session") is not None:
+                    req["session"].stop()
+                req["error"] = "learner run ended before the capture completed"
+                self._finish_profile(req)
         # drain per-region stopwatch samples into the registry (decorated
         # regions anywhere in the process accumulate between reports)
         global_stopwatch.report(registry=self.metrics)

@@ -149,6 +149,10 @@ class ProfilerHook(Hook):
     because the stop edge falls between gate points; the start gate is
     internal. Rank-0 only; profiler failures are logged, never fatal."""
 
+    # consecutive start failures before the hook retires itself: a logdir
+    # on a read-only/full volume fails every gate — skip, don't spam/crash
+    MAX_CONSECUTIVE_FAILURES = 3
+
     def __init__(self, logdir: str, freq: int = 1000, duration: int = 2,
                  priority: int = 90, profiler=None):
         super().__init__("profiler", "after_iter", priority, freq=1)
@@ -156,22 +160,35 @@ class ProfilerHook(Hook):
         self._freq = freq
         self._duration = duration
         self._stop_at = None
+        self._consecutive_failures = 0
+        self.disabled = False
         from ..obs import ProfilerSession
 
         self.session = ProfilerSession(logdir, profiler=profiler)
 
     def __call__(self, learner) -> None:
-        if learner.rank != 0:
+        if learner.rank != 0 or self.disabled:
             return
         it = learner.last_iter.val
         if self.session.active:
             if it >= self._stop_at and self.session.stop():
                 learner.logger.info(
-                    f"profiler trace captured -> {self.session.logdir}"
+                    f"profiler trace captured -> "
+                    f"{self.session.last_profile_path or self.session.logdir}"
                 )
         elif it % self._freq == 0:
             if self.session.start():
                 self._stop_at = it + self._duration
+                self._consecutive_failures = 0
+            else:
+                self._consecutive_failures += 1
+                if self._consecutive_failures >= self.MAX_CONSECUTIVE_FAILURES:
+                    self.disabled = True
+                    learner.logger.info(
+                        f"profiler hook disabled after "
+                        f"{self._consecutive_failures} consecutive start "
+                        f"failures (logdir {self.session.logdir!r} unwritable?)"
+                    )
 
 
 def default_hooks(

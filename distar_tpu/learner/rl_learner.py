@@ -259,6 +259,10 @@ class RLLearner(BaseLearner):
             # scalars replicate
             out_shardings=(param_sh, opt_sh, repl),
         )
+        # analytic per-step collective estimate from the live mesh + params
+        # (obs/perf.py) — the sanity bar a trace's collective bucket is read
+        # against
+        self._perf.set_collectives(self.mesh, self._state["params"])
 
     def shard_batch(self, batch):
         """Place a host batch onto the mesh: B sharded over dp everywhere
@@ -368,24 +372,13 @@ class RLLearner(BaseLearner):
         )
 
     # ----------------------------------------------------------------- admin
-    def start_admin(self, port: int = 0):
-        """Serve the live admin API (update_config / reset_value / save_ckpt /
-        status); requests apply at iteration boundaries."""
-        from .admin import LearnerAdminServer
-
-        self._admin = LearnerAdminServer(self, port=port)
-        self._admin.start()
-        self.logger.info(f"admin API on {self._admin.host}:{self._admin.port}")
-        return self._admin
-
+    # (start_admin / request_save / request_profile live on BaseLearner; the
+    # RL learner adds the config-patch and value-reset surfaces)
     def request_update_config(self, cfg_patch: dict) -> None:
         self._pending_config_patch = cfg_patch
 
     def request_value_reset(self) -> None:
         self._pending_value_reset = True
-
-    def request_save(self) -> None:
-        self._pending_save = True
 
     def _apply_admin_requests(self) -> None:
         patch = getattr(self, "_pending_config_patch", None)
@@ -461,6 +454,11 @@ class RLLearner(BaseLearner):
         trace_age = data.pop("trace_age_s", None)
         if not on_device:
             data = self.shard_batch(self._cap(data))
+        self._perf_note_step_args(
+            self._train_step,
+            self._state["params"], self._state["opt_state"], data,
+            jnp.asarray(only_value),
+        )
         params, opt_state, info = self._train_step(
             self._state["params"], self._state["opt_state"], data,
             jnp.asarray(only_value),

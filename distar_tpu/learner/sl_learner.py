@@ -175,6 +175,8 @@ class SLLearner(BaseLearner):
             # broadcast over their subtrees)
             out_shardings=(param_sh, opt_sh, flat_sh, repl),
         )
+        # analytic per-step collective estimate (obs/perf.py)
+        self._perf.set_collectives(self.mesh, self._state["params"])
 
     def evaluate(self, dataloader, max_batches: int = 0) -> Dict[str, float]:
         """Held-out metric pass: run the SL forward + loss/metric grid over
@@ -270,6 +272,10 @@ class SLLearner(BaseLearner):
                 "new_episodes": new_episodes,
                 "traj_lens": traj_lens,
             }
+        self._perf_note_step_args(
+            self._train_step,
+            self._state["params"], self._state["opt_state"], data, self._hidden,
+        )
         params, opt_state, out_state, info = self._train_step(
             self._state["params"], self._state["opt_state"], data, self._hidden
         )

@@ -43,6 +43,15 @@ from .trace import (
     wrap_payload,
 )
 from .profiler import ProfilerSession, record_step_phases
+from .perf import (
+    PerfMonitor,
+    estimate_collective_bytes,
+    flops_of_compiled,
+    flops_of_lowered,
+    memory_report,
+    peak_flops,
+)
+from .traceview import analyze_trace, classify, render_markdown
 from .timeseries import RegistrySampler, TimeSeriesStore
 from .shipper import SERIALIZED_CONTENT_TYPE, TelemetryIngest, TelemetryShipper
 from .flightrecorder import FlightRecorder, get_flight_recorder, set_flight_recorder
@@ -80,6 +89,15 @@ __all__ = [
     "wrap_payload",
     "ProfilerSession",
     "record_step_phases",
+    "PerfMonitor",
+    "estimate_collective_bytes",
+    "flops_of_compiled",
+    "flops_of_lowered",
+    "memory_report",
+    "peak_flops",
+    "analyze_trace",
+    "classify",
+    "render_markdown",
     "RegistrySampler",
     "TimeSeriesStore",
     "SERIALIZED_CONTENT_TYPE",

@@ -296,6 +296,17 @@ def default_rulebook(roles: Iterable[str] = ("learner", "actor", "coordinator",
             window_s=stall_window_s, for_count=3,
             summary="learner stopped completing optimisation steps",
         ))
+        book.append(HealthRule(
+            name="learner_mfu_collapse",
+            # labelled family: one series per learner token; only published
+            # on backends with a known peak (TPU), so CPU runs see no data
+            # and no-data is not a breach
+            metric="distar_perf_mfu", agg="last", op="<", threshold=0.02,
+            window_s=stall_window_s, for_count=3, severity="warning",
+            summary="measured MFU collapsed below 2% of the chip's peak — "
+                    "the step is input/host-bound or a kernel regressed "
+                    "(capture a trace: opsctl profile)",
+        ))
     if "actor" in roles:
         book.append(HealthRule(
             name="actor_env_starvation",

@@ -9,6 +9,7 @@ publish its data-wait / device-step / host-callback breakdown.
 from __future__ import annotations
 
 import logging
+import os
 from typing import Dict, Optional
 
 from .registry import MetricsRegistry, get_registry
@@ -18,11 +19,17 @@ class ProfilerSession:
     """Start/stop wrapper for the device profiler.
 
     ``profiler`` is injectable (tests pass a stub); the default resolves
-    ``jax.profiler`` lazily so importing obs never imports jax."""
+    ``jax.profiler`` lazily so importing obs never imports jax. Failures are
+    never fatal but no longer silent either: each failed start/stop counts
+    ``distar_profiler_failures_total{stage=...}``, and a successful stop
+    records ``last_profile_path`` — the newest capture dir under the logdir,
+    what the admin ``/profile`` route hands to the trace analyzer."""
 
     def __init__(self, logdir: str, profiler=None, registry: Optional[MetricsRegistry] = None):
         self.logdir = logdir
         self.active = False
+        self.failures = 0
+        self.last_profile_path: Optional[str] = None
         self._profiler = profiler
         self._registry = registry
 
@@ -33,13 +40,26 @@ class ProfilerSession:
             self._profiler = jax.profiler
         return self._profiler
 
+    def _count_failure(self, stage: str) -> None:
+        self.failures += 1
+        reg = self._registry or get_registry()
+        reg.counter(
+            "distar_profiler_failures_total",
+            "profiler start/stop failures (best-effort, training continues)",
+            stage=stage,
+        ).inc()
+
     def start(self) -> bool:
         if self.active:
             return True
         try:
+            # surface an unwritable logdir HERE, typed, instead of letting
+            # stop_trace throw away an entire captured session later
+            os.makedirs(self.logdir, exist_ok=True)
             self._resolve().start_trace(self.logdir)
         except Exception as e:  # best-effort: never kill training over a trace
             logging.warning("profiler start_trace failed: %r", e)
+            self._count_failure("start")
             return False
         self.active = True
         reg = self._registry or get_registry()
@@ -54,8 +74,21 @@ class ProfilerSession:
             self._resolve().stop_trace()
         except Exception as e:
             logging.warning("profiler stop_trace failed: %r", e)
+            self._count_failure("stop")
             return False
+        self.last_profile_path = self._newest_capture() or self.logdir
         return True
+
+    def _newest_capture(self) -> Optional[str]:
+        """Newest session dir under ``<logdir>/plugins/profile/`` (the
+        layout ``jax.profiler`` writes); None when nothing landed."""
+        root = os.path.join(self.logdir, "plugins", "profile")
+        try:
+            stamps = [os.path.join(root, d) for d in os.listdir(root)]
+            stamps = [d for d in stamps if os.path.isdir(d)]
+            return max(stamps, key=os.path.getmtime) if stamps else None
+        except OSError:
+            return None
 
 
 _PHASES = ("data_wait", "device_step", "host_callback")

@@ -55,6 +55,24 @@ def _scoped_experiments_root(tmp_path_factory):
         os.environ["DISTAR_EXPERIMENTS_ROOT"] = prev
 
 
+@pytest.fixture(autouse=True, scope="session")
+def _no_background_perf_aot():
+    """Disable the perf monitor's background AOT flop extraction suite-wide.
+
+    Every learner that trains would otherwise spawn one background
+    lower()/cost_analysis() thread (obs/perf.py) — dozens of concurrent
+    re-traces of small models on an oversubscribed CPU host slow the suite
+    for zero test value. Tests that exercise the AOT path opt back in per
+    learner via ``learner.perf.aot=True``."""
+    prev = os.environ.get("DISTAR_PERF_AOT")
+    os.environ["DISTAR_PERF_AOT"] = "0"
+    yield
+    if prev is None:
+        os.environ.pop("DISTAR_PERF_AOT", None)
+    else:
+        os.environ["DISTAR_PERF_AOT"] = prev
+
+
 @pytest.fixture
 def rng():
     return np.random.default_rng(0)

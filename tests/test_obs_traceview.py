@@ -1,0 +1,141 @@
+"""Trace analyzer (obs/traceview.py): bucket classification, share math,
+malformed-event tolerance — against the checked-in miniature trace fixture
+(tests/data/mini.trace.json: 2 steps of a synthetic train module covering
+every bucket, plus loop-body repeats, a foreign module, python noise and
+malformed entries)."""
+import gzip
+import json
+import os
+
+import pytest
+
+from distar_tpu.obs.traceview import (
+    BUCKETS,
+    analyze_events,
+    analyze_trace,
+    classify,
+    device_op_events,
+    find_trace_files,
+    render_markdown,
+)
+
+FIXTURE = os.path.join(os.path.dirname(__file__), "data", "mini.trace.json")
+
+
+def _fixture_events():
+    with open(FIXTURE) as f:
+        return json.load(f)["traceEvents"]
+
+
+# ------------------------------------------------------------ classification
+@pytest.mark.parametrize("name,scope,bucket", [
+    ("dot.3", "", "matmul/MXU"),
+    ("convolution.12", "", "matmul/MXU"),
+    ("custom-call.1", "jit(train)/EntityEncoder/attention/softmax", "entity-attention"),
+    ("fusion.7", "flash_attention_fwd", "entity-attention"),
+    ("scatter.2", "", "scatter"),
+    ("dynamic-update-slice.4", "", "scatter"),
+    ("while.9", "", "lstm-scan"),
+    ("fusion.1", "core_lstm/scan/body", "lstm-scan"),
+    ("all-reduce.5", "", "collectives"),
+    ("all_gather.2", "", "collectives"),
+    ("collective-permute.1", "", "collectives"),
+    ("infeed.1", "", "host/infeed"),
+    ("copy-start.3", "", "host/infeed"),
+    ("broadcast.8", "", "other"),
+    ("transpose.2", "", "other"),
+])
+def test_classify(name, scope, bucket):
+    assert classify(name, scope) == bucket
+
+
+def test_collectives_outrank_matmul_in_scoped_fusions():
+    # an all-reduce fused around a dot is collective time, not MXU time
+    assert classify("all-reduce.3", "jit(train)/dot_general") == "collectives"
+
+
+# ----------------------------------------------------------------- filtering
+def test_device_op_filter_counts_malformed_and_drops_noise():
+    ops, malformed = device_op_events(_fixture_events())
+    # python noise (no hlo args) excluded silently; junk dur + negative dur
+    # + non-dict counted as malformed
+    assert malformed == 3
+    assert all(op["dur_us"] >= 0 for op in ops)
+    assert not any("isinstance" in op["name"] for op in ops)
+
+
+# ------------------------------------------------------------------ analysis
+def test_analyze_shares_sum_to_one_and_rank():
+    report = analyze_events(_fixture_events())
+    assert report["malformed_events"] == 3
+    shares = [b["share"] for b in report["buckets"]]
+    assert abs(sum(shares) - 1.0) < 1e-6
+    # ranked most-expensive first
+    times = [b["time_us"] for b in report["buckets"]]
+    assert times == sorted(times, reverse=True)
+    by_name = {b["bucket"]: b for b in report["buckets"]}
+    # fixture arithmetic: matmul = 2*(400+100) + 30 (foreign module)
+    assert by_name["matmul/MXU"]["time_us"] == pytest.approx(1030.0)
+    # lstm-scan = 2*150 (while) + 6*10 (loop-body fusions under core_lstm)
+    assert by_name["lstm-scan"]["time_us"] == pytest.approx(360.0)
+    assert by_name["entity-attention"]["time_us"] == pytest.approx(400.0)
+    assert set(by_name) <= set(BUCKETS)
+
+
+def test_analyze_infers_steps_from_dominant_module():
+    report = analyze_events(_fixture_events())
+    assert report["dominant_module"] == "jit_train_step"
+    # loop-body fusions appear 6x but every per-step op appears exactly 2x:
+    # the min-count heuristic must land on 2
+    assert report["steps_inferred"] == 2
+    assert report["steps"] == 2
+    assert report["step_time_device_us"] == pytest.approx(
+        report["total_device_us"] / 2)
+
+
+def test_analyze_explicit_steps_override():
+    report = analyze_events(_fixture_events(), steps=4)
+    assert report["steps"] == 4
+    by_name = {b["bucket"]: b for b in report["buckets"]}
+    assert by_name["scatter"]["per_step_us"] == pytest.approx(240.0 / 4)
+
+
+def test_analyze_empty_trace_degrades():
+    report = analyze_events([])
+    assert report["total_device_us"] == 0.0
+    assert report["buckets"] == []
+    assert report["steps"] == 1  # divisor never 0
+
+
+# ---------------------------------------------------------------- file layer
+def test_find_and_analyze_logdir_layout(tmp_path):
+    # the jax.profiler on-disk layout: logdir/plugins/profile/<stamp>/*.gz
+    old = tmp_path / "plugins" / "profile" / "2026_01_01" / "host.trace.json.gz"
+    new = tmp_path / "plugins" / "profile" / "2026_01_02" / "host.trace.json.gz"
+    for i, p in enumerate((old, new)):
+        p.parent.mkdir(parents=True)
+        with gzip.open(p, "wt") as f:
+            json.dump({"traceEvents": [
+                {"ph": "X", "pid": 1, "tid": 1, "ts": 0, "dur": 10.0 + i,
+                 "name": "dot.1", "args": {"hlo_op": "dot.1", "hlo_module": "m"}},
+            ]}, f)
+    os.utime(old, (1, 1))  # force mtime ordering
+    files = find_trace_files(str(tmp_path))
+    assert files[0] == str(new)
+    report = analyze_trace(str(tmp_path))
+    assert report["trace_path"] == str(new)
+    assert report["total_device_us"] == pytest.approx(11.0)
+
+
+def test_analyze_trace_missing_raises(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        analyze_trace(str(tmp_path))
+
+
+def test_render_markdown_table():
+    report = analyze_events(_fixture_events())
+    md = render_markdown(report)
+    assert md.startswith("| bucket |")
+    assert "matmul/MXU" in md and "%" in md
+    # every reported bucket appears as a row
+    assert md.count("\n|") >= len(report["buckets"]) + 1

@@ -1,0 +1,141 @@
+"""tools/perf_gate.py — the tier-1 perf regression gate.
+
+Runs against the COMMITTED baseline artifact (skips when absent): the gate
+must pass on the baseline vs itself, fail on an injected 2x step-time
+regression, and hard-fail the impossible-timing precondition regardless of
+how favourable the comparison looks."""
+import copy
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+import perf_gate  # noqa: E402
+
+BASELINE = os.path.join(REPO, "artifacts", "perf_baseline_cpu_r07.json")
+
+pytestmark = pytest.mark.skipif(
+    not os.path.exists(BASELINE),
+    reason="no committed perf baseline artifact",
+)
+
+
+@pytest.fixture(scope="module")
+def baseline():
+    with open(BASELINE) as f:
+        return json.load(f)
+
+
+def test_gate_passes_on_committed_baseline(baseline):
+    assert perf_gate.impossible_timing(baseline) == []
+    regressions, _notes = perf_gate.compare(baseline, baseline, tolerance=0.5)
+    assert regressions == []
+
+
+def test_gate_fails_on_injected_2x_regression(baseline, tmp_path):
+    candidate = copy.deepcopy(baseline)
+    for p in candidate["sl_sweep"]:
+        p["step_time_s"] *= 2.0
+        p["frames_per_sec"] /= 2.0
+    regressions, _ = perf_gate.compare(baseline, candidate, tolerance=0.5)
+    assert regressions, "2x slower must breach a 50% tolerance"
+    # and through the CLI, end to end (exit code contract: 1 = regression)
+    cand_path = tmp_path / "cand.json"
+    cand_path.write_text(json.dumps(candidate))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "perf_gate.py"), "check",
+         "--baseline", BASELINE, "--candidate", str(cand_path)],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "REGRESSION" in proc.stdout
+
+
+def test_gate_tolerance_absorbs_noise(baseline):
+    candidate = copy.deepcopy(baseline)
+    for p in candidate["sl_sweep"]:
+        p["step_time_s"] *= 1.3  # 30% drift < 50% tolerance
+    regressions, _ = perf_gate.compare(baseline, candidate, tolerance=0.5)
+    assert regressions == []
+
+
+def test_impossible_timing_is_a_hard_precondition(baseline, tmp_path):
+    # a candidate claiming a TPU whose own flop count says the step cannot
+    # run that fast must fail with exit 2 even though it "improved"
+    candidate = copy.deepcopy(baseline)
+    candidate["device"] = "TPU v5 lite"
+    for p in candidate["sl_sweep"]:
+        flops = max(p.get("flops_unoptimized", 0), p.get("flops_optimized", 0))
+        assert flops > 0, "baseline must carry flop counts"
+        p["step_time_s"] = flops / (200 * 197e12)  # 200x peak: impossible
+        p["frames_per_sec"] = 10 ** 9
+    offences = perf_gate.impossible_timing(candidate)
+    assert offences
+    cand_path = tmp_path / "impossible.json"
+    cand_path.write_text(json.dumps(candidate))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "perf_gate.py"), "check",
+         "--baseline", BASELINE, "--candidate", str(cand_path)],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 2, proc.stdout + proc.stderr
+    assert "PRECONDITION" in proc.stdout
+
+
+def test_suspect_flag_is_a_hard_precondition(baseline):
+    candidate = copy.deepcopy(baseline)
+    candidate["suspect"] = True
+    candidate["suspect_reason"] = "CPU-derived scaling numbers"
+    assert perf_gate.impossible_timing(candidate)
+
+
+def test_missing_candidate_points_note_not_fail(baseline):
+    candidate = copy.deepcopy(baseline)
+    candidate["sl_sweep"] = []
+    candidate.pop("value", None)
+    regressions, notes = perf_gate.compare(baseline, candidate, tolerance=0.5)
+    # nothing comparable IS a failure; a truncated (but nonempty) sweep is not
+    assert any("no comparable points" in r for r in regressions) or notes
+
+
+def test_trajectory_collects_rounds_and_flags_suspects():
+    rows = perf_gate.collect_trajectory()
+    assert rows, "repo carries BENCH_*/MULTICHIP_* artifacts"
+    by_artifact = {r["artifact"]: r for r in rows}
+    assert "perf_baseline_cpu_r07.json" in by_artifact
+    # the physically-incoherent 109x rows stay flagged forever
+    if "BENCH_LOCAL_r05.json" in by_artifact:
+        assert "SUSPECT" in by_artifact["BENCH_LOCAL_r05.json"]["status"]
+    # the r06 multichip artifact flags itself in-band
+    if "multichip_scaling_cpu_r06.json" in by_artifact:
+        assert "SUSPECT" in by_artifact["multichip_scaling_cpu_r06.json"]["status"]
+
+
+def test_trajectory_write_round_trips_markers(tmp_path):
+    target = tmp_path / "PERF.md"
+    target.write_text("# perf\n\nintro text\n")
+    ns = type("A", (), {"write": str(target)})
+    perf_gate.cmd_trajectory(ns)
+    first = target.read_text()
+    assert perf_gate.TRAJ_BEGIN in first and perf_gate.TRAJ_END in first
+    assert "intro text" in first
+    perf_gate.cmd_trajectory(ns)  # idempotent: replaces between markers
+    second = target.read_text()
+    assert second.count(perf_gate.TRAJ_BEGIN) == 1
+    assert second == first
+
+
+def test_perf_md_trajectory_block_is_current():
+    """PERF.md's committed trajectory table matches what the artifacts
+    derive — the block can't silently rot as artifacts accumulate."""
+    with open(os.path.join(REPO, "PERF.md")) as f:
+        text = f.read()
+    assert perf_gate.TRAJ_BEGIN in text
+    committed = text.split(perf_gate.TRAJ_BEGIN, 1)[1].split(perf_gate.TRAJ_END, 1)[0]
+    fresh = perf_gate.render_trajectory(perf_gate.collect_trajectory())
+    assert committed.strip() == fresh.strip()

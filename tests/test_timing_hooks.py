@@ -192,3 +192,55 @@ def test_profiler_sessions_counted_in_registry(tmp_path):
         assert reg.counter("distar_profiler_sessions_total").value == 2  # it=3, it=6
     finally:
         set_registry(prev)
+
+
+def test_profiler_failures_counted_and_hook_self_disables(tmp_path):
+    """start/stop failures are no longer silent warnings: each one counts
+    distar_profiler_failures_total{stage=...}, and after 3 consecutive
+    start failures (unwritable logdir) the hook retires itself instead of
+    re-failing at every gate."""
+    from distar_tpu.obs import MetricsRegistry, set_registry
+
+    reg = MetricsRegistry()
+    prev = set_registry(reg)
+    try:
+        prof = _FakeProfiler(fail=True)
+        hook = ProfilerHook(str(tmp_path), freq=1, duration=1, profiler=prof)
+        learner = _profiled_learner()
+        for it in range(1, 10):
+            learner.last_iter.val = it
+            hook(learner)
+        assert hook.disabled
+        # exactly MAX_CONSECUTIVE_FAILURES attempts, then silence
+        assert reg.counter(
+            "distar_profiler_failures_total", stage="start"
+        ).value == ProfilerHook.MAX_CONSECUTIVE_FAILURES
+    finally:
+        set_registry(prev)
+
+
+def test_profiler_session_records_last_profile_path(tmp_path):
+    """A successful stop resolves the newest capture dir under the logdir
+    (the jax.profiler plugins/profile/<stamp>/ layout) — what the admin
+    /profile route hands to the analyzer."""
+    import os
+
+    from distar_tpu.obs import MetricsRegistry, ProfilerSession
+
+    stamp = tmp_path / "plugins" / "profile" / "2026_01_02"
+
+    class WritingProfiler(_FakeProfiler):
+        def stop_trace(self):
+            os.makedirs(stamp)
+            super().stop_trace()
+
+    sess = ProfilerSession(str(tmp_path), profiler=WritingProfiler(),
+                           registry=MetricsRegistry())
+    assert sess.start()
+    assert sess.stop()
+    assert sess.last_profile_path == str(stamp)
+    # failure paths count into the session's registry, typed by stage
+    failing = ProfilerSession(str(tmp_path), profiler=_FakeProfiler(fail=True),
+                              registry=MetricsRegistry())
+    assert not failing.start()
+    assert failing.failures == 1

@@ -101,42 +101,29 @@ def main() -> None:
                     learner.state["params"], learner.state["opt_state"],
                     batch, learner._hidden,
                 )
+            from distar_tpu.obs.perf import (
+                flops_of_compiled, flops_of_lowered, memory_report,
+            )
+
             t0 = time.perf_counter()
             # _train_step is the learner's jitted step (donation + out
             # shardings already applied) — lower exactly what training runs
             lowered = learner._train_step.lower(*fn_args)
             row["trace_s"] = round(time.perf_counter() - t0, 1)
-            try:
-                c = lowered.cost_analysis()
-                row["flops_unoptimized"] = float(c.get("flops", 0.0)) if c else 0.0
-            except Exception:
-                pass
+            flops = flops_of_lowered(lowered)
+            if flops:
+                row["flops_unoptimized"] = flops
             t0 = time.perf_counter()
             compiled = lowered.compile()
             row["compile_s"] = round(time.perf_counter() - t0, 1)
-            try:
-                # executable-level count: post-optimization, the honest MFU
-                # numerator (the unoptimized-HLO count can overcount)
-                c = compiled.cost_analysis()
-                if isinstance(c, (list, tuple)):
-                    c = c[0] if c else None
-                if c:
-                    row["flops_optimized"] = float(c.get("flops", 0.0))
-            except Exception:
-                pass
-            mem = compiled.memory_analysis()
-            if mem is not None:
-                for k in (
-                    "argument_size_in_bytes", "output_size_in_bytes",
-                    "temp_size_in_bytes", "generated_code_size_in_bytes",
-                ):
-                    v = getattr(mem, k, None)
-                    if v is not None:
-                        row[k.replace("_in_bytes", "_mb")] = round(v / 1e6, 1)
-                tot = getattr(mem, "temp_size_in_bytes", 0) + getattr(
-                    mem, "argument_size_in_bytes", 0
-                ) + getattr(mem, "output_size_in_bytes", 0)
-                row["total_mb"] = round(tot / 1e6, 1)
+            # executable-level count: post-optimization, the honest MFU
+            # numerator (the unoptimized-HLO count can overcount); memory
+            # fields come through the same obs/perf.py helper bench.py and
+            # the live learner gauges use
+            flops = flops_of_compiled(compiled)
+            if flops:
+                row["flops_optimized"] = flops
+            row.update(memory_report(compiled))
             if args.steps > 0:
                 # chained re-timing at a longer window than bench's 4 iters:
                 # each call consumes the previous call's params/opt (+ the

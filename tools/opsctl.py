@@ -8,13 +8,20 @@ serve HTTP frontend, or a training role started with --metrics-port):
   python tools/opsctl.py tail-alerts  --addr 127.0.0.1:8423 [--interval 2]
   python tools/opsctl.py query        --addr 127.0.0.1:8423 \\
         --name distar_learner_step_seconds_p50 [--window 300] [--source local]
+  python tools/opsctl.py profile      --addr <learner-admin host:port> \\
+        [--steps 2] [--timeout 600]
 
 ``status`` exits 0 when healthy, 1 when any rule is warning, 2 when firing —
-scriptable for cron probes. ``tail-alerts`` follows the transition history
-(one line per ok/warning/firing edge, deduped by event sequence). When the
-probed address is a replay admin surface (``--type replay`` with
-``--metrics-port``), ``status`` additionally prints per-table occupancy and
-rate-limiter state from GET ``/replay/stats``.
+scriptable for cron probes; it also prints a per-role step-time/MFU digest
+from the ``distar_perf_*`` series when any are in the probed TSDB.
+``tail-alerts`` follows the transition history (one line per
+ok/warning/firing edge, deduped by event sequence). When the probed address
+is a replay admin surface (``--type replay`` with ``--metrics-port``),
+``status`` additionally prints per-table occupancy and rate-limiter state
+from GET ``/replay/stats``. ``profile`` talks to a LEARNER ADMIN surface
+(``rl_train --admin-port``): captures --steps iterations of jax.profiler
+trace on the live learner and prints the ranked per-bucket attribution
+table (obs/traceview.py).
 """
 from __future__ import annotations
 
@@ -103,6 +110,37 @@ def _print_replay(stats: dict) -> None:
               f"({spill.get('root')})")
 
 
+# the per-role perf series worth a one-line digest (flattened TSDB keys;
+# token = learner class name, sources = fleet processes)
+_PERF_DIGEST_NAMES = tuple(
+    f"{name}{{token={token}}}"
+    for name in ("distar_perf_step_seconds", "distar_perf_frames_per_s",
+                 "distar_perf_mfu", "distar_perf_implied_tflops")
+    for token in ("rllearner", "sllearner")
+)
+
+
+def _print_perf_digest(addr: str) -> None:
+    """Per-role step-time/MFU digest from the probed TSDB: one line per
+    (series, source) with the last value — the 10-second answer to "how
+    fast is each learner stepping and at what MFU"."""
+    rows = []
+    for name in _PERF_DIGEST_NAMES:
+        body = _try_get(addr, f"/timeseries?name={urllib.parse.quote(name)}&window_s=600")
+        if not body or not body.get("points"):
+            continue
+        for source, st in (body.get("stats") or {}).items():
+            if st and st.get("last") is not None:
+                rows.append((source, name, st["last"], st.get("mean")))
+    if not rows:
+        return
+    print("perf:")
+    for source, name, last, mean in sorted(rows):
+        short = name.replace("distar_perf_", "")
+        mean_s = f"{mean:.6g}" if isinstance(mean, (int, float)) else "—"
+        print(f"  {source:<24} {short:<40} last={last:<12.6g} mean={mean_s}")
+
+
 def cmd_status(args) -> int:
     body = _get(args.addr, "/healthz")
     status = body.get("status", "unknown")
@@ -128,6 +166,7 @@ def cmd_status(args) -> int:
     replay = _try_get(args.addr, "/replay/stats")
     if replay:
         _print_replay(replay)
+    _print_perf_digest(args.addr)
     return {"ok": 0, "warning": 1}.get(status, 2)
 
 
@@ -186,10 +225,40 @@ def cmd_query(args) -> int:
     return 0
 
 
+def cmd_profile(args) -> int:
+    """On-demand fleet profiling: POST /learner/profile?steps=N on a live
+    learner's admin surface, print the ranked bucket table. Blocks while
+    the learner captures + analyzes (bounded by --timeout)."""
+    url = (f"http://{args.addr}/learner/profile?steps={args.steps}"
+           f"&timeout_s={args.timeout}")
+    req = urllib.request.Request(url, data=b"{}", method="POST",
+                                 headers={"Content-Type": "application/json"})
+    try:
+        # +30s transport grace over the learner-side capture budget
+        with urllib.request.urlopen(req, timeout=args.timeout + 30.0) as resp:
+            body = json.loads(resp.read())
+    except (urllib.error.URLError, ConnectionError, TimeoutError, OSError,
+            ValueError) as e:
+        raise SystemExit(f"POST {url} failed: {e!r}")
+    if body.get("code") != 0:
+        raise SystemExit(f"profile failed: {body.get('info')}")
+    report = body["info"]
+    if args.json:
+        print(json.dumps(report, indent=1))
+        return 0
+    print(report.get("markdown", ""))
+    perf = report.get("perf") or {}
+    if perf:
+        parts = [f"{k}={v:.6g}" for k, v in sorted(perf.items())
+                 if isinstance(v, (int, float))]
+        print("live perf: " + " ".join(parts))
+    return 0
+
+
 def main() -> int:
     p = argparse.ArgumentParser(description=__doc__,
                                 formatter_class=argparse.RawDescriptionHelpFormatter)
-    p.add_argument("command", choices=("status", "tail-alerts", "query"))
+    p.add_argument("command", choices=("status", "tail-alerts", "query", "profile"))
     p.add_argument("--addr", default="127.0.0.1:8423", help="host:port of a health surface")
     p.add_argument("--interval", type=float, default=2.0, help="tail-alerts poll cadence")
     p.add_argument("--once", action="store_true",
@@ -199,12 +268,19 @@ def main() -> int:
     p.add_argument("--window", type=float, default=300.0, help="query window seconds")
     p.add_argument("--source", default="", help="query: restrict to one source")
     p.add_argument("--tail", type=int, default=10, help="query: points to print per source")
-    p.add_argument("--json", action="store_true", help="query: raw JSON output")
+    p.add_argument("--json", action="store_true",
+                   help="query/profile: raw JSON output")
+    p.add_argument("--steps", type=int, default=2,
+                   help="profile: iterations of device trace to capture")
+    p.add_argument("--timeout", type=float, default=600.0,
+                   help="profile: learner-side capture+analysis budget (s)")
     args = p.parse_args()
     if args.command == "status":
         return cmd_status(args)
     if args.command == "tail-alerts":
         return cmd_tail_alerts(args)
+    if args.command == "profile":
+        return cmd_profile(args)
     if not args.name:
         p.error("query requires --name")
     return cmd_query(args)

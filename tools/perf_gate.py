@@ -1,0 +1,304 @@
+#!/usr/bin/env python
+"""perf_gate: performance regression gate + bench-trajectory aggregator.
+
+Two commands, both consuming the JSON artifacts bench.py / obs.traceview
+already emit (nothing here measures — this is the layer that finally READS
+the `BENCH_*`/`MULTICHIP_*` files every round produces):
+
+  check       compare a fresh artifact against a committed baseline with a
+              noise tolerance. The impossible-timing recheck is a HARD
+              precondition: a candidate whose own flop counts say its
+              timing beats 1.1x the chip's datasheet peak — or that carries
+              an in-band ``suspect``/``suspect_timing`` flag — fails the
+              gate no matter how good the comparison looks (no number
+              enters README/PERF without passing it; ROADMAP item 5).
+              Exit 0 pass / 1 regression / 2 precondition failed.
+
+  trajectory  aggregate the round-over-round artifacts (BENCH_r*.json,
+              BENCH_LOCAL_*.json, MULTICHIP_r*.json, artifacts/*_r*.json)
+              into a markdown table, optionally rewritten in place between
+              the PERF.md trajectory markers.
+
+Usage:
+  python tools/perf_gate.py check --baseline artifacts/perf_baseline_cpu.json \\
+         --candidate fresh.json [--tolerance 0.5]
+  python tools/perf_gate.py trajectory [--write PERF.md]
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+from typing import Dict, List, Optional, Tuple
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from distar_tpu.obs.perf import peak_flops  # noqa: E402
+
+TRAJ_BEGIN = "<!-- perf-trajectory:begin -->"
+TRAJ_END = "<!-- perf-trajectory:end -->"
+
+
+# ------------------------------------------------------------------- loading
+def load_artifact(path: str) -> dict:
+    with open(path) as f:
+        doc = json.load(f)
+    # driver wrapper format {n, cmd, rc, tail, parsed} -> the parsed result
+    if isinstance(doc, dict) and "parsed" in doc and "tail" in doc:
+        return {"_wrapper": doc, **(doc.get("parsed") or {})}
+    return doc
+
+
+def _points(artifact: dict) -> Dict[Tuple, dict]:
+    """Comparable sweep points keyed by (kind, batch, unroll, cap, remat).
+    Headline-only artifacts key a single ('headline',) point."""
+    out: Dict[Tuple, dict] = {}
+    for kind in ("sl", "rl", "sl_real"):
+        for p in artifact.get(f"{kind}_sweep", []) or []:
+            if "step_time_s" not in p and "frames_per_sec" not in p:
+                continue  # errored sweep entry
+            key = (kind, p.get("batch"), p.get("unroll"),
+                   p.get("max_entities"), bool(p.get("remat")))
+            out[key] = p
+    if not out and isinstance(artifact.get("value"), (int, float)) \
+            and artifact.get("value"):
+        out[("headline", None, None, None, False)] = {
+            "frames_per_sec": artifact["value"], "unit": artifact.get("unit"),
+        }
+    return out
+
+
+# --------------------------------------------------------- the physics check
+def impossible_timing(artifact: dict) -> List[str]:
+    """Re-run bench.py's impossible-timing recheck over an artifact: any
+    point whose max(flops_unoptimized, flops_optimized)/step_time exceeds
+    1.1x the named device's datasheet peak is physically impossible. Points
+    already flagged in-band (suspect / suspect_timing) count too. Returns
+    the list of offences (empty = clean)."""
+    offences: List[str] = []
+    peak = peak_flops(str(artifact.get("device", "")))
+    if artifact.get("suspect") or artifact.get("suspect_timing"):
+        offences.append(
+            f"artifact flags itself suspect: "
+            f"{artifact.get('suspect_reason', 'suspect_timing set')!r}"
+        )
+    for key, p in _points(artifact).items():
+        if p.get("suspect_timing"):
+            offences.append(f"{key}: suspect_timing set by the bench recheck")
+            continue
+        step = p.get("step_time_s")
+        flops = max(
+            float(p.get("flops_unoptimized", 0.0) or 0.0),
+            float(p.get("flops_optimized", 0.0) or 0.0),
+            float(p.get("flops_per_step", 0.0) or 0.0),
+        )
+        if peak and step and flops and flops / step > 1.1 * peak:
+            offences.append(
+                f"{key}: {flops / step / 1e12:.1f} TFLOP/s implied > 1.1x "
+                f"{peak / 1e12:.0f} TFLOP/s peak ({artifact.get('device')})"
+            )
+    return offences
+
+
+# ------------------------------------------------------------------ checking
+def compare(baseline: dict, candidate: dict, tolerance: float) -> Tuple[List[str], List[str]]:
+    """(regressions, notes). A config regresses when its step time grew (or
+    its throughput shrank) by more than ``tolerance`` (0.5 = 50%) over the
+    baseline; configs missing from the candidate are notes, not failures
+    (budget-truncated sweeps are normal)."""
+    regressions: List[str] = []
+    notes: List[str] = []
+    base_pts, cand_pts = _points(baseline), _points(candidate)
+    if not base_pts:
+        notes.append("baseline has no comparable points")
+    compared = 0
+    for key, bp in sorted(base_pts.items(), key=str):
+        cp = cand_pts.get(key)
+        if cp is None:
+            notes.append(f"{key}: missing from candidate (sweep truncated?)")
+            continue
+        compared += 1
+        bs, cs = bp.get("step_time_s"), cp.get("step_time_s")
+        if bs and cs and cs > bs * (1.0 + tolerance):
+            regressions.append(
+                f"{key}: step_time {cs:.4f}s vs baseline {bs:.4f}s "
+                f"(+{(cs / bs - 1) * 100:.0f}% > {tolerance * 100:.0f}% tolerance)"
+            )
+            continue
+        bf, cf = bp.get("frames_per_sec"), cp.get("frames_per_sec")
+        if bf and cf and cf < bf / (1.0 + tolerance):
+            regressions.append(
+                f"{key}: {cf:.2f} frames/s vs baseline {bf:.2f} "
+                f"(-{(1 - cf / bf) * 100:.0f}% > {tolerance * 100:.0f}% tolerance)"
+            )
+    # traceview reports compare on device step time
+    b_step, c_step = (a.get("step_time_device_us") for a in (baseline, candidate))
+    if b_step and c_step:
+        compared += 1
+        if c_step > b_step * (1.0 + tolerance):
+            regressions.append(
+                f"trace device step: {c_step:.0f}us vs baseline {b_step:.0f}us"
+            )
+    if not compared:
+        regressions.append("no comparable points between baseline and candidate")
+    return regressions, notes
+
+
+def cmd_check(args) -> int:
+    baseline = load_artifact(args.baseline)
+    candidate = load_artifact(args.candidate)
+    offences = impossible_timing(candidate)
+    if offences:
+        for o in offences:
+            print(f"PRECONDITION: {o}")
+        print("perf_gate: FAIL (impossible-timing precondition)")
+        return 2
+    regressions, notes = compare(baseline, candidate, args.tolerance)
+    for n in notes:
+        print(f"note: {n}")
+    for r in regressions:
+        print(f"REGRESSION: {r}")
+    if regressions:
+        print("perf_gate: FAIL")
+        return 1
+    print(f"perf_gate: PASS (tolerance {args.tolerance * 100:.0f}%)")
+    return 0
+
+
+# ---------------------------------------------------------------- trajectory
+def _round_of(path: str) -> str:
+    m = re.search(r"_r(\d+)", os.path.basename(path))
+    return m.group(1).lstrip("0") or "0" if m else "?"
+
+
+def _status_of(artifact: dict) -> str:
+    if artifact.get("suspect") or artifact.get("suspect_timing"):
+        return "SUSPECT (in-band flag)"
+    if impossible_timing(artifact):
+        return "SUSPECT (impossible timing)"
+    if artifact.get("metric") is None:  # wrapper with no parsed result line
+        return "no result"
+    err = artifact.get("error")
+    if err:
+        return "no result"
+    value = artifact.get("value")
+    if isinstance(value, (int, float)) and value == 0.0:
+        return "no result"
+    vs = artifact.get("vs_baseline")
+    if isinstance(vs, (int, float)) and vs > 20.0:
+        # the b6x64 "109x" class: physically incoherent vs the reference
+        # baseline but carrying no flop counts to prove it in-band
+        return "SUSPECT (>20x baseline, unverifiable)"
+    if artifact.get("device", "").lower().startswith("tpu"):
+        return "ok (on-silicon)"
+    return "ok (CPU-derived)"
+
+
+def _multichip_row(path: str, doc: dict) -> Optional[dict]:
+    if "multichip" in doc:  # executed-GSPMD scaling case (round 6+)
+        return {
+            "round": _round_of(path), "artifact": os.path.basename(path),
+            "metric": "dp scaling efficiency", "value": doc.get("value"),
+            "unit": doc.get("unit", ""), "status": _status_of(doc),
+        }
+    if "ok" in doc:  # dryrun wrapper format (rounds 1-5)
+        return {
+            "round": _round_of(path), "artifact": os.path.basename(path),
+            "metric": "multichip dryrun", "value": 1.0 if doc.get("ok") else 0.0,
+            "unit": f"ok @ {doc.get('n_devices', '?')} devices",
+            "status": "ok (structural)" if doc.get("ok") else "no result",
+        }
+    return None
+
+
+def collect_trajectory(repo: str = _REPO) -> List[dict]:
+    rows: List[dict] = []
+    for path in sorted(glob.glob(os.path.join(repo, "BENCH_r*.json"))
+                       + glob.glob(os.path.join(repo, "BENCH_LOCAL_r*.json"))
+                       + glob.glob(os.path.join(repo, "artifacts", "perf_baseline*.json"))):
+        try:
+            doc = load_artifact(path)
+        except (OSError, ValueError):
+            continue
+        rows.append({
+            "round": _round_of(path), "artifact": os.path.basename(path),
+            "metric": doc.get("metric", "?"), "value": doc.get("value"),
+            "unit": doc.get("unit", ""), "status": _status_of(doc),
+        })
+    for path in sorted(glob.glob(os.path.join(repo, "MULTICHIP_r*.json"))
+                       + glob.glob(os.path.join(repo, "artifacts", "multichip_*.json"))):
+        try:
+            doc = load_artifact(path)
+        except (OSError, ValueError):
+            continue
+        row = _multichip_row(path, doc)
+        if row:
+            rows.append(row)
+    rows.sort(key=lambda r: (r["round"].zfill(3), r["artifact"]))
+    return rows
+
+
+def render_trajectory(rows: List[dict]) -> str:
+    lines = [
+        "| round | artifact | metric | value | unit | status |",
+        "|---|---|---|---|---|---|",
+    ]
+    for r in rows:
+        value = r["value"]
+        value = f"{value:.3g}" if isinstance(value, (int, float)) else (value or "—")
+        lines.append(
+            f"| {r['round']} | `{r['artifact']}` | {r['metric']} "
+            f"| {value} | {r['unit']} | {r['status']} |"
+        )
+    return "\n".join(lines)
+
+
+def cmd_trajectory(args) -> int:
+    rows = collect_trajectory()
+    table = render_trajectory(rows)
+    if not args.write:
+        print(table)
+        return 0
+    with open(args.write) as f:
+        text = f.read()
+    block = f"{TRAJ_BEGIN}\n{table}\n{TRAJ_END}"
+    if TRAJ_BEGIN in text and TRAJ_END in text:
+        pre, rest = text.split(TRAJ_BEGIN, 1)
+        _, post = rest.split(TRAJ_END, 1)
+        text = pre + block + post
+    else:
+        text = text.rstrip() + (
+            "\n\n## Bench trajectory (artifact-derived, via tools/perf_gate.py)\n\n"
+            f"{block}\n"
+        )
+    with open(args.write, "w") as f:
+        f.write(text)
+    print(f"wrote {len(rows)} trajectory rows into {args.write}")
+    return 0
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(description=__doc__,
+                                formatter_class=argparse.RawDescriptionHelpFormatter)
+    sub = p.add_subparsers(dest="command", required=True)
+    pc = sub.add_parser("check", help="gate a fresh artifact against a baseline")
+    pc.add_argument("--baseline", required=True)
+    pc.add_argument("--candidate", required=True)
+    pc.add_argument("--tolerance", type=float, default=0.5,
+                    help="allowed fractional slowdown before failing "
+                         "(0.5 = step time may grow 50%%; CPU-noise sized)")
+    pt = sub.add_parser("trajectory", help="round-over-round artifact table")
+    pt.add_argument("--write", default="",
+                    help="rewrite this file's trajectory block in place "
+                         "(e.g. PERF.md); default prints to stdout")
+    args = p.parse_args()
+    return cmd_check(args) if args.command == "check" else cmd_trajectory(args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
