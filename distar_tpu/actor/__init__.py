@@ -1,6 +1,12 @@
 from .actor import Actor, ACTOR_DEFAULTS
 from .agent import Agent, sample_fake_z, time_decay_factor
 from .inference import BatchedInference, decollate
+from .rollout_plane import (
+    GatewayPolicyClient,
+    InlinePolicyClient,
+    PolicyClient,
+    RolloutPlane,
+)
 
 __all__ = [
     "Actor",
@@ -10,4 +16,8 @@ __all__ = [
     "time_decay_factor",
     "BatchedInference",
     "decollate",
+    "GatewayPolicyClient",
+    "InlinePolicyClient",
+    "PolicyClient",
+    "RolloutPlane",
 ]

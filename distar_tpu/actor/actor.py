@@ -9,10 +9,13 @@ TPU-first divergence (documented design choice): the reference forks one
 process per env and funnels inference through shared-memory slots
 (actor.py:301-319, agent.py:715-739). Here the env fleet steps in lockstep
 inside one process and every slot's observation joins ONE fixed-shape jitted
-batch (inference.BatchedInference) — the natural shape for a TPU host, where
-a single batched forward amortises dispatch and the MXU. SC2-process
-concurrency (the real env's slow step) belongs to the env layer's own worker
-pool behind the same interface.
+batch — the natural shape for a TPU host, where a single batched forward
+amortises dispatch and the MXU. WHERE that batch runs is the rollout
+plane's choice (rollout_plane.PolicyClient, the Sebulba split): a private
+per-actor BatchedInference (``inline``, default), this host's shared
+gateway+engine (``local``), or a remote bin/serve gateway (``remote``).
+SC2-process concurrency (the real env's slow step) belongs to the env
+layer's own worker pool behind the same interface.
 """
 from __future__ import annotations
 
@@ -31,7 +34,7 @@ from ..model import Model, default_model_config
 from ..obs import get_registry, start_trace
 from ..utils import Config, deep_merge_dicts
 from .agent import Agent, sample_fake_z
-from .inference import BatchedInference, decollate
+from .rollout_plane import RolloutPlane
 
 ACTOR_DEFAULTS = Config(
     {
@@ -58,6 +61,19 @@ ACTOR_DEFAULTS = Config(
                 "mirror": False,
                 "priority": 1.0,
                 "timeout_s": 60.0,
+            },
+            # rollout inference plane (docs/serving.md, Sebulba split):
+            # ``inline`` keeps today's per-actor BatchedInference; ``local``
+            # shares ONE in-process gateway+engine per player across every
+            # job on this host; ``remote`` rides the framed-TCP data plane
+            # of a bin/serve.py gateway at ``addr``. ``slots`` sizes the
+            # shared local engine (0 = this job's env_num).
+            "plane": {
+                "backend": "inline",
+                "addr": "",
+                "slots": 0,
+                "max_delay_s": 0.005,
+                "timeout_s": 30.0,
             },
         }
     }
@@ -86,6 +102,16 @@ class Actor:
         self._init_params = init_params
         self._player_params = dict(player_params or {})
         self._rng = np.random.default_rng(self.cfg.seed)
+        # ONE plane per actor, surviving across jobs: shared engines (and
+        # their compilations) persist; inline stays per-job by construction.
+        # Unsized shared engines default to BOTH sides of a job reserving
+        # env_num sessions each (a self-play job puts two clients of the
+        # SAME player on one gateway; exact-capacity admission would
+        # otherwise fail the second side's reserve at job start)
+        pcfg = dict(self.cfg.get("plane", {}) or {})
+        if not pcfg.get("slots"):
+            pcfg["slots"] = 2 * self.cfg.env_num
+        self.plane = RolloutPlane(model=self.model, **pcfg)
         self._replay_client = None  # lazily dialed from cfg.actor.replay
         rcfg = self.cfg.get("replay", {}) or {}
         if rcfg.get("enabled", False) and rcfg.get("addr", ""):
@@ -240,6 +266,8 @@ class Actor:
         """Drain the FIFO plane to the freshest publication (non-blocking).
         reset_flag ORs across everything drained — exactly one publication
         carries it and it must not be lost to a newer one."""
+        if self.adapter is None:  # adapterless actor (play/eval/tests): no
+            return None           # publication plane to drain
         latest, reset_seen = None, False
         while True:
             data = self.adapter.pull(f"{player_id}model", block=False)
@@ -251,13 +279,16 @@ class Actor:
             if latest is None or data.get("iter", 0) >= latest.get("iter", 0):
                 latest = data
 
-    def _refresh_models(self, job, player_ids, infer, params) -> bool:
+    def _refresh_models(self, job, player_ids, clients, params) -> bool:
         """Periodic weight hot-reload for update_players (the
         freshness-critical path, reference actor_comm.py:172-216: actors pull
         every ~10s; a learner-sent reset_flag additionally restarts
-        episodes). Returns True when a reset was requested."""
+        episodes). On the gateway backends the refresh is ONE registry
+        hot-swap per (player, iteration) on the plane — deduped, applied at
+        a flush boundary, shared by every client — instead of per-actor
+        param installs. Returns True when a reset was requested."""
         reset = False
-        for side in list(infer):
+        for side in list(clients):
             player = player_ids[side]
             if player not in job.get("update_players", []):
                 continue
@@ -265,7 +296,7 @@ class Actor:
             if data is not None and data.get("iter", -1) > self._model_iters.get(player, -1):
                 new_params = jax.tree.map(np.asarray, data["params"])
                 params[player] = new_params
-                infer[side].params = new_params
+                clients[side].refresh(new_params, data.get("iter", 0))
                 self._note_model_iter(player, data.get("iter", 0))
                 reset = reset or bool(data.get("reset_flag", False))
         return reset
@@ -317,20 +348,26 @@ class Actor:
             if plugins.is_model_free(_pipeline(side))
         }
 
-        # slots: (env, side); one BatchedInference per model-driven side
+        # slots: (env, side); one PolicyClient per model-driven side. The
+        # plane decides where the model actually lives: a private
+        # BatchedInference (inline), this host's shared gateway (local), or
+        # a remote bin/serve gateway (remote) — LSTM carries, teacher state
+        # and weight refresh all follow the backend (docs/serving.md)
         params = {
             pid: self._load_player_params(pid)
             for side, pid in enumerate(player_ids)
             if side not in modelfree_sides
         }
-        infer = {
-            side: BatchedInference(self.model, params[pid], n_env, seed=side)
+        teacher_params = {
+            side: self._load_teacher_params(side, job, params[pid])
             for side, pid in enumerate(player_ids)
             if side not in modelfree_sides
         }
-        teacher_hidden = {side: infer[side]._zero_hidden() for side in infer}
-        teacher_params = {
-            side: self._load_teacher_params(side, job, params[pid])
+        clients = {
+            side: self.plane.client_for(
+                pid, num_slots=n_env, params=params[pid],
+                teacher_params=teacher_params[side], seed=side,
+            )
             for side, pid in enumerate(player_ids)
             if side not in modelfree_sides
         }
@@ -368,27 +405,25 @@ class Actor:
             )
         sides = list(range(len(player_ids)))
         hidden_backup = {
-            (e, side): infer[side].hidden_for_slot(e)
+            (e, side): clients[side].hidden_for_slot(e)
             for e in range(n_env)
             for side in sides
-            if side in infer
+            if side in clients
         }
 
         def reset_slot(e: int) -> None:
             """Restart env slot e: fresh episode, fresh Z, zeroed policy and
             teacher LSTM carries (shared by episode-end and league-reset).
-            The fresh obs arrives asynchronously via the pool."""
+            On the gateway backends the zeroing happens server-side — a
+            session reset. The fresh obs arrives asynchronously via the
+            pool."""
             for side in sides:
                 if side in modelfree_sides:
                     agents[(e, side)].reset()
                     continue
                 agents[(e, side)].reset(z=self._sample_z(side, job))
-                infer[side].reset_slot(e)
-                teacher_hidden[side] = tuple(
-                    (h.at[e].set(0.0), c.at[e].set(0.0))
-                    for h, c in teacher_hidden[side]
-                )
-                hidden_backup[(e, side)] = infer[side].hidden_for_slot(e)
+                clients[side].reset_slot(e)
+                hidden_backup[(e, side)] = clients[side].hidden_for_slot(e)
             pool.reset(e)
 
         def handle_episode_end(e: int, next_obs, rewards, info) -> None:
@@ -403,7 +438,7 @@ class Actor:
                         pending_teacher.pop((e, side)),
                         hidden_backup[(e, side)],
                     )
-                    self._maybe_push(job, ag, traj, infer, hidden_backup, e, side)
+                    self._maybe_push(job, ag, traj, clients, hidden_backup, e, side)
             episodes_done += 1
             result = {
                 "game_steps": info.get("game_loop", 0),
@@ -467,7 +502,7 @@ class Actor:
             while episodes_done < episodes:
                 if time.time() - last_model_refresh > self.cfg.model_update_interval_s:
                     last_model_refresh = time.time()
-                    refreshed = self._refresh_models(job, player_ids, infer, params)
+                    refreshed = self._refresh_models(job, player_ids, clients, params)
                     for ag in agents.values():
                         ag.model_last_iter = self._model_iters.get(ag.player_id, 0)
                     if refreshed:
@@ -526,7 +561,7 @@ class Actor:
                                     pending_teacher.pop((e, side)),
                                     hidden_backup[(e, side)],
                                 )
-                                self._maybe_push(job, ag, traj, infer, hidden_backup, e, side)
+                                self._maybe_push(job, ag, traj, clients, hidden_backup, e, side)
                             prepared.append(ag.pre_process(obs[e][side]))
                             last_prepared[(e, side)] = prepared[-1]
                             active.append(True)
@@ -537,12 +572,12 @@ class Actor:
                         # no lane of this side is due: skip both forwards
                         # (hidden state untouched for inactive lanes anyway)
                         continue
-                    outs = infer[side].sample(prepared, active)
+                    outs = clients[side].sample(prepared, active)
                     # teacher logits at act time with the FROZEN teacher
-                    # weights, stored until the next obs arrives
-                    t_logits, teacher_hidden[side] = infer[side].teacher_logits(
-                        teacher_params[side], prepared, teacher_hidden[side], outs, active
-                    )
+                    # weights, stored until the next obs arrives (on the
+                    # gateway backends these rode the SAME flush as the
+                    # policy forward — no second round-trip)
+                    t_logits = clients[side].teacher_logits(prepared, outs, active)
                     for e in range(n_env):
                         if active[e]:
                             act = agents[(e, side)].post_process(outs[e])
@@ -557,6 +592,8 @@ class Actor:
                         del obs[e]
         finally:
             pool.close()
+            for c in clients.values():
+                c.close()  # frees the job's sessions on shared gateways
         self.results.extend(results)
         return results
 
@@ -634,11 +671,11 @@ class Actor:
                 f"{player_id}traj", traj, timeout_ms=120_000, trace=trace
             )
 
-    def _maybe_push(self, job, ag, traj, infer, hidden_backup, e, side) -> None:
+    def _maybe_push(self, job, ag, traj, clients, hidden_backup, e, side) -> None:
         if traj is None:
             return
         # next trajectory starts from the CURRENT carry (before this cycle's
-        # forward)
-        hidden_backup[(e, side)] = infer[side].hidden_for_slot(e)
+        # forward) — read back from wherever the plane keeps it
+        hidden_backup[(e, side)] = clients[side].hidden_for_slot(e)
         if ag.player_id in job["send_data_players"]:
             self.push_trajectory(ag.player_id, traj)

@@ -21,14 +21,28 @@ from ..model import Model
 
 
 def decollate(tree, idx: int):
-    """Slice one slot out of a batched output pytree (host numpy)."""
-    return jax.tree.map(lambda x: np.asarray(x)[idx], tree)
+    """Slice one slot out of a batched output pytree.
+
+    The whole pytree is fetched to host in ONE ``jax.device_get`` (a single
+    transfer covering every leaf) and the slot is handed out as views of
+    that host copy — never one ``np.asarray`` device sync per leaf per
+    slot, which cost num_slots x num_leaves transfers per step."""
+    host = jax.device_get(tree)
+    return jax.tree.map(lambda x: x[idx], host)
 
 
 class BatchedInference:
-    """Owns params + hidden states for all slots of one player_id."""
+    """Owns params + hidden states for all slots of one player_id.
 
-    def __init__(self, model: Model, params, num_slots: int, seed: int = 0):
+    Also owns the (optional) frozen-teacher side of the rollout contract:
+    ``teacher_params`` plus one teacher LSTM carry per slot, advanced by
+    ``teacher_step`` and zeroed alongside the policy carry in
+    ``reset_slot`` — so an engine built on this object holds the COMPLETE
+    per-slot recurrent state server-side (the serve plane's session-per-slot
+    contract, docs/serving.md)."""
+
+    def __init__(self, model: Model, params, num_slots: int, seed: int = 0,
+                 teacher_params=None):
         self.model = model
         self.params = params
         self.num_slots = num_slots
@@ -36,6 +50,8 @@ class BatchedInference:
         self._hidden_size = cfg["encoder"]["core_lstm"]["hidden_size"]
         self._num_layers = cfg["encoder"]["core_lstm"]["num_layers"]
         self.hidden = self._zero_hidden()
+        self.teacher_params = teacher_params
+        self.teacher_hidden = self._zero_hidden()
         self._rng = jax.random.PRNGKey(seed)
 
         self._sample = jax.jit(
@@ -63,6 +79,12 @@ class BatchedInference:
         the next ``sample``."""
         self.params = params
 
+    def set_teacher_params(self, params) -> None:
+        """Install (or replace) the frozen teacher weights. Same shape-
+        stability contract as ``set_params``: the jitted teacher forward is
+        reused, never recompiled."""
+        self.teacher_params = params
+
     def warmup(self, template_obs: dict, params=None) -> None:
         """One throwaway batched forward on scratch hidden state.
 
@@ -78,15 +100,18 @@ class BatchedInference:
         )
 
     def reset_slot(self, idx: int) -> None:
-        """Zero one slot's hidden state (episode boundary)."""
+        """Zero one slot's policy AND teacher hidden state (episode
+        boundary — the slot's whole recurrent state restarts together)."""
         self.hidden = tuple(
             (h.at[idx].set(0.0), c.at[idx].set(0.0)) for h, c in self.hidden
         )
+        self.teacher_hidden = tuple(
+            (h.at[idx].set(0.0), c.at[idx].set(0.0)) for h, c in self.teacher_hidden
+        )
 
     def hidden_for_slot(self, idx: int):
-        return tuple(
-            (np.asarray(h[idx]), np.asarray(c[idx])) for h, c in self.hidden
-        )
+        # slice on device, then ONE host fetch for the whole carry tuple
+        return jax.device_get(tuple((h[idx], c[idx]) for h, c in self.hidden))
 
     def sample(self, prepared: List[dict], active: Optional[List[bool]] = None) -> List[dict]:
         """One batched forward over all slots; returns per-slot outputs.
@@ -103,11 +128,11 @@ class BatchedInference:
         old_hidden = self.hidden
         out = self._sample(self.params, batch, self.hidden, key)
         self.hidden = self._merge_hidden(out["hidden_state"], old_hidden, active)
-        outs = []
-        host = jax.tree.map(np.asarray, {k: v for k, v in out.items() if k != "hidden_state"})
-        for i in range(self.num_slots):
-            outs.append(jax.tree.map(lambda x: x[i], host))
-        return outs
+        # ONE device->host transfer for the whole batched output pytree;
+        # per-slot dicts are views of that host copy (satellite of the
+        # rollout plane: the old per-leaf np.asarray cost one sync each)
+        host = jax.device_get({k: v for k, v in out.items() if k != "hidden_state"})
+        return [jax.tree.map(lambda x: x[i], host) for i in range(self.num_slots)]
 
     def _merge_hidden(self, new, old, active: Optional[List[bool]]):
         if active is None or all(active):
@@ -129,6 +154,23 @@ class BatchedInference:
         sun = jnp.asarray(np.stack([np.asarray(o["selected_units_num"]) for o in outputs]))
         out = self._teacher(teacher_params, batch, teacher_hidden, action_info, sun)
         merged = self._merge_hidden(out["hidden_state"], teacher_hidden, active)
-        host_logit = jax.tree.map(np.asarray, out["logit"])
+        host_logit = jax.device_get(out["logit"])  # one transfer, slots view it
         per_slot = [jax.tree.map(lambda x: x[i], host_logit) for i in range(self.num_slots)]
         return per_slot, merged
+
+    def teacher_step(
+        self, prepared: List[dict], outputs: List[dict],
+        active: Optional[List[bool]] = None,
+    ) -> List[dict]:
+        """Stateful teacher forward over the instance's own frozen teacher
+        weights and per-slot teacher carries (advanced here; inactive slots
+        keep theirs). Requires ``teacher_params`` to be installed."""
+        if self.teacher_params is None:
+            raise RuntimeError(
+                "teacher_step: no teacher params installed "
+                "(set_teacher_params / teacher_params ctor arg)"
+            )
+        per_slot, self.teacher_hidden = self.teacher_logits(
+            self.teacher_params, prepared, self.teacher_hidden, outputs, active
+        )
+        return per_slot

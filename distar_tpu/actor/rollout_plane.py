@@ -1,0 +1,452 @@
+"""Rollout inference plane: one batched policy server for the actor fleet.
+
+The Sebulba split (PAPERS.md [Podracer]): dedicate inference to a single
+batched server and make actors thin env drivers, instead of every Actor
+process instantiating its own ``BatchedInference`` — N model replicas, N
+compilations, N per-step Python dispatch loops (the DI-star reference's
+``gpu_batch_inference`` centralisation, re-decentralised by our per-actor
+port until this module). Everything here composes serve-plane machinery
+that already exists: the deadline-aware ``MicroBatcher``, ``SessionTable``
+sticky LSTM carries, the hot-swap ``ModelRegistry`` and the framed-TCP
+frontend.
+
+``PolicyClient`` is the surface the actor's job loop speaks — batched
+``sample`` + ``teacher_logits`` over its env slots, per-slot carry
+reset/readback, weight ``refresh`` — with three backends behind
+``RolloutPlane.client_for``:
+
+  * ``inline`` — today's per-actor ``BatchedInference`` engine, private to
+    the client (default; zero behaviour change).
+  * ``local``  — ONE shared in-process ``InferenceGateway`` per player on
+    this host: every actor thread/job's slots become sticky sessions whose
+    LSTM carries live in the shared engine, and all their cycles coalesce
+    in the micro-batcher into one fixed-shape flush. One engine, one
+    compilation, one registry to hot-swap.
+  * ``remote`` — framed-TCP ``ServeClient`` against a ``bin/serve.py``
+    gateway, riding the resilience retry/reconnect policies through
+    gateway restarts (a restart re-materializes carries from zero —
+    counted in ``distar_actor_carry_resets_total``).
+
+Episode resets map to session resets (carry zeroing, server-side), teacher
+logits piggyback on the same flush (``want_teacher``), and model refresh is
+a single registry hot-swap per host instead of per-actor polling.
+Session-per-slot admission is EXACT capacity: clients ``reserve`` every
+slot's session at creation and fail fast with a typed ``CapacityError``
+instead of shedding mid-episode.
+"""
+from __future__ import annotations
+
+import itertools
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+from ..obs import get_registry
+from ..serve.errors import ServeError, ShedError
+
+_CLIENT_SEQ = itertools.count()
+
+PLANE_BACKENDS = ("inline", "local", "remote")
+
+
+def _default_engine_factory(player_id: str, num_slots: int, params,
+                            teacher_params, model, seed: int):
+    """Real-model engine: the actor fleet's compiled ``sample_action``."""
+    from ..serve.engine import BatchedInferenceEngine
+    from .inference import BatchedInference
+
+    if model is None:
+        raise ValueError("rollout plane: a Model is required to build the "
+                         "default engine (pass model= or engine_factory=)")
+    return BatchedInferenceEngine(BatchedInference(
+        model, params, num_slots, seed=seed, teacher_params=teacher_params,
+    ))
+
+
+class PolicyClient:
+    """One job-side handle onto the plane: ``num_slots`` env lanes of one
+    player's policy (+ optional frozen teacher). Lifetime = one job."""
+
+    num_slots: int
+    backend: str
+
+    def sample(self, prepared: List[dict], active: Optional[List[bool]] = None
+               ) -> List[Optional[dict]]:
+        """One fleet cycle: per-slot outputs for active lanes (inactive
+        entries are unspecified and must not be consumed)."""
+        raise NotImplementedError
+
+    def teacher_logits(self, prepared: List[dict], outputs: List[dict],
+                       active: Optional[List[bool]] = None
+                       ) -> List[Optional[dict]]:
+        """Teacher-forced logits for the cycle just sampled (same
+        ``active`` mask). ``None`` entries when no teacher is installed."""
+        raise NotImplementedError
+
+    def reset_slot(self, idx: int) -> None:
+        raise NotImplementedError
+
+    def hidden_for_slot(self, idx: int):
+        raise NotImplementedError
+
+    def refresh(self, params, iteration: int = 0) -> None:
+        """Install freshly published weights (hot swap, never a recompile)."""
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class InlinePolicyClient(PolicyClient):
+    """Private engine per client — the legacy per-actor replica, kept as
+    the default backend (and the baseline the bench compares against)."""
+
+    backend = "inline"
+
+    def __init__(self, engine, player_id: str = ""):
+        self.engine = engine
+        self.player_id = player_id
+        self.num_slots = engine.num_slots
+        reg = get_registry()
+        self._c_samples = reg.counter(
+            "distar_rollout_samples_total", "slot-steps sampled through the plane",
+            backend=self.backend,
+        )
+        self._h_cycle = reg.histogram(
+            "distar_rollout_sample_seconds", "plane round-trip per fleet cycle",
+            backend=self.backend,
+        )
+
+    def sample(self, prepared, active=None):
+        t0 = time.perf_counter()
+        outs = self.engine.forward(
+            prepared, [True] * self.num_slots if active is None else active
+        )
+        self._h_cycle.observe(time.perf_counter() - t0)
+        self._c_samples.inc(
+            self.num_slots if active is None else sum(bool(a) for a in active)
+        )
+        return outs
+
+    def teacher_logits(self, prepared, outputs, active=None):
+        if not getattr(self.engine, "has_teacher", False):
+            return [None] * self.num_slots
+        return self.engine.teacher_forward(
+            prepared, outputs, [True] * self.num_slots if active is None else active
+        )
+
+    def reset_slot(self, idx: int) -> None:
+        self.engine.reset_slot(idx)
+
+    def hidden_for_slot(self, idx: int):
+        return self.engine.hidden_for_slot(idx)
+
+    def refresh(self, params, iteration: int = 0) -> None:
+        self.engine.set_params(params)
+
+
+class _LocalTarget:
+    """In-process adapter giving ``GatewayPolicyClient`` the same surface
+    ``ServeClient`` speaks, minus the wire."""
+
+    def __init__(self, gateway):
+        self._gw = gateway
+
+    def act_many(self, requests, timeout_s=None):
+        return self._gw.act_many(requests, timeout_s)
+
+    def reserve(self, session_ids):
+        return self._gw.reserve_sessions(session_ids)
+
+    def hidden(self, session_id):
+        return self._gw.session_hidden(session_id)
+
+    def set_teacher(self, params):
+        return self._gw.set_teacher(params)
+
+    def reset(self, session_id):
+        return self._gw.reset_session(session_id)
+
+    def end(self, session_id):
+        return self._gw.end_session(session_id)
+
+    def load(self, version, source=None, params=None, activate=False):
+        return self._gw.load_version(version, source=source, params=params,
+                                     activate=activate)
+
+    def close(self):
+        pass
+
+
+class GatewayPolicyClient(PolicyClient):
+    """Slots-as-sessions client over a gateway target (in-process or TCP).
+
+    Each env slot pins one sticky session whose LSTM carry — policy and
+    teacher — lives server-side in the shared engine. A cycle is ONE
+    ``act_many`` call (teacher logits piggyback via ``want_teacher``);
+    per-lane sheds are retried individually within the cycle's timeout so a
+    transient queue-full never re-executes lanes that already advanced
+    their carry. ``session_step`` answers are monotonic per episode; when
+    the counter runs backwards the server-side carry was re-materialized
+    from zero (gateway restart, eviction) — counted per player in
+    ``distar_actor_carry_resets_total`` so re-materialization cost is
+    visible, and the episode keeps rolling on the fresh carry."""
+
+    def __init__(self, target, session_ids: List[str], player_id: str = "",
+                 backend: str = "local", want_teacher: bool = False,
+                 timeout_s: float = 30.0, reserve: bool = True):
+        self.target = target
+        self.session_ids = list(session_ids)
+        self.player_id = player_id
+        self.backend = backend
+        self.num_slots = len(session_ids)
+        self.want_teacher = want_teacher
+        self.timeout_s = timeout_s
+        self._expected_step = [0] * self.num_slots
+        self._last_teacher: List[Optional[dict]] = [None] * self.num_slots
+        self._refresh_cb = None  # plane-level registry swap, set by client_for
+        reg = get_registry()
+        self._c_samples = reg.counter(
+            "distar_rollout_samples_total", "slot-steps sampled through the plane",
+            backend=backend,
+        )
+        self._h_cycle = reg.histogram(
+            "distar_rollout_sample_seconds", "plane round-trip per fleet cycle",
+            backend=backend,
+        )
+        self._c_shed = reg.counter(
+            "distar_rollout_shed_total", "plane sheds seen by actors (retried client-side)",
+            backend=backend,
+        )
+        self._c_carry_resets = reg.counter(
+            "distar_actor_carry_resets_total",
+            "server-side LSTM carries re-materialized from zero",
+            player=player_id or "?",
+        )
+        if reserve:
+            # exact-capacity admission: every slot's session exists (and its
+            # carry is zeroed) before the first env step, or we fail HERE
+            # with a typed CapacityError — never a shed mid-episode
+            self.target.reserve(self.session_ids)
+
+    # ------------------------------------------------------------------ steps
+    def _note_result(self, idx: int, out: dict) -> None:
+        st = out.get("session_step")
+        if st is None:
+            return
+        if st <= self._expected_step[idx]:
+            # the server's episode-step counter ran backwards: our session
+            # was re-created (restart/eviction) and the carry restarted
+            # from zero mid-episode
+            self._c_carry_resets.inc()
+        self._expected_step[idx] = int(st)
+
+    def sample(self, prepared, active=None):
+        active = [True] * self.num_slots if active is None else active
+        lanes = [i for i in range(self.num_slots) if active[i]]
+        outs: List[Optional[dict]] = [None] * self.num_slots
+        self._last_teacher = [None] * self.num_slots
+        t0 = time.perf_counter()
+        deadline = t0 + self.timeout_s
+        while lanes:
+            results = self.target.act_many(
+                [{"session_id": self.session_ids[i], "obs": prepared[i],
+                  "want_teacher": self.want_teacher} for i in lanes],
+                timeout_s=self.timeout_s,
+            )
+            retry = []
+            for i, res in zip(lanes, results):
+                if isinstance(res, ShedError):
+                    self._c_shed.inc()
+                    if time.perf_counter() < deadline:
+                        retry.append(i)  # only the shed lane re-executes
+                        continue
+                    raise res
+                if isinstance(res, ServeError):
+                    raise res
+                outs[i] = res
+                self._note_result(i, res)
+                if self.want_teacher:
+                    tl = res.get("teacher_logit")
+                    if tl is None:
+                        raise RuntimeError(
+                            "rollout plane: teacher logits requested but the "
+                            "gateway engine serves none (set_teacher failed?)"
+                        )
+                    self._last_teacher[i] = tl
+            lanes = retry
+            if lanes:
+                time.sleep(0.02)
+        self._h_cycle.observe(time.perf_counter() - t0)
+        self._c_samples.inc(sum(bool(a) for a in active))
+        return outs
+
+    def teacher_logits(self, prepared, outputs, active=None):
+        """Served from the cycle's own flush (``want_teacher`` piggyback) —
+        no second round-trip."""
+        if not self.want_teacher:
+            return [None] * self.num_slots
+        return list(self._last_teacher)
+
+    def reset_slot(self, idx: int) -> None:
+        self._expected_step[idx] = 0
+        try:
+            self.target.reset(self.session_ids[idx])
+        except ServeError:
+            pass  # unknown session (fresh gateway): next act allocs zeroed
+
+    def hidden_for_slot(self, idx: int):
+        return self.target.hidden(self.session_ids[idx])
+
+    def refresh(self, params, iteration: int = 0) -> None:
+        if self._refresh_cb is not None:
+            self._refresh_cb(params, iteration)
+
+    def close(self) -> None:
+        for sid in self.session_ids:
+            try:
+                self.target.end(sid)
+            except (ServeError, ConnectionError, OSError):
+                pass
+        self.target.close()
+
+
+class RolloutPlane:
+    """Per-host factory/owner of the rollout inference plane.
+
+    One instance per Actor (created once, surviving across jobs — the
+    shared engines and their compilations persist). ``client_for`` hands
+    each job side a ``PolicyClient`` on the configured backend; ``local``
+    gateways are lazily built per player and shared by every subsequent
+    client; weight refresh dedupes by learner iteration so N clients cost
+    one registry hot-swap."""
+
+    def __init__(self, backend: str = "inline", addr: str = "",
+                 slots: int = 0, max_delay_s: float = 0.005,
+                 timeout_s: float = 30.0, queue_capacity: int = 1024,
+                 idle_ttl_s: float = 300.0, model=None, engine_factory=None):
+        if backend not in PLANE_BACKENDS:
+            raise ValueError(
+                f"actor.plane.backend must be one of {PLANE_BACKENDS}, got {backend!r}"
+            )
+        self.backend = backend
+        self.addr = str(addr)
+        if backend == "remote":
+            self._remote_addr()  # fail fast on a malformed address
+        self.slots = int(slots)
+        self.max_delay_s = max_delay_s
+        self.timeout_s = timeout_s
+        self.queue_capacity = queue_capacity
+        self.idle_ttl_s = idle_ttl_s
+        self._model = model
+        self._engine_factory = engine_factory or _default_engine_factory
+        self._gateways: Dict[str, object] = {}
+        self._refresh_iters: Dict[str, int] = {}
+        self._lock = threading.Lock()
+        reg = get_registry()
+        reg.gauge(
+            "distar_rollout_plane_backend", "active rollout-plane backend (1 = active)",
+            backend=backend,
+        ).set(1)
+        self._c_swaps = reg.counter(
+            "distar_rollout_swaps_total", "registry hot-swaps driven by plane refresh",
+        )
+
+    # ------------------------------------------------------------------ utils
+    def _remote_addr(self):
+        host, _, port = self.addr.rpartition(":")
+        try:
+            return host or "127.0.0.1", int(port)
+        except ValueError:
+            raise ValueError(
+                f"actor.plane.addr must be 'host:port', got {self.addr!r}"
+            ) from None
+
+    def _session_ids(self, player_id: str, num_slots: int) -> List[str]:
+        uid = f"{os.getpid():x}-{next(_CLIENT_SEQ)}"
+        return [f"{player_id}/{uid}/{j}" for j in range(num_slots)]
+
+    # ---------------------------------------------------------------- clients
+    def client_for(self, player_id: str, *, num_slots: int, params=None,
+                   teacher_params=None, seed: int = 0, model=None) -> PolicyClient:
+        model = model if model is not None else self._model
+        if self.backend == "inline":
+            engine = self._engine_factory(
+                player_id=player_id, num_slots=num_slots, params=params,
+                teacher_params=teacher_params, model=model, seed=seed,
+            )
+            return InlinePolicyClient(engine, player_id)
+        if self.backend == "local":
+            gw = self._local_gateway(player_id, num_slots, params, model, seed)
+            target = _LocalTarget(gw)
+        else:  # remote
+            from ..resilience import RetryPolicy
+            from ..serve.tcp_frontend import ServeClient
+
+            host, port = self._remote_addr()
+            # patient reconnect budget: a gateway kill+restart (seconds of
+            # dead port) must stay invisible to the job loop — the episode
+            # rides through on re-materialized carries
+            target = ServeClient(
+                host, port, timeout_s=self.timeout_s,
+                retry_policy=RetryPolicy(
+                    max_attempts=10, backoff_base_s=0.2, backoff_max_s=2.0,
+                    deadline_s=max(4 * self.timeout_s, 30.0),
+                ),
+            )
+        if teacher_params is not None:
+            target.set_teacher(teacher_params)
+        client = GatewayPolicyClient(
+            target, self._session_ids(player_id, num_slots),
+            player_id=player_id, backend=self.backend,
+            want_teacher=teacher_params is not None, timeout_s=self.timeout_s,
+        )
+        client._refresh_cb = lambda p, it: self._install(player_id, target, p, it)
+        return client
+
+    def _local_gateway(self, player_id: str, num_slots: int, params, model,
+                       seed: int):
+        from ..serve.gateway import InferenceGateway
+
+        with self._lock:
+            gw = self._gateways.get(player_id)
+            if gw is None:
+                slots = self.slots or num_slots
+                engine = self._engine_factory(
+                    player_id=player_id, num_slots=slots, params=params,
+                    teacher_params=None, model=model, seed=seed,
+                )
+                gw = InferenceGateway(
+                    engine,
+                    max_batch=slots,
+                    max_delay_s=self.max_delay_s,
+                    queue_capacity=self.queue_capacity,
+                    idle_ttl_s=self.idle_ttl_s,
+                    default_timeout_s=self.timeout_s,
+                ).start()
+                if params is not None:
+                    gw.load_version(f"{player_id}@boot", params=params,
+                                    activate=True)
+                self._gateways[player_id] = gw
+            return gw
+
+    # ---------------------------------------------------------------- refresh
+    def _install(self, player_id: str, target, params, iteration: int) -> None:
+        """One registry hot-swap per (player, learner iteration) on this
+        plane — N clients refreshing the same publication dedupe here."""
+        with self._lock:
+            if iteration <= self._refresh_iters.get(player_id, -1):
+                return
+            self._refresh_iters[player_id] = iteration
+        target.load(f"{player_id}@{iteration}", params=params, activate=True)
+        self._c_swaps.inc()
+
+    # --------------------------------------------------------------- lifecycle
+    def shutdown(self, timeout_s: float = 10.0) -> None:
+        """Drain and stop every shared local gateway (tests/bench teardown;
+        actors normally keep the plane alive for the process lifetime)."""
+        with self._lock:
+            gateways, self._gateways = dict(self._gateways), {}
+        for gw in gateways.values():
+            gw.drain_and_stop(timeout_s)

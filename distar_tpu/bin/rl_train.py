@@ -322,6 +322,7 @@ def run_all(args) -> None:
     traj_len = args.traj_len
     actor = Actor(
         cfg={"actor": {"env_num": args.env_num, "traj_len": traj_len,
+                       "plane": _plane_cfg(args),
                        **actor_replay_cfg}},
         league=league,
         adapter=actor_adapter,
@@ -374,6 +375,20 @@ def run_all(args) -> None:
 def _addr(s: str):
     host, _, port = s.rpartition(":")
     return host or "127.0.0.1", int(port)
+
+
+def _plane_cfg(args) -> dict:
+    """Rollout-plane block for the actor config (docs/serving.md): which
+    backend serves policy forwards — per-actor inline (default), one shared
+    in-process gateway per player (local), or a remote bin/serve gateway
+    (remote, needs --plane-addr)."""
+    if args.plane == "remote" and not args.plane_addr:
+        raise SystemExit("--plane remote requires --plane-addr host:port")
+    return {
+        "backend": args.plane,
+        "addr": args.plane_addr,
+        "slots": args.plane_slots,
+    }
 
 
 def run_learner(args) -> None:
@@ -442,7 +457,8 @@ def run_actor(args) -> None:
     )
     _maybe_serve_metrics(args)
     model_cfg = _model_cfg(args)
-    actor_cfg = {"env_num": args.env_num, "traj_len": args.traj_len}
+    actor_cfg = {"env_num": args.env_num, "traj_len": args.traj_len,
+                 "plane": _plane_cfg(args)}
     if args.replay_addr:
         actor_cfg["replay"] = {"enabled": True, "addr": args.replay_addr}
     actor = Actor(
@@ -541,6 +557,20 @@ def main() -> None:
                         "(0 = leases disabled)")
     p.add_argument("--league-addr", default="", help="host:port of the league server")
     p.add_argument("--coordinator-addr", default="", help="host:port of the coordinator")
+    p.add_argument("--plane", default="inline",
+                   choices=("inline", "local", "remote"),
+                   help="rollout inference plane backend (docs/serving.md): "
+                        "inline = per-actor BatchedInference (legacy), "
+                        "local = one shared in-process gateway per player, "
+                        "remote = framed-TCP against a bin/serve gateway "
+                        "(--plane-addr)")
+    p.add_argument("--plane-addr", default="",
+                   help="host:port of a bin/serve TCP frontend for "
+                        "--plane remote")
+    p.add_argument("--plane-slots", type=int, default=0,
+                   help="shared local engine lanes (0 = this job's env_num); "
+                        "sessions reserve exact capacity, so size it for "
+                        "every concurrent job on the host")
     p.add_argument("--replay", action="store_true",
                    help="--type all: route trajectories through an "
                         "in-process replay store (smoke config of the "

@@ -35,17 +35,18 @@ class PendingRequest:
 
     __slots__ = (
         "session_id", "slot", "obs", "enqueue_ts", "deadline_ts", "ctx",
-        "result", "error", "_event", "_state", "_lock",
+        "want_teacher", "result", "error", "_event", "_state", "_lock",
     )
 
     def __init__(self, session_id: str, slot: int, obs, deadline_ts: Optional[float],
-                 ctx: Optional[dict] = None):
+                 ctx: Optional[dict] = None, want_teacher: bool = False):
         self.session_id = session_id
         self.slot = slot
         self.obs = obs
         self.enqueue_ts = time.time()
         self.deadline_ts = deadline_ts
         self.ctx = ctx  # obs.trace context riding the request
+        self.want_teacher = want_teacher  # piggyback teacher logits on the flush
         self.result = None
         self.error: Optional[ServeError] = None
         self._event = threading.Event()

@@ -9,11 +9,16 @@ the surface the batcher needs:
   * ``reset_slot(idx)``           — zero one lane's carry (episode reset)
   * ``set_params(params)``        — install new weights (hot swap); must be
     shape-stable so the compiled forward is reused, not recompiled
+  * ``teacher_forward(prepared, outputs, active)`` (optional, gated by
+    ``has_teacher``) — teacher-forced logits for the freshly sampled
+    actions, advancing per-slot teacher carries on ``active`` lanes only
+  * ``hidden_for_slot(idx)`` (optional) — the lane's current policy carry
+    (actors stamp it into trajectories as the learner's burn-in state)
 
 ``BatchedInferenceEngine`` adapts ``actor.inference.BatchedInference`` — the
 serving path reuses the actor fleet's compiled ``sample_action`` verbatim.
 ``MockModelEngine`` is a CPU stand-in with observable per-slot dynamics for
-tests and ``tools/loadgen.py``.
+tests, ``tools/loadgen.py`` and ``BENCH_MODE=rollout``.
 """
 from __future__ import annotations
 
@@ -34,14 +39,28 @@ class BatchedInferenceEngine:
     def num_slots(self) -> int:
         return self._infer.num_slots
 
+    @property
+    def has_teacher(self) -> bool:
+        return self._infer.teacher_params is not None
+
     def forward(self, prepared: List[dict], active: List[bool]) -> List[dict]:
         return self._infer.sample(prepared, active)
+
+    def teacher_forward(self, prepared: List[dict], outputs: List[dict],
+                        active: List[bool]) -> List[dict]:
+        return self._infer.teacher_step(prepared, outputs, active)
 
     def reset_slot(self, idx: int) -> None:
         self._infer.reset_slot(idx)
 
     def set_params(self, params) -> None:
         self._infer.set_params(params)
+
+    def set_teacher_params(self, params) -> None:
+        self._infer.set_teacher_params(params)
+
+    def hidden_for_slot(self, idx: int):
+        return self._infer.hidden_for_slot(idx)
 
     def warmup(self, template_obs: dict, params=None) -> float:
         """Compile/execute the batched forward off the serving path: one
@@ -63,16 +82,35 @@ class MockModelEngine:
     which weights served each request. ``delay_s`` models device time; the
     sleep releases the GIL like a real device dispatch, so concurrent
     submitters pile up behind it exactly as they would behind a TPU step.
+
+    Two knobs model the one-device economics the rollout bench measures:
+    ``per_slot_delay_s`` adds batch-size-dependent cost (sleep = delay_s +
+    per_slot_delay_s * active lanes — a batched flush amortises the base
+    cost), and ``device_lock`` — when several engine INSTANCES share one
+    lock, their forwards serialise like N per-actor model replicas
+    contending for the same physical chip.
     """
 
-    def __init__(self, num_slots: int, params: Optional[dict] = None, delay_s: float = 0.0):
+    def __init__(self, num_slots: int, params: Optional[dict] = None,
+                 delay_s: float = 0.0, per_slot_delay_s: float = 0.0,
+                 device_lock: Optional[threading.Lock] = None,
+                 teacher_params: Optional[dict] = None):
         self.num_slots = num_slots
         self.params = dict(params or {"version": "v0", "bias": 0.0})
         self.delay_s = delay_s
+        self.per_slot_delay_s = per_slot_delay_s
+        self.device_lock = device_lock
+        self.teacher_params = dict(teacher_params) if teacher_params else None
         self.steps = np.zeros(num_slots, dtype=np.int64)
+        self.teacher_steps = np.zeros(num_slots, dtype=np.int64)
         self.forward_calls = 0
+        self.teacher_calls = 0
         self.warmup_calls = 0
         self._lock = threading.Lock()
+
+    @property
+    def has_teacher(self) -> bool:
+        return self.teacher_params is not None
 
     def warmup(self, template_obs: dict, params=None) -> float:
         if self.delay_s:
@@ -85,14 +123,32 @@ class MockModelEngine:
         with self._lock:
             self.params = dict(params)
 
+    def set_teacher_params(self, params) -> None:
+        with self._lock:
+            self.teacher_params = dict(params)
+
     def reset_slot(self, idx: int) -> None:
         with self._lock:
             self.steps[idx] = 0
+            self.teacher_steps[idx] = 0
+
+    def hidden_for_slot(self, idx: int):
+        with self._lock:
+            return {"step": int(self.steps[idx])}
+
+    def _device_time(self, n_active: int) -> None:
+        d = self.delay_s + self.per_slot_delay_s * n_active
+        if d <= 0:
+            return
+        if self.device_lock is not None:
+            with self.device_lock:  # one chip: replica forwards serialise
+                time.sleep(d)
+        else:
+            time.sleep(d)
 
     def forward(self, prepared: List[dict], active: List[bool]) -> List[dict]:
         assert len(prepared) == self.num_slots and len(active) == self.num_slots
-        if self.delay_s:
-            time.sleep(self.delay_s)
+        self._device_time(sum(bool(a) for a in active))
         with self._lock:
             self.forward_calls += 1
             params = dict(self.params)
@@ -106,6 +162,30 @@ class MockModelEngine:
                         "action": np.asarray(np.sum(x) + params.get("bias", 0.0)),
                         "step": int(self.steps[i]),
                         "version": params.get("version"),
+                    }
+                )
+            return outs
+
+    def teacher_forward(self, prepared: List[dict], outputs: List[dict],
+                        active: List[bool]) -> List[dict]:
+        """Teacher-forced mock: advances the per-slot TEACHER counter on
+        active lanes only and echoes the teacher version, so carry semantics
+        (reset zeroes it, inactive lanes keep theirs) are assertable."""
+        assert len(prepared) == self.num_slots and len(active) == self.num_slots
+        if self.teacher_params is None:
+            raise RuntimeError("teacher_forward: no teacher params installed")
+        self._device_time(sum(bool(a) for a in active))
+        with self._lock:
+            self.teacher_calls += 1
+            tparams = dict(self.teacher_params)
+            outs = []
+            for i in range(self.num_slots):
+                if active[i]:
+                    self.teacher_steps[i] += 1
+                outs.append(
+                    {
+                        "teacher_step": int(self.teacher_steps[i]),
+                        "teacher_version": tparams.get("version"),
                     }
                 )
             return outs

@@ -99,54 +99,113 @@ class InferenceGateway:
         self.batcher.drain_and_stop(timeout)
 
     # ----------------------------------------------------------- client API
-    def act(self, session_id: str, obs: Dict[str, Any], timeout_s: Optional[float] = None):
+    def act(self, session_id: str, obs: Dict[str, Any], timeout_s: Optional[float] = None,
+            want_teacher: bool = False):
         """One agent step: returns the engine's per-slot output dict plus
         ``model_version``. Raises a typed ``ServeError`` (``ShedError``
         subclasses are retryable load sheds)."""
+        out = self.act_many(
+            [{"session_id": session_id, "obs": obs, "want_teacher": want_teacher}],
+            timeout_s=timeout_s,
+        )[0]
+        if isinstance(out, ServeError):
+            raise out
+        return out
+
+    def act_many(self, requests, timeout_s: Optional[float] = None):
+        """Submit one cycle of requests — ``[{"session_id", "obs",
+        "want_teacher"?}, ...]`` — and wait for all of them. Returns a
+        per-request list whose entries are either the output dict or a
+        typed ``ServeError`` INSTANCE (never raised: partial success must
+        not lose the lanes that did complete — the rollout plane retries
+        shed lanes individually). This is the actor-grade surface: a whole
+        env fleet's cycle lands in the micro-batcher in one call, with no
+        per-slot caller threads, and coalesces with every other caller's
+        cycle into the same fixed-shape flush."""
         timeout_s = self.default_timeout_s if timeout_s is None else timeout_s
         t0 = time.perf_counter()
-        ctx = start_trace("serve_request", session=session_id)
-        try:
-            slot = self.sessions.acquire(session_id)
-        except ShedError:  # CapacityError: no slot, nothing idle to evict
-            self._c_req["shed"].inc()
-            raise
-        self._g_inflight.inc()
-        try:
+        results: List[Any] = [None] * len(requests)
+        pending: List[tuple] = []
+        for i, r in enumerate(requests):
+            session_id = r["session_id"]
+            ctx = start_trace("serve_request", session=session_id)
+            try:
+                slot = self.sessions.acquire(session_id)
+            except ShedError as e:  # CapacityError: no slot, nothing to evict
+                self._c_req["shed"].inc()
+                results[i] = e
+                continue
             with self._template_lock:
                 if self._template is None:
-                    self._template = _zeros_like_tree(obs)
+                    self._template = _zeros_like_tree(r["obs"])
             req = PendingRequest(
-                session_id, slot, obs,
+                session_id, slot, r["obs"],
                 deadline_ts=time.time() + timeout_s, ctx=ctx,
+                want_teacher=bool(r.get("want_teacher", False)),
             )
             try:
                 self.batcher.submit(req)  # QueueFull/Draining shed here
-            except ShedError:
+            except ShedError as e:
                 self._c_req["shed"].inc()
-                raise
-            if not req.wait(timeout_s + 0.25):
-                # rendezvous never fired (flush wedged past the grace):
-                # abandon so a late delivery is discarded
-                if req.abandon():
-                    self._c_req["timeout"].inc()
-                    raise ServeError(f"no response within {timeout_s}s")
-            if req.error is not None:
-                self._c_req["shed" if req.error.shed else "error"].inc()
-                raise req.error
-            self._c_req["ok"].inc()
-            self._h_latency.observe(time.perf_counter() - t0)
-            return req.result
-        finally:
-            self._g_inflight.dec()
-            self.sessions.release(session_id)
+                self.sessions.release(session_id)
+                results[i] = e
+                continue
+            self._g_inflight.inc()
+            pending.append((i, session_id, req))
+        wall_deadline = time.monotonic() + timeout_s + 0.25
+        for i, session_id, req in pending:
+            try:
+                if not req.wait(max(0.0, wall_deadline - time.monotonic())):
+                    # rendezvous never fired (flush wedged past the grace):
+                    # abandon so a late delivery is discarded
+                    if req.abandon():
+                        self._c_req["timeout"].inc()
+                        results[i] = ServeError(f"no response within {timeout_s}s")
+                        continue
+                if req.error is not None:
+                    self._c_req["shed" if req.error.shed else "error"].inc()
+                    results[i] = req.error
+                    continue
+                self._c_req["ok"].inc()
+                self._h_latency.observe(time.perf_counter() - t0)
+                results[i] = req.result
+            finally:
+                self._g_inflight.dec()
+                self.sessions.release(session_id)
+        return results
+
+    def reserve_sessions(self, session_ids) -> Dict[str, int]:
+        """Exact-capacity bulk admission: allocate (or confirm) a slot for
+        every id atomically, shedding the WHOLE reservation typed
+        (``CapacityError``) when the table can't host it — actors fail fast
+        at job start instead of shedding mid-episode."""
+        return self.sessions.reserve(list(session_ids))
+
+    def session_hidden(self, session_id: str):
+        """The session's current policy carry (actors stamp it into
+        trajectories as the learner's burn-in state). ``None`` when the
+        session is unknown or the engine keeps no readable carry."""
+        slot = self.sessions.slot_of(session_id)
+        if slot is None or not hasattr(self.engine, "hidden_for_slot"):
+            return None
+        return self.engine.hidden_for_slot(slot)
+
+    def set_teacher(self, params) -> bool:
+        """Install frozen-teacher weights on the engine (the rollout
+        plane's teacher-logits path batches through the same flushes)."""
+        if not hasattr(self.engine, "set_teacher_params"):
+            raise ServeError("engine has no teacher surface")
+        self.engine.set_teacher_params(params)
+        return True
 
     def reset_session(self, session_id: str) -> bool:
-        """Episode boundary: zero the session's LSTM carry, keep the slot."""
+        """Episode boundary: zero the session's LSTM carry (policy AND
+        teacher), restart its step counter, keep the slot."""
         slot = self.sessions.slot_of(session_id)
         if slot is None:
             return False
         self.engine.reset_slot(slot)
+        self.sessions.reset_steps(session_id)
         return True
 
     def end_session(self, session_id: str) -> bool:
@@ -189,8 +248,24 @@ class InferenceGateway:
             mark_hop(r.ctx, "serve_flush")
         with Span("serve_forward"):
             outs = self.engine.forward(prepared, active)
+        # teacher logits piggyback on the same flush (one extra batched
+        # forward serving every lane that asked, not one per caller); lanes
+        # that didn't ask must not advance their teacher carry
+        t_outs = None
+        wanting = [r for r in batch if r.want_teacher]
+        if wanting and getattr(self.engine, "has_teacher", False):
+            t_active = [False] * self.engine.num_slots
+            for r in wanting:
+                t_active[r.slot] = True
+            with Span("serve_teacher_forward"):
+                t_outs = self.engine.teacher_forward(prepared, outs, t_active)
         for r in batch:
             out = dict(outs[r.slot])
             out["model_version"] = self._served_version
+            if t_outs is not None and r.want_teacher:
+                out["teacher_logit"] = t_outs[r.slot]
+            # episode-local forward count: clients detect a server-side
+            # carry reset (gateway restart, eviction) when it runs backwards
+            out["session_step"] = self.sessions.note_step(r.session_id)
             finish_trace(r.ctx, "serve_done")
             r.complete(result=out)

@@ -13,14 +13,14 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import Callable, Dict, Optional
+from typing import Callable, Dict, List, Optional
 
 from ..obs import get_registry
 from .errors import CapacityError
 
 
 class _Session:
-    __slots__ = ("session_id", "slot", "last_seen", "inflight", "created")
+    __slots__ = ("session_id", "slot", "last_seen", "inflight", "created", "steps")
 
     def __init__(self, session_id: str, slot: int, now: float):
         self.session_id = session_id
@@ -28,6 +28,7 @@ class _Session:
         self.last_seen = now
         self.inflight = 0
         self.created = now
+        self.steps = 0  # forwards served this episode (zeroed on reset)
 
 
 class SessionTable:
@@ -73,6 +74,59 @@ class SessionTable:
             s.last_seen = now
             s.inflight += 1
             return s.slot
+
+    def reserve(self, session_ids: List[str]) -> Dict[str, int]:
+        """All-or-nothing bulk allocation (the rollout plane's exact-
+        capacity admission: actors pre-allocate every env slot's session at
+        job start so nothing sheds mid-episode). Either every id gets a
+        slot — already-known ids keep theirs — or the table is untouched
+        and a typed ``CapacityError`` reports the shortfall up front.
+        Eviction of idle-expired sessions is allowed, exactly as in the
+        single-session path; in-flight sessions are never victims."""
+        now = time.time()
+        with self._lock:
+            need = [sid for sid in dict.fromkeys(session_ids)
+                    if sid not in self._sessions]
+            evictable = sum(
+                1 for s in self._sessions.values()
+                if s.inflight == 0 and now - s.last_seen >= self.idle_ttl_s
+            )
+            if len(need) > len(self._free) + evictable:
+                raise CapacityError(
+                    f"reserve of {len(need)} new sessions exceeds capacity: "
+                    f"{len(self._free)} free + {evictable} evictable of "
+                    f"{self.num_slots} slots"
+                )
+            out: Dict[str, int] = {}
+            for sid in need:
+                slot = self._alloc_locked(now)  # cannot fail: counted above
+                self._sessions[sid] = _Session(sid, slot, now)
+                if self._on_alloc is not None:
+                    self._on_alloc(slot)
+            self._g_active.set(len(self._sessions))
+            for sid in dict.fromkeys(session_ids):
+                s = self._sessions[sid]
+                s.last_seen = now
+                out[sid] = s.slot
+            return out
+
+    def note_step(self, session_id: str) -> int:
+        """One forward served for this session; returns the episode-local
+        step count (clients detect a server-side carry reset — restart,
+        eviction — when this counter runs backwards)."""
+        with self._lock:
+            s = self._sessions.get(session_id)
+            if s is None:
+                return 0
+            s.steps += 1
+            return s.steps
+
+    def reset_steps(self, session_id: str) -> None:
+        """Episode boundary: the step counter restarts with the carry."""
+        with self._lock:
+            s = self._sessions.get(session_id)
+            if s is not None:
+                s.steps = 0
 
     def release(self, session_id: str) -> None:
         """A request for this session finished (delivered, shed or timed

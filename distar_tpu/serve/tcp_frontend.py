@@ -9,13 +9,24 @@ connection is a session's natural home but nothing enforces it — the
 many sessions.
 
 Requests are ``{"op": ..., ...}`` dicts:
-  act    {session_id, obs, timeout_s?}     -> {code: 0, outputs}
-  reset  {session_id}                      -> {code: 0, reset: bool}
-  end    {session_id}                      -> {code: 0, ended: bool}
-  load   {version, source|params, activate?} -> {code: 0, info}
-  swap   {version}                         -> {code: 0, generation}
-  status {}                                -> {code: 0, status}
-  ping   {}                                -> {code: 0, pong: True}
+  act      {session_id, obs, timeout_s?, want_teacher?} -> {code: 0, outputs}
+  act_many {requests: [{session_id, obs, want_teacher?}], timeout_s?}
+                                           -> {code: 0, results: [entry]}
+                                              entry = {ok: outputs} | wire error
+  reserve  {session_ids: [...]}            -> {code: 0, slots: {sid: slot}}
+  hidden   {session_id}                    -> {code: 0, hidden}
+  set_teacher {params}                     -> {code: 0, ok: True}
+  reset    {session_id}                    -> {code: 0, reset: bool}
+  end      {session_id}                    -> {code: 0, ended: bool}
+  load     {version, source|params, activate?} -> {code: 0, info}
+  swap     {version}                       -> {code: 0, generation}
+  status   {}                              -> {code: 0, status}
+  ping     {}                              -> {code: 0, pong: True}
+
+``act_many`` is the rollout-plane cycle op: one frame carries a whole env
+fleet's step, per-lane results (including per-lane typed sheds) come back
+in one frame, and different actors' cycles coalesce in the server's
+micro-batcher.
 
 Serve errors answer ``{code: <wire code>, error, shed}`` (errors.to_wire);
 the client rehydrates them into the typed exceptions.
@@ -135,8 +146,22 @@ class ServeTCPServer:
         gw = self.gateway
         try:
             if op == "act":
-                out = gw.act(req["session_id"], req["obs"], req.get("timeout_s"))
+                out = gw.act(req["session_id"], req["obs"], req.get("timeout_s"),
+                             want_teacher=bool(req.get("want_teacher", False)))
                 return {"code": 0, "outputs": out}
+            if op == "act_many":
+                results = gw.act_many(req["requests"], req.get("timeout_s"))
+                return {"code": 0, "results": [
+                    r.to_wire() if isinstance(r, ServeError) else {"ok": r}
+                    for r in results
+                ]}
+            if op == "reserve":
+                return {"code": 0,
+                        "slots": gw.reserve_sessions(req["session_ids"])}
+            if op == "hidden":
+                return {"code": 0, "hidden": gw.session_hidden(req["session_id"])}
+            if op == "set_teacher":
+                return {"code": 0, "ok": gw.set_teacher(req["params"])}
             if op == "reset":
                 return {"code": 0, "reset": gw.reset_session(req["session_id"])}
             if op == "end":
@@ -211,11 +236,40 @@ class ServeClient:
             policy=self._policy,
         )
 
-    def act(self, session_id: str, obs, timeout_s: Optional[float] = None) -> dict:
+    def act(self, session_id: str, obs, timeout_s: Optional[float] = None,
+            want_teacher: bool = False) -> dict:
         req = {"op": "act", "session_id": session_id, "obs": obs}
         if timeout_s is not None:
             req["timeout_s"] = timeout_s
+        if want_teacher:
+            req["want_teacher"] = True
         return self._call(req)["outputs"]
+
+    def act_many(self, requests, timeout_s: Optional[float] = None) -> list:
+        """One cycle of requests in one frame; returns a per-request list of
+        output dicts or typed ``ServeError`` INSTANCES (per-lane sheds come
+        back as values, not raises — partial success keeps its lanes).
+        NOTE: a transport retry re-executes the WHOLE cycle server-side
+        (at-least-once), which advances succeeded lanes' carries once more —
+        acceptable on the restart path, where carries re-materialize from
+        zero anyway."""
+        req = {"op": "act_many", "requests": list(requests)}
+        if timeout_s is not None:
+            req["timeout_s"] = timeout_s
+        entries = self._call(req)["results"]
+        return [e["ok"] if isinstance(e, dict) and "ok" in e else error_from_wire(e)
+                for e in entries]
+
+    def reserve(self, session_ids) -> dict:
+        """Bulk session pre-allocation; typed ``CapacityError`` on shortfall
+        (exact-capacity admission — nothing sheds mid-episode)."""
+        return self._call({"op": "reserve", "session_ids": list(session_ids)})["slots"]
+
+    def hidden(self, session_id: str):
+        return self._call({"op": "hidden", "session_id": session_id})["hidden"]
+
+    def set_teacher(self, params) -> bool:
+        return self._call({"op": "set_teacher", "params": params})["ok"]
 
     def reset(self, session_id: str) -> bool:
         return self._call({"op": "reset", "session_id": session_id})["reset"]

@@ -1,0 +1,451 @@
+"""Rollout inference plane: PolicyClient backends (inline / local / remote)
+over the serve gateway, exact-capacity session reservation, teacher-logits
+piggybacking, carry re-materialization through a gateway kill+restart, and
+the plane-level weight-refresh dedupe.
+
+Everything fast runs on ``MockModelEngine`` (per-slot step counters make
+carry semantics assertable); the slow tests drive the REAL small model
+through ``Actor.run_job`` on the local and remote backends.
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from distar_tpu.actor.rollout_plane import (
+    GatewayPolicyClient,
+    RolloutPlane,
+    _LocalTarget,
+)
+from distar_tpu.obs import MetricsRegistry, get_registry, set_registry
+from distar_tpu.serve import (
+    CapacityError,
+    InferenceGateway,
+    MockModelEngine,
+    QueueFullError,
+    ServeTCPServer,
+    SessionTable,
+)
+
+from conftest import SMALL_MODEL
+
+
+@pytest.fixture(autouse=True)
+def fresh_registry():
+    prev = set_registry(MetricsRegistry())
+    yield
+    set_registry(prev)
+
+
+def obs_of(v: float) -> dict:
+    return {"x": np.full((2, 3), v, dtype=np.float32)}
+
+
+def mock_factory(**over):
+    def factory(player_id, num_slots, params, teacher_params, model, seed):
+        kw = dict(params={"version": "v1", "bias": 0.0},
+                  teacher_params=teacher_params)
+        kw.update(over)
+        return MockModelEngine(num_slots, **kw)
+
+    return factory
+
+
+# ----------------------------------------------------- SessionTable.reserve
+def test_reserve_all_or_nothing_typed():
+    table = SessionTable(4, idle_ttl_s=300.0)
+    slots = table.reserve(["a", "b", "c"])
+    assert sorted(slots.values()) == [0, 1, 2]
+    # idempotent for known ids, allocates only the new one
+    slots2 = table.reserve(["a", "b", "d"])
+    assert slots2["a"] == slots["a"] and slots2["d"] == 3
+    # table full, nothing idle-expired: the WHOLE reservation sheds typed
+    # and the table is untouched (all-or-nothing)
+    with pytest.raises(CapacityError):
+        table.reserve(["e", "f"])
+    assert table.stats()["active"] == 4
+    assert table.slot_of("e") is None and table.slot_of("f") is None
+
+
+def test_reserve_evicts_idle_expired_only():
+    table = SessionTable(2, idle_ttl_s=0.05)
+    table.reserve(["old1", "old2"])
+    time.sleep(0.1)  # both idle-expired
+    slots = table.reserve(["new1", "new2"])
+    assert sorted(slots.values()) == [0, 1]
+    assert table.slot_of("old1") is None  # evicted
+
+
+def test_inflight_carries_survive_interleaved_lru_eviction():
+    """Satellite acceptance: a session with a request in flight is never an
+    LRU victim — its slot (and therefore its carry) survives an interleaved
+    eviction pass triggered by reserve() under pressure."""
+    engine = MockModelEngine(2, params={"version": "v1"})
+    gw = InferenceGateway(engine, max_delay_s=0.001, idle_ttl_s=0.02).start()
+    try:
+        gw.act("busy", obs_of(1.0))
+        gw.act("busy", obs_of(1.0))  # carry advanced to 2
+        gw.act("idle", obs_of(1.0))
+        time.sleep(0.06)  # both idle-expired by ttl...
+        gw.sessions.acquire("busy")  # ...but "busy" now has one in flight
+        try:
+            # eviction pass must take the idle session, not the in-flight one
+            slots = gw.reserve_sessions(["fresh"])
+            assert slots["fresh"] == gw.sessions.slot_of("fresh")
+            assert gw.sessions.slot_of("idle") is None  # the victim
+            assert gw.sessions.slot_of("busy") is not None
+        finally:
+            gw.sessions.release("busy")
+        # the in-flight session's carry is intact: next step continues at 3
+        assert gw.act("busy", obs_of(1.0))["step"] == 3
+        # and a second reservation now has NO legal victim -> typed shed
+        gw.sessions.acquire("busy")
+        gw.sessions.acquire("fresh")
+        try:
+            with pytest.raises(CapacityError):
+                gw.reserve_sessions(["overflow"])
+        finally:
+            gw.sessions.release("busy")
+            gw.sessions.release("fresh")
+    finally:
+        gw.drain_and_stop()
+
+
+# ------------------------------------------------------------- device fetch
+def test_decollate_fetches_once_and_hands_out_views():
+    from distar_tpu.actor.inference import decollate
+
+    tree = {"a": np.arange(12).reshape(4, 3), "b": {"c": np.ones((4, 2))}}
+    out = decollate(tree, 2)
+    np.testing.assert_array_equal(out["a"], [6, 7, 8])
+    assert out["b"]["c"].shape == (2,)
+
+
+# --------------------------------------------------------- local plane client
+def test_local_clients_coalesce_in_one_flush():
+    plane = RolloutPlane(backend="local", slots=8,
+                         engine_factory=mock_factory(delay_s=0.004),
+                         max_delay_s=0.02)
+    try:
+        c1 = plane.client_for("MP0", num_slots=4)
+        c2 = plane.client_for("MP0", num_slots=4)
+        errs = []
+
+        def cycles(c, n):
+            try:
+                for _ in range(n):
+                    outs = c.sample([obs_of(1.0)] * 4, [True] * 4)
+                    assert all(o is not None for o in outs)
+            except Exception as e:  # pragma: no cover
+                errs.append(e)
+
+        t1 = threading.Thread(target=cycles, args=(c1, 6))
+        t2 = threading.Thread(target=cycles, args=(c2, 6))
+        t1.start(); t2.start(); t1.join(); t2.join()
+        assert not errs
+        snap = get_registry().snapshot()
+        occ = (snap["distar_serve_batch_occupancy_sum"]
+               / snap["distar_serve_batch_occupancy_count"])
+        assert occ > 1.0, "two actors' cycles never coalesced"
+        assert snap["distar_rollout_samples_total{backend=local}"] == 48
+        c1.close(); c2.close()
+    finally:
+        plane.shutdown()
+
+
+def test_local_partial_active_and_reset_slot_semantics():
+    plane = RolloutPlane(backend="local", engine_factory=mock_factory())
+    try:
+        c = plane.client_for("MP0", num_slots=2)
+        outs = c.sample([obs_of(1.0)] * 2, [True, True])
+        assert [o["step"] for o in outs] == [1, 1]
+        outs = c.sample([obs_of(1.0)] * 2, [True, False])
+        assert outs[0]["step"] == 2 and outs[1] is None  # inactive lane held
+        c.reset_slot(0)
+        outs = c.sample([obs_of(1.0)] * 2, [True, True])
+        # slot 0 restarted from zero carry; slot 1 kept its carry
+        assert [o["step"] for o in outs] == [1, 2]
+        assert c.hidden_for_slot(0) == {"step": 1}
+        assert c.hidden_for_slot(1) == {"step": 2}
+        c.close()
+    finally:
+        plane.shutdown()
+
+
+def test_teacher_piggybacks_on_same_flush_and_carries_track_active():
+    plane = RolloutPlane(backend="local", engine_factory=mock_factory())
+    try:
+        c = plane.client_for("MP0", num_slots=2,
+                             teacher_params={"version": "t1"})
+        outs = c.sample([obs_of(1.0)] * 2, [True, True])
+        tl = c.teacher_logits([obs_of(1.0)] * 2, outs, [True, True])
+        assert [t["teacher_step"] for t in tl] == [1, 1]
+        assert tl[0]["teacher_version"] == "t1"
+        outs = c.sample([obs_of(1.0)] * 2, [False, True])
+        tl = c.teacher_logits([obs_of(1.0)] * 2, outs, [False, True])
+        assert tl[0] is None and tl[1]["teacher_step"] == 2
+        c.reset_slot(1)  # zeroes policy AND teacher carry
+        outs = c.sample([obs_of(1.0)] * 2, [True, True])
+        tl = c.teacher_logits([obs_of(1.0)] * 2, outs, [True, True])
+        assert tl[1]["teacher_step"] == 1
+        # exactly one engine forward + one teacher forward per cycle: the
+        # teacher rode the SAME flush, never a second round-trip
+        gw = plane._gateways["MP0"]
+        assert gw.engine.teacher_calls == gw.engine.forward_calls
+        c.close()
+    finally:
+        plane.shutdown()
+
+
+def test_exact_capacity_reservation_fails_fast_at_client_creation():
+    plane = RolloutPlane(backend="local", slots=2, engine_factory=mock_factory())
+    try:
+        plane.client_for("MP0", num_slots=2)
+        with pytest.raises(CapacityError):
+            plane.client_for("MP0", num_slots=2)  # 2 slots already reserved
+    finally:
+        plane.shutdown()
+
+
+def test_refresh_dedupes_to_one_registry_swap_per_iteration():
+    plane = RolloutPlane(backend="local", slots=4, engine_factory=mock_factory())
+    try:
+        c1 = plane.client_for("MP0", num_slots=2,
+                              params={"version": "v1", "bias": 0.0})
+        c2 = plane.client_for("MP0", num_slots=2)
+        c1.refresh({"version": "v7", "bias": 7.0}, 7)
+        c2.refresh({"version": "v7", "bias": 7.0}, 7)  # same iter: deduped
+        c2.refresh({"version": "v5", "bias": 5.0}, 5)  # stale iter: ignored
+        out = c2.sample([obs_of(0.0)] * 2)
+        assert all(o["model_version"] == "MP0@7" for o in out if o)
+        snap = get_registry().snapshot()
+        assert snap["distar_rollout_swaps_total"] == 1
+        c1.close(); c2.close()
+    finally:
+        plane.shutdown()
+
+
+def test_shed_lanes_retry_individually_without_reexecuting_winners():
+    """A transient per-lane shed must retry ONLY the shed lane: lanes that
+    already advanced their carry are never double-stepped by the retry."""
+
+    class FlakyTarget(_LocalTarget):
+        def __init__(self, gw):
+            super().__init__(gw)
+            self.calls = 0
+
+        def act_many(self, requests, timeout_s=None):
+            self.calls += 1
+            results = super().act_many(requests, timeout_s)
+            if self.calls == 1:  # shed the LAST lane of the first cycle
+                results[-1] = QueueFullError("induced")
+            return results
+
+    engine = MockModelEngine(2, params={"version": "v1"})
+    gw = InferenceGateway(engine, max_delay_s=0.001).start()
+    target = FlakyTarget(gw)
+    try:
+        client = GatewayPolicyClient(target, ["s0", "s1"], player_id="MP0",
+                                     timeout_s=5.0)
+        outs = client.sample([obs_of(1.0)] * 2, [True, True])
+        assert outs[0]["step"] == 1
+        # lane 1's first answer was dropped as a shed, so its retry is the
+        # visible step... the dropped forward still advanced the carry once
+        assert target.calls == 2
+        assert get_registry().snapshot()[
+            "distar_rollout_shed_total{backend=local}"] == 1
+        client.close()
+    finally:
+        gw.drain_and_stop()
+
+
+# -------------------------------------------------- remote + chaos restart
+def _serve_stack(slots=4, port=0, teacher=True):
+    engine = MockModelEngine(
+        slots, params={"version": "v1", "bias": 0.0},
+        teacher_params={"version": "t1"} if teacher else None,
+    )
+    gw = InferenceGateway(engine, max_delay_s=0.002, default_timeout_s=5.0).start()
+    gw.load_version("v1", params={"version": "v1", "bias": 0.0}, activate=True)
+    srv = ServeTCPServer(gw, host="127.0.0.1", port=port).start()
+    return engine, gw, srv
+
+
+def test_remote_backend_round_trip_with_teacher():
+    engine, gw, srv = _serve_stack()
+    plane = RolloutPlane(backend="remote", addr=f"{srv.host}:{srv.port}",
+                         timeout_s=5.0)
+    try:
+        c = plane.client_for("MP0", num_slots=2,
+                             teacher_params={"version": "t2"})
+        outs = c.sample([obs_of(2.0)] * 2)
+        assert [o["step"] for o in outs] == [1, 1]
+        tl = c.teacher_logits([obs_of(2.0)] * 2, outs)
+        assert tl[0]["teacher_version"] == "t2"  # set_teacher over the wire
+        assert c.hidden_for_slot(0) == {"step": 1}
+        c.reset_slot(0)
+        assert c.sample([obs_of(2.0)] * 2)[0]["step"] == 1
+        c.close()
+    finally:
+        srv.stop()
+        gw.drain_and_stop()
+
+
+def test_remote_rides_gateway_kill_restart_and_counts_carry_resets(chaos):
+    """Satellite acceptance: the gateway dies mid-episode (chaos
+    ``kill_role``) and comes back on the same port; the episode FINISHES
+    through the client's reconnect/retry, the carry re-materializes from
+    zero (server step counter restarts), and the re-materialization is
+    counted in ``distar_actor_carry_resets_total``."""
+    engine, gw, srv = _serve_stack(teacher=False)
+    port = srv.port
+    plane = RolloutPlane(backend="remote", addr=f"127.0.0.1:{port}",
+                         timeout_s=5.0)
+    client = plane.client_for("MP0", num_slots=2)
+    new_stack = []
+    try:
+        episode_steps = []
+        for i in range(3):  # first half of the "episode"
+            outs = client.sample([obs_of(1.0)] * 2)
+            episode_steps.append(outs[0]["step"])
+        assert episode_steps == [1, 2, 3]
+
+        # kill the gateway hard (chaos-tagged), restart on the SAME port
+        chaos.kill_role(srv, name="serve-gateway")
+        gw.drain_and_stop(timeout=2.0)
+        new_stack[:] = _serve_stack(port=port, teacher=False)
+
+        for i in range(3):  # second half rides reconnect + fresh carries
+            outs = client.sample([obs_of(1.0)] * 2)
+            episode_steps.append(outs[0]["step"])
+        # the episode finished; the carry restarted from zero at the kill
+        assert episode_steps == [1, 2, 3, 1, 2, 3]
+        snap = get_registry().snapshot()
+        # both lanes' carries were re-materialized exactly once
+        assert snap["distar_actor_carry_resets_total{player=MP0}"] == 2
+        assert any(e["kind"] == "kill_role" for e in chaos.events)
+        client.close()
+    finally:
+        if new_stack:
+            new_stack[2].stop()
+            new_stack[1].drain_and_stop(timeout=2.0)
+        else:
+            srv.stop()
+            gw.drain_and_stop(timeout=2.0)
+
+
+# ------------------------------------------------------------ actor e2e
+def _actor(plane_cfg, tmp_path=None, env_num=2):
+    from distar_tpu.actor import Actor
+    from distar_tpu.envs import MockEnv
+
+    return Actor(
+        cfg={"actor": {"env_num": env_num, "traj_len": 2, "seed": 3,
+                       "plane": plane_cfg}},
+        model_cfg=SMALL_MODEL,
+        env_fn=lambda: MockEnv(episode_game_loops=300, seed=1),
+    )
+
+
+@pytest.mark.slow
+def test_actor_runs_job_on_local_plane_real_model():
+    """The actor's whole hot path — sample, teacher logits, resets, carry
+    backup — through the SHARED in-process gateway on the real small model;
+    results match the job contract and the coalescing metrics exist."""
+    actor = _actor({"backend": "local", "slots": 2, "max_delay_s": 0.002,
+                    "timeout_s": 120.0})
+    results = actor.run_job(episodes=2)
+    assert len(results) >= 2
+    assert all("0" in r for r in results)
+    snap = get_registry().snapshot()
+    assert snap["distar_rollout_samples_total{backend=local}"] > 0
+    assert snap["distar_serve_batch_occupancy_count"] > 0
+    assert snap["distar_rollout_plane_backend{backend=local}"] == 1.0
+    actor.plane.shutdown()
+
+
+@pytest.mark.slow
+def test_actor_runs_job_on_remote_plane_real_model():
+    """Remote backend end-to-end on the real model: a bin/serve-shaped
+    gateway (BatchedInferenceEngine over TCP) serves an Actor job; teacher
+    logits and carries ride the wire."""
+    import jax
+
+    from distar_tpu.actor.inference import BatchedInference
+    from distar_tpu.lib import features as F
+    from distar_tpu.model import Model, default_model_config
+    from distar_tpu.serve import BatchedInferenceEngine
+    from distar_tpu.utils import deep_merge_dicts
+
+    cfg = deep_merge_dicts(default_model_config(), SMALL_MODEL)
+    cfg.use_value_network = False
+    model = Model(cfg)
+    # boot params exactly as the actor would build them
+    probe = _actor({"backend": "inline"})
+    params = probe._initial_params()
+    # both job sides (MP0 and HP0) reserve env_num sessions on this ONE
+    # gateway — size it for the whole job (exact-capacity admission)
+    engine = BatchedInferenceEngine(
+        BatchedInference(model, jax.tree.map(np.asarray, params), num_slots=4))
+    gw = InferenceGateway(engine, max_delay_s=0.002,
+                          default_timeout_s=120.0).start()
+    gw.load_version("v1", params=params, activate=True)
+    srv = ServeTCPServer(gw, host="127.0.0.1").start()
+    try:
+        actor = _actor({"backend": "remote", "addr": f"{srv.host}:{srv.port}",
+                        "timeout_s": 120.0})
+        results = actor.run_job(episodes=1)
+        assert len(results) >= 1
+        snap = get_registry().snapshot()
+        assert snap["distar_rollout_samples_total{backend=remote}"] > 0
+    finally:
+        srv.stop()
+        gw.drain_and_stop()
+
+
+def test_actor_defaults_shared_slots_to_both_job_sides():
+    """A self-play job puts TWO clients of the same player on one shared
+    gateway (2 x env_num sessions); an unsized plane must default to that,
+    or the second side's exact-capacity reserve fails every job."""
+    actor = _actor({"backend": "local"}, env_num=3)
+    assert actor.plane.slots == 6
+    # an explicit size is respected
+    actor = _actor({"backend": "local", "slots": 2}, env_num=3)
+    assert actor.plane.slots == 2
+
+
+# ----------------------------------------------------------- tools plumbing
+def test_loadgen_sessions_mode_reports_shed_rate():
+    import sys
+    from pathlib import Path
+
+    sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+    from tools.loadgen import run_loadgen
+
+    summary = run_loadgen(mode="sessions", rate=300.0, duration_s=1.0,
+                          requests_per_session=3, slots=8, mock_delay_s=0.001)
+    assert summary["mode"] == "sessions"
+    assert summary["sessions"]["started"] > 0
+    assert summary["sessions"]["completed"] > 0
+    assert "shed_rate" in summary and "session_shed_rate" in summary["sessions"]
+
+
+def test_perf_gate_trajectory_picks_up_rollout_artifacts(tmp_path):
+    import json
+    import sys
+    from pathlib import Path
+
+    sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+    from tools.perf_gate import collect_trajectory
+
+    (tmp_path / "artifacts").mkdir()
+    (tmp_path / "ROLLOUT_r99.json").write_text(json.dumps({
+        "metric": "rollout plane env-steps/s, local vs inline @16 actors",
+        "value": 4.5, "unit": "x inline", "vs_baseline": 2.0, "device": "cpu",
+    }))
+    rows = collect_trajectory(repo=str(tmp_path))
+    rollout = [r for r in rows if r["artifact"] == "ROLLOUT_r99.json"]
+    assert rollout and rollout[0]["round"] == "99"
+    assert rollout[0]["status"] == "ok (CPU-derived)"
+    assert rollout[0]["value"] == 4.5

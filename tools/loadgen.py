@@ -9,13 +9,20 @@ Drives an ``InferenceGateway`` through any of three targets:
   * ``--http host:port`` — the JSON frontend (expect float-inflation
     overhead; this is the showmatch path, not the actor path)
 
-Modes (the two canonical load-test shapes):
-  * closed — ``--clients N`` workers each issue the next request the moment
-    the previous returns (think-time 0): measures saturated throughput and
-    the batch coalescing under full load.
-  * open   — requests arrive at ``--rate R`` per second on a fixed schedule
-    regardless of completions: measures latency at a given offered load and
-    shed behaviour past saturation.
+Modes (the canonical load-test shapes):
+  * closed   — ``--clients N`` workers each issue the next request the
+    moment the previous returns (think-time 0): measures saturated
+    throughput and the batch coalescing under full load.
+  * open     — requests arrive at ``--rate R`` per second on a fixed
+    schedule regardless of completions: measures latency at a given offered
+    load and shed behaviour past saturation.
+  * sessions — the eval-farm/ladder shape: SESSIONS arrive at ``--rate R``
+    per second, each plays ``--requests-per-session`` sequential steps on
+    its own sticky session and then ends it (freeing the slot), so
+    thousands of distinct sessions can be sustained on one gateway whose
+    slot table is far smaller. Arrivals past live capacity shed typed
+    (``CapacityError``) — the summary reports the shed RATE, which is the
+    eval-farm sizing number.
 
 Output: bench.py-style JSON result lines on stdout (the LAST line is the
 summary), optionally mirrored to ``--artifact <path>``. A mid-run hot swap
@@ -74,17 +81,22 @@ def _make_obs(i: int) -> dict:
 
 
 class _InprocTarget:
-    def __init__(self, slots: int, delay_s: float, max_delay_s: float, capacity: int):
+    def __init__(self, slots: int, delay_s: float, max_delay_s: float, capacity: int,
+                 idle_ttl_s: float = 300.0):
         self.engine = MockModelEngine(slots, params={"version": "v1", "bias": 0.0},
                                       delay_s=delay_s)
         self.gateway = InferenceGateway(
             self.engine, max_delay_s=max_delay_s, queue_capacity=capacity,
+            idle_ttl_s=idle_ttl_s,
         ).start()
         self.gateway.load_version("v1", params={"version": "v1", "bias": 0.0},
                                   activate=True)
 
     def act(self, session: str, obs, timeout_s: float):
         return self.gateway.act(session, obs, timeout_s)
+
+    def end(self, session: str) -> None:
+        self.gateway.end_session(session)
 
     def swap(self) -> None:
         self.gateway.load_version("v2", params={"version": "v2", "bias": 1.0},
@@ -108,6 +120,9 @@ class _TcpTarget:
 
     def act(self, session: str, obs, timeout_s: float):
         return self._client().act(session, obs, timeout_s)
+
+    def end(self, session: str) -> None:
+        self._client().end(session)
 
     def swap(self) -> None:
         self._client().load("loadgen-swap", params={"version": "loadgen-swap"},
@@ -143,6 +158,9 @@ class _HttpTarget:
             "timeout_s": timeout_s,
         })
 
+    def end(self, session: str) -> None:
+        self._post("end", {"session_id": session})
+
     def swap(self) -> None:
         raise RuntimeError("hot swap over HTTP needs a checkpoint source; use --tcp")
 
@@ -161,10 +179,12 @@ def run_loadgen(
     rate: float = 200.0,
     duration_s: float = 5.0,
     requests_per_client: int = 0,
+    requests_per_session: int = 8,
     slots: int = 8,
     mock_delay_s: float = 0.002,
     max_delay_s: float = 0.005,
     queue_capacity: int = 256,
+    idle_ttl_s: float = 300.0,
     timeout_s: float = 5.0,
     swap_at: float = 0.0,
     tcp: Optional[str] = None,
@@ -173,13 +193,14 @@ def run_loadgen(
 ) -> dict:
     """Importable driver (the slow soak test calls this). Returns the
     summary dict that is also the last stdout JSON line."""
-    assert mode in ("closed", "open")
+    assert mode in ("closed", "open", "sessions")
     if tcp:
         target = _TcpTarget(tcp)
     elif http:
         target = _HttpTarget(http)
     else:
-        target = _InprocTarget(slots, mock_delay_s, max_delay_s, queue_capacity)
+        target = _InprocTarget(slots, mock_delay_s, max_delay_s, queue_capacity,
+                               idle_ttl_s=idle_ttl_s)
     stats = _Stats()
     artifact_lines: List[dict] = []
     stop_at = time.perf_counter() + duration_s
@@ -203,6 +224,43 @@ def run_loadgen(
             emit({"metric": "serve_swap_issue", "value": time.perf_counter() - t0,
                   "unit": "s"}, artifact_lines)
 
+    sessions_started = [0]
+    sessions_completed = [0]
+    sessions_shed = [0]
+    sess_lock = threading.Lock()
+
+    def session_life(n: int) -> None:
+        """One eval-farm session: arrive, play ``requests_per_session``
+        sequential steps on a sticky session, end it (freeing the slot). A
+        shed at ARRIVAL (capacity) abandons the session — that's the number
+        the farm sizes against; a shed mid-session retries briefly."""
+        sid = f"farm-{n}"
+        with sess_lock:
+            sessions_started[0] += 1
+        i = 0
+        while i < requests_per_session:
+            t0 = time.perf_counter()
+            try:
+                target.act(sid, _make_obs(i), timeout_s)
+                stats.record(time.perf_counter() - t0, "ok")
+                i += 1
+            except ShedError:
+                stats.record(None, "shed")
+                if i == 0:  # no slot for this session: the farm is full
+                    with sess_lock:
+                        sessions_shed[0] += 1
+                    return
+                time.sleep(0.01)
+            except Exception:
+                stats.record(None, "error")
+                return
+        try:
+            target.end(sid)
+        except Exception:
+            pass
+        with sess_lock:
+            sessions_completed[0] += 1
+
     t_start = time.perf_counter()
     if mode == "closed":
         def worker(w: int) -> None:
@@ -224,7 +282,7 @@ def run_loadgen(
             t.start()
         for t in threads:
             t.join()
-    else:  # open loop: fixed arrival schedule, unbounded worker threads
+    else:  # open / sessions: fixed arrival schedule, unbounded worker threads
         period = 1.0 / max(rate, 1e-9)
         threads = []
         i = 0
@@ -234,15 +292,18 @@ def run_loadgen(
             if now < next_fire:
                 time.sleep(min(next_fire - now, 0.01))
                 continue
-            session = f"loadgen-{i % max(slots, 1)}"
-            t = threading.Thread(target=one, args=(session, i))
+            if mode == "sessions":
+                t = threading.Thread(target=session_life, args=(i,))
+            else:
+                session = f"loadgen-{i % max(slots, 1)}"
+                t = threading.Thread(target=one, args=(session, i))
             t.start()
             threads.append(t)
             i += 1
             next_fire += period
             maybe_swap((now - t_start) / duration_s)
         for t in threads:
-            t.join(timeout_s + 1.0)
+            t.join(timeout_s * (requests_per_session if mode == "sessions" else 1) + 1.0)
     elapsed = time.perf_counter() - t_start
     target.close()
 
@@ -259,7 +320,19 @@ def run_loadgen(
         "elapsed_s": round(elapsed, 3),
         "latency_p50_s": round(stats.quantile(0.5), 6),
         "latency_p99_s": round(stats.quantile(0.99), 6),
+        # the eval-farm sizing number: what fraction of offered work the
+        # gateway refused (typed sheds / everything offered)
+        "shed_rate": round(stats.shed / max(total, 1), 4),
     }
+    if mode == "sessions":
+        summary["sessions"] = {
+            "started": sessions_started[0],
+            "completed": sessions_completed[0],
+            "shed_at_arrival": sessions_shed[0],
+            "requests_per_session": requests_per_session,
+            "session_shed_rate": round(
+                sessions_shed[0] / max(sessions_started[0], 1), 4),
+        }
     if tcp is None and http is None:
         # in-process: the serve metrics live in OUR registry — report the
         # coalescing the acceptance criteria care about
@@ -280,16 +353,23 @@ def run_loadgen(
 
 def main() -> None:
     p = argparse.ArgumentParser(description=__doc__)
-    p.add_argument("--mode", choices=("closed", "open"), default="closed")
+    p.add_argument("--mode", choices=("closed", "open", "sessions"), default="closed")
     p.add_argument("--clients", type=int, default=8, help="closed-loop workers")
-    p.add_argument("--rate", type=float, default=200.0, help="open-loop arrivals/s")
+    p.add_argument("--rate", type=float, default=200.0,
+                   help="open-loop request arrivals/s; sessions mode: "
+                        "session arrivals/s")
     p.add_argument("--duration-s", type=float, default=5.0)
     p.add_argument("--requests-per-client", type=int, default=0,
                    help="closed loop: stop after N requests instead of duration")
+    p.add_argument("--requests-per-session", type=int, default=8,
+                   help="sessions mode: steps each arriving session plays "
+                        "before ending (eval-farm episode length)")
     p.add_argument("--slots", type=int, default=8, help="in-process mock slots")
     p.add_argument("--mock-delay-s", type=float, default=0.002)
     p.add_argument("--max-delay-s", type=float, default=0.005)
     p.add_argument("--queue-capacity", type=int, default=256)
+    p.add_argument("--idle-ttl-s", type=float, default=300.0,
+                   help="in-process gateway session idle eviction")
     p.add_argument("--timeout-s", type=float, default=5.0)
     p.add_argument("--swap-at", type=float, default=0.0,
                    help="hot-swap when this fraction of the run has elapsed (0=off)")

@@ -13,7 +13,10 @@ serve HTTP frontend, or a training role started with --metrics-port):
 
 ``status`` exits 0 when healthy, 1 when any rule is warning, 2 when firing —
 scriptable for cron probes; it also prints a per-role step-time/MFU digest
-from the ``distar_perf_*`` series when any are in the probed TSDB.
+from the ``distar_perf_*`` series when any are in the probed TSDB, and an
+actor-throughput digest (env-steps/s, rollout-plane backend, plane sample
+rates, serve shed rate) from the ``distar_actor_*``/``distar_rollout_*``/
+``distar_serve_*`` series.
 ``tail-alerts`` follows the transition history (one line per
 ok/warning/firing edge, deduped by event sequence). When the probed address
 is a replay admin surface (``--type replay`` with ``--metrics-port``),
@@ -120,6 +123,48 @@ _PERF_DIGEST_NAMES = tuple(
 )
 
 
+def _print_actor_digest(addr: str) -> None:
+    """Actor-throughput digest from the probed TSDB: env-steps/s, the
+    rollout-plane backend serving the fleet, plane sample rates per
+    backend, and the serve-plane shed rate — the four numbers that say
+    whether the rollout plane is keeping the fleet fed (docs/serving.md)."""
+    rows = []
+    body = _try_get(addr, "/timeseries?name=distar_actor_env_step_rate&window_s=600")
+    for source, st in ((body or {}).get("stats") or {}).items():
+        if st and st.get("last") is not None:
+            rows.append((source, "env_steps_per_s", f"{st['last']:.6g}"))
+    backends = []
+    for backend in ("inline", "local", "remote"):
+        name = urllib.parse.quote(
+            f"distar_rollout_plane_backend{{backend={backend}}}")
+        body = _try_get(addr, f"/timeseries?name={name}&window_s=600")
+        for source, st in ((body or {}).get("stats") or {}).items():
+            if st and st.get("last") == 1.0:
+                backends.append((source, backend))
+        name = urllib.parse.quote(
+            f"distar_rollout_samples_total{{backend={backend}}}")
+        body = _try_get(addr, f"/timeseries?name={name}&window_s=600")
+        for source, st in ((body or {}).get("stats") or {}).items():
+            if st and st.get("rate"):
+                rows.append((source, f"plane_samples_per_s[{backend}]",
+                             f"{st['rate']:.6g}"))
+    shed = 0.0
+    for reason in ("shed_queue_full", "shed_deadline", "shed_capacity", "draining"):
+        name = urllib.parse.quote(f"distar_serve_shed_total{{reason={reason}}}")
+        body = _try_get(addr, f"/timeseries?name={name}&window_s=600")
+        for _source, st in ((body or {}).get("stats") or {}).items():
+            shed += st.get("rate") or 0.0
+    if not rows and not backends:
+        return
+    print("actor:")
+    for source, backend in sorted(backends):
+        print(f"  {source:<24} plane_backend={backend}")
+    for source, name, value in sorted(rows):
+        print(f"  {source:<24} {name:<28} {value}")
+    if shed:
+        print(f"  serve shed rate: {shed:.4g}/s")
+
+
 def _print_perf_digest(addr: str) -> None:
     """Per-role step-time/MFU digest from the probed TSDB: one line per
     (series, source) with the last value — the 10-second answer to "how
@@ -167,6 +212,7 @@ def cmd_status(args) -> int:
     if replay:
         _print_replay(replay)
     _print_perf_digest(args.addr)
+    _print_actor_digest(args.addr)
     return {"ok": 0, "warning": 1}.get(status, 2)
 
 

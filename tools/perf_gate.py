@@ -15,9 +15,9 @@ the `BENCH_*`/`MULTICHIP_*` files every round produces):
               Exit 0 pass / 1 regression / 2 precondition failed.
 
   trajectory  aggregate the round-over-round artifacts (BENCH_r*.json,
-              BENCH_LOCAL_*.json, MULTICHIP_r*.json, artifacts/*_r*.json)
-              into a markdown table, optionally rewritten in place between
-              the PERF.md trajectory markers.
+              BENCH_LOCAL_*.json, MULTICHIP_r*.json, ROLLOUT_r*.json,
+              artifacts/*_r*.json) into a markdown table, optionally
+              rewritten in place between the PERF.md trajectory markers.
 
 Usage:
   python tools/perf_gate.py check --baseline artifacts/perf_baseline_cpu.json \\
@@ -220,7 +220,9 @@ def collect_trajectory(repo: str = _REPO) -> List[dict]:
     rows: List[dict] = []
     for path in sorted(glob.glob(os.path.join(repo, "BENCH_r*.json"))
                        + glob.glob(os.path.join(repo, "BENCH_LOCAL_r*.json"))
-                       + glob.glob(os.path.join(repo, "artifacts", "perf_baseline*.json"))):
+                       + glob.glob(os.path.join(repo, "ROLLOUT_r*.json"))
+                       + glob.glob(os.path.join(repo, "artifacts", "perf_baseline*.json"))
+                       + glob.glob(os.path.join(repo, "artifacts", "rollout_*.json"))):
         try:
             doc = load_artifact(path)
         except (OSError, ValueError):
