@@ -52,15 +52,20 @@ ACTOR_DEFAULTS = Config(
             "max_entities": None,
             # replay-store push target (config-switched; default off so the
             # legacy point-to-point shuttle path is untouched). ``addr`` is
-            # "host:port" of a ReplayServer; ``mirror`` additionally keeps
-            # the shuttle push alive (migration/dual-write drills);
-            # ``priority`` seeds the table priority for fresh trajectories.
+            # "host:port" of a ReplayServer, a comma-separated shard list
+            # (trajectories route by consistent hash — docs/data_plane.md
+            # sharding), or "inproc" for the zero-copy colocated store;
+            # ``mirror`` additionally keeps the shuttle push alive
+            # (migration/dual-write drills); ``priority`` seeds the table
+            # priority for fresh trajectories; ``compress`` is this side's
+            # wire-compression preference (negotiated per connection).
             "replay": {
                 "enabled": False,
                 "addr": "",
                 "mirror": False,
                 "priority": 1.0,
                 "timeout_s": 60.0,
+                "compress": True,
             },
             # rollout inference plane (docs/serving.md, Sebulba split):
             # ``inline`` keeps today's per-actor BatchedInference; ``local``
@@ -602,24 +607,58 @@ class Actor:
         return self.cfg.get("replay", {}) or {}
 
     def _replay_target(self):
-        """Validated ``(host, port)`` from ``cfg.actor.replay.addr``; raises
+        """Validated target spec from ``cfg.actor.replay.addr``: the string
+        ``"inproc"`` (colocated store), or a list of ``(host, port)`` pairs
+        (one = single store, several = consistent-hash shard fleet). Raises
         a clear config error instead of a bare ``int()`` ValueError."""
+        from ..replay import is_inproc_addr
+
         addr = str(self._replay_cfg().get("addr", ""))
-        host, _, port = addr.rpartition(":")
-        try:
-            return host or "127.0.0.1", int(port)
-        except ValueError:
+        if is_inproc_addr(addr):
+            return addr
+        targets = []
+        for part in addr.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            host, _, port = part.rpartition(":")
+            try:
+                targets.append((host or "127.0.0.1", int(port)))
+            except ValueError:
+                raise ValueError(
+                    f"actor.replay.addr must be 'host:port' (optionally "
+                    f"comma-separated for a shard fleet) or 'inproc', "
+                    f"got {addr!r}"
+                ) from None
+        if not targets:
             raise ValueError(
-                f"actor.replay.addr must be 'host:port', got {addr!r}"
-            ) from None
+                f"actor.replay.addr must name at least one 'host:port', "
+                f"got {addr!r}"
+            )
+        return targets
 
     def _get_replay_client(self):
-        """Dial the replay store once per actor (the client reconnects +
-        retries internally; docs/data_plane.md store path)."""
+        """Dial the replay plane once per actor (clients reconnect + retry
+        internally; docs/data_plane.md store path): the in-process store
+        handle for ``inproc`` (zero serialization), one ``InsertClient``
+        for a single store, or a ``ShardedInsertClient`` routing across
+        the fleet by consistent hash."""
         if self._replay_client is None:
-            from ..replay import InsertClient
+            target = self._replay_target()
+            compress = bool(self._replay_cfg().get("compress", True))
+            if isinstance(target, str):  # inproc fast path
+                from ..replay import LocalReplayClient
 
-            self._replay_client = InsertClient(*self._replay_target())
+                self._replay_client = LocalReplayClient()
+            elif len(target) == 1:
+                from ..replay import InsertClient
+
+                self._replay_client = InsertClient(*target[0], compress=compress)
+            else:
+                from ..replay import ShardMap, ShardedInsertClient
+
+                self._replay_client = ShardedInsertClient(
+                    ShardMap([f"{h}:{p}" for h, p in target]), compress=compress)
         return self._replay_client
 
     def push_trajectory(self, player_id: str, traj) -> None:

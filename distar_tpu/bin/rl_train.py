@@ -16,6 +16,7 @@ from __future__ import annotations
 import argparse
 import os
 import time
+from typing import Optional
 
 from ..actor import Actor
 from ..comm import Adapter, Coordinator, CoordinatorServer
@@ -216,38 +217,70 @@ def _table_config(args):
     )
 
 
-def _build_replay_store(args):
-    """Store + spill for the replay role; recovery runs before serving so
-    acked-but-unsampled trajectories from a crashed generation are resident
-    before the first sample lands."""
+def _build_replay_store(args, shard_id: str = "", spill_dir: Optional[str] = None):
+    """Store + spill for a serving replay role; recovery runs before serving
+    so acked-but-unsampled trajectories from a crashed generation are
+    resident before the first sample lands (as pre-encoded payloads, so
+    re-serving them skips the recompression pass). ``shard_id`` labels this
+    member's metrics/stats when it is one of a fleet."""
     from ..replay import ReplayStore, SpillRing
 
     _table_config(args)  # fail fast on invalid combos (e.g. fifo + spi > 1)
     spill = None
-    if args.replay_spill_dir:
-        spill = SpillRing(args.replay_spill_dir, max_items=args.replay_spill_max)
-    store = ReplayStore(table_factory=lambda name: _table_config(args), spill=spill)
+    spill_dir = args.replay_spill_dir if spill_dir is None else spill_dir
+    if spill_dir:
+        spill = SpillRing(spill_dir, max_items=args.replay_spill_max)
+    store = ReplayStore(table_factory=lambda name: _table_config(args),
+                        spill=spill, shard_id=shard_id, recover_encoded=True)
     recovered = store.recover()
     if recovered:
-        print(f"replay: recovered {recovered} acked trajectories from spill",
-              flush=True)
+        print(f"replay{f' shard {shard_id}' if shard_id else ''}: recovered "
+              f"{recovered} acked trajectories from spill", flush=True)
     return store
+
+
+def _learner_replay_client(args, addrs: str):
+    """Sample-side client for a learner: ``inproc`` -> the colocated
+    zero-copy handle, one address -> a plain ``SampleClient``, several ->
+    the consistent-hash fleet's fan-in sampler (per-shard breakers,
+    stalled shards skip), ``discover`` -> the coordinator's shard map."""
+    from ..replay import (
+        LocalReplayClient, SampleClient, ShardMap, ShardedSampleClient,
+        is_inproc_addr,
+    )
+
+    compress = getattr(args, "replay_compress", True)
+    if is_inproc_addr(addrs):
+        return LocalReplayClient()
+    if addrs.strip().lower() == "discover":
+        shard_map = ShardMap.discover(_addr(args.coordinator_addr))
+    else:
+        shard_map = ShardMap.parse(addrs)
+    if len(shard_map) == 1:
+        return SampleClient(*_addr(shard_map.addrs[0]), compress=compress)
+    return ShardedSampleClient(shard_map, mode=args.replay_fanin,
+                               compress=compress)
 
 
 def run_replay(args) -> None:
     """Standalone replay-store role: framed-TCP data plane on --port, HTTP
     admin/stats (+ /metrics + health routes) on --metrics-port, crash-restart
-    under the supervisor with spill recovery on every (re)start."""
-    from ..replay import ReplayAdminServer, ReplayServer
+    under the supervisor with spill recovery on every (re)start. With
+    --coordinator-addr the shard registers under the ``replay_shard`` token
+    (lease + heartbeat), so actors/learners started with ``--replay-addr
+    discover`` find the whole fleet without static address lists."""
+    from ..replay import ReplayAdminServer, ReplayServer, register_shard
 
+    shard_id = args.replay_shard_id or (f":{args.port}" if args.port else "")
     _init_health(
-        args, roles=("replay",), source="replay",
+        args, roles=("replay",), source=f"replay{shard_id}" if shard_id else "replay",
         shipper_addr=_addr(args.coordinator_addr) if args.coordinator_addr else None,
     )
 
     def serve_loop(ctx):
-        store = _build_replay_store(args)
-        server = ReplayServer(store, port=args.port)
+        store = _build_replay_store(args, shard_id=shard_id)
+        server = ReplayServer(store, port=args.port,
+                              compress=args.replay_compress)
         server.start()
         admin = None
         if args.metrics_port is not None:
@@ -255,11 +288,20 @@ def run_replay(args) -> None:
             admin.start()
             print(f"replay admin on http://{admin.host}:{admin.port}/replay/stats",
                   flush=True)
+        heartbeat = None
+        if args.coordinator_addr:
+            heartbeat = register_shard(
+                _addr(args.coordinator_addr), server.host, server.port,
+                meta={"admin_port": args.metrics_port},
+                lease_s=args.lease_s or None,
+            )
         print(f"replay store serving on {server.host}:{server.port}", flush=True)
         try:
             while not ctx.should_exit:
                 ctx.sleep(1.0)
         finally:
+            if heartbeat is not None:
+                heartbeat.stop_event.set()
             server.stop()
             if admin is not None:
                 admin.stop()
@@ -304,19 +346,42 @@ def run_all(args) -> None:
     learner_adapter = Adapter(coordinator=co)
 
     # --replay: an in-process store between actor and learner — the smoke
-    # configuration of the store path (real server + clients on loopback)
-    replay_server = None
+    # configuration of the store path. Three shapes:
+    #   * default: ONE real server + clients on loopback TCP;
+    #   * --replay-shards N: N servers, actors route by consistent hash,
+    #     the learner fans in (the fleet smoke — real sharded data plane);
+    #   * --replay-fast-path: no server at all — the Sebulba colocated
+    #     layout hands actor and learner a direct store handle (zero
+    #     serialization on push AND sample).
+    replay_servers = []
     actor_replay_cfg = {}
-    if args.replay:
+    if args.replay and args.replay_fast_path:
+        if args.replay_shards > 1:
+            raise SystemExit("--replay-fast-path is the single colocated "
+                             "store; it cannot combine with --replay-shards")
+        from ..replay import set_local_store
+
+        set_local_store(_build_replay_store(args))
+        actor_replay_cfg = {"replay": {"enabled": True, "addr": "inproc"}}
+        print("replay store (colocated zero-copy fast path)", flush=True)
+    elif args.replay:
         from ..replay import ReplayServer
 
-        replay_server = ReplayServer(_build_replay_store(args), port=0).start()
-        actor_replay_cfg = {
-            "replay": {"enabled": True,
-                       "addr": f"{replay_server.host}:{replay_server.port}"}
-        }
-        print(f"replay store (in-process) on "
-              f"{replay_server.host}:{replay_server.port}", flush=True)
+        spill_root = args.replay_spill_dir
+        for i in range(max(args.replay_shards, 1)):
+            shard_id = f"s{i}" if args.replay_shards > 1 else ""
+            spill_dir = os.path.join(spill_root, shard_id) \
+                if (spill_root and shard_id) else spill_root
+            store = _build_replay_store(args, shard_id=shard_id,
+                                        spill_dir=spill_dir)
+            replay_servers.append(
+                ReplayServer(store, port=0,
+                             compress=args.replay_compress).start())
+        addrs = ",".join(f"{s.host}:{s.port}" for s in replay_servers)
+        actor_replay_cfg = {"replay": {"enabled": True, "addr": addrs,
+                                       "compress": args.replay_compress}}
+        print(f"replay store{'s' if len(replay_servers) > 1 else ''} "
+              f"(in-process) on {addrs}", flush=True)
 
     player_id = list(league.active_players.keys())[0]
     traj_len = args.traj_len
@@ -347,12 +412,12 @@ def run_all(args) -> None:
 
     learner = plugins.load_component(args.pipeline, "RLLearner")(
         _learner_cfg(args, model_cfg), **_mesh_kwargs(args))
-    if replay_server is not None:
+    if args.replay:
         from ..learner.rl_dataloader import ReplayDataLoader
-        from ..replay import SampleClient
 
+        loader_addr = actor_replay_cfg["replay"]["addr"]
         learner.set_dataloader(ReplayDataLoader(
-            SampleClient(replay_server.host, replay_server.port),
+            _learner_replay_client(args, loader_addr),
             player_id, args.batch_size,
         ))
     else:
@@ -363,8 +428,12 @@ def run_all(args) -> None:
     # let the actor finish its in-flight job: a daemon thread killed inside a
     # jitted computation aborts the interpreter teardown
     supervisor.stop(timeout=120)
-    if replay_server is not None:
-        replay_server.stop()
+    for server in replay_servers:
+        server.stop()
+    if args.replay and args.replay_fast_path:
+        from ..replay import set_local_store
+
+        set_local_store(None)
     print(
         f"rl_train done: {learner.last_iter.val} iters, "
         f"loss={learner.variable_record.get('total_loss').avg:.4f}, "
@@ -430,13 +499,14 @@ def run_learner(args) -> None:
         # latest pointer before cold-starting — zero manual intervention
         learner.resume_latest()
     if args.replay_addr:
-        # store-backed sampling mode: batches come from the replay table
-        # instead of the point-to-point pull cache (docs/data_plane.md)
+        # store-backed sampling mode: batches come from the replay table(s)
+        # instead of the point-to-point pull cache — a comma-separated list
+        # (or 'discover') fans in across the shard fleet (docs/data_plane.md)
         from ..learner.rl_dataloader import ReplayDataLoader
-        from ..replay import SampleClient
 
         learner.set_dataloader(ReplayDataLoader(
-            SampleClient(*_addr(args.replay_addr)), args.player_id, args.batch_size,
+            _learner_replay_client(args, args.replay_addr),
+            args.player_id, args.batch_size,
         ))
     else:
         learner.set_dataloader(RLDataLoader(adapter, args.player_id, args.batch_size))
@@ -460,7 +530,17 @@ def run_actor(args) -> None:
     actor_cfg = {"env_num": args.env_num, "traj_len": args.traj_len,
                  "plane": _plane_cfg(args)}
     if args.replay_addr:
-        actor_cfg["replay"] = {"enabled": True, "addr": args.replay_addr}
+        replay_addr = args.replay_addr
+        if replay_addr.strip().lower() == "discover":
+            # resolve the fleet once at launch from the coordinator's shard
+            # registrations (the actor config carries plain addresses)
+            from ..replay import ShardMap
+
+            replay_addr = ",".join(
+                ShardMap.discover(_addr(args.coordinator_addr)).addrs)
+            print(f"replay: discovered shard fleet {replay_addr}", flush=True)
+        actor_cfg["replay"] = {"enabled": True, "addr": replay_addr,
+                               "compress": args.replay_compress}
     actor = Actor(
         cfg={"actor": actor_cfg},
         league=league,
@@ -576,9 +656,33 @@ def main() -> None:
                         "in-process replay store (smoke config of the "
                         "store path) instead of the point-to-point shuttle")
     p.add_argument("--replay-addr", default="",
-                   help="host:port of a replay store; actors push "
-                        "trajectories there, learners sample from it "
-                        "(default: the legacy shuttle path)")
+                   help="replay data-plane target: one 'host:port', a "
+                        "comma-separated shard fleet (consistent-hash "
+                        "routing + learner fan-in), or 'discover' to read "
+                        "the fleet from the coordinator's replay_shard "
+                        "registrations (default: the legacy shuttle path)")
+    p.add_argument("--replay-shards", type=int, default=1,
+                   help="--type all: stand up this many in-process replay "
+                        "shards (actors route by consistent hash, the "
+                        "learner fans in with per-shard rate limiting)")
+    p.add_argument("--replay-fast-path", action="store_true",
+                   help="--type all: zero-copy colocated store — actor "
+                        "pushes and learner samples through a direct "
+                        "in-process handle, no sockets, no serialization "
+                        "(the Sebulba layout's data plane)")
+    p.add_argument("--no-replay-compress", dest="replay_compress",
+                   action="store_false", default=True,
+                   help="disable wire compression on replay data-plane "
+                        "connections (negotiated per connection; servers "
+                        "started with this flag refuse it for all peers)")
+    p.add_argument("--replay-fanin", default="round_robin",
+                   choices=("round_robin", "weighted"),
+                   help="shard order for learner fan-in sampling: strict "
+                        "rotation, or fullest-shard-first (weighted by "
+                        "resident items)")
+    p.add_argument("--replay-shard-id", default="",
+                   help="--type replay: metrics/stats label for this fleet "
+                        "member (default ':<port>')")
     p.add_argument("--replay-max-size", type=int, default=1024,
                    help="replay role: per-table item cap (FIFO eviction)")
     p.add_argument("--replay-spi", type=float, default=1.0,

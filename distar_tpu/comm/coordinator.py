@@ -111,6 +111,15 @@ class Coordinator:
                 return None
             return q.popleft()
 
+    def peers(self, token: str) -> list:
+        """Non-destructive listing of a token's live records — the service-
+        discovery read (``ask`` is a work-queue pop; a shard map built by
+        popping would unregister the fleet it discovered). Lease sweeping
+        applies first, so evicted endpoints never appear in a fresh map."""
+        with self._lock:
+            self._sweep_leases()
+            return [dict(r) for r in self._records.get(token, ())]
+
     _UNSET = object()  # sentinel: "use the instance default max_age_s"
 
     @staticmethod
@@ -199,6 +208,7 @@ class CoordinatorServer:
         routes = {
             "register": lambda b: co.register(**b),
             "ask": lambda b: co.ask(b["token"]),
+            "peers": lambda b: co.peers(b["token"]),
             "strike": lambda b: co.strike(b["ip"], b["port"]),
             "heartbeat": lambda b: co.heartbeat(**b),
             # absent max_age_s -> the coordinator's own default filter, so

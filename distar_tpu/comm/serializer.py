@@ -25,17 +25,27 @@ MAGIC_ZLIB = b"DTZ0"
 MAGIC_LZ = b"DTL0"  # + u64 LE decompressed size + lz4-block stream
 
 
-def dumps(obj: Any, compress: bool = True) -> bytes:
+def dumps_sized(obj: Any, compress: bool = True) -> "tuple[bytes, int]":
+    """``(blob, raw_len)`` where ``raw_len`` is the pickled-payload size
+    before compression — the number wire-bytes telemetry compares the
+    on-the-wire frame against (``distar_replay_*_bytes_{raw,wire}``)."""
     payload = pickle.dumps(obj, protocol=5)
+    raw_len = len(payload)
     if compress:
         lz = shuttle.lz_compress(payload)
         if lz is not None:
-            return MAGIC_LZ + struct.pack("<Q", len(payload)) + lz
-        return MAGIC_ZLIB + zlib.compress(payload, level=1)
-    return MAGIC_RAW + payload
+            return MAGIC_LZ + struct.pack("<Q", raw_len) + lz, raw_len
+        return MAGIC_ZLIB + zlib.compress(payload, level=1), raw_len
+    return MAGIC_RAW + payload, raw_len
 
 
-def loads(blob: bytes) -> Any:
+def dumps(obj: Any, compress: bool = True) -> bytes:
+    return dumps_sized(obj, compress=compress)[0]
+
+
+def loads_sized(blob: bytes) -> "tuple[Any, int]":
+    """``(obj, raw_len)`` — the decode twin of ``dumps_sized`` (``raw_len``
+    is the decompressed pickle-payload size, whatever the codec)."""
     magic, body = blob[:4], blob[4:]
     if magic == MAGIC_LZ:
         if len(body) < 8:
@@ -46,12 +56,48 @@ def loads(blob: bytes) -> Any:
         # corrupt/hostile header, not a legitimate payload
         if n > max(1024, (len(body) - 8) * 255):
             raise ValueError(f"implausible decompressed size {n} for {len(body) - 8}-byte stream")
-        return pickle.loads(shuttle.lz_decompress(body[8:], n))
+        return pickle.loads(shuttle.lz_decompress(body[8:], n)), n
     if magic == MAGIC_ZLIB:
-        return pickle.loads(zlib.decompress(body))
+        payload = zlib.decompress(body)
+        return pickle.loads(payload), len(payload)
     if magic == MAGIC_RAW:
-        return pickle.loads(body)
+        return pickle.loads(body), len(body)
     raise ValueError(f"unknown payload magic {magic!r}")
+
+
+def loads(blob: bytes) -> Any:
+    return loads_sized(blob)[0]
+
+
+class Opaque:
+    """A fully-encoded payload (a complete ``dumps()`` blob, magic included)
+    embedded as a value inside a larger message. Senders that would compress
+    the enclosing frame can skip the pass when its bulk is Opaque — the
+    bytes are already through the codec (the replay store uses this to
+    re-serve spill-recovered trajectories without recompressing them).
+    Receivers call ``decode()`` to get the original object back."""
+
+    __slots__ = ("blob",)
+
+    def __init__(self, blob: bytes):
+        self.blob = blob
+
+    def decode(self) -> Any:
+        return loads(self.blob)
+
+    @classmethod
+    def encode(cls, obj: Any, compress: bool = True) -> "Opaque":
+        return cls(dumps(obj, compress=compress))
+
+    def __reduce__(self):
+        return (Opaque, (self.blob,))
+
+
+def maybe_decode(obj: Any) -> Any:
+    """Transparently unwrap ``Opaque`` payloads; everything else passes
+    through untouched (every sample-consumption path calls this, so whether
+    an item survived a store restart is invisible to the learner)."""
+    return obj.decode() if isinstance(obj, Opaque) else obj
 
 
 def save_payload(path: str, obj: Any, compress: bool = True) -> str:

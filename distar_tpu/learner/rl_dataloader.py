@@ -200,7 +200,15 @@ class ReplayDataLoader:
     ``last_sample_info`` for priority updates and logging, and starvation
     blocks server-side in the store's rate limiter (the client retries
     rate-limit timeouts under its policy). Staleness/reuse histograms are
-    recorded store-side (``distar_replay_sampled_*``)."""
+    recorded store-side (``distar_replay_sampled_*``).
+
+    The client can be a single-store ``SampleClient``, the zero-copy
+    ``LocalReplayClient`` (colocated fast path), or a
+    ``ShardedSampleClient`` fanning in across a shard fleet — the loader is
+    agnostic: all three speak the same ``sample``/``update_priorities``
+    surface, and for the sharded one the per-item ``shard`` field on
+    ``last_sample_info`` routes priority updates back to exactly the shard
+    each item came from."""
 
     def __init__(self, sample_client, player_id: str, batch_size: int,
                  table: Optional[str] = None, sample_timeout_s: float = 30.0):
@@ -253,5 +261,11 @@ class ReplayDataLoader:
 
     def update_priorities(self, updates: Dict[int, float]) -> int:
         """PER hook: push learner-side priorities (e.g. TD error magnitudes)
-        back to the table; unknown seqs (already evicted) are ignored."""
+        back to the table; unknown seqs (already evicted) are ignored. On a
+        sharded fleet the last batch's sample info routes each update to
+        the shard that served the item (seqs are per-shard counters, so a
+        broadcast could re-prioritize a stranger's seq)."""
+        if getattr(self._client, "sharded", False):
+            return self._client.update_priorities(
+                self._table, updates, info=self.last_sample_info)
         return self._client.update_priorities(self._table, updates)

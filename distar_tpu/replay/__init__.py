@@ -18,7 +18,17 @@ from .errors import (
     UnknownTableError,
     error_from_wire,
 )
+from .local import LocalReplayClient, is_inproc_addr, local_store, set_local_store
 from .server import ReplayAdminServer, ReplayServer
+from .sharding import (
+    SHARD_TOKEN,
+    HashRing,
+    ShardMap,
+    ShardedInsertClient,
+    ShardedSampleClient,
+    register_shard,
+    stable_hash,
+)
 from .spill import SpillRing
 from .store import (
     RateLimiter,
@@ -39,8 +49,19 @@ __all__ = [
     "ReplayError",
     "UnknownTableError",
     "error_from_wire",
+    "LocalReplayClient",
+    "is_inproc_addr",
+    "local_store",
+    "set_local_store",
     "ReplayAdminServer",
     "ReplayServer",
+    "SHARD_TOKEN",
+    "HashRing",
+    "ShardMap",
+    "ShardedInsertClient",
+    "ShardedSampleClient",
+    "register_shard",
+    "stable_hash",
     "SpillRing",
     "RateLimiter",
     "ReplayStore",

@@ -8,18 +8,28 @@ the ``replay.errors`` taxonomy; ``rate_limited`` is *retryable* (the store
 is pacing, not failing), so a default-policy client transparently rides
 through limiter blocks AND store restarts within its deadline budget.
 
-At-least-once note: a retried ``insert`` whose first attempt's ack was lost
-may insert twice. The spill/recovery contract is "no acked item is lost";
-duplicate trajectories are benign for RL training (one extra gradient
-sample), so inserts carry no dedup token.
+Exactly-once inserts: every logical ``insert`` mints one idempotency key
+that rides EVERY retry of that insert. A retry after the ambiguous failure
+(server committed, ack lost when the connection died) is answered from the
+store's idem cache with the original seq instead of re-applying — no
+duplicate item, no duplicate spill blob. (A retry that crosses a store
+*restart* still lands as the documented at-least-once duplicate: the cache
+is process-lifetime, and a duplicate trajectory is benign for RL training.)
+
+Wire compression is negotiated once per connection: ``_connect`` sends a
+``hello`` declaring this client's preference, the server answers the ANDed
+setting, and both directions honour it. A pre-negotiation server (or one
+that answers hello with an error) degrades to the legacy always-compressed
+contract, so mixed-version fleets interoperate.
 """
 from __future__ import annotations
 
 import socket
 import threading
+import uuid
 from typing import Any, Dict, List, Optional, Tuple
 
-from ..comm.serializer import recv_msg, send_msg
+from ..comm.serializer import maybe_decode, recv_msg, send_msg
 from ..resilience import CircuitBreaker, RetryPolicy, retry_call
 from .errors import error_from_wire
 
@@ -35,7 +45,7 @@ class _ReplayClientBase:
     def __init__(self, host: str, port: int, timeout_s: float = 60.0,
                  retry_policy: Optional[RetryPolicy] = None,
                  breaker: Optional[CircuitBreaker] = None,
-                 op_prefix: str = "replay"):
+                 op_prefix: str = "replay", compress: bool = True):
         self._addr = (host, port)
         self._timeout_s = timeout_s
         self._policy = retry_policy or DEFAULT_REPLAY_POLICY
@@ -44,18 +54,37 @@ class _ReplayClientBase:
         self._op_prefix = op_prefix
         self._sock: Optional[socket.socket] = None
         self._lock = threading.Lock()
+        #: what this side ASKS for; the per-connection negotiated setting
+        #: (server's enablement ANDed in) lands in _neg_compress on connect
+        self._want_compress = bool(compress)
+        self._neg_compress = bool(compress)
+        self.server_shard_id: str = ""
 
     def _connect(self) -> None:
         self.close()
         self._sock = socket.create_connection(self._addr, timeout=self._timeout_s)
         self._sock.settimeout(self._timeout_s)
+        try:
+            send_msg(self._sock, {"op": "hello", "compress": self._want_compress},
+                     compress=False)
+            resp = recv_msg(self._sock)
+        except (ConnectionError, OSError, ValueError):
+            self.close()
+            raise
+        if isinstance(resp, dict) and resp.get("code") == 0 and "compress" in resp:
+            self._neg_compress = bool(resp["compress"])
+            self.server_shard_id = str(resp.get("shard", "") or "")
+        else:
+            # pre-negotiation server: it answered hello with an error frame
+            # and will compress every response — mirror the legacy contract
+            self._neg_compress = True
 
     def _call_once(self, req: dict) -> dict:
         with self._lock:
             if self._sock is None:
                 self._connect()
             try:
-                send_msg(self._sock, req)
+                send_msg(self._sock, req, compress=self._neg_compress)
                 resp = recv_msg(self._sock)
             except (ConnectionError, OSError, ValueError):
                 # stream no longer trustworthy: drop it so the retry dials
@@ -109,7 +138,11 @@ class InsertClient(_ReplayClientBase):
 
     def insert(self, table: str, item: Any, priority: float = 1.0,
                timeout_s: Optional[float] = None) -> int:
-        req = {"op": "insert", "table": table, "item": item, "priority": priority}
+        # one idem key per LOGICAL insert, minted here so every retry of
+        # this call carries the same token: a commit whose ack the wire ate
+        # answers the cached seq on re-offer instead of double-applying
+        req = {"op": "insert", "table": table, "item": item,
+               "priority": priority, "idem": uuid.uuid4().hex}
         if timeout_s is not None:
             req["timeout_s"] = timeout_s
         return self._call(req)["seq"]
@@ -129,7 +162,9 @@ class SampleClient(_ReplayClientBase):
         if timeout_s is not None:
             req["timeout_s"] = timeout_s
         resp = self._call(req)
-        return resp["items"], resp["info"]
+        # spill re-serves arrive as pre-encoded Opaque payloads (the server
+        # skipped recompression); unwrap here so consumers never see them
+        return [maybe_decode(i) for i in resp["items"]], resp["info"]
 
     def update_priorities(self, table: str, updates: Dict[int, float]) -> int:
         return self._call(

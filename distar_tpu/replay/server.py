@@ -6,7 +6,8 @@ one request/response dict pair per frame, ``{"code": 0, ...}`` on success,
 ``{"code": <wire code>, "error": ...}`` typed on failure (errors.to_wire).
 
 Requests:
-  insert  {table, item, priority?, timeout_s?}    -> {code: 0, seq}
+  hello   {compress?}                             -> {code: 0, compress, shard}
+  insert  {table, item, priority?, timeout_s?, idem?} -> {code: 0, seq}
   sample  {table, batch_size?, timeout_s?}        -> {code: 0, items, info}
   update_priorities {table, updates}              -> {code: 0, applied}
   stats   {}                                      -> {code: 0, stats}
@@ -20,6 +21,17 @@ retryable ``rate_limited`` wire error). The admin surface
 (``ReplayAdminServer``) follows the CoordinatorServer pattern: GET
 ``/metrics`` (Prometheus scrape), the fleet-health routes, and GET
 ``/replay/stats`` for opsctl.
+
+Wire compression is negotiated per connection: the optional ``hello``
+frame declares whether the client wants payload compression, the server
+answers with the setting both sides will use (its own enablement ANDed
+in), and every later frame on the connection honours it. A client that
+never says hello gets the legacy always-compressed behaviour. Responses
+whose bulk is already through the codec — ``Opaque`` spill re-serves —
+skip the frame-level compression pass regardless (recompressing lz output
+buys bytes-per-CPU nothing). ``distar_replay_{tx,rx}_bytes_{raw,wire}``
+counters account both directions so the compression ratio actually paid
+for is a scrapeable number, not a guess.
 """
 from __future__ import annotations
 
@@ -28,7 +40,14 @@ import socket
 import threading
 from typing import Optional
 
-from ..comm.serializer import recv_msg, send_msg
+from ..comm.serializer import (
+    Opaque,
+    dumps_sized,
+    frame,
+    loads_sized,
+    read_frame,
+    sock_recv_exact,
+)
 from ..obs import get_registry
 from .errors import ReplayError
 from .store import ReplayStore
@@ -38,9 +57,12 @@ class ReplayServer:
     """Thread-per-connection framed-TCP server over one ``ReplayStore``."""
 
     def __init__(self, store: ReplayStore, host: str = "127.0.0.1", port: int = 0,
-                 default_timeout_s: float = 30.0):
+                 default_timeout_s: float = 30.0, compress: bool = True):
         self.store = store
         self.default_timeout_s = default_timeout_s
+        #: server-side compression enablement; the per-connection setting is
+        #: this ANDed with whatever the client's hello asks for
+        self.compress = bool(compress)
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._listener.bind((host, port))
@@ -51,10 +73,26 @@ class ReplayServer:
         self._conns: set = set()
         self._conns_lock = threading.Lock()
         reg = get_registry()
+        shard = getattr(store, "shard_id", "") or ""
+        extra = {"shard": shard} if shard else {}
         self._g_conns = reg.gauge(
-            "distar_replay_server_connections", "open replay data-plane connections")
+            "distar_replay_server_connections", "open replay data-plane connections",
+            **extra)
         self._c_requests = reg.counter(
-            "distar_replay_server_requests_total", "replay request frames handled")
+            "distar_replay_server_requests_total", "replay request frames handled",
+            **extra)
+        self._c_tx_raw = reg.counter(
+            "distar_replay_tx_bytes_raw_total",
+            "response payload bytes before wire compression", **extra)
+        self._c_tx_wire = reg.counter(
+            "distar_replay_tx_bytes_wire_total",
+            "response payload bytes actually sent on the wire", **extra)
+        self._c_rx_raw = reg.counter(
+            "distar_replay_rx_bytes_raw_total",
+            "request payload bytes after wire decompression", **extra)
+        self._c_rx_wire = reg.counter(
+            "distar_replay_rx_bytes_wire_total",
+            "request payload bytes actually received on the wire", **extra)
 
     def start(self) -> "ReplayServer":
         self._accept_thread = threading.Thread(
@@ -99,23 +137,57 @@ class ReplayServer:
                 daemon=True,
             ).start()
 
+    # --------------------------------------------------------- counted wire IO
+    def _recv_counted(self, conn: socket.socket):
+        blob = read_frame(lambda n: sock_recv_exact(conn, n))
+        obj, raw_len = loads_sized(blob)
+        self._c_rx_wire.inc(len(blob))
+        self._c_rx_raw.inc(raw_len)
+        return obj
+
+    def _send_counted(self, conn: socket.socket, obj, compress: bool) -> None:
+        # skip the compression pass when the response bulk is already
+        # through the codec (Opaque spill re-serves): lz-of-lz costs a full
+        # CPU pass for ~zero byte savings
+        if compress and isinstance(obj, dict):
+            items = obj.get("items")
+            if items and any(isinstance(i, Opaque) for i in items):
+                compress = False
+        blob, raw_len = dumps_sized(obj, compress=compress)
+        conn.sendall(frame(blob))
+        self._c_tx_wire.inc(len(blob))
+        self._c_tx_raw.inc(raw_len)
+
     def _serve_conn(self, conn: socket.socket) -> None:
         self._g_conns.inc()
         with self._conns_lock:
             self._conns.add(conn)
+        compress = self.compress  # legacy clients never negotiate: stay on
         try:
             with conn:
                 while not self._stop.is_set():
                     try:
-                        req = recv_msg(conn)
+                        req = self._recv_counted(conn)
                     except (ConnectionError, OSError):
                         return  # peer closed (possibly mid-frame)
                     except ValueError as e:
-                        send_msg(conn, {"code": "bad_frame", "error": repr(e)})
+                        self._send_counted(
+                            conn, {"code": "bad_frame", "error": repr(e)}, compress)
                         return
                     self._c_requests.inc()
+                    if isinstance(req, dict) and req.get("op") == "hello":
+                        # per-connection negotiation: both sides commit to
+                        # the ANDed setting for every later frame
+                        compress = self.compress and bool(req.get("compress", True))
+                        reply = {"code": 0, "compress": compress,
+                                 "shard": getattr(self.store, "shard_id", "")}
+                        try:
+                            self._send_counted(conn, reply, compress)
+                        except (ConnectionError, OSError):
+                            return
+                        continue
                     try:
-                        send_msg(conn, self._dispatch(req))
+                        self._send_counted(conn, self._dispatch(req), compress)
                     except (ConnectionError, OSError):
                         return
         finally:
@@ -133,6 +205,7 @@ class ReplayServer:
                 seq = self.store.insert(
                     req["table"], req["item"],
                     priority=float(req.get("priority", 1.0)), timeout_s=timeout_s,
+                    idem=req.get("idem"),
                 )
                 return {"code": 0, "seq": seq}
             if op == "sample":
@@ -215,3 +288,76 @@ class ReplayAdminServer:
     def stop(self) -> None:
         self._server.shutdown()
         self._server.server_close()
+
+
+def main(argv=None) -> int:
+    """Minimal standalone shard: ``python -m distar_tpu.replay.server``.
+
+    The jax-free twin of ``bin/rl_train --type replay`` (no health stack, no
+    supervisor) — what the sharded bench and chaos drills spawn per shard so
+    fleet members are real OS processes (separate GILs, real sockets), not
+    threads sharing the parent's interpreter. Prints one parseable
+    ``REPLAY-SHARD <host> <port>`` line once serving, then runs until
+    SIGTERM/SIGINT or stdin EOF (so a dying parent reaps the fleet)."""
+    import argparse
+    import signal
+    import sys
+
+    from .spill import SpillRing
+    from .store import TableConfig
+
+    p = argparse.ArgumentParser(description="standalone replay shard")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=0)
+    p.add_argument("--shard-id", default="")
+    p.add_argument("--spill-dir", default="")
+    p.add_argument("--spill-max", type=int, default=4096)
+    p.add_argument("--max-size", type=int, default=4096)
+    p.add_argument("--sampler", default="uniform",
+                   choices=("fifo", "uniform", "prioritized"))
+    p.add_argument("--spi", type=float, default=0.0,
+                   help="samples-per-insert ratio (<=0 disables)")
+    p.add_argument("--min-size", type=int, default=1)
+    p.add_argument("--error-buffer", type=float, default=None)
+    p.add_argument("--no-compress", dest="compress", action="store_false",
+                   help="refuse wire compression in the hello negotiation")
+    args = p.parse_args(argv)
+
+    cfg = TableConfig(
+        max_size=args.max_size, sampler=args.sampler,
+        samples_per_insert=None if args.spi <= 0 else args.spi,
+        min_size_to_sample=max(args.min_size, 1),
+        error_buffer=args.error_buffer,
+    )
+    spill = SpillRing(args.spill_dir, max_items=args.spill_max) \
+        if args.spill_dir else None
+    store = ReplayStore(table_factory=lambda name: cfg, spill=spill,
+                        shard_id=args.shard_id, recover_encoded=True)
+    recovered = store.recover()
+    server = ReplayServer(store, host=args.host, port=args.port,
+                          compress=args.compress).start()
+    # CLI entrypoint output: the parseable serving line callers wait for
+    print(f"REPLAY-SHARD {server.host} {server.port} "  # lint: allow-print
+          f"recovered={recovered}", flush=True)
+    stop = threading.Event()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, lambda *_: stop.set())
+    try:
+        import select
+
+        while not stop.is_set():
+            # poll (not block) stdin so a signal still exits promptly; EOF
+            # on a piped stdin means the parent went away — reap ourselves
+            ready, _, _ = select.select([sys.stdin], [], [], 0.5)
+            if ready and not sys.stdin.buffer.read(1):
+                break
+    except (OSError, ValueError, KeyboardInterrupt):
+        pass
+    server.stop()
+    if spill is not None:
+        spill.flush()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

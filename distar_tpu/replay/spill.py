@@ -141,11 +141,19 @@ class SpillRing:
         except (FileNotFoundError, OSError):
             pass
 
-    def recover(self) -> Iterator[dict]:
+    def recover(self, keep_encoded: bool = False) -> Iterator[dict]:
         """Yield ``{key, table, priority, item}`` for every live CRC-valid
         blob (oldest first); corrupt blobs are counted, unlinked and
         skipped. Rebuilds the in-memory index as it goes, so a recovered
-        ring keeps ring/release semantics."""
+        ring keeps ring/release semantics.
+
+        ``keep_encoded=True`` yields the item as a ``serializer.Opaque``
+        wrapper around the stored (already-compressed) payload instead of
+        decoding it: recovery skips the unpickle pass, and a later wire
+        re-serve ships the blob without recompressing (CRC still verifies
+        integrity either way)."""
+        from ..comm.serializer import Opaque
+
         backend, rest = storage.resolve(self.root)
         paths = sorted(
             p for p in backend.list(os.path.join(rest, ""))
@@ -163,7 +171,7 @@ class SpillRing:
                     blob = backend.read_bytes(path)
                     if (zlib.crc32(blob) & 0xFFFFFFFF) != manifest[key]:
                         raise ValueError(f"manifest crc mismatch for {key}")
-                item = loads(payload)
+                item = Opaque(payload) if keep_encoded else loads(payload)
             except Exception:
                 self._c_corrupt.inc()
                 self._unlink(key)

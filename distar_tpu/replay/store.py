@@ -106,10 +106,12 @@ class RateLimiter:
     def __init__(self, samples_per_insert: Optional[float] = 1.0,
                  min_size_to_sample: int = 1,
                  error_buffer: Optional[float] = None,
-                 table: str = ""):
+                 table: str = "", shard: str = ""):
         """``samples_per_insert=None`` disables ratio enforcement entirely
         (pure buffer semantics — the legacy pull-cache contract); only
-        ``min_size_to_sample`` still gates sampling."""
+        ``min_size_to_sample`` still gates sampling. ``shard`` labels the
+        block-time series so colocated shard-fleet members (chaos drills,
+        --replay-shards smoke runs) don't collapse into one series."""
         assert samples_per_insert is None or samples_per_insert > 0.0
         assert min_size_to_sample >= 1
         self.spi = None if samples_per_insert is None else float(samples_per_insert)
@@ -121,11 +123,12 @@ class RateLimiter:
         self._samples = 0
         self._block_s = {"insert": 0.0, "sample": 0.0}
         reg = get_registry()
+        extra = {"shard": shard} if shard else {}
         self._c_block = {
             side: reg.counter(
                 "distar_replay_limiter_block_seconds_total",
                 "cumulative wall-clock the rate limiter blocked each side",
-                table=table, side=side,
+                table=table, side=side, **extra,
             )
             for side in ("insert", "sample")
         }
@@ -264,10 +267,12 @@ class TableConfig:
 
 class ReplayTable:
     def __init__(self, name: str, config: Optional[TableConfig] = None,
-                 on_release: Optional[Callable[[_Item, str], None]] = None):
+                 on_release: Optional[Callable[[_Item, str], None]] = None,
+                 shard: str = ""):
         import random
 
         self.name = name
+        self.shard = shard
         self.config = config or TableConfig()
         cfg = self.config
         self._rng = random.Random(cfg.seed)
@@ -280,31 +285,39 @@ class ReplayTable:
             samples_per_insert=cfg.samples_per_insert,
             min_size_to_sample=cfg.min_size_to_sample,
             error_buffer=cfg.error_buffer,
-            table=name,
+            table=name, shard=shard,
         )
         reg = get_registry()
+        # shard label only when set: a single-store deployment keeps the
+        # exact series names every dashboard/rule already matches on, while
+        # a fleet gets one series per (table, shard) — the per-shard axis
+        # the default rulebook evaluates over
+        extra = {"shard": shard} if shard else {}
         self._c_inserts = reg.counter(
-            "distar_replay_inserts_total", "trajectories inserted", table=name)
+            "distar_replay_inserts_total", "trajectories inserted",
+            table=name, **extra)
         self._c_samples = reg.counter(
-            "distar_replay_samples_total", "trajectory samples served", table=name)
+            "distar_replay_samples_total", "trajectory samples served",
+            table=name, **extra)
         self._c_evict = {
             reason: reg.counter(
                 "distar_replay_evictions_total", "items evicted by policy",
-                table=name, reason=reason,
+                table=name, reason=reason, **extra,
             )
             for reason in ("size", "staleness")
         }
         self._g_size = reg.gauge(
-            "distar_replay_table_size", "items resident in the table", table=name)
+            "distar_replay_table_size", "items resident in the table",
+            table=name, **extra)
         self._g_occ = reg.gauge(
             "distar_replay_table_occupancy", "resident share of max_size (0..1)",
-            table=name)
+            table=name, **extra)
         self._h_staleness = reg.histogram(
             "distar_replay_sampled_staleness_seconds",
-            "age of items at sampling time", table=name)
+            "age of items at sampling time", table=name, **extra)
         self._h_reuse = reg.histogram(
             "distar_replay_sampled_reuse",
-            "per-item sample count at sampling time", table=name)
+            "per-item sample count at sampling time", table=name, **extra)
 
     # ------------------------------------------------------------- internals
     def _slot(self, seq: int) -> int:
@@ -473,6 +486,7 @@ class ReplayTable:
             newest = max((now - i.ts for i in self._items.values()), default=0.0)
         return {
             "name": self.name,
+            **({"shard": self.shard} if self.shard else {}),
             "size": n,
             "max_size": self.config.max_size,
             "occupancy": round(n / self.config.max_size, 4),
@@ -489,19 +503,38 @@ class ReplayStore:
     per-player tables appear as the league mints players, no pre-declaration
     step."""
 
+    #: bound on remembered insert idempotency keys (an LRU of the newest
+    #: ones; far larger than any retry window's in-flight count)
+    IDEM_CACHE = 8192
+
     def __init__(self, table_factory: Optional[Callable[[str], TableConfig]] = None,
-                 spill: Optional[object] = None):
+                 spill: Optional[object] = None, shard_id: str = "",
+                 recover_encoded: bool = False):
         self._factory = table_factory
         self._spill = spill
+        self.shard_id = shard_id
+        #: recover spilled items as pre-encoded ``Opaque`` payloads — skips
+        #: the unpickle on recovery AND the recompress on every wire
+        #: re-serve (the serving roles turn this on; default off so direct
+        #: in-process consumers keep seeing plain objects)
+        self._recover_encoded = recover_encoded
         self._tables: Dict[str, ReplayTable] = {}
+        self._idem: Dict[str, int] = {}  # idem key -> acked seq (insertion-ordered)
         self._lock = threading.Lock()
+        self._c_dedup = get_registry().counter(
+            "distar_replay_insert_dedup_total",
+            "retried inserts answered from the idempotency cache "
+            "(ack lost after commit — without this they double-apply)",
+            **({"shard": shard_id} if shard_id else {}),
+        )
 
     # --------------------------------------------------------------- tables
     def create_table(self, name: str, config: Optional[TableConfig] = None) -> ReplayTable:
         with self._lock:
             if name in self._tables:
                 return self._tables[name]
-            table = ReplayTable(name, config=config, on_release=self._make_release())
+            table = ReplayTable(name, config=config, on_release=self._make_release(),
+                                shard=self.shard_id)
             self._tables[name] = table
             return table
 
@@ -529,7 +562,8 @@ class ReplayStore:
 
     # ------------------------------------------------------------------ ops
     def insert(self, table: str, item: Any, priority: float = 1.0,
-               timeout_s: Optional[float] = 60.0) -> int:
+               timeout_s: Optional[float] = 60.0,
+               idem: Optional[str] = None) -> int:
         """Durable acked insert: the item lands on disk — fsync'd, CRC'd —
         and THEN in the table, before the seq is returned. The spill write
         must come first: the moment ``tbl.insert`` makes the item live, a
@@ -540,7 +574,21 @@ class ReplayStore:
         released here — the caller was never acked. A crash between append
         and insert leaves an unacked blob that recovery re-inserts; the
         producer's retry makes that the documented at-least-once duplicate,
-        never a loss."""
+        never a loss.
+
+        ``idem`` makes a client retry safe against the *ambiguous* failure
+        (server committed, ack lost on the wire): a repeated key within the
+        bounded cache window answers the original seq without re-applying —
+        no duplicate item, no duplicate spill blob, no double limiter
+        commit. The cache is process-lifetime only; a retry that crosses a
+        store restart still lands as the documented at-least-once
+        duplicate."""
+        if idem is not None:
+            with self._lock:
+                cached = self._idem.get(idem)
+            if cached is not None:
+                self._c_dedup.inc()
+                return cached
         tbl = self.table(table)
         spill_key = None
         if self._spill is not None:
@@ -553,6 +601,11 @@ class ReplayStore:
             if spill_key is not None:
                 self._spill.release(spill_key)
             raise
+        if idem is not None:
+            with self._lock:
+                self._idem[idem] = seq
+                while len(self._idem) > self.IDEM_CACHE:
+                    self._idem.pop(next(iter(self._idem)))
         return seq
 
     def sample(self, table: str, batch_size: int = 1,
@@ -568,7 +621,7 @@ class ReplayStore:
         if self._spill is None:
             return 0
         n = 0
-        for rec in self._spill.recover():
+        for rec in self._spill.recover(keep_encoded=self._recover_encoded):
             tbl = self.table(rec["table"])
             tbl.insert(rec["item"], priority=rec["priority"],
                        spill_key=rec["key"], restore=True)
@@ -577,6 +630,8 @@ class ReplayStore:
 
     def stats(self) -> dict:
         out = {"tables": {name: self.table(name).stats() for name in self.tables()}}
+        if self.shard_id:
+            out["shard"] = self.shard_id
         if self._spill is not None:
             out["spill"] = self._spill.stats()
         return out

@@ -1,0 +1,531 @@
+"""Sharded replay fleet coverage: consistent-hash stability, fleet routing,
+fan-in isolation, wire-compression negotiation, the zero-copy colocated
+fast path, and the insert idempotency contract (docs/data_plane.md
+sharding section)."""
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from distar_tpu.comm.serializer import Opaque, dumps
+from distar_tpu.obs import get_registry
+from distar_tpu.replay import (
+    HashRing,
+    InsertClient,
+    LocalReplayClient,
+    RateLimitTimeout,
+    ReplayServer,
+    ReplayStore,
+    SampleClient,
+    ShardMap,
+    ShardedInsertClient,
+    ShardedSampleClient,
+    SpillRing,
+    TableConfig,
+    UnknownTableError,
+    set_local_store,
+    stable_hash,
+)
+from distar_tpu.resilience import RetryPolicy
+
+
+def _cfg(**kw):
+    base = dict(max_size=256, sampler="uniform", samples_per_insert=None,
+                min_size_to_sample=1)
+    base.update(kw)
+    return TableConfig(**base)
+
+
+def _fleet(n, table_cfg=None, spill_dirs=None, **server_kw):
+    """n in-process shard servers + their ShardMap."""
+    servers = []
+    for i in range(n):
+        spill = SpillRing(spill_dirs[i], max_items=1024) if spill_dirs else None
+        store = ReplayStore(table_factory=table_cfg or (lambda name: _cfg()),
+                            spill=spill, shard_id=f"s{i}",
+                            recover_encoded=True)
+        store.recover()
+        servers.append(ReplayServer(store, port=0, **server_kw).start())
+    return servers, ShardMap([f"{s.host}:{s.port}" for s in servers])
+
+
+def _registry_sum(prefix):
+    return sum(v for k, v in get_registry().snapshot().items()
+               if k.startswith(prefix))
+
+
+# ---------------------------------------------------------------- hash ring
+def test_ring_deterministic_within_process():
+    a = ShardMap(["h1:1", "h2:2", "h3:3"])
+    b = ShardMap(["h1:1", "h2:2", "h3:3"])
+    keys = [f"k{i}" for i in range(200)]
+    assert [a.shard_for("T", k) for k in keys] == [b.shard_for("T", k) for k in keys]
+
+
+def test_ring_deterministic_across_processes():
+    """The routing function must agree between an actor process and a
+    learner process: PYTHONHASHSEED-salted ``hash()`` would not, md5 does."""
+    keys = [f"key-{i}" for i in range(64)]
+    local = [ShardMap(["a:1", "b:2", "c:3"]).shard_for("MP0", k) for k in keys]
+    code = (
+        "from distar_tpu.replay import ShardMap\n"
+        "m = ShardMap(['a:1', 'b:2', 'c:3'])\n"
+        f"print('\\n'.join(m.shard_for('MP0', f'key-{{i}}') for i in range({len(keys)})))\n"
+    )
+    out = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True, check=True,
+        env={**os.environ, "PYTHONHASHSEED": "12345", "JAX_PLATFORMS": "cpu"},
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    assert out.stdout.strip().splitlines() == local
+
+
+def test_ring_growth_remaps_bounded_fraction():
+    """Consistent hashing's point: N -> N+1 moves only ~1/(N+1) of keys
+    (naive mod-N routing moves ~N/(N+1) — almost everything)."""
+    n = 4
+    addrs = [f"h{i}:{i}" for i in range(n)]
+    keys = [f"k{i}" for i in range(2000)]
+    before = {k: ShardMap(addrs).shard_for("T", k) for k in keys}
+    after = {k: ShardMap(addrs + [f"h{n}:{n}"]).shard_for("T", k) for k in keys}
+    moved = sum(1 for k in keys if before[k] != after[k])
+    ideal = 1.0 / (n + 1)
+    assert moved / len(keys) < 1.6 * ideal, (moved, len(keys))
+    # and every move went TO the new shard (nothing shuffles between
+    # survivors — the property mod-N lacks)
+    assert all(after[k] == f"h{n}:{n}" for k in keys if before[k] != after[k])
+
+
+def test_ring_spreads_keys_reasonably():
+    addrs = ["h1:1", "h2:2", "h3:3"]
+    m = ShardMap(addrs)
+    from collections import Counter
+
+    counts = Counter(m.shard_for("T", f"k{i}") for i in range(3000))
+    assert set(counts) == set(addrs)  # every shard owns some keys
+    assert max(counts.values()) < 2 * min(counts.values())
+
+
+def test_stable_hash_is_not_pyhash():
+    assert stable_hash("x") == stable_hash("x")
+    assert stable_hash("x") != hash("x")  # astronomically unlikely to collide
+
+
+def test_shard_map_parse_and_validation():
+    m = ShardMap.parse("a:1, b:2 ,a:1")
+    assert m.addrs == ["a:1", "b:2"]  # order-preserving dedupe
+    assert len(m) == 2
+    with pytest.raises(ValueError):
+        ShardMap([])
+
+
+# ----------------------------------------------------------- sharded clients
+def test_insert_routes_by_key_and_sample_pair_lands_same_shard():
+    servers, shard_map = _fleet(3)
+    try:
+        ic = ShardedInsertClient(shard_map)
+        keys = [f"ep{i}" for i in range(30)]
+        for k in keys:
+            ic.insert("MP0", {"k": k}, key=k, timeout_s=5.0)
+        # the item physically lives on the shard the routing function names
+        by_addr = {
+            f"{s.host}:{s.port}": (
+                {it.data["k"] for it in s.store.table("MP0")._items.values()}
+                if "MP0" in s.store.tables() else set())
+            for s in servers
+        }
+        for k in keys:
+            owner = ic.shard_for("MP0", k)
+            assert k in by_addr[owner]
+            # insert/sample pair: the sample side's routing agrees
+            assert ShardedSampleClient(shard_map).shard_map.shard_for("MP0", k) == owner
+        ic.close()
+    finally:
+        for s in servers:
+            s.stop()
+
+
+def test_fanin_serves_all_shards_and_tags_info():
+    servers, shard_map = _fleet(3)
+    try:
+        ic = ShardedInsertClient(shard_map)
+        for i in range(30):
+            ic.insert("MP0", i, timeout_s=5.0)
+        sc = ShardedSampleClient(shard_map)
+        seen = set()
+        for _ in range(20):
+            _items, info = sc.sample("MP0", batch_size=2, timeout_s=5.0)
+            seen.update(d["shard"] for d in info)
+            assert all("seq" in d for d in info)
+        assert seen == set(shard_map.addrs)  # round-robin touched everyone
+        ic.close()
+        sc.close()
+    finally:
+        for s in servers:
+            s.stop()
+
+
+def test_stalled_shard_blocks_only_itself():
+    """Per-shard limiter invariant: one shard whose spi limiter cannot admit
+    a sample (no inserts ever landed there) must not park the fan-in — the
+    rotation skips it and serves from the fed shards within the timeout."""
+    servers, shard_map = _fleet(
+        2, table_cfg=lambda name: _cfg(samples_per_insert=1.0, error_buffer=8.0))
+    try:
+        # feed ONLY shard 0, directly (bypassing the ring on purpose);
+        # 6 inserts stay inside the limiter's insert-ahead window (eb=8)
+        direct = InsertClient(servers[0].host, servers[0].port)
+        for i in range(6):
+            direct.insert("MP0", i, timeout_s=5.0)
+        sc = ShardedSampleClient(shard_map)
+        t0 = time.monotonic()
+        items, info = sc.sample("MP0", batch_size=2, timeout_s=10.0)
+        assert time.monotonic() - t0 < 8.0  # did not burn the whole budget
+        fed = f"{servers[0].host}:{servers[0].port}"
+        assert {d["shard"] for d in info} == {fed}
+        assert _registry_sum("distar_replay_fanin_skips_total") >= 0
+        direct.close()
+        sc.close()
+    finally:
+        for s in servers:
+            s.stop()
+
+
+def test_fanin_rides_through_shard_kill_and_restart_recovers(tmp_path):
+    """The test-sized shard-loss drill: kill 1 of 3, the learner keeps
+    sampling from survivors; restart over the same spill brings the
+    victim's unsampled tail back (tools/chaos.py replay-drill --shards is
+    the CLI-scale version)."""
+    spill_dirs = [str(tmp_path / f"s{i}") for i in range(3)]
+    servers, shard_map = _fleet(
+        3, table_cfg=lambda name: _cfg(sampler="fifo"), spill_dirs=spill_dirs)
+    try:
+        ic = ShardedInsertClient(shard_map)
+        keys = [f"k{i}" for i in range(24)]
+        owner = {k: ic.shard_for("MP0", k) for k in keys}
+        for k in keys:
+            ic.insert("MP0", {"k": k}, key=k, timeout_s=5.0)
+        victim_addr = f"{servers[0].host}:{servers[0].port}"
+        victim_port = servers[0].port
+        victim_keys = {k for k in keys if owner[k] == victim_addr}
+        assert victim_keys, "hash ring gave shard 0 nothing — widen the key set"
+        servers[0].stop()
+
+        sc = ShardedSampleClient(shard_map)
+        got = set()
+        deadline = time.monotonic() + 20.0
+        while len(got) < len(keys) - len(victim_keys) and time.monotonic() < deadline:
+            try:
+                items, info = sc.sample("MP0", batch_size=1, timeout_s=2.0)
+            except RateLimitTimeout:
+                continue
+            got.update(it["k"] for it in items)
+            assert all(d["shard"] != victim_addr for d in info)
+        assert got == set(keys) - victim_keys  # survivors fully served
+
+        # restart the victim over its spill, same address
+        store = ReplayStore(table_factory=lambda name: _cfg(sampler="fifo"),
+                            spill=SpillRing(spill_dirs[0], max_items=1024),
+                            shard_id="s0", recover_encoded=True)
+        recovered = store.recover()
+        assert recovered == len(victim_keys)
+        servers[0] = ReplayServer(store, host=servers[0].host,
+                                  port=victim_port).start()
+        deadline = time.monotonic() + 20.0
+        while len(got) < len(keys) and time.monotonic() < deadline:
+            try:
+                items, _info = sc.sample("MP0", batch_size=1, timeout_s=2.0)
+            except RateLimitTimeout:
+                continue
+            got.update(it["k"] for it in items)
+        assert got == set(keys)  # zero items lost fleet-wide
+        ic.close()
+        sc.close()
+    finally:
+        for s in servers:
+            s.stop()
+
+
+def test_fanin_unknown_table_raises_only_when_no_shard_has_it():
+    servers, shard_map = _fleet(2, table_cfg=None)
+    # no factory: tables must pre-exist
+    for s in servers:
+        s.store._factory = None
+    try:
+        sc = ShardedSampleClient(shard_map)
+        with pytest.raises(UnknownTableError):
+            sc.sample("nope", timeout_s=2.0)
+        # one shard grows the table -> fan-in finds it
+        servers[1].store.create_table("late", _cfg())
+        servers[1].store.insert("late", {"v": 1})
+        items, _ = sc.sample("late", timeout_s=5.0)
+        assert items == [{"v": 1}]
+        sc.close()
+    finally:
+        for s in servers:
+            s.stop()
+
+
+def test_sharded_update_priorities_routes_by_info():
+    servers, shard_map = _fleet(
+        2, table_cfg=lambda name: _cfg(sampler="prioritized"))
+    try:
+        ic = ShardedInsertClient(shard_map)
+        for i in range(16):
+            ic.insert("MP0", i, timeout_s=5.0)
+        sc = ShardedSampleClient(shard_map)
+        _items, info = sc.sample("MP0", batch_size=4, timeout_s=5.0)
+        updates = {d["seq"]: 50.0 for d in info}
+        applied = sc.update_priorities("MP0", updates, info=info)
+        assert applied == len({d["seq"] for d in info})
+        ic.close()
+        sc.close()
+    finally:
+        for s in servers:
+            s.stop()
+
+
+def test_fleet_stats_reports_dead_shards_without_raising():
+    servers, shard_map = _fleet(2)
+    try:
+        servers[1].stop()
+        sc = ShardedSampleClient(shard_map)
+        stats = sc.fleet_stats()
+        assert set(stats) == set(shard_map.addrs)
+        dead = f"{servers[1].host}:{servers[1].port}"
+        assert "error" in stats[dead]
+        alive = next(a for a in shard_map.addrs if a != dead)
+        assert "tables" in stats[alive]
+        sc.close()
+    finally:
+        servers[0].stop()
+
+
+# -------------------------------------------------------- wire compression
+def test_compression_negotiation_and_byte_metrics():
+    store = ReplayStore(table_factory=lambda name: _cfg())
+    server = ReplayServer(store, port=0).start()
+    payload = b"\x00" * 100_000  # maximally compressible
+    try:
+        on = InsertClient(server.host, server.port, compress=True)
+        before_w = _registry_sum("distar_replay_rx_bytes_wire_total")
+        before_r = _registry_sum("distar_replay_rx_bytes_raw_total")
+        on.insert("T", payload, timeout_s=5.0)
+        wire_on = _registry_sum("distar_replay_rx_bytes_wire_total") - before_w
+        raw_on = _registry_sum("distar_replay_rx_bytes_raw_total") - before_r
+        assert on._neg_compress is True
+        assert raw_on > 100_000
+        assert wire_on < raw_on / 10  # compression actually engaged
+
+        off = InsertClient(server.host, server.port, compress=False)
+        before_w = _registry_sum("distar_replay_rx_bytes_wire_total")
+        off.insert("T", payload, timeout_s=5.0)
+        wire_off = _registry_sum("distar_replay_rx_bytes_wire_total") - before_w
+        assert off._neg_compress is False
+        assert wire_off > 100_000  # sent raw, as negotiated
+        on.close()
+        off.close()
+    finally:
+        server.stop()
+
+
+def test_server_side_compress_disable_wins_negotiation():
+    store = ReplayStore(table_factory=lambda name: _cfg())
+    server = ReplayServer(store, port=0, compress=False).start()
+    try:
+        client = InsertClient(server.host, server.port, compress=True)
+        client.insert("T", b"\x00" * 1000, timeout_s=5.0)
+        assert client._neg_compress is False  # server's refusal is ANDed in
+        client.close()
+    finally:
+        server.stop()
+
+
+def test_spill_reserve_skips_recompression(tmp_path):
+    """A store that recovered with ``recover_encoded`` holds Opaque blobs
+    and re-serves them WITHOUT a recompression pass (uncompressed frame
+    around already-compressed payload); the client decodes transparently."""
+    spill = SpillRing(str(tmp_path), max_items=64)
+    store = ReplayStore(table_factory=lambda name: _cfg(), spill=spill)
+    original = {"traj": list(range(100)), "pad": b"\x00" * 10_000}
+    store.insert("MP0", original)
+
+    fresh = ReplayStore(table_factory=lambda name: _cfg(),
+                        spill=SpillRing(str(tmp_path), max_items=64),
+                        recover_encoded=True)
+    assert fresh.recover() == 1
+    item = next(iter(fresh.table("MP0")._items.values()))
+    assert isinstance(item.data, Opaque)  # resident as the encoded blob
+    server = ReplayServer(fresh, port=0).start()
+    try:
+        before = _registry_sum("distar_replay_tx_bytes_raw_total")
+        before_wire = _registry_sum("distar_replay_tx_bytes_wire_total")
+        sc = SampleClient(server.host, server.port)
+        items, _info = sc.sample("MP0", timeout_s=5.0)
+        assert items[0] == original  # client decoded the Opaque transparently
+        raw = _registry_sum("distar_replay_tx_bytes_raw_total") - before
+        wire = _registry_sum("distar_replay_tx_bytes_wire_total") - before_wire
+        # the frame went out UNcompressed (raw==wire up to the magic): had
+        # the server recompressed, wire would be well below raw
+        assert wire == pytest.approx(raw, abs=16)
+        sc.close()
+    finally:
+        server.stop()
+
+
+# ------------------------------------------------------ colocated fast path
+def test_local_client_is_zero_copy():
+    store = ReplayStore(table_factory=lambda name: _cfg())
+    client = LocalReplayClient(store)
+    obj = {"arr": bytearray(1000)}
+    client.insert("T", obj)
+    items, info = client.sample("T", batch_size=1)
+    assert items[0] is obj  # the object itself — no serialization happened
+    assert info[0]["seq"] == 0
+
+
+def test_local_client_decodes_recovered_opaque(tmp_path):
+    spill = SpillRing(str(tmp_path), max_items=64)
+    ReplayStore(table_factory=lambda name: _cfg(), spill=spill).insert(
+        "T", {"v": 7})
+    fresh = ReplayStore(table_factory=lambda name: _cfg(),
+                        spill=SpillRing(str(tmp_path), max_items=64),
+                        recover_encoded=True)
+    fresh.recover()
+    items, _ = LocalReplayClient(fresh).sample("T", timeout_s=5.0)
+    assert items[0] == {"v": 7}
+
+
+def test_local_store_registry_required_for_inproc_addr():
+    set_local_store(None)
+    with pytest.raises(RuntimeError):
+        LocalReplayClient()
+    store = ReplayStore(table_factory=lambda name: _cfg())
+    set_local_store(store)
+    try:
+        client = LocalReplayClient()
+        client.insert("T", 1)
+        assert client.sample("T")[0] == [1]
+    finally:
+        set_local_store(None)
+
+
+def test_actor_replay_target_accepts_fleet_and_inproc():
+    from distar_tpu.actor import Actor
+
+    actor = Actor(cfg={"actor": {"replay": {
+        "enabled": True, "addr": "h1:7000,h2:7001"}}})
+    assert actor._replay_target() == [("h1", 7000), ("h2", 7001)]
+    actor = Actor(cfg={"actor": {"replay": {"enabled": True, "addr": "inproc"}}})
+    assert actor._replay_target() == "inproc"
+    with pytest.raises(ValueError):
+        Actor(cfg={"actor": {"replay": {"enabled": True, "addr": "h1:x,h2:y"}}})
+
+
+# ------------------------------------------------------- insert idempotency
+def test_retried_insert_after_lost_ack_does_not_double_apply(tmp_path):
+    """The ambiguous-failure regression: server commits the insert (table +
+    spill), then the connection dies before the ack. The client's retry
+    must be answered from the idem cache — one item, one spill blob, the
+    ORIGINAL seq."""
+    spill = SpillRing(str(tmp_path), max_items=64)
+    store = ReplayStore(table_factory=lambda name: _cfg(), spill=spill)
+    server = ReplayServer(store, port=0).start()
+    original_send = server._send_counted
+    dropped = []
+
+    def drop_first_ack(conn, obj, compress):
+        if not dropped and isinstance(obj, dict) and "seq" in obj:
+            dropped.append(obj["seq"])
+            conn.close()  # post-commit reset: the ack dies on the wire
+            raise ConnectionError("chaos: ack dropped after commit")
+        return original_send(conn, obj, compress)
+
+    server._send_counted = drop_first_ack
+    try:
+        client = InsertClient(server.host, server.port,
+                              retry_policy=RetryPolicy(max_attempts=4,
+                                                       backoff_base_s=0.01,
+                                                       deadline_s=10.0))
+        seq = client.insert("T", {"v": 1}, timeout_s=5.0)
+        assert dropped, "the chaos hook never fired"
+        assert seq == dropped[0]  # the retry got the ORIGINAL seq
+        assert store.table("T").size() == 1  # not double-applied
+        assert spill.live_count() == 1  # no duplicate blob either
+        assert _registry_sum("distar_replay_insert_dedup_total") >= 1
+        client.close()
+    finally:
+        server._send_counted = original_send
+        server.stop()
+
+
+def test_distinct_inserts_never_dedup():
+    store = ReplayStore(table_factory=lambda name: _cfg())
+    server = ReplayServer(store, port=0).start()
+    try:
+        client = InsertClient(server.host, server.port)
+        seqs = [client.insert("T", i, timeout_s=5.0) for i in range(10)]
+        assert len(set(seqs)) == 10
+        assert store.table("T").size() == 10
+        client.close()
+    finally:
+        server.stop()
+
+
+def test_idem_cache_is_bounded():
+    store = ReplayStore(table_factory=lambda name: _cfg(max_size=16))
+    store.IDEM_CACHE = 4
+    for i in range(10):
+        store.insert("T", i, idem=f"id{i}")
+    assert len(store._idem) == 4
+    assert "id9" in store._idem and "id0" not in store._idem
+
+
+# ---------------------------------------------------------- coordinator map
+def test_shard_map_discovery_via_coordinator_peers():
+    from distar_tpu.comm import Coordinator, CoordinatorServer
+    from distar_tpu.replay import register_shard
+
+    co = CoordinatorServer(coordinator=Coordinator())
+    co.start()
+    try:
+        hb1 = register_shard((co.host, co.port), "10.0.0.1", 7000,
+                             meta={"admin_port": 9000}, lease_s=30.0)
+        hb2 = register_shard((co.host, co.port), "10.0.0.2", 7000, lease_s=30.0)
+        m = ShardMap.discover((co.host, co.port))
+        assert m.addrs == ["10.0.0.1:7000", "10.0.0.2:7000"]
+        # peers is non-destructive: a second discovery sees the same fleet
+        assert ShardMap.discover((co.host, co.port)).addrs == m.addrs
+        hb1.stop_event.set()
+        hb2.stop_event.set()
+    finally:
+        co.stop()
+
+
+def test_shard_map_discovery_empty_fleet_raises():
+    from distar_tpu.comm import Coordinator, CoordinatorServer
+
+    co = CoordinatorServer(coordinator=Coordinator())
+    co.start()
+    try:
+        with pytest.raises(ValueError):
+            ShardMap.discover((co.host, co.port))
+    finally:
+        co.stop()
+
+
+def test_hash_ring_single_node_owns_everything():
+    ring = HashRing(["only:1"])
+    assert all(ring.lookup(f"k{i}") == "only:1" for i in range(50))
+
+
+def test_opaque_roundtrip():
+    blob = dumps({"x": 1})
+    o = Opaque(blob)
+    assert o.decode() == {"x": 1}
+    import pickle
+
+    assert pickle.loads(pickle.dumps(o)).decode() == {"x": 1}

@@ -38,6 +38,7 @@ import json
 import os
 import signal
 import sys
+import time
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if _REPO not in sys.path:
@@ -74,13 +75,136 @@ def cmd_reset(args) -> int:
     return 0 if n else 1
 
 
+def _sharded_replay_drill(args) -> int:
+    """Shard-loss drill: N consistent-hash shards, one killed mid-run.
+
+    Proves the fleet contract the single-store drill cannot: (a) the
+    learner-side fan-in rides through the kill on the surviving shards
+    without assistance, (b) ONLY the killed shard's unsampled tail goes
+    missing while it is down (every surviving item is attributable to a
+    live shard by the routing function), and (c) restarting the shard over
+    its own spill directory restores exactly that tail — zero items lost
+    fleet-wide. Consume-once (fifo) tables make the ledger exact: each key
+    is sampleable exactly once, so set arithmetic is the whole proof."""
+    from distar_tpu.replay import (
+        ReplayServer, ReplayStore, ShardMap, ShardedInsertClient,
+        ShardedSampleClient, SpillRing, TableConfig,
+    )
+
+    def table_cfg(_name):
+        return TableConfig(max_size=max(args.items * 2, 8), sampler="fifo",
+                           samples_per_insert=None, min_size_to_sample=1)
+
+    def build_store(i):
+        spill = None if args.no_spill else SpillRing(
+            os.path.join(args.dir, f"s{i}"), max_items=args.items * 2)
+        store = ReplayStore(table_factory=table_cfg, spill=spill,
+                            shard_id=f"s{i}", recover_encoded=True)
+        return store, store.recover()
+
+    inj = ChaosInjector(seed=args.seed)
+    servers = [ReplayServer(build_store(i)[0], port=0).start()
+               for i in range(args.shards)]
+    addrs = [f"{s.host}:{s.port}" for s in servers]
+    shard_map = ShardMap(addrs)
+    inserter = ShardedInsertClient(shard_map)
+
+    keys = [f"k{i}" for i in range(args.items)]
+    owner = {k: inserter.shard_for("drill", k) for k in keys}
+    for k in keys:
+        inserter.insert("drill", {"k": k}, key=k, timeout_s=10.0)
+
+    sampler = ShardedSampleClient(shard_map)
+
+    def drain(budget_s: float, want=None) -> set:
+        """Fan-in sample until ``want`` is fully seen or the budget lapses.
+        A timeout is NOT terminal: a restarted shard sits behind an open
+        circuit breaker for a few seconds, so the loop keeps offering until
+        the budget says the remainder is genuinely unreachable."""
+        got, deadline = set(), time.monotonic() + budget_s
+        while time.monotonic() < deadline:
+            if want is not None and want <= got:
+                break
+            try:
+                items, _info = sampler.sample(
+                    "drill", batch_size=1,
+                    timeout_s=min(1.0, max(0.1, deadline - time.monotonic())))
+            except Exception:
+                time.sleep(0.2)
+                continue
+            got.update(it["k"] for it in items)
+        return got
+
+    # phase 1: train a while, then the chaos moment — kill shard 0 with
+    # part of its table acked and unsampled
+    pre = drain_n(sampler, keys, args.items // 4)
+    victim = addrs[0]
+    inj.kill_role(servers[0], name=f"replay:{victim}")
+
+    # phase 2: the learner keeps sampling unassisted; everything still
+    # reachable must come from surviving shards
+    survivors = {k for k in keys if owner[k] != victim}
+    mid = drain(15.0, want=survivors - pre)
+    assert all(owner[k] != victim for k in mid), \
+        "sampled a key from the dead shard?!"
+    missing = set(keys) - pre - mid
+    wrong = [k for k in missing if owner[k] != victim]
+
+    # phase 3: restart the killed shard over its spill; its tail comes back
+    store2, recovered = build_store(0)
+    host, port = victim.rsplit(":", 1)
+    server2 = ReplayServer(store2, host=host, port=int(port)).start()
+    servers[0] = server2
+    post = drain(20.0, want=missing)
+    lost = set(keys) - pre - mid - post
+    for s in servers:
+        s.stop()
+
+    verdict = {
+        "shards": args.shards, "items": args.items, "killed": victim,
+        "sampled_pre_kill": len(pre), "sampled_during_outage": len(mid),
+        "unreachable_during_outage": len(missing),
+        "unreachable_not_owned_by_victim": len(wrong),
+        "recovered_from_spill": recovered,
+        "sampled_after_restart": len(post), "lost_fleet_wide": len(lost),
+        "spill": not args.no_spill, "events": [e["kind"] for e in inj.events],
+    }
+    print(json.dumps(verdict))
+    if args.no_spill:
+        ok = len(lost) == len(missing) and len(missing) > 0
+        print("verdict: shard loss demonstrated without spill"
+              if ok else "verdict: UNEXPECTED — nothing lost?")
+        return 0 if ok else 1
+    ok = (not wrong and not lost and recovered == len(missing)
+          and len(missing) > 0 and len(mid) > 0)
+    print("verdict: learner rode through the shard kill; the killed shard's "
+          "tail recovered from spill; zero items lost fleet-wide"
+          if ok else "verdict: DRILL FAILED")
+    return 0 if ok else 1
+
+
+def drain_n(sampler, keys, n: int) -> set:
+    """Sample until ``n`` unique keys were consumed (pre-kill warmup)."""
+    got = set()
+    while len(got) < n:
+        items, _info = sampler.sample("drill", batch_size=1, timeout_s=10.0)
+        got.update(it["k"] for it in items)
+    return got
+
+
 def cmd_replay_drill(args) -> int:
-    """Kill-the-store-mid-run drill on a real server + real clients."""
+    """Kill-the-store-mid-run drill on a real server + real clients.
+    ``--shards N`` (N > 1) runs the shard-loss variant instead; ``--shards
+    1`` is the original whole-store kill — the counter-demo that a single
+    store loses its entire unsampled tail where the fleet loses 1/N."""
     from distar_tpu.replay import (
         InsertClient, ReplayServer, ReplayStore, SampleClient, SpillRing,
         TableConfig,
     )
     from distar_tpu.resilience import RetryPolicy
+
+    if args.shards > 1:
+        return _sharded_replay_drill(args)
 
     def table_cfg(_name):
         return TableConfig(max_size=max(args.items * 2, 8),
@@ -250,6 +374,13 @@ def main() -> int:
                        help="kill a replay store mid-run; prove spill recovery")
     d.add_argument("--dir", required=True, help="spill directory")
     d.add_argument("--items", type=int, default=50, help="acked inserts before the kill")
+    d.add_argument("--shards", type=int, default=1,
+                   help="N > 1: shard-loss variant — kill 1 of N "
+                        "consistent-hash shards mid-run; the learner must "
+                        "ride through on the rest, only the victim's "
+                        "unsampled tail may go missing, and its restart "
+                        "must spill-recover exactly that tail (--shards 1 "
+                        "is the whole-store counter-demo)")
     d.add_argument("--no-spill", action="store_true",
                    help="counter-demo: run without durability and show the loss")
     d.add_argument("--seed", type=int, default=0)

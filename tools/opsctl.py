@@ -21,7 +21,11 @@ rates, serve shed rate) from the ``distar_actor_*``/``distar_rollout_*``/
 ok/warning/firing edge, deduped by event sequence). When the probed address
 is a replay admin surface (``--type replay`` with ``--metrics-port``),
 ``status`` additionally prints per-table occupancy and rate-limiter state
-from GET ``/replay/stats``. ``profile`` talks to a LEARNER ADMIN surface
+from GET ``/replay/stats`` — and for a SHARD FLEET it aggregates: pass the
+admin surfaces via ``--replay-addrs a:p,b:p,...`` or probe the coordinator,
+whose ``replay_shard`` registrations are auto-discovered; the digest then
+shows every shard's tables plus a fleet-aggregate line (total residency,
+summed limiter block time, staleness span). ``profile`` talks to a LEARNER ADMIN surface
 (``rl_train --admin-port``): captures --steps iterations of jax.profiler
 trace on the live learner and prints the ranked per-bucket attribution
 table (obs/traceview.py).
@@ -87,30 +91,88 @@ def _try_get(addr: str, path: str, timeout: float = 5.0):
         return None
 
 
-def _print_replay(stats: dict) -> None:
-    """Replay-store digest for ``status``: per-table occupancy + the rate
-    limiter's live state (the two numbers that say which side of the fleet
-    is behind)."""
-    tables = stats.get("tables", {})
-    if not tables:
+def _discover_replay_admins(addr: str, timeout: float = 5.0) -> list:
+    """Shard-fleet discovery for ``status``: when the probed address is a
+    coordinator, its ``replay_shard`` registrations (POST /coordinator/peers)
+    name every live shard and the admin port each put in its meta. Returns
+    admin addresses; [] when the address isn't a coordinator (or no shard
+    registered) — never exits."""
+    try:
+        req = urllib.request.Request(
+            f"http://{addr}/coordinator/peers",
+            data=json.dumps({"token": "replay_shard"}).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            body = json.loads(resp.read())
+    except (urllib.error.URLError, ConnectionError, TimeoutError, OSError,
+            ValueError):
+        return []
+    admins = []
+    for rec in (body.get("info") or []):
+        admin_port = (rec.get("meta") or {}).get("admin_port")
+        if admin_port:
+            admins.append(f"{rec['ip']}:{admin_port}")
+    return sorted(set(admins))
+
+
+def _print_replay(per_shard: dict) -> None:
+    """Replay digest for ``status``, fleet-aware: one block per shard
+    (occupancy + rate-limiter state per table — the numbers that say which
+    side of the fleet is behind and WHICH shard), then the fleet aggregate
+    (total residency, summed limiter block time, staleness span). A
+    single-store probe is just a one-shard fleet."""
+    per_shard = {k: v for k, v in per_shard.items() if v}
+    if not per_shard:
         return
-    print("replay tables:")
-    for name in sorted(tables):
-        t = tables[name]
-        lim = t.get("limiter", {})
-        spi = lim.get("samples_per_insert")
-        blocked = ("insert" if not lim.get("can_insert", True) else
-                   "sample" if not lim.get("can_sample", True) else "-")
-        print(f"  {name:<16} {t.get('size', 0):>6}/{t.get('max_size', 0):<6} "
-              f"occ={t.get('occupancy', 0.0):5.2f}  sampler={t.get('sampler', '?'):<11} "
-              f"spi={spi if spi is not None else 'off':<5} "
-              f"ins={lim.get('inserts', 0)} smp={lim.get('samples', 0)} "
-              f"blocked={blocked} "
-              f"block_s=ins:{lim.get('block_insert_s', 0.0)}/smp:{lim.get('block_sample_s', 0.0)}")
-    spill = stats.get("spill")
-    if spill:
-        print(f"  spill: {spill.get('live')}/{spill.get('max_items')} live "
-              f"({spill.get('root')})")
+    fleet = len(per_shard) > 1
+    agg = {"size": 0, "max": 0, "ins": 0, "smp": 0,
+           "block_ins": 0.0, "block_smp": 0.0,
+           "stale_min": None, "stale_max": None, "spill_live": 0}
+    print("replay fleet:" if fleet else "replay tables:")
+    for shard_addr in sorted(per_shard):
+        stats = per_shard[shard_addr]
+        tag = f"[{stats.get('shard') or shard_addr}] " if fleet else ""
+        if "error" in stats:
+            print(f"  {tag}UNREACHABLE: {stats['error']}")
+            continue
+        for name in sorted(stats.get("tables", {})):
+            t = stats["tables"][name]
+            lim = t.get("limiter", {})
+            spi = lim.get("samples_per_insert")
+            blocked = ("insert" if not lim.get("can_insert", True) else
+                       "sample" if not lim.get("can_sample", True) else "-")
+            print(f"  {tag}{name:<16} {t.get('size', 0):>6}/{t.get('max_size', 0):<6} "
+                  f"occ={t.get('occupancy', 0.0):5.2f}  sampler={t.get('sampler', '?'):<11} "
+                  f"spi={spi if spi is not None else 'off':<5} "
+                  f"ins={lim.get('inserts', 0)} smp={lim.get('samples', 0)} "
+                  f"blocked={blocked} "
+                  f"block_s=ins:{lim.get('block_insert_s', 0.0)}/smp:{lim.get('block_sample_s', 0.0)}")
+            agg["size"] += t.get("size", 0)
+            agg["max"] += t.get("max_size", 0)
+            agg["ins"] += lim.get("inserts", 0)
+            agg["smp"] += lim.get("samples", 0)
+            agg["block_ins"] += lim.get("block_insert_s", 0.0)
+            agg["block_smp"] += lim.get("block_sample_s", 0.0)
+            for key, side in (("newest_item_s", "stale_min"),
+                              ("oldest_item_s", "stale_max")):
+                v = t.get(key)
+                if v is not None:
+                    cur = agg[side]
+                    pick = min if side == "stale_min" else max
+                    agg[side] = v if cur is None else pick(cur, v)
+        spill = stats.get("spill")
+        if spill:
+            agg["spill_live"] += spill.get("live", 0) or 0
+            print(f"  {tag}spill: {spill.get('live')}/{spill.get('max_items')} live "
+                  f"({spill.get('root')})")
+    if fleet:
+        occ = agg["size"] / agg["max"] if agg["max"] else 0.0
+        stale = (f"staleness={agg['stale_min']}..{agg['stale_max']}s "
+                 if agg["stale_min"] is not None else "")
+        print(f"  aggregate: {len(per_shard)} shards  {agg['size']}/{agg['max']} "
+              f"items (occ={occ:.2f})  ins={agg['ins']} smp={agg['smp']}  "
+              f"block_s=ins:{round(agg['block_ins'], 3)}/smp:{round(agg['block_smp'], 3)}  "
+              f"{stale}spill_live={agg['spill_live']}")
 
 
 # the per-role perf series worth a one-line digest (flattened TSDB keys;
@@ -208,9 +270,21 @@ def cmd_status(args) -> int:
         print(f"tsdb: {tsdb.get('series')} series "
               f"(cap {tsdb.get('max_series')} x {tsdb.get('points_per_series')} pts, "
               f"{tsdb.get('dropped_series')} dropped)")
-    replay = _try_get(args.addr, "/replay/stats")
-    if replay:
-        _print_replay(replay)
+    # replay digest: explicit --replay-addrs fleet, else shards discovered
+    # from a probed coordinator's replay_shard registrations, else the
+    # probed address itself (a single replay admin surface)
+    admin_addrs = ([a.strip() for a in args.replay_addrs.split(",") if a.strip()]
+                   if args.replay_addrs else _discover_replay_admins(args.addr))
+    if admin_addrs:
+        per_shard = {}
+        for admin in admin_addrs:
+            stats = _try_get(admin, "/replay/stats")
+            per_shard[admin] = stats if stats else {"error": "unreachable"}
+        _print_replay(per_shard)
+    else:
+        replay = _try_get(args.addr, "/replay/stats")
+        if replay:
+            _print_replay({args.addr: replay})
     _print_perf_digest(args.addr)
     _print_actor_digest(args.addr)
     return {"ok": 0, "warning": 1}.get(status, 2)
@@ -310,6 +384,11 @@ def main() -> int:
     p.add_argument("--once", action="store_true",
                    help="tail-alerts: print the history once and exit "
                         "(exit 2 when anything is firing)")
+    p.add_argument("--replay-addrs", default="",
+                   help="status: comma-separated replay ADMIN surfaces to "
+                        "aggregate the shard-fleet digest across (default: "
+                        "auto-discover from the probed coordinator's "
+                        "replay_shard registrations, else probe --addr)")
     p.add_argument("--name", default="", help="query: flattened series name")
     p.add_argument("--window", type=float, default=300.0, help="query window seconds")
     p.add_argument("--source", default="", help="query: restrict to one source")

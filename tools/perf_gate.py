@@ -221,8 +221,10 @@ def collect_trajectory(repo: str = _REPO) -> List[dict]:
     for path in sorted(glob.glob(os.path.join(repo, "BENCH_r*.json"))
                        + glob.glob(os.path.join(repo, "BENCH_LOCAL_r*.json"))
                        + glob.glob(os.path.join(repo, "ROLLOUT_r*.json"))
+                       + glob.glob(os.path.join(repo, "REPLAY_SHARD_r*.json"))
                        + glob.glob(os.path.join(repo, "artifacts", "perf_baseline*.json"))
-                       + glob.glob(os.path.join(repo, "artifacts", "rollout_*.json"))):
+                       + glob.glob(os.path.join(repo, "artifacts", "rollout_*.json"))
+                       + glob.glob(os.path.join(repo, "artifacts", "replay_*.json"))):
         try:
             doc = load_artifact(path)
         except (OSError, ValueError):
@@ -232,6 +234,16 @@ def collect_trajectory(repo: str = _REPO) -> List[dict]:
             "metric": doc.get("metric", "?"), "value": doc.get("value"),
             "unit": doc.get("unit", ""), "status": _status_of(doc),
         })
+        fast = doc.get("replay_fast_path") or {}
+        if fast.get("vs_tcp_loopback"):
+            # the sharded-replay artifact carries the colocated fast-path
+            # A/B in-band; surface it as its own trajectory row
+            rows.append({
+                "round": _round_of(path), "artifact": os.path.basename(path),
+                "metric": "replay colocated fast path vs framed-TCP loopback",
+                "value": fast["vs_tcp_loopback"], "unit": "x",
+                "status": _status_of(doc),
+            })
     for path in sorted(glob.glob(os.path.join(repo, "MULTICHIP_r*.json"))
                        + glob.glob(os.path.join(repo, "artifacts", "multichip_*.json"))):
         try:
