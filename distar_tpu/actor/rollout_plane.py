@@ -325,15 +325,21 @@ class RolloutPlane:
     def __init__(self, backend: str = "inline", addr: str = "",
                  slots: int = 0, max_delay_s: float = 0.005,
                  timeout_s: float = 30.0, queue_capacity: int = 1024,
-                 idle_ttl_s: float = 300.0, model=None, engine_factory=None):
+                 idle_ttl_s: float = 300.0, model=None, engine_factory=None,
+                 coordinator_addr: str = ""):
         if backend not in PLANE_BACKENDS:
             raise ValueError(
                 f"actor.plane.backend must be one of {PLANE_BACKENDS}, got {backend!r}"
             )
         self.backend = backend
         self.addr = str(addr)
-        if backend == "remote":
+        self.coordinator_addr = str(coordinator_addr)
+        if backend == "remote" and not self._is_fleet_addr():
             self._remote_addr()  # fail fast on a malformed address
+        if backend == "remote" and self.addr == "discover" and not self.coordinator_addr:
+            raise ValueError(
+                "actor.plane.addr 'discover' needs actor.plane.coordinator_addr "
+                "(CLI: --plane-addr discover requires --coordinator-addr)")
         self.slots = int(slots)
         self.max_delay_s = max_delay_s
         self.timeout_s = timeout_s
@@ -354,14 +360,56 @@ class RolloutPlane:
         )
 
     # ------------------------------------------------------------------ utils
+    def _is_fleet_addr(self) -> bool:
+        """``discover`` (coordinator-discovered gateway fleet) and multi-
+        address lists ride the session-affinity router (serve.fleet) instead
+        of a single ``ServeClient`` — same surface, fleet semantics."""
+        return self.addr == "discover" or "," in self.addr
+
     def _remote_addr(self):
         host, _, port = self.addr.rpartition(":")
         try:
             return host or "127.0.0.1", int(port)
         except ValueError:
             raise ValueError(
-                f"actor.plane.addr must be 'host:port', got {self.addr!r}"
+                f"actor.plane.addr must be 'host:port', a 'h1:p1,h2:p2' fleet "
+                f"list, or 'discover' — got {self.addr!r}"
             ) from None
+
+    def _remote_target(self, player_id: str):
+        """The remote data-plane client for one job client: a plain
+        ``ServeClient`` for a single gateway address, or a ``FleetClient``
+        (consistent-hash session affinity, failover re-route, canary split)
+        for ``discover``/multi-address fleets. Both are player-stamped so a
+        multiplexed gateway (``GatewayMux``) serves several players over
+        one address; single-model gateways ignore the field."""
+        from ..resilience import RetryPolicy
+
+        if self._is_fleet_addr():
+            from ..serve.fleet import FleetClient, GatewayMap
+
+            if self.addr == "discover":
+                host, _, port = self.coordinator_addr.rpartition(":")
+                return FleetClient(
+                    coordinator_addr=(host or "127.0.0.1", int(port)),
+                    timeout_s=self.timeout_s, player=player_id or None)
+            return FleetClient(gateway_map=GatewayMap.parse(self.addr),
+                               timeout_s=self.timeout_s,
+                               player=player_id or None)
+        from ..serve.tcp_frontend import ServeClient
+
+        host, port = self._remote_addr()
+        # patient reconnect budget: a gateway kill+restart (seconds of
+        # dead port) must stay invisible to the job loop — the episode
+        # rides through on re-materialized carries
+        return ServeClient(
+            host, port, timeout_s=self.timeout_s,
+            player=player_id or None,
+            retry_policy=RetryPolicy(
+                max_attempts=10, backoff_base_s=0.2, backoff_max_s=2.0,
+                deadline_s=max(4 * self.timeout_s, 30.0),
+            ),
+        )
 
     def _session_ids(self, player_id: str, num_slots: int) -> List[str]:
         uid = f"{os.getpid():x}-{next(_CLIENT_SEQ)}"
@@ -380,21 +428,8 @@ class RolloutPlane:
         if self.backend == "local":
             gw = self._local_gateway(player_id, num_slots, params, model, seed)
             target = _LocalTarget(gw)
-        else:  # remote
-            from ..resilience import RetryPolicy
-            from ..serve.tcp_frontend import ServeClient
-
-            host, port = self._remote_addr()
-            # patient reconnect budget: a gateway kill+restart (seconds of
-            # dead port) must stay invisible to the job loop — the episode
-            # rides through on re-materialized carries
-            target = ServeClient(
-                host, port, timeout_s=self.timeout_s,
-                retry_policy=RetryPolicy(
-                    max_attempts=10, backoff_base_s=0.2, backoff_max_s=2.0,
-                    deadline_s=max(4 * self.timeout_s, 30.0),
-                ),
-            )
+        else:  # remote: single gateway, static fleet list, or discover
+            target = self._remote_target(player_id)
         if teacher_params is not None:
             target.set_teacher(teacher_params)
         client = GatewayPolicyClient(

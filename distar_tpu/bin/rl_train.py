@@ -452,11 +452,17 @@ def _plane_cfg(args) -> dict:
     in-process gateway per player (local), or a remote bin/serve gateway
     (remote, needs --plane-addr)."""
     if args.plane == "remote" and not args.plane_addr:
-        raise SystemExit("--plane remote requires --plane-addr host:port")
+        raise SystemExit("--plane remote requires --plane-addr host:port "
+                         "(or 'h1:p1,h2:p2', or 'discover')")
+    if args.plane == "remote" and args.plane_addr == "discover" \
+            and not args.coordinator_addr:
+        raise SystemExit("--plane-addr discover requires --coordinator-addr "
+                         "(gateways register under the serve_gateway token)")
     return {
         "backend": args.plane,
         "addr": args.plane_addr,
         "slots": args.plane_slots,
+        "coordinator_addr": args.coordinator_addr or "",
     }
 
 
@@ -645,8 +651,12 @@ def main() -> None:
                         "remote = framed-TCP against a bin/serve gateway "
                         "(--plane-addr)")
     p.add_argument("--plane-addr", default="",
-                   help="host:port of a bin/serve TCP frontend for "
-                        "--plane remote")
+                   help="--plane remote target: one 'host:port' bin/serve "
+                        "TCP frontend, a 'h1:p1,h2:p2' gateway fleet (rides "
+                        "the serve.fleet session-affinity router), or "
+                        "'discover' to build the fleet from the "
+                        "coordinator's serve_gateway registrations "
+                        "(needs --coordinator-addr)")
     p.add_argument("--plane-slots", type=int, default=0,
                    help="shared local engine lanes (0 = this job's env_num); "
                         "sessions reserve exact capacity, so size it for "

@@ -8,6 +8,17 @@ batch), and activates before the frontends accept traffic. At runtime new
 versions hot-swap through POST /serve/load + /serve/swap (or the TCP
 ``load``/``swap`` ops) with zero downtime.
 
+Fleet membership: ``--coordinator-addr host:port`` registers this
+gateway's TCP data-plane endpoint under the ``serve_gateway`` token with
+lease/heartbeat keep-alive, so serve-fleet routers (``serve.fleet``),
+``opsctl status`` and the rollout controller discover it; dying (or
+draining) gateways fall out of fresh maps when the lease lapses.
+
+Player multiplexing: ``--players MP0,MP1`` (mock) or repeated
+``--player-checkpoint PLAYER=URL`` (real models) serve several player
+models behind this ONE address (``GatewayMux``) — requests route by the
+wire ``player`` field; clients that send none get the first player.
+
 Shutdown (SIGTERM/SIGINT) is drain-then-stop: frontends stop accepting,
 admitted requests flush, then the process exits.
 """
@@ -21,13 +32,12 @@ import threading
 from ..utils.log import TextLogger
 
 
-def build_engine(args):
+def build_engine(args, checkpoint=None):
     """Engine + (optional) registry load_fn for the chosen model."""
     from ..serve import BatchedInferenceEngine, MockModelEngine
 
     if args.mock:
         return MockModelEngine(args.slots, delay_s=args.mock_delay_s), None
-    import jax
 
     from ..actor.inference import BatchedInference
     from ..model import Model, default_model_config
@@ -38,9 +48,37 @@ def build_engine(args):
     if args.config:
         model_cfg = deep_merge_dicts(model_cfg, read_config(args.config).get("model", {}))
     model = Model(model_cfg)
-    params = default_load_fn(args.checkpoint)
+    params = default_load_fn(checkpoint or args.checkpoint)
     infer = BatchedInference(model, params, args.slots, seed=args.seed)
     return BatchedInferenceEngine(infer), default_load_fn
+
+
+def build_gateway(args, checkpoint=None):
+    """One ``InferenceGateway`` serving one model (the per-player unit)."""
+    from ..serve import InferenceGateway, ModelRegistry
+
+    engine, load_fn = build_engine(args, checkpoint=checkpoint)
+    gateway = InferenceGateway(
+        engine,
+        max_batch=args.slots,
+        max_delay_s=args.max_delay_ms / 1000.0,
+        queue_capacity=args.queue_capacity,
+        idle_ttl_s=args.idle_ttl_s,
+    )
+    if load_fn is not None:
+        # re-register the checkpoint through the registry so later hot-swaps
+        # and the already-loaded boot version share one version table
+        gateway.registry = ModelRegistry(load_fn=load_fn, warmup_fn=gateway._warmup)
+        gateway.load_version(args.version, source=checkpoint or args.checkpoint,
+                             activate=True)
+    else:
+        # mock: register a boot version too (gateway_proc parity) so the
+        # fleet rollout always has a rollback target and status shows a
+        # real generation instead of the engine's v0 default
+        gateway.load_version(args.version,
+                             params={"version": args.version, "bias": 0.0},
+                             activate=True)
+    return gateway
 
 
 def main() -> None:
@@ -59,12 +97,32 @@ def main() -> None:
     p.add_argument("--mock-delay-s", type=float, default=0.0)
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--drain-timeout-s", type=float, default=30.0)
+    p.add_argument("--players", default="",
+                   help="mock multiplexing: comma list of player ids served "
+                        "behind this one address (each gets its own mock "
+                        "engine + registry)")
+    p.add_argument("--player-checkpoint", action="append", default=[],
+                   metavar="PLAYER=URL",
+                   help="real-model multiplexing: serve PLAYER from URL "
+                        "behind this one address (repeatable; first named "
+                        "player is the default for legacy clients)")
+    p.add_argument("--coordinator-addr", default="",
+                   help="register this gateway under the serve_gateway "
+                        "token at host:port (lease/heartbeat; routers and "
+                        "opsctl discover the fleet there)")
+    p.add_argument("--lease-s", type=float, default=10.0,
+                   help="registration lease TTL (stop heartbeating = "
+                        "evicted from the fleet map)")
     p.add_argument("--no-health", action="store_true",
                    help="disable the fleet-health subsystem (watchdog rules, "
                         "TSDB, crash recorder)")
     args = p.parse_args()
-    if not args.mock and not args.checkpoint:
-        p.error("--checkpoint is required unless --mock")
+    player_ckpts = dict(s.split("=", 1) for s in args.player_checkpoint)
+    if not args.mock and not args.checkpoint and not player_ckpts:
+        p.error("--checkpoint (or --player-checkpoint) is required unless --mock")
+    if args.players and not args.mock:
+        p.error("--players is the mock multiplexer; use --player-checkpoint "
+                "PLAYER=URL for real models")
 
     from ..learner.base_learner import experiments_root
 
@@ -83,30 +141,40 @@ def main() -> None:
             os.path.join(serve_dir, "flight"), config=vars(args)
         )
 
-    engine, load_fn = build_engine(args)
+    from ..serve import GatewayMux, ServeHTTPServer, ServeTCPServer
 
-    from ..serve import InferenceGateway, ModelRegistry, ServeHTTPServer, ServeTCPServer
+    players = [s.strip() for s in args.players.split(",") if s.strip()]
+    if player_ckpts:
+        target = GatewayMux({pl: build_gateway(args, checkpoint=url)
+                             for pl, url in player_ckpts.items()})
+        players = sorted(player_ckpts)
+    elif players:
+        target = GatewayMux({pl: build_gateway(args) for pl in players})
+    else:
+        target = build_gateway(args)
+    target.start()
 
-    gateway = InferenceGateway(
-        engine,
-        max_batch=args.slots,
-        max_delay_s=args.max_delay_ms / 1000.0,
-        queue_capacity=args.queue_capacity,
-        idle_ttl_s=args.idle_ttl_s,
-    )
-    if load_fn is not None:
-        # re-register the checkpoint through the registry so later hot-swaps
-        # and the already-loaded boot version share one version table
-        gateway.registry = ModelRegistry(load_fn=load_fn, warmup_fn=gateway._warmup)
-        gateway.load_version(args.version, source=args.checkpoint, activate=True)
-    gateway.start()
+    http = ServeHTTPServer(target, host=args.host, port=args.http_port).start()
+    tcp = ServeTCPServer(target, host=args.host, port=args.tcp_port).start()
 
-    http = ServeHTTPServer(gateway, host=args.host, port=args.http_port).start()
-    tcp = ServeTCPServer(gateway, host=args.host, port=args.tcp_port).start()
+    beat = None
+    if args.coordinator_addr:
+        from ..serve.fleet import register_gateway
+
+        chost, _, cport = args.coordinator_addr.rpartition(":")
+        beat = register_gateway(
+            (chost or "127.0.0.1", int(cport)), tcp.host, tcp.port,
+            meta={"players": players, "slots": args.slots,
+                  "http_port": http.port, "version": args.version,
+                  "mock": bool(args.mock)},
+            lease_s=args.lease_s or None,
+        )
     logger.info(
         f"serving: http={http.host}:{http.port} tcp={tcp.host}:{tcp.port} "
         f"slots={args.slots} max_delay={args.max_delay_ms}ms "
-        f"{'mock' if args.mock else args.checkpoint}"
+        f"players={players or ['<single>']} "
+        f"{'mock' if args.mock else (args.checkpoint or player_ckpts)}"
+        + (f" registered@{args.coordinator_addr}" if beat else "")
     )
 
     done = threading.Event()
@@ -118,9 +186,11 @@ def main() -> None:
     signal.signal(signal.SIGTERM, _shutdown)
     signal.signal(signal.SIGINT, _shutdown)
     done.wait()
+    if beat is not None:
+        beat.stop_event.set()  # stop refreshing: the lease lapses fleet-side
     http.stop()
     tcp.stop()
-    gateway.drain_and_stop(args.drain_timeout_s)
+    target.drain_and_stop(args.drain_timeout_s)
     logger.info("drained; bye")
 
 

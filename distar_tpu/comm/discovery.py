@@ -1,0 +1,71 @@
+"""Coordinator-backed service discovery: register + lease keep-alive + read.
+
+The client-side idiom every scale-out fleet speaks (PR 4 gave the broker
+lease/heartbeat eviction; PR 9 added the non-popping ``peers`` read): a
+service process registers its endpoint under a token with a lease, keeps it
+alive from a daemon thread — re-registering when the broker answers a
+heartbeat with False, i.e. it lost our records across a restart — and
+consumers read the live fleet back non-destructively via ``peers`` (an
+``ask`` would pop the records and unregister the fleet it discovered).
+Lease-expired endpoints are evicted broker-side, so a fresh read never
+contains a process that stopped heartbeating.
+
+``replay.sharding.register_shard`` (token ``replay_shard``) and
+``serve.fleet.discovery.register_gateway`` (token ``serve_gateway``) are
+thin wrappers over this module.
+"""
+from __future__ import annotations
+
+import threading
+from typing import List, Optional, Tuple
+
+
+def register_endpoint(coordinator_addr: Tuple[str, int], token: str, host: str,
+                      port: int, meta: Optional[dict] = None,
+                      lease_s: Optional[float] = None,
+                      heartbeat_interval_s: Optional[float] = None,
+                      stop_event: Optional[threading.Event] = None) -> threading.Thread:
+    """Register ``host:port`` under ``token`` and keep its lease alive from a
+    daemon thread. The first register happens synchronously (a failure raises
+    to the caller — a fleet member that can't reach its broker should fail
+    loudly at startup, not silently serve undiscovered); later heartbeats
+    never raise. Returns the started thread; set ``thread.stop_event`` (or
+    pass your own) to end the keep-alive."""
+    from .coordinator import coordinator_request
+
+    chost, cport = coordinator_addr
+    body = {"token": token, "ip": host, "port": port, "meta": meta or {}}
+    if lease_s:
+        body["lease_s"] = lease_s
+    coordinator_request(chost, cport, "register", body)
+    interval = heartbeat_interval_s or (max(1.0, lease_s / 3.0) if lease_s else 10.0)
+    stop = stop_event or threading.Event()
+
+    def beat():
+        while not stop.wait(interval):
+            try:
+                hb = {"ip": host, "port": port}
+                if lease_s:
+                    hb["lease_s"] = lease_s
+                alive = coordinator_request(chost, cport, "heartbeat", hb)
+                if not (alive or {}).get("info", False):
+                    coordinator_request(chost, cport, "register", body)
+            except Exception:  # noqa: BLE001 - keep-alive must never crash a role
+                continue
+
+    t = threading.Thread(target=beat, name=f"{token}-heartbeat", daemon=True)
+    t.stop_event = stop  # type: ignore[attr-defined]
+    t.start()
+    return t
+
+
+def discover_endpoints(coordinator_addr: Tuple[str, int], token: str) -> List[dict]:
+    """The live fleet registered under ``token``: a non-destructive read of
+    the coordinator's ``peers`` route. Returns the raw records
+    (``{"ip", "port", "meta", "ts"}``), possibly empty — callers decide
+    whether an empty fleet is an error."""
+    from .coordinator import coordinator_request
+
+    host, port = coordinator_addr
+    reply = coordinator_request(host, port, "peers", {"token": token})
+    return list(reply.get("info") or [])

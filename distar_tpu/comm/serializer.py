@@ -10,43 +10,114 @@ loads handles every magic regardless of what this host can produce (the
 lz magic carries the decompressed size, and a pure-Python decoder exists
 for .so-less hosts). Pickle protocol 5 keeps large numpy arrays zero-copy
 on the serialise side.
+
+Named codecs (``supported_codecs``): ``lz4`` (the default pair above),
+``zlib`` (forced zlib-1), and ``zstd`` — gated on a ``zstandard`` binding
+being importable; the replay data plane's per-connection ``hello``
+negotiation picks one by preference intersection (``negotiate_codec``), so
+mixed-capability fleets interoperate and a host without the binding is
+simply never offered zstd frames.
 """
 from __future__ import annotations
 
 import pickle
 import struct
 import zlib
-from typing import Any
+from typing import Any, Optional, Sequence, Tuple
 
 from . import shuttle
 
 MAGIC_RAW = b"DTR0"
 MAGIC_ZLIB = b"DTZ0"
 MAGIC_LZ = b"DTL0"  # + u64 LE decompressed size + lz4-block stream
+MAGIC_ZSTD = b"DTS0"  # + u64 LE decompressed size + zstd stream
+
+try:  # optional: the image may not ship a zstd binding — everything gates
+    import zstandard as _zstd  # type: ignore[import-not-found]
+except ImportError:
+    _zstd = None
+
+#: negotiable wire codec names, preference-ordered for this host. "lz4" is
+#: the legacy default (native LZ4-block with a zlib-1 fallback encoder —
+#: one name, because a receiver handles both magics regardless); "zstd"
+#: trades CPU for a better ratio on cold links and only appears when the
+#: host can actually decode it.
+def supported_codecs() -> Tuple[str, ...]:
+    return ("lz4", "zlib") + (("zstd",) if _zstd is not None else ())
 
 
-def dumps_sized(obj: Any, compress: bool = True) -> "tuple[bytes, int]":
+def zstd_available() -> bool:
+    return _zstd is not None
+
+
+def negotiate_codec(client_prefs: Optional[Sequence[str]],
+                    server_codecs: Optional[Sequence[str]] = None) -> str:
+    """The wire codec a connection commits to: the client's first
+    preference the server also speaks, else the legacy ``"lz4"`` (which is
+    what a client that never sent a preference list gets)."""
+    server = tuple(server_codecs) if server_codecs is not None else supported_codecs()
+    for pref in client_prefs or ():
+        if pref in server and pref in supported_codecs():
+            return str(pref)
+    return "lz4"
+
+
+def _zstd_compress(payload: bytes) -> bytes:
+    return _zstd.ZstdCompressor(level=3).compress(payload)
+
+
+def _zstd_decompress(body: bytes, n: int) -> bytes:
+    return _zstd.ZstdDecompressor().decompress(body, max_output_size=n)
+
+
+def dumps_sized(obj: Any, compress: bool = True,
+                codec: str = "lz4") -> "tuple[bytes, int]":
     """``(blob, raw_len)`` where ``raw_len`` is the pickled-payload size
     before compression — the number wire-bytes telemetry compares the
-    on-the-wire frame against (``distar_replay_*_bytes_{raw,wire}``)."""
+    on-the-wire frame against (``distar_replay_*_bytes_{raw,wire}``).
+    ``codec`` picks the compressor (a negotiated name from
+    ``supported_codecs``); decode side is codec-agnostic — ``loads``
+    dispatches on the magic."""
     payload = pickle.dumps(obj, protocol=5)
     raw_len = len(payload)
-    if compress:
-        lz = shuttle.lz_compress(payload)
-        if lz is not None:
-            return MAGIC_LZ + struct.pack("<Q", raw_len) + lz, raw_len
+    if not compress:
+        return MAGIC_RAW + payload, raw_len
+    if codec == "zstd":
+        if _zstd is None:
+            raise ValueError("zstd codec requested but no zstd binding on this host")
+        return MAGIC_ZSTD + struct.pack("<Q", raw_len) + _zstd_compress(payload), raw_len
+    if codec == "zlib":
         return MAGIC_ZLIB + zlib.compress(payload, level=1), raw_len
-    return MAGIC_RAW + payload, raw_len
+    if codec != "lz4":
+        raise ValueError(f"unknown wire codec {codec!r} (know {supported_codecs()})")
+    lz = shuttle.lz_compress(payload)
+    if lz is not None:
+        return MAGIC_LZ + struct.pack("<Q", raw_len) + lz, raw_len
+    return MAGIC_ZLIB + zlib.compress(payload, level=1), raw_len
 
 
-def dumps(obj: Any, compress: bool = True) -> bytes:
-    return dumps_sized(obj, compress=compress)[0]
+def dumps(obj: Any, compress: bool = True, codec: str = "lz4") -> bytes:
+    return dumps_sized(obj, compress=compress, codec=codec)[0]
 
 
 def loads_sized(blob: bytes) -> "tuple[Any, int]":
     """``(obj, raw_len)`` — the decode twin of ``dumps_sized`` (``raw_len``
     is the decompressed pickle-payload size, whatever the codec)."""
     magic, body = blob[:4], blob[4:]
+    if magic == MAGIC_ZSTD:
+        if len(body) < 8:
+            raise ValueError("truncated zstd payload header")
+        (n,) = struct.unpack("<Q", body[:8])
+        # same hostile-header cap as lz: zstd tops out well under 255x on
+        # real payloads; anything above is corruption/desync, not data
+        if n > max(1024, (len(body) - 8) * 255):
+            raise ValueError(
+                f"implausible decompressed size {n} for {len(body) - 8}-byte stream")
+        if _zstd is None:
+            raise ValueError(
+                "zstd-compressed payload but no zstd binding on this host "
+                "(negotiation should have prevented this)")
+        return pickle.loads(_zstd_decompress(body[8:], n)), n
     if magic == MAGIC_LZ:
         if len(body) < 8:
             raise ValueError("truncated lz payload header")
@@ -154,9 +225,9 @@ def sock_recv_exact(sock, n: int) -> bytes:
     return b"".join(chunks)
 
 
-def send_msg(sock, obj: Any, compress: bool = True) -> None:
+def send_msg(sock, obj: Any, compress: bool = True, codec: str = "lz4") -> None:
     """Serialize + frame + send one message on a connected socket."""
-    sock.sendall(frame(dumps(obj, compress=compress)))
+    sock.sendall(frame(dumps(obj, compress=compress, codec=codec)))
 
 
 def recv_msg(sock, max_frame_bytes: int = DEFAULT_MAX_FRAME) -> Any:

@@ -17,9 +17,11 @@ duplicate item, no duplicate spill blob. (A retry that crosses a store
 is process-lifetime, and a duplicate trajectory is benign for RL training.)
 
 Wire compression is negotiated once per connection: ``_connect`` sends a
-``hello`` declaring this client's preference, the server answers the ANDed
-setting, and both directions honour it. A pre-negotiation server (or one
-that answers hello with an error) degrades to the legacy always-compressed
+``hello`` declaring this client's preference — on/off AND a codec
+preference list (``lz4`` default, ``zstd`` when the host has a binding) —
+the server answers the ANDed setting plus the chosen codec name, and both
+directions honour them. A pre-negotiation server (or one that answers
+hello with an error) degrades to the legacy always-compressed lz4
 contract, so mixed-version fleets interoperate.
 """
 from __future__ import annotations
@@ -29,7 +31,7 @@ import threading
 import uuid
 from typing import Any, Dict, List, Optional, Tuple
 
-from ..comm.serializer import maybe_decode, recv_msg, send_msg
+from ..comm.serializer import maybe_decode, recv_msg, send_msg, supported_codecs
 from ..resilience import CircuitBreaker, RetryPolicy, retry_call
 from .errors import error_from_wire
 
@@ -45,7 +47,8 @@ class _ReplayClientBase:
     def __init__(self, host: str, port: int, timeout_s: float = 60.0,
                  retry_policy: Optional[RetryPolicy] = None,
                  breaker: Optional[CircuitBreaker] = None,
-                 op_prefix: str = "replay", compress: bool = True):
+                 op_prefix: str = "replay", compress: bool = True,
+                 codec: str = "lz4"):
         self._addr = (host, port)
         self._timeout_s = timeout_s
         self._policy = retry_policy or DEFAULT_REPLAY_POLICY
@@ -54,10 +57,16 @@ class _ReplayClientBase:
         self._op_prefix = op_prefix
         self._sock: Optional[socket.socket] = None
         self._lock = threading.Lock()
-        #: what this side ASKS for; the per-connection negotiated setting
-        #: (server's enablement ANDed in) lands in _neg_compress on connect
+        #: what this side ASKS for; the per-connection negotiated settings
+        #: (server's enablement/choice ANDed in) land in _neg_* on connect
         self._want_compress = bool(compress)
         self._neg_compress = bool(compress)
+        # preference list: the asked-for codec first, lz4 as the universal
+        # fallback; only codecs THIS host can decode are ever offered
+        prefs = [c for c in dict.fromkeys((codec, "lz4"))
+                 if c in supported_codecs()]
+        self._want_codecs = prefs or ["lz4"]
+        self._neg_codec = "lz4"
         self.server_shard_id: str = ""
 
     def _connect(self) -> None:
@@ -65,7 +74,8 @@ class _ReplayClientBase:
         self._sock = socket.create_connection(self._addr, timeout=self._timeout_s)
         self._sock.settimeout(self._timeout_s)
         try:
-            send_msg(self._sock, {"op": "hello", "compress": self._want_compress},
+            send_msg(self._sock, {"op": "hello", "compress": self._want_compress,
+                                  "codecs": list(self._want_codecs)},
                      compress=False)
             resp = recv_msg(self._sock)
         except (ConnectionError, OSError, ValueError):
@@ -73,18 +83,21 @@ class _ReplayClientBase:
             raise
         if isinstance(resp, dict) and resp.get("code") == 0 and "compress" in resp:
             self._neg_compress = bool(resp["compress"])
+            self._neg_codec = str(resp.get("codec") or "lz4")
             self.server_shard_id = str(resp.get("shard", "") or "")
         else:
             # pre-negotiation server: it answered hello with an error frame
             # and will compress every response — mirror the legacy contract
             self._neg_compress = True
+            self._neg_codec = "lz4"
 
     def _call_once(self, req: dict) -> dict:
         with self._lock:
             if self._sock is None:
                 self._connect()
             try:
-                send_msg(self._sock, req, compress=self._neg_compress)
+                send_msg(self._sock, req, compress=self._neg_compress,
+                         codec=self._neg_codec)
                 resp = recv_msg(self._sock)
             except (ConnectionError, OSError, ValueError):
                 # stream no longer trustworthy: drop it so the retry dials

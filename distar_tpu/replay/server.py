@@ -6,7 +6,7 @@ one request/response dict pair per frame, ``{"code": 0, ...}`` on success,
 ``{"code": <wire code>, "error": ...}`` typed on failure (errors.to_wire).
 
 Requests:
-  hello   {compress?}                             -> {code: 0, compress, shard}
+  hello   {compress?, codecs?}            -> {code: 0, compress, codec, shard}
   insert  {table, item, priority?, timeout_s?, idem?} -> {code: 0, seq}
   sample  {table, batch_size?, timeout_s?}        -> {code: 0, items, info}
   update_priorities {table, updates}              -> {code: 0, applied}
@@ -45,8 +45,10 @@ from ..comm.serializer import (
     dumps_sized,
     frame,
     loads_sized,
+    negotiate_codec,
     read_frame,
     sock_recv_exact,
+    supported_codecs,
 )
 from ..obs import get_registry
 from .errors import ReplayError
@@ -57,12 +59,16 @@ class ReplayServer:
     """Thread-per-connection framed-TCP server over one ``ReplayStore``."""
 
     def __init__(self, store: ReplayStore, host: str = "127.0.0.1", port: int = 0,
-                 default_timeout_s: float = 30.0, compress: bool = True):
+                 default_timeout_s: float = 30.0, compress: bool = True,
+                 codecs: Optional[tuple] = None):
         self.store = store
         self.default_timeout_s = default_timeout_s
         #: server-side compression enablement; the per-connection setting is
         #: this ANDed with whatever the client's hello asks for
         self.compress = bool(compress)
+        #: codecs this server is willing to speak (restrictable per deploy);
+        #: the per-connection codec is the client's first preference in here
+        self.codecs = tuple(codecs) if codecs is not None else supported_codecs()
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._listener.bind((host, port))
@@ -145,7 +151,8 @@ class ReplayServer:
         self._c_rx_raw.inc(raw_len)
         return obj
 
-    def _send_counted(self, conn: socket.socket, obj, compress: bool) -> None:
+    def _send_counted(self, conn: socket.socket, obj, compress: bool,
+                      codec: str = "lz4") -> None:
         # skip the compression pass when the response bulk is already
         # through the codec (Opaque spill re-serves): lz-of-lz costs a full
         # CPU pass for ~zero byte savings
@@ -153,7 +160,7 @@ class ReplayServer:
             items = obj.get("items")
             if items and any(isinstance(i, Opaque) for i in items):
                 compress = False
-        blob, raw_len = dumps_sized(obj, compress=compress)
+        blob, raw_len = dumps_sized(obj, compress=compress, codec=codec)
         conn.sendall(frame(blob))
         self._c_tx_wire.inc(len(blob))
         self._c_tx_raw.inc(raw_len)
@@ -163,6 +170,7 @@ class ReplayServer:
         with self._conns_lock:
             self._conns.add(conn)
         compress = self.compress  # legacy clients never negotiate: stay on
+        codec = "lz4"  # ...and never leave the legacy codec
         try:
             with conn:
                 while not self._stop.is_set():
@@ -172,22 +180,26 @@ class ReplayServer:
                         return  # peer closed (possibly mid-frame)
                     except ValueError as e:
                         self._send_counted(
-                            conn, {"code": "bad_frame", "error": repr(e)}, compress)
+                            conn, {"code": "bad_frame", "error": repr(e)},
+                            compress, codec)
                         return
                     self._c_requests.inc()
                     if isinstance(req, dict) and req.get("op") == "hello":
                         # per-connection negotiation: both sides commit to
-                        # the ANDed setting for every later frame
+                        # the ANDed compression setting and the intersected
+                        # codec choice for every later frame
                         compress = self.compress and bool(req.get("compress", True))
-                        reply = {"code": 0, "compress": compress,
+                        codec = negotiate_codec(req.get("codecs"), self.codecs)
+                        reply = {"code": 0, "compress": compress, "codec": codec,
                                  "shard": getattr(self.store, "shard_id", "")}
                         try:
-                            self._send_counted(conn, reply, compress)
+                            self._send_counted(conn, reply, compress, codec)
                         except (ConnectionError, OSError):
                             return
                         continue
                     try:
-                        self._send_counted(conn, self._dispatch(req), compress)
+                        self._send_counted(conn, self._dispatch(req), compress,
+                                           codec)
                     except (ConnectionError, OSError):
                         return
         finally:
@@ -321,6 +333,10 @@ def main(argv=None) -> int:
     p.add_argument("--error-buffer", type=float, default=None)
     p.add_argument("--no-compress", dest="compress", action="store_false",
                    help="refuse wire compression in the hello negotiation")
+    p.add_argument("--codecs", default="",
+                   help="comma list restricting the codecs this shard will "
+                        "negotiate (default: everything the host supports; "
+                        "lz4 always remains the fallback)")
     args = p.parse_args(argv)
 
     cfg = TableConfig(
@@ -334,8 +350,9 @@ def main(argv=None) -> int:
     store = ReplayStore(table_factory=lambda name: cfg, spill=spill,
                         shard_id=args.shard_id, recover_encoded=True)
     recovered = store.recover()
+    codecs = tuple(c for c in args.codecs.split(",") if c.strip()) or None
     server = ReplayServer(store, host=args.host, port=args.port,
-                          compress=args.compress).start()
+                          compress=args.compress, codecs=codecs).start()
     # CLI entrypoint output: the parseable serving line callers wait for
     print(f"REPLAY-SHARD {server.host} {server.port} "  # lint: allow-print
           f"recovered={recovered}", flush=True)

@@ -116,13 +116,12 @@ class ShardMap:
         """Read the live shard fleet from the coordinator's non-destructive
         ``peers`` route (lease-expired shards have already been evicted).
         Raises ``ValueError`` when no shard has registered yet."""
-        from ..comm.coordinator import coordinator_request
+        from ..comm.discovery import discover_endpoints
 
-        host, port = coordinator_addr
-        reply = coordinator_request(host, port, "peers", {"token": token})
-        records = reply.get("info") or []
+        records = discover_endpoints(coordinator_addr, token)
         addrs = sorted({f"{r['ip']}:{r['port']}" for r in records})
         if not addrs:
+            host, port = coordinator_addr
             raise ValueError(
                 f"no {token!r} registrations at coordinator {host}:{port} "
                 "(are the replay shards up, and started with --coordinator-addr?)"
@@ -143,10 +142,12 @@ class _ShardedBase:
     _client_cls: Callable = None  # type: ignore[assignment]
 
     def __init__(self, shard_map: ShardMap, retry_policy: Optional[RetryPolicy] = None,
-                 compress: bool = True, timeout_s: float = 60.0):
+                 compress: bool = True, timeout_s: float = 60.0,
+                 codec: str = "lz4"):
         self.shard_map = shard_map
         self._retry_policy = retry_policy
         self._compress = compress
+        self._codec = codec
         self._timeout_s = timeout_s
         self._clients: Dict[str, object] = {}
         self._lock = threading.Lock()
@@ -159,6 +160,7 @@ class _ShardedBase:
                 client = type(self)._client_cls(
                     host, port, timeout_s=self._timeout_s,
                     retry_policy=self._retry_policy, compress=self._compress,
+                    codec=self._codec,
                 )
                 self._clients[addr] = client
             return client
@@ -393,29 +395,9 @@ def register_shard(coordinator_addr: Tuple[str, int], host: str, port: int,
     """Register one shard under ``SHARD_TOKEN`` and keep its lease alive
     from a daemon thread (re-registering when the broker says it lost us —
     the PR 4 heartbeat contract). Returns the started thread."""
-    from ..comm.coordinator import coordinator_request
+    from ..comm.discovery import register_endpoint
 
-    chost, cport = coordinator_addr
-    body = {"token": SHARD_TOKEN, "ip": host, "port": port, "meta": meta or {}}
-    if lease_s:
-        body["lease_s"] = lease_s
-    coordinator_request(chost, cport, "register", body)
-    interval = heartbeat_interval_s or (max(1.0, lease_s / 3.0) if lease_s else 10.0)
-    stop = stop_event or threading.Event()
-
-    def beat():
-        while not stop.wait(interval):
-            try:
-                hb = {"ip": host, "port": port}
-                if lease_s:
-                    hb["lease_s"] = lease_s
-                alive = coordinator_request(chost, cport, "heartbeat", hb)
-                if not (alive or {}).get("info", False):
-                    coordinator_request(chost, cport, "register", body)
-            except Exception:  # noqa: BLE001 - keep-alive must never crash a shard
-                continue
-
-    t = threading.Thread(target=beat, name="replay-shard-heartbeat", daemon=True)
-    t.stop_event = stop  # type: ignore[attr-defined]
-    t.start()
-    return t
+    return register_endpoint(
+        coordinator_addr, SHARD_TOKEN, host, port, meta=meta, lease_s=lease_s,
+        heartbeat_interval_s=heartbeat_interval_s, stop_event=stop_event,
+    )

@@ -33,6 +33,7 @@ from .errors import (
     QueueFullError,
     ServeError,
     ShedError,
+    UnknownPlayerError,
     UnknownVersionError,
     error_from_wire,
 )
@@ -41,6 +42,7 @@ from .batcher import MicroBatcher, PendingRequest
 from .sessions import SessionTable
 from .registry import ModelRegistry
 from .gateway import InferenceGateway
+from .mux import GatewayMux
 from .http_frontend import ServeHTTPServer
 from .tcp_frontend import ServeClient, ServeTCPServer
 
@@ -49,6 +51,7 @@ __all__ = [
     "CapacityError",
     "DeadlineExceededError",
     "DrainingError",
+    "GatewayMux",
     "InferenceGateway",
     "MicroBatcher",
     "MockModelEngine",
@@ -61,6 +64,7 @@ __all__ = [
     "ServeTCPServer",
     "SessionTable",
     "ShedError",
+    "UnknownPlayerError",
     "UnknownVersionError",
     "error_from_wire",
 ]

@@ -152,18 +152,21 @@ class MockModelEngine:
         with self._lock:
             self.forward_calls += 1
             params = dict(self.params)
-            outs = []
+            # inactive lanes are padding by contract (their outputs are
+            # discarded and must not be consumed) — skip their work, so a
+            # many-slot gateway's flush cost scales with ACTIVE lanes, not
+            # table size (the 10k-session capacity harness regime)
+            outs: List[dict] = [None] * self.num_slots  # type: ignore[list-item]
             for i in range(self.num_slots):
-                if active[i]:
-                    self.steps[i] += 1
+                if not active[i]:
+                    continue
+                self.steps[i] += 1
                 x = prepared[i].get("x", 0.0)
-                outs.append(
-                    {
-                        "action": np.asarray(np.sum(x) + params.get("bias", 0.0)),
-                        "step": int(self.steps[i]),
-                        "version": params.get("version"),
-                    }
-                )
+                outs[i] = {
+                    "action": np.asarray(np.sum(x) + params.get("bias", 0.0)),
+                    "step": int(self.steps[i]),
+                    "version": params.get("version"),
+                }
             return outs
 
     def teacher_forward(self, prepared: List[dict], outputs: List[dict],
@@ -178,14 +181,13 @@ class MockModelEngine:
         with self._lock:
             self.teacher_calls += 1
             tparams = dict(self.teacher_params)
-            outs = []
+            outs: List[dict] = [None] * self.num_slots  # type: ignore[list-item]
             for i in range(self.num_slots):
-                if active[i]:
-                    self.teacher_steps[i] += 1
-                outs.append(
-                    {
-                        "teacher_step": int(self.teacher_steps[i]),
-                        "teacher_version": tparams.get("version"),
-                    }
-                )
+                if not active[i]:
+                    continue
+                self.teacher_steps[i] += 1
+                outs[i] = {
+                    "teacher_step": int(self.teacher_steps[i]),
+                    "teacher_version": tparams.get("version"),
+                }
             return outs

@@ -57,6 +57,12 @@ class UnknownVersionError(ServeError):
     code = "unknown_version"
 
 
+class UnknownPlayerError(ServeError):
+    """Request named a player this multiplexed gateway does not serve."""
+
+    code = "unknown_player"
+
+
 _WIRE_CODES = {
     cls.code: cls
     for cls in (
@@ -67,6 +73,7 @@ _WIRE_CODES = {
         CapacityError,
         DrainingError,
         UnknownVersionError,
+        UnknownPlayerError,
     )
 }
 

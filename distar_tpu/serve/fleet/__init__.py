@@ -1,0 +1,32 @@
+"""Multi-gateway serving fleet: discovery, session-affinity routing, rollout.
+
+The horizontal scale-out of ``distar_tpu/serve/`` (ROADMAP item 4): many
+``bin/serve.py`` gateways register with the coordinator under the
+``serve_gateway`` token (PR 4 lease/heartbeat + PR 9 ``peers`` discovery),
+a routing tier pins sticky-carry sessions to gateways over the replay
+fleet's consistent-hash ring — usable as an in-client library
+(``FleetClient``, the rollout plane's ``--plane-addr discover`` backend)
+or a thin standalone proxy (``python -m distar_tpu.serve.fleet.router``) —
+and ``FleetRollout`` drives atomic fleet-wide model hot-swaps with
+per-gateway ack/rollback plus canary-percent rollout.
+
+Failure model in one line: a dead gateway's sessions re-route to survivors
+within one retry budget and re-materialize from a zero carry, counted in
+``distar_fleet_session_migrations_total`` (docs/serving.md, fleet section).
+"""
+from .discovery import GATEWAY_TOKEN, GatewayMap, register_gateway
+from .rollout import CANARY_TOKEN, FleetRollout, fetch_canary, publish_canary
+from .router import FleetClient, FleetRouter, RouterGatewayAdapter
+
+__all__ = [
+    "CANARY_TOKEN",
+    "FleetClient",
+    "FleetRollout",
+    "FleetRouter",
+    "GATEWAY_TOKEN",
+    "GatewayMap",
+    "RouterGatewayAdapter",
+    "fetch_canary",
+    "publish_canary",
+    "register_gateway",
+]

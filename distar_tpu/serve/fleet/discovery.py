@@ -1,0 +1,107 @@
+"""Gateway fleet discovery: ``serve_gateway`` registrations + the fleet map.
+
+Every serving gateway (``bin/serve.py --coordinator-addr``, or the jax-free
+``fleet.gateway_proc`` drill twin) registers its framed-TCP data-plane
+address with the coordinator under the ``serve_gateway`` token, carrying a
+meta block the rest of the fleet plans against:
+
+  players    list of player ids this gateway serves (one entry for a
+             single-model gateway, several behind a ``GatewayMux``)
+  slots      engine batch lanes = max live sessions
+  http_port  the HTTP/JSON frontend (opsctl digests hit ``/serve/status``)
+  version    boot model version name (live generation comes from status)
+
+The TCP address is the gateway's *identity*: a restarted gateway on the
+same address keeps its ring segment (so routing looks for sessions exactly
+where they were pinned), mirroring the replay shard fleet's contract.
+Liveness is the PR 4 lease/heartbeat: a gateway that stops heartbeating is
+evicted broker-side and drops out of freshly discovered maps.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+#: coordinator token serving gateways register under
+GATEWAY_TOKEN = "serve_gateway"
+
+
+def register_gateway(coordinator_addr: Tuple[str, int], host: str, port: int,
+                     meta: Optional[dict] = None, lease_s: Optional[float] = None,
+                     heartbeat_interval_s: Optional[float] = None,
+                     stop_event: Optional[threading.Event] = None) -> threading.Thread:
+    """Register one gateway's TCP data-plane endpoint under
+    ``GATEWAY_TOKEN`` and keep its lease alive (``comm.discovery`` idiom).
+    Returns the heartbeat thread; its ``stop_event`` ends the keep-alive."""
+    from ...comm.discovery import register_endpoint
+
+    return register_endpoint(
+        coordinator_addr, GATEWAY_TOKEN, host, port, meta=meta, lease_s=lease_s,
+        heartbeat_interval_s=heartbeat_interval_s, stop_event=stop_event,
+    )
+
+
+class GatewayMap:
+    """Ordered gateway address list + per-gateway meta.
+
+    Same role as the replay fleet's ``ShardMap``: the stable membership a
+    router hashes over. Addresses are data-plane ``host:port`` identities;
+    ``meta`` keeps whatever each gateway advertised at registration (empty
+    for maps built from a plain address list)."""
+
+    def __init__(self, addrs: Sequence[str], meta: Optional[Dict[str, dict]] = None):
+        self.addrs = list(dict.fromkeys(a.strip() for a in addrs if a.strip()))
+        if not self.addrs:
+            raise ValueError("gateway map needs at least one 'host:port' address")
+        self.meta: Dict[str, dict] = {a: dict((meta or {}).get(a) or {})
+                                      for a in self.addrs}
+
+    def __len__(self) -> int:
+        return len(self.addrs)
+
+    def __contains__(self, addr: str) -> bool:
+        return addr in self.meta
+
+    @classmethod
+    def parse(cls, spec: str) -> "GatewayMap":
+        """``"h1:p1,h2:p2,..."`` -> map (a single address is a 1-gateway map)."""
+        return cls(str(spec).split(","))
+
+    @classmethod
+    def discover(cls, coordinator_addr: Tuple[str, int],
+                 token: str = GATEWAY_TOKEN) -> "GatewayMap":
+        """Build the map from the coordinator's live ``serve_gateway``
+        registrations (lease-evicted gateways never appear). Raises
+        ``ValueError`` when no gateway has registered yet."""
+        from ...comm.discovery import discover_endpoints
+
+        records = discover_endpoints(coordinator_addr, token)
+        meta: Dict[str, dict] = {}
+        for r in records:
+            meta[f"{r['ip']}:{r['port']}"] = dict(r.get("meta") or {})
+        if not meta:
+            host, port = coordinator_addr
+            raise ValueError(
+                f"no {token!r} registrations at coordinator {host}:{port} "
+                "(are the gateways up, and started with --coordinator-addr?)"
+            )
+        addrs = sorted(meta)
+        return cls(addrs, meta=meta)
+
+    def players(self) -> List[str]:
+        """Every player id any gateway in the map advertises."""
+        out: List[str] = []
+        for addr in self.addrs:
+            for p in self.meta.get(addr, {}).get("players") or []:
+                if p not in out:
+                    out.append(p)
+        return out
+
+    def http_addr(self, addr: str) -> Optional[str]:
+        """The gateway's HTTP/JSON surface (``host:http_port``) when its
+        registration advertised one — the opsctl/status side-channel."""
+        http_port = self.meta.get(addr, {}).get("http_port")
+        if not http_port:
+            return None
+        host = addr.rpartition(":")[0] or "127.0.0.1"
+        return f"{host}:{http_port}"

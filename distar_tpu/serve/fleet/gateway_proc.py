@@ -1,0 +1,107 @@
+"""Standalone mock gateway: ``python -m distar_tpu.serve.fleet.gateway_proc``.
+
+The jax-free twin of ``bin/serve.py --mock`` (no model, no learner imports,
+no health stack) — what the fleet capacity harness, the serve chaos drill
+and the discovery tests spawn per gateway, so fleet members are real OS
+processes (own GIL, real sockets) that start in well under a second.
+Follows the ``replay.server`` fleet-process idiom: prints one parseable
+``SERVE-GATEWAY <host> <tcp_port> <http_port>`` line once serving, then
+runs until SIGTERM/SIGINT or stdin EOF (a dying parent reaps the fleet).
+
+``--players MP0,MP1`` serves several mock models behind the one address
+(``GatewayMux``); ``--coordinator host:port`` registers the data-plane
+endpoint under ``serve_gateway`` with lease/heartbeat so routers and
+opsctl discover it.
+"""
+from __future__ import annotations
+
+import argparse
+import signal
+import sys
+import threading
+
+
+def main(argv=None) -> int:
+    from ..engine import MockModelEngine
+    from ..gateway import InferenceGateway
+    from ..http_frontend import ServeHTTPServer
+    from ..mux import GatewayMux
+    from ..tcp_frontend import ServeTCPServer
+    from .discovery import register_gateway
+
+    p = argparse.ArgumentParser(description="standalone mock serve gateway")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=0, help="TCP data plane")
+    p.add_argument("--http-port", type=int, default=0)
+    p.add_argument("--slots", type=int, default=32)
+    p.add_argument("--players", default="",
+                   help="comma list -> multiplexed gateway (default: one "
+                        "anonymous player)")
+    p.add_argument("--version", default="v1", help="boot model version name")
+    p.add_argument("--mock-delay-s", type=float, default=0.0)
+    p.add_argument("--max-delay-ms", type=float, default=5.0)
+    p.add_argument("--queue-capacity", type=int, default=1024)
+    p.add_argument("--idle-ttl-s", type=float, default=300.0)
+    p.add_argument("--coordinator", default="",
+                   help="coordinator host:port to register under serve_gateway")
+    p.add_argument("--lease-s", type=float, default=10.0)
+    args = p.parse_args(argv)
+
+    players = [s.strip() for s in args.players.split(",") if s.strip()]
+
+    def build_gateway(player: str) -> InferenceGateway:
+        params = {"version": args.version, "bias": 0.0, "player": player}
+        gw = InferenceGateway(
+            MockModelEngine(args.slots, params=params, delay_s=args.mock_delay_s),
+            max_batch=args.slots,
+            max_delay_s=args.max_delay_ms / 1000.0,
+            queue_capacity=args.queue_capacity,
+            idle_ttl_s=args.idle_ttl_s,
+        )
+        gw.load_version(args.version, params=params, activate=True)
+        return gw
+
+    if players:
+        target = GatewayMux({pl: build_gateway(pl) for pl in players}).start()
+    else:
+        target = build_gateway("").start()
+
+    tcp = ServeTCPServer(target, host=args.host, port=args.port).start()
+    http = ServeHTTPServer(target, host=args.host, port=args.http_port).start()
+
+    beat = None
+    if args.coordinator:
+        chost, _, cport = args.coordinator.rpartition(":")
+        beat = register_gateway(
+            (chost or "127.0.0.1", int(cport)), tcp.host, tcp.port,
+            meta={"players": players, "slots": args.slots,
+                  "http_port": http.port, "version": args.version,
+                  "mock": True},
+            lease_s=args.lease_s,
+        )
+
+    # CLI entrypoint output: the parseable serving line callers wait for
+    print(f"SERVE-GATEWAY {tcp.host} {tcp.port} {http.port}",  # lint: allow-print
+          flush=True)
+    stop = threading.Event()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, lambda *_: stop.set())
+    try:
+        import select
+
+        while not stop.is_set():
+            ready, _, _ = select.select([sys.stdin], [], [], 0.5)
+            if ready and not sys.stdin.buffer.read(1):
+                break
+    except (OSError, ValueError, KeyboardInterrupt):
+        pass
+    if beat is not None:
+        beat.stop_event.set()
+    tcp.stop()
+    http.stop()
+    target.drain_and_stop(5.0)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,307 @@
+"""Fleet-wide model rollout: atomic hot-swap + canary-percent rollout.
+
+PR 2's hot-swap contract holds per gateway (activate at a flush boundary,
+zero in-flight loss). This module lifts it fleet-wide, coordinator-driven:
+
+  * ``FleetRollout.rollout(version, ...)`` — ALL-OR-NOTHING generation
+    bump across every gateway. Phase 1 loads + warms the version on every
+    gateway and collects acks; any NACK aborts with nothing activated
+    anywhere (a loaded-but-inactive version is inert). Phase 2 activates
+    gateway by gateway; a NACK mid-phase rolls every already-swapped
+    gateway back to the version it was serving — the fleet never settles
+    split-brained. Outcomes are counted in
+    ``distar_fleet_rollouts_total{outcome}``.
+
+  * canary: ``canary_start(version, canary_addrs, ...)`` activates the new
+    generation on a SUBSET of gateways only, and directs ``pct``% of NEW
+    sessions there (the deterministic hash split in ``FleetRouter``) — via
+    a ``router=`` handle for in-process routers, and by publishing the
+    config to the coordinator (``serve_canary`` token) for polling ones
+    (the standalone proxy's refresh loop applies it; in-client routers can
+    call ``fetch_canary`` on their own cadence). ``compare()`` reads both
+    pools' request outcomes + latency tails off gateway ``status``;
+    ``promote()`` is a normal atomic rollout plus clearing the canary
+    config. Existing sessions never migrate for a canary — affinity wins.
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+from ...obs import get_registry
+from ..errors import ServeError
+from .discovery import GatewayMap
+from .router import TRANSPORT_ERRORS, _split_addr
+
+#: coordinator token the live canary config is published under (a kv record,
+#: not an endpoint: latest-timestamp record wins, pct=0 means no canary)
+CANARY_TOKEN = "serve_canary"
+
+
+def publish_canary(coordinator_addr: Tuple[str, int], addrs: Sequence[str],
+                   pct: float, version: str = "") -> None:
+    """Publish (or clear, with ``pct=0``) the fleet's canary config. Routers
+    polling ``fetch_canary`` converge on it within their refresh cadence."""
+    from ...comm.coordinator import coordinator_request
+
+    host, port = coordinator_addr
+    coordinator_request(host, port, "register", {
+        "token": CANARY_TOKEN, "ip": "canary", "port": 0,
+        "meta": {"addrs": list(addrs), "pct": float(pct), "version": version},
+    })
+
+
+def fetch_canary(coordinator_addr: Tuple[str, int]) -> Optional[dict]:
+    """The latest published canary config (``{"addrs", "pct", "version"}``),
+    or None when nothing was ever published."""
+    from ...comm.discovery import discover_endpoints
+
+    records = discover_endpoints(coordinator_addr, CANARY_TOKEN)
+    if not records:
+        return None
+    latest = max(records, key=lambda r: r.get("ts", 0.0))
+    return dict(latest.get("meta") or {})
+
+
+class FleetRollout:
+    """Rollout controller over a gateway map (discovered or static)."""
+
+    def __init__(self, gateway_map: GatewayMap, timeout_s: float = 60.0,
+                 client_factory: Optional[Callable[[str], Any]] = None,
+                 coordinator_addr: Optional[Tuple[str, int]] = None):
+        self.map = gateway_map
+        self.timeout_s = float(timeout_s)
+        self.coordinator_addr = coordinator_addr
+        self._client_factory = client_factory
+        self._clients: Dict[str, Any] = {}
+        self._c_rollouts = {
+            outcome: get_registry().counter(
+                "distar_fleet_rollouts_total",
+                "fleet-wide rollout attempts by outcome", outcome=outcome)
+            for outcome in ("ok", "load_nack", "rolled_back", "rollback_failed")
+        }
+
+    # ------------------------------------------------------------------ plumbing
+    def _client(self, addr: str):
+        client = self._clients.get(addr)
+        if client is None:
+            if self._client_factory is not None:
+                client = self._client_factory(addr)
+            else:
+                from ..tcp_frontend import ServeClient
+
+                host, port = _split_addr(addr)
+                client = ServeClient(host, port, timeout_s=self.timeout_s)
+            self._clients[addr] = client
+        return client
+
+    def close(self) -> None:
+        clients, self._clients = list(self._clients.values()), {}
+        for c in clients:
+            try:
+                c.close()
+            except Exception:  # noqa: BLE001 - teardown best-effort
+                pass
+
+    def fleet_status(self, addrs: Optional[Sequence[str]] = None) -> Dict[str, dict]:
+        out: Dict[str, dict] = {}
+        for addr in addrs or self.map.addrs:
+            try:
+                out[addr] = self._client(addr).status()
+            except (ServeError,) + TRANSPORT_ERRORS as e:
+                out[addr] = {"error": repr(e)}
+        return out
+
+    # ------------------------------------------------------------------ rollout
+    def rollout(self, version: str, source: Optional[str] = None, params=None,
+                addrs: Optional[Sequence[str]] = None,
+                player: Optional[str] = None) -> dict:
+        """Atomic fleet-wide generation bump; see module docstring. Returns
+        ``{"ok", "outcome", "acks", "generations"|"rollback"}`` — never
+        raises for per-gateway NACKs (the verdict is the return value)."""
+        targets = list(addrs or self.map.addrs)
+        t0 = time.perf_counter()
+        # what each gateway serves NOW — the rollback target
+        prev: Dict[str, Optional[str]] = {}
+        for addr in targets:
+            st = self.fleet_status([addr])[addr]
+            if "error" in st:
+                self._c_rollouts["load_nack"].inc()
+                return {"ok": False, "outcome": "load_nack", "phase": "status",
+                        "acks": {addr: st["error"]}}
+            prev[addr] = (st.get("registry") or {}).get("current")
+
+        # phase 1: load + warm everywhere; a loaded version is inert until
+        # activated, so any NACK aborts with the fleet untouched
+        acks: Dict[str, Any] = {}
+        nack = False
+        for addr in targets:
+            try:
+                acks[addr] = self._client(addr).load(
+                    version, source=source, params=params, activate=False,
+                    player=player)
+            except (ServeError,) + TRANSPORT_ERRORS as e:
+                acks[addr] = {"error": repr(e)}
+                nack = True
+        if nack:
+            self._c_rollouts["load_nack"].inc()
+            return {"ok": False, "outcome": "load_nack", "phase": "load",
+                    "acks": acks}
+
+        # phase 2: activate gateway by gateway; NACK -> roll the already-
+        # swapped prefix back to what it was serving
+        generations: Dict[str, int] = {}
+        swapped: List[str] = []
+        for addr in targets:
+            try:
+                generations[addr] = self._client(addr).swap(version, player=player)
+                swapped.append(addr)
+            except (ServeError,) + TRANSPORT_ERRORS as e:
+                rollback: Dict[str, Any] = {}
+                failed = False
+                for done in swapped:
+                    target = prev[done]
+                    try:
+                        if target is None:
+                            raise ServeError(
+                                "no previous version to roll back to")
+                        rollback[done] = self._client(done).swap(
+                            target, player=player)
+                    except (ServeError,) + TRANSPORT_ERRORS as re:
+                        rollback[done] = {"error": repr(re)}
+                        failed = True
+                outcome = "rollback_failed" if failed else "rolled_back"
+                self._c_rollouts[outcome].inc()
+                return {"ok": False, "outcome": outcome, "phase": "swap",
+                        "failed_gateway": addr, "error": repr(e),
+                        "acks": acks, "rollback": rollback}
+        self._c_rollouts["ok"].inc()
+        return {"ok": True, "outcome": "ok", "acks": acks,
+                "generations": generations,
+                "elapsed_s": round(time.perf_counter() - t0, 4)}
+
+    # ------------------------------------------------------------------- canary
+    def canary_start(self, version: str, canary_addrs: Sequence[str],
+                     pct: float, source: Optional[str] = None, params=None,
+                     router=None, player: Optional[str] = None) -> dict:
+        """Activate ``version`` on the canary gateways only (atomic within
+        the subset) and direct ``pct``% of NEW sessions there — via the
+        given in-process ``router`` and/or the coordinator-published config
+        every polling router converges on."""
+        canary_addrs = [a for a in canary_addrs if a in self.map.meta]
+        if not canary_addrs:
+            raise ValueError("canary_start: no valid canary gateway addresses")
+        verdict = self.rollout(version, source=source, params=params,
+                               addrs=canary_addrs, player=player)
+        if not verdict["ok"]:
+            return verdict
+        if router is not None:
+            router.set_canary(canary_addrs, pct)
+        if self.coordinator_addr is not None:
+            publish_canary(self.coordinator_addr, canary_addrs, pct, version)
+        return {**verdict, "canary": {"addrs": canary_addrs, "pct": pct,
+                                      "version": version}}
+
+    def compare(self, canary_addrs: Sequence[str]) -> dict:
+        """Canary vs stable, from each gateway's own request accounting:
+        cumulative outcome counters, shed rate and latency tails per pool —
+        the promote/abort evidence. (Counters are lifetime; for a clean
+        A/B, snapshot before the canary and diff, or read the
+        ``distar_serve_*`` series over the canary window via the TSDB.)"""
+        canary_set = set(canary_addrs)
+        pools: Dict[str, dict] = {
+            "stable": {"gateways": 0, "requests": {}, "shed_rate": 0.0,
+                       "latency_p99_s": 0.0},
+            "canary": {"gateways": 0, "requests": {}, "shed_rate": 0.0,
+                       "latency_p99_s": 0.0},
+        }
+        for addr, st in self.fleet_status().items():
+            pool = pools["canary" if addr in canary_set else "stable"]
+            if "error" in st:
+                pool.setdefault("unreachable", []).append(addr)
+                continue
+            pool["gateways"] += 1
+            for k, v in (st.get("requests") or {}).items():
+                pool["requests"][k] = pool["requests"].get(k, 0.0) + v
+            pool["shed_rate"] += st.get("shed_rate", 0.0)
+            pool["latency_p99_s"] = max(
+                pool["latency_p99_s"], (st.get("latency_s") or {}).get("p99", 0.0))
+        for pool in pools.values():
+            if pool["gateways"]:
+                pool["shed_rate"] = round(pool["shed_rate"] / pool["gateways"], 6)
+        return pools
+
+    def promote(self, version: str, source: Optional[str] = None, params=None,
+                router=None, player: Optional[str] = None) -> dict:
+        """The canary graduated: atomic fleet-wide rollout of ``version``,
+        then clear the canary split (pins stay — sessions already on canary
+        gateways are now on the fleet generation anyway)."""
+        verdict = self.rollout(version, source=source, params=params,
+                               player=player)
+        if verdict["ok"]:
+            if router is not None:
+                router.clear_canary()
+            if self.coordinator_addr is not None:
+                publish_canary(self.coordinator_addr, [], 0.0, version)
+        return verdict
+
+
+def main(argv=None) -> int:
+    """Operator CLI: ``python -m distar_tpu.serve.fleet.rollout <cmd>``.
+
+    ``status`` prints per-gateway serving state; ``rollout`` drives the
+    atomic fleet-wide swap; ``canary`` activates a subset + publishes the
+    routing split to the coordinator; ``promote`` graduates it. Exit 0 only
+    when the fleet converged (rollback leaves exit 1 with the verdict
+    printed as JSON)."""
+    import argparse
+    import json
+
+    p = argparse.ArgumentParser(description="serve-fleet rollout controller")
+    p.add_argument("command", choices=("status", "rollout", "canary", "promote"))
+    p.add_argument("--gateways", default="", help="static 'h1:p1,h2:p2' list")
+    p.add_argument("--discover", default="",
+                   help="coordinator host:port to discover gateways from")
+    p.add_argument("--version", default="", help="registry version name")
+    p.add_argument("--source", default="", help="checkpoint storage URL")
+    p.add_argument("--canary-addrs", default="",
+                   help="canary: comma list of gateway addrs to canary")
+    p.add_argument("--canary-pct", type=float, default=10.0)
+    p.add_argument("--player", default="", help="multiplexed gateways: player id")
+    p.add_argument("--timeout-s", type=float, default=60.0)
+    args = p.parse_args(argv)
+    if bool(args.gateways) == bool(args.discover):
+        p.error("exactly one of --gateways / --discover")
+    coordinator = None
+    if args.discover:
+        host, _, port = args.discover.rpartition(":")
+        coordinator = (host or "127.0.0.1", int(port))
+        gateway_map = GatewayMap.discover(coordinator)
+    else:
+        gateway_map = GatewayMap.parse(args.gateways)
+    ctl = FleetRollout(gateway_map, timeout_s=args.timeout_s,
+                       coordinator_addr=coordinator)
+    player = args.player or None
+    try:
+        if args.command == "status":
+            print(json.dumps(ctl.fleet_status(),  # lint: allow-print
+                             default=str, indent=1))
+            return 0
+        if not args.version or not args.source:
+            p.error(f"{args.command} requires --version and --source")
+        if args.command == "rollout":
+            verdict = ctl.rollout(args.version, source=args.source, player=player)
+        elif args.command == "canary":
+            addrs = [a for a in args.canary_addrs.split(",") if a.strip()]
+            verdict = ctl.canary_start(args.version, addrs, args.canary_pct,
+                                       source=args.source, player=player)
+        else:  # promote
+            verdict = ctl.promote(args.version, source=args.source, player=player)
+        print(json.dumps(verdict, default=str))  # lint: allow-print
+        return 0 if verdict.get("ok") else 1
+    finally:
+        ctl.close()
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

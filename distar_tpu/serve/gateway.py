@@ -220,12 +220,24 @@ class InferenceGateway:
         return self.registry.activate(version)
 
     def status(self) -> dict:
+        requests = {name: c.value for name, c in self._c_req.items()}
+        total = sum(requests.values())
         return {
             "draining": self._draining,
             "queue_depth": self.batcher.depth,
             "served_version": self._served_version,
+            # the generation actually serving (applied at a flush boundary),
+            # which trails registry.generation during an in-progress swap
+            "generation": self._applied_generation,
             "sessions": self.sessions.stats(),
             "registry": self.registry.status(),
+            # cumulative outcome counters + latency tails: what the fleet
+            # rollout's canary-vs-stable compare and the opsctl serving
+            # digest read per gateway
+            "requests": requests,
+            "shed_rate": round(requests.get("shed", 0.0) / total, 6) if total else 0.0,
+            "latency_s": {"p50": self._h_latency.quantile(0.5),
+                          "p99": self._h_latency.quantile(0.99)},
         }
 
     # ---------------------------------------------------------------- flush

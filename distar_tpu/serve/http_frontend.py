@@ -56,9 +56,14 @@ def arrayify(obj):
 
 class ServeHTTPServer:
     def __init__(self, gateway, host: str = "127.0.0.1", port: int = 0):
-        gw = gateway
+        root = gateway
 
         def routes(name: str, body: dict):
+            # multiplexed gateways resolve the optional ``player`` field
+            # (absent = default player; single-model gateways ignore it)
+            gw = root
+            if hasattr(gw, "resolve"):
+                gw = gw.resolve(body.get("player"))
             if name == "act":
                 out = gw.act(
                     body["session_id"], arrayify(body["obs"]), body.get("timeout_s")

@@ -28,6 +28,12 @@ fleet's step, per-lane results (including per-lane typed sheds) come back
 in one frame, and different actors' cycles coalesce in the server's
 micro-batcher.
 
+Every request may carry an optional ``player`` field: multiplexed servers
+(``serve.mux.GatewayMux`` — one address, several player models) resolve it
+to the right model; single-model servers ignore it; absent means the
+server's default player — so legacy single-model clients keep working
+unchanged against both server generations.
+
 Serve errors answer ``{code: <wire code>, error, shed}`` (errors.to_wire);
 the client rehydrates them into the typed exceptions.
 """
@@ -145,6 +151,12 @@ class ServeTCPServer:
         op = req["op"]
         gw = self.gateway
         try:
+            # multiplexed gateways (serve.mux.GatewayMux, fleet router
+            # adapter) resolve the optional wire ``player`` field to the
+            # right model; a plain single-model gateway ignores it — legacy
+            # clients never send it and keep working unchanged
+            if hasattr(gw, "resolve"):
+                gw = gw.resolve(req.get("player"))
             if op == "act":
                 out = gw.act(req["session_id"], req["obs"], req.get("timeout_s"),
                              want_teacher=bool(req.get("want_teacher", False)))
@@ -195,12 +207,19 @@ class ServeClient:
     deadlines — are application answers, never retried here: shed/backoff
     decisions belong to the caller. NOTE: a retried ``act`` may execute twice
     on the server (at-least-once); inference is idempotent per (session,
-    obs), so replays are safe for every current op."""
+    obs), so replays are safe for every current op.
+
+    ``player`` (ctor default and/or per-call) stamps the wire ``player``
+    field so one multiplexed gateway address can serve several player
+    models (``serve.mux.GatewayMux``); a single-model server ignores the
+    field, so stamped clients interoperate with legacy gateways."""
 
     def __init__(self, host: str, port: int, timeout_s: float = 30.0,
-                 retry_policy: Optional[RetryPolicy] = None):
+                 retry_policy: Optional[RetryPolicy] = None,
+                 player: Optional[str] = None):
         self._addr = (host, port)
         self._timeout_s = timeout_s
+        self._player = player
         self._policy = retry_policy or RetryPolicy(
             max_attempts=3, backoff_base_s=0.2, backoff_max_s=2.0,
             deadline_s=4 * timeout_s,
@@ -236,16 +255,23 @@ class ServeClient:
             policy=self._policy,
         )
 
+    def _stamp(self, req: dict, player: Optional[str]) -> dict:
+        p = self._player if player is None else player
+        if p is not None:
+            req["player"] = p
+        return req
+
     def act(self, session_id: str, obs, timeout_s: Optional[float] = None,
-            want_teacher: bool = False) -> dict:
+            want_teacher: bool = False, player: Optional[str] = None) -> dict:
         req = {"op": "act", "session_id": session_id, "obs": obs}
         if timeout_s is not None:
             req["timeout_s"] = timeout_s
         if want_teacher:
             req["want_teacher"] = True
-        return self._call(req)["outputs"]
+        return self._call(self._stamp(req, player))["outputs"]
 
-    def act_many(self, requests, timeout_s: Optional[float] = None) -> list:
+    def act_many(self, requests, timeout_s: Optional[float] = None,
+                 player: Optional[str] = None) -> list:
         """One cycle of requests in one frame; returns a per-request list of
         output dicts or typed ``ServeError`` INSTANCES (per-lane sheds come
         back as values, not raises — partial success keeps its lanes).
@@ -256,36 +282,42 @@ class ServeClient:
         req = {"op": "act_many", "requests": list(requests)}
         if timeout_s is not None:
             req["timeout_s"] = timeout_s
-        entries = self._call(req)["results"]
+        entries = self._call(self._stamp(req, player))["results"]
         return [e["ok"] if isinstance(e, dict) and "ok" in e else error_from_wire(e)
                 for e in entries]
 
-    def reserve(self, session_ids) -> dict:
+    def reserve(self, session_ids, player: Optional[str] = None) -> dict:
         """Bulk session pre-allocation; typed ``CapacityError`` on shortfall
         (exact-capacity admission — nothing sheds mid-episode)."""
-        return self._call({"op": "reserve", "session_ids": list(session_ids)})["slots"]
+        return self._call(self._stamp(
+            {"op": "reserve", "session_ids": list(session_ids)}, player))["slots"]
 
-    def hidden(self, session_id: str):
-        return self._call({"op": "hidden", "session_id": session_id})["hidden"]
+    def hidden(self, session_id: str, player: Optional[str] = None):
+        return self._call(self._stamp(
+            {"op": "hidden", "session_id": session_id}, player))["hidden"]
 
-    def set_teacher(self, params) -> bool:
-        return self._call({"op": "set_teacher", "params": params})["ok"]
+    def set_teacher(self, params, player: Optional[str] = None) -> bool:
+        return self._call(self._stamp(
+            {"op": "set_teacher", "params": params}, player))["ok"]
 
-    def reset(self, session_id: str) -> bool:
-        return self._call({"op": "reset", "session_id": session_id})["reset"]
+    def reset(self, session_id: str, player: Optional[str] = None) -> bool:
+        return self._call(self._stamp(
+            {"op": "reset", "session_id": session_id}, player))["reset"]
 
-    def end(self, session_id: str) -> bool:
-        return self._call({"op": "end", "session_id": session_id})["ended"]
+    def end(self, session_id: str, player: Optional[str] = None) -> bool:
+        return self._call(self._stamp(
+            {"op": "end", "session_id": session_id}, player))["ended"]
 
     def load(self, version: str, source: Optional[str] = None, params=None,
-             activate: bool = False) -> dict:
-        return self._call(
+             activate: bool = False, player: Optional[str] = None) -> dict:
+        return self._call(self._stamp(
             {"op": "load", "version": version, "source": source, "params": params,
-             "activate": activate}
+             "activate": activate}, player)
         )["info"]
 
-    def swap(self, version: str) -> int:
-        return self._call({"op": "swap", "version": version})["generation"]
+    def swap(self, version: str, player: Optional[str] = None) -> int:
+        return self._call(self._stamp(
+            {"op": "swap", "version": version}, player))["generation"]
 
     def status(self) -> dict:
         return self._call({"op": "status"})["status"]

@@ -437,12 +437,12 @@ def test_retried_insert_after_lost_ack_does_not_double_apply(tmp_path):
     original_send = server._send_counted
     dropped = []
 
-    def drop_first_ack(conn, obj, compress):
+    def drop_first_ack(conn, obj, compress, codec="lz4"):
         if not dropped and isinstance(obj, dict) and "seq" in obj:
             dropped.append(obj["seq"])
             conn.close()  # post-commit reset: the ack dies on the wire
             raise ConnectionError("chaos: ack dropped after commit")
-        return original_send(conn, obj, compress)
+        return original_send(conn, obj, compress, codec)
 
     server._send_counted = drop_first_ack
     try:

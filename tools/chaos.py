@@ -14,6 +14,7 @@ faults tests already prove survivable:
         [--no-spill] [--seed 0]
   python tools/chaos.py multichip-drill --dir /tmp/mc_drill \\
         [--mesh dp=4,fsdp=2] [--resume-mesh dp=8] [--kill-after 2] [--iters 5]
+  python tools/chaos.py serve-drill --gateways 3 [--sessions 48] [--steps 8]
 
 ``corrupt`` damages a checkpoint in place (the resume path must fall back);
 ``kill`` sends a signal to a role process (the supervisor/orchestrator must
@@ -330,6 +331,124 @@ def cmd_multichip_drill(args) -> int:
     return 0 if ok else 1
 
 
+def cmd_serve_drill(args) -> int:
+    """Gateway-loss drill on the serving fleet: N real gateway processes
+    behind the session-affinity router, one killed mid-episode under load.
+
+    The contract being proven (docs/serving.md fleet section): (a) every
+    session — including every session pinned to the victim — finishes its
+    episode; (b) the router re-routes the victim's sessions to survivors
+    within one retry budget, and each re-routed session's carry
+    re-materializes from zero, counted EXACTLY (migrations ==
+    victim-pinned sessions, detected via session_step running backwards);
+    (c) callers see ZERO typed-error leakage beyond shed accounting — a
+    dead gateway surfaces as transparent failover, never as an error
+    return. Exit 0 only when all three hold."""
+    import subprocess
+    import threading
+
+    import numpy as np
+
+    from distar_tpu.obs import get_registry
+    from distar_tpu.serve import ShedError
+    from distar_tpu.serve.fleet import FleetClient, GatewayMap
+
+    def spawn():
+        cmd = [sys.executable, "-m", "distar_tpu.serve.fleet.gateway_proc",
+               "--port", "0", "--http-port", "0", "--slots", str(args.slots)]
+        proc = subprocess.Popen(cmd, stdin=subprocess.PIPE,
+                                stdout=subprocess.PIPE,
+                                stderr=subprocess.DEVNULL, text=True)
+        parts = proc.stdout.readline().split()
+        if len(parts) < 4 or parts[0] != "SERVE-GATEWAY":
+            raise RuntimeError(f"gateway failed to start: {parts}")
+        return proc, f"{parts[1]}:{parts[2]}"
+
+    inj = ChaosInjector(seed=args.seed)
+    spawned = [spawn() for _ in range(args.gateways)]
+    procs = [p for p, _ in spawned]
+    addrs = [a for _, a in spawned]
+    fc = FleetClient(gateway_map=GatewayMap(addrs), timeout_s=10.0,
+                     down_ttl_s=60.0)
+    obs = {"x": np.ones((4, 4), dtype=np.float32)}
+    sids = [f"drill-{i}" for i in range(args.sessions)]
+    completed = {sid: 0 for sid in sids}
+    sheds = [0]
+    errors = []
+    lock = threading.Lock()
+    kill_at = max(1, args.steps // 2)
+    killed = [None]
+
+    def step_all(step: int) -> None:
+        """One fleet cycle: every session steps once (sheds retried within
+        the cycle — they are backpressure, not loss)."""
+        pending = list(sids)
+        deadline = time.monotonic() + 30.0
+        while pending and time.monotonic() < deadline:
+            results = fc.act_many(
+                [{"session_id": s, "obs": obs} for s in pending], timeout_s=10.0)
+            nxt = []
+            for s, r in zip(pending, results):
+                if isinstance(r, ShedError):
+                    with lock:
+                        sheds[0] += 1
+                    nxt.append(s)
+                elif isinstance(r, Exception):
+                    with lock:
+                        errors.append((s, step, repr(r)))
+                else:
+                    completed[s] += 1
+            pending = nxt
+            if pending:
+                time.sleep(0.05)
+        for s in pending:
+            with lock:
+                errors.append((s, step, "cycle budget exhausted"))
+
+    migrations0 = get_registry().snapshot().get(
+        "distar_fleet_session_migrations_total", 0.0)
+    for step in range(args.steps):
+        step_all(step)
+        if step + 1 == kill_at:
+            # the chaos moment: kill the gateway holding the most sessions
+            pins = fc.router.stats()["pins_per_gateway"]
+            victim = max(pins, key=lambda a: pins[a])
+            killed[0] = {"addr": victim, "pinned": pins[victim]}
+            inj.kill_role(procs[addrs.index(victim)], name=f"serve:{victim}")
+            procs[addrs.index(victim)].wait(timeout=10)
+    migrations = get_registry().snapshot().get(
+        "distar_fleet_session_migrations_total", 0.0) - migrations0
+
+    finished = sum(1 for s in sids if completed[s] == args.steps)
+    fc.close()
+    for proc in procs:
+        try:
+            proc.stdin.close()
+            proc.wait(timeout=10)
+        except Exception:
+            proc.kill()
+    verdict = {
+        "gateways": args.gateways, "sessions": args.sessions,
+        "steps": args.steps, "killed": killed[0],
+        "finished_sessions": finished,
+        "migrations": migrations,
+        "sheds_retried": sheds[0],
+        "error_leaks": len(errors),
+        "events": [e["kind"] for e in inj.events],
+    }
+    print(json.dumps(verdict))
+    ok = (
+        finished == args.sessions
+        and killed[0] is not None
+        and migrations == killed[0]["pinned"]
+        and not errors
+    )
+    print("verdict: gateway killed under load; every session re-routed and "
+          "finished; migrations counted exactly; zero error leakage"
+          if ok else f"verdict: DRILL FAILED {errors[:5]}")
+    return 0 if ok else 1
+
+
 def cmd_latest(args) -> int:
     mgr = CheckpointManager(args.dir)
     gens = mgr.generations()
@@ -385,6 +504,16 @@ def main() -> int:
                    help="counter-demo: run without durability and show the loss")
     d.add_argument("--seed", type=int, default=0)
 
+    s = sub.add_parser("serve-drill",
+                       help="kill 1 of N serve gateways under load; prove "
+                            "router re-route + exact migration accounting")
+    s.add_argument("--gateways", type=int, default=3)
+    s.add_argument("--sessions", type=int, default=48)
+    s.add_argument("--steps", type=int, default=8,
+                   help="episode length per session (kill at the midpoint)")
+    s.add_argument("--slots", type=int, default=64, help="slots per gateway")
+    s.add_argument("--seed", type=int, default=0)
+
     m = sub.add_parser("multichip-drill",
                        help="kill a multichip learner after a sharded save; "
                             "prove resume on a DIFFERENT mesh shape")
@@ -406,6 +535,7 @@ def main() -> int:
     return {"corrupt": cmd_corrupt, "kill": cmd_kill,
             "reset": cmd_reset, "latest": cmd_latest,
             "replay-drill": cmd_replay_drill,
+            "serve-drill": cmd_serve_drill,
             "multichip-drill": cmd_multichip_drill}[args.command](args)
 
 

@@ -24,6 +24,19 @@ Modes (the canonical load-test shapes):
     (``CapacityError``) — the summary reports the shed RATE, which is the
     eval-farm sizing number.
 
+  * fleet    — the multi-gateway capacity harness (``--mode fleet``):
+    spawns ``--gateways`` real gateway SUBPROCESSES (the jax-free
+    ``serve.fleet.gateway_proc``, ``--slots`` lanes each — or drives an
+    external fleet via ``--tcp a:p,b:p``), mounts the session-affinity
+    ``FleetClient`` router over them, and sweeps ``--fleet-levels``
+    CONCURRENT resident sessions: each level allocates that many sticky
+    sessions fleet-wide (worker threads interleave many live sessions
+    each, so concurrency is server-side slot residency, not thread
+    count), steps every session ``--requests-per-session`` times and
+    ends it. Reports the sessions/gateway distribution and the
+    shed-rate curve as levels sweep past fleet slot capacity — the
+    numbers a 10k+ session deployment is sized against.
+
 Output: bench.py-style JSON result lines on stdout (the LAST line is the
 summary), optionally mirrored to ``--artifact <path>``. A mid-run hot swap
 (``--swap-at <frac>``) exercises the registry under load and reports swap
@@ -33,6 +46,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import threading
 import time
@@ -173,6 +187,193 @@ def emit(line: dict, artifact_lines: List[dict]) -> None:
     artifact_lines.append(line)
 
 
+# --------------------------------------------------------------- fleet mode
+def _spawn_gateway_fleet(n: int, slots: int, delay_s: float):
+    """``n`` real mock-gateway subprocesses (jax-free gateway_proc — own
+    GIL, real sockets). Returns ``(procs, addrs)``; closing a proc's stdin
+    reaps it (the replay bench fleet idiom)."""
+    import subprocess
+
+    procs, addrs = [], []
+    for _ in range(n):
+        cmd = [sys.executable, "-m", "distar_tpu.serve.fleet.gateway_proc",
+               "--port", "0", "--http-port", "0", "--slots", str(slots),
+               "--mock-delay-s", str(delay_s)]
+        proc = subprocess.Popen(cmd, stdin=subprocess.PIPE,
+                                stdout=subprocess.PIPE,
+                                stderr=subprocess.DEVNULL, text=True)
+        parts = proc.stdout.readline().split()
+        if len(parts) < 4 or parts[0] != "SERVE-GATEWAY":
+            raise RuntimeError(f"gateway failed to start: {parts}")
+        addrs.append(f"{parts[1]}:{parts[2]}")
+        procs.append(proc)
+    return procs, addrs
+
+
+def _reap_gateway_fleet(procs) -> None:
+    for proc in procs:
+        try:
+            proc.stdin.close()
+            proc.wait(timeout=10)
+        except Exception:
+            proc.kill()
+
+
+def run_fleet_loadgen(
+    gateways: int = 3,
+    slots: int = 512,
+    fleet_levels: str = "",
+    fleet_workers: int = 32,
+    requests_per_session: int = 4,
+    mock_delay_s: float = 0.0,
+    timeout_s: float = 10.0,
+    tcp: Optional[str] = None,
+    artifact: Optional[str] = None,
+) -> dict:
+    """The multi-gateway capacity harness (``--mode fleet``); importable —
+    the fleet smoke test and the FLEET_r* artifact runs call this. Returns
+    the summary dict (= last stdout JSON line), which carries the in-band
+    honesty flags (``host_cores``, ``scaling_valid``): on a small CI host
+    the whole fleet time-shares the cores, so the curve proves the routed
+    fleet EXECUTES at each level, not that it scales."""
+    from distar_tpu.serve.fleet import FleetClient, GatewayMap
+
+    host_cores = len(os.sched_getaffinity(0)) if hasattr(os, "sched_getaffinity") \
+        else (os.cpu_count() or 1)
+    if tcp:
+        procs, addrs = [], [a.strip() for a in tcp.split(",") if a.strip()]
+    else:
+        procs, addrs = _spawn_gateway_fleet(gateways, slots, mock_delay_s)
+    capacity = slots * len(addrs)
+    if fleet_levels:
+        levels = [int(x) for x in fleet_levels.split(",") if x.strip()]
+    else:
+        # sweep up THROUGH fleet capacity and past it: the shed knee is
+        # the measurement
+        levels = sorted({max(1, capacity // 6), max(1, capacity // 2),
+                         capacity, capacity + max(1, capacity // 4)})
+    artifact_lines: List[dict] = []
+    from distar_tpu.serve.fleet import FleetRouter
+
+    # ONE router (pins, migration accounting, down-list) shared by
+    # per-worker FleetClients: a ServeClient holds one connection with one
+    # request in flight, so per-worker clients are what lets W requests
+    # ride the wire concurrently while affinity state stays coherent
+    router = FleetRouter(GatewayMap(addrs))
+    clients = [FleetClient(router=router, timeout_s=timeout_s)
+               for _ in range(fleet_workers)]
+    obs = _make_obs(0)
+    curve: List[dict] = []
+    try:
+        for level in levels:
+            stats = _Stats()
+            shed_arrival = [0]
+            live_sessions: List[List[str]] = [[] for _ in range(fleet_workers)]
+            lock = threading.Lock()
+            # workers interleave their share of the level's sessions so all
+            # admitted sessions are RESIDENT (slot held, carry live) at once
+            arrived = threading.Barrier(fleet_workers + 1)
+            sampled = threading.Barrier(fleet_workers + 1)
+
+            def worker(w: int, sids: List[str]) -> None:
+                fc = clients[w]
+                mine = live_sessions[w]
+                for sid in sids:  # arrival pass: allocate the sticky slot
+                    t0 = time.perf_counter()
+                    try:
+                        fc.act(sid, obs, timeout_s)
+                        stats.record(time.perf_counter() - t0, "ok")
+                        mine.append(sid)
+                    except ShedError:
+                        stats.record(None, "shed")
+                        with lock:
+                            shed_arrival[0] += 1
+                    except Exception:
+                        stats.record(None, "error")
+                arrived.wait()
+                sampled.wait()  # main thread reads live residency here
+                for _step in range(max(requests_per_session - 1, 0)):
+                    for sid in mine:
+                        t0 = time.perf_counter()
+                        try:
+                            fc.act(sid, obs, timeout_s)
+                            stats.record(time.perf_counter() - t0, "ok")
+                        except ShedError:
+                            stats.record(None, "shed")
+                        except Exception:
+                            stats.record(None, "error")
+                for sid in mine:
+                    try:
+                        fc.end(sid)
+                    except Exception:
+                        pass
+
+            sids = [f"fleet-{level}-{i}" for i in range(level)]
+            shares = [sids[w::fleet_workers] for w in range(fleet_workers)]
+            t_start = time.perf_counter()
+            threads = [threading.Thread(target=worker, args=(w, shares[w]))
+                       for w in range(fleet_workers)]
+            for t in threads:
+                t.start()
+            arrived.wait()
+            # every admitted session now holds a slot somewhere: measure
+            # true server-side residency + the per-gateway distribution
+            per_gateway = dict(router.stats()["pins_per_gateway"])
+            resident = sum(len(m) for m in live_sessions)
+            sampled.wait()
+            for t in threads:
+                t.join()
+            elapsed = time.perf_counter() - t_start
+            total = stats.ok + stats.shed + stats.errors
+            row = {
+                "level": level,
+                "concurrent_resident": resident,
+                "sessions_per_gateway": per_gateway,
+                "shed_at_arrival": shed_arrival[0],
+                "session_shed_rate": round(shed_arrival[0] / max(level, 1), 4),
+                "shed_rate": round(stats.shed / max(total, 1), 4),
+                "errors": stats.errors,
+                "req_per_s": round(stats.ok / max(elapsed, 1e-9), 2),
+                "latency_p50_s": round(stats.quantile(0.5), 6),
+                "latency_p99_s": round(stats.quantile(0.99), 6),
+                "elapsed_s": round(elapsed, 3),
+            }
+            curve.append(row)
+            emit({"metric": "fleet level", **row}, artifact_lines)
+    finally:
+        for fc in clients:
+            fc.close()
+        _reap_gateway_fleet(procs)
+    best = max((r["concurrent_resident"] for r in curve), default=0)
+    snap = get_registry().snapshot()
+    summary = {
+        "metric": "serve fleet concurrent resident sessions "
+                  "(mock gateways, loopback)",
+        "value": best,
+        "unit": "sessions",
+        "mode": "fleet",
+        "device": "cpu",
+        "cpu_derived": True,
+        "host_cores": host_cores,
+        # the fleet needs cores to scale onto — gateways + the client side;
+        # on a smaller host the curve still proves routed capacity executes
+        "scaling_valid": host_cores >= len(addrs) + 1,
+        "gateways": len(addrs),
+        "slots_per_gateway": slots,
+        "fleet_slot_capacity": capacity,
+        "requests_per_session": requests_per_session,
+        "fleet_curve": curve,
+        "migrations": snap.get("distar_fleet_session_migrations_total", 0.0),
+        "errors_total": sum(r["errors"] for r in curve),
+    }
+    emit(summary, artifact_lines)
+    if artifact:
+        with open(artifact, "w") as f:
+            for line in artifact_lines:
+                f.write(json.dumps(line) + "\n")
+    return summary
+
+
 def run_loadgen(
     mode: str = "closed",
     clients: int = 8,
@@ -190,10 +391,20 @@ def run_loadgen(
     tcp: Optional[str] = None,
     http: Optional[str] = None,
     artifact: Optional[str] = None,
+    gateways: int = 3,
+    fleet_levels: str = "",
+    fleet_workers: int = 32,
 ) -> dict:
     """Importable driver (the slow soak test calls this). Returns the
     summary dict that is also the last stdout JSON line."""
-    assert mode in ("closed", "open", "sessions")
+    assert mode in ("closed", "open", "sessions", "fleet")
+    if mode == "fleet":
+        return run_fleet_loadgen(
+            gateways=gateways, slots=slots, fleet_levels=fleet_levels,
+            fleet_workers=fleet_workers,
+            requests_per_session=requests_per_session,
+            mock_delay_s=mock_delay_s, timeout_s=timeout_s, tcp=tcp,
+            artifact=artifact)
     if tcp:
         target = _TcpTarget(tcp)
     elif http:
@@ -353,7 +564,20 @@ def run_loadgen(
 
 def main() -> None:
     p = argparse.ArgumentParser(description=__doc__)
-    p.add_argument("--mode", choices=("closed", "open", "sessions"), default="closed")
+    p.add_argument("--mode", choices=("closed", "open", "sessions", "fleet"),
+                   default="closed")
+    p.add_argument("--gateways", type=int, default=3,
+                   help="fleet mode: gateway subprocesses to spawn (ignored "
+                        "with --tcp, which may name an external fleet "
+                        "'a:p,b:p')")
+    p.add_argument("--fleet-levels", default="",
+                   help="fleet mode: comma list of concurrent-resident-"
+                        "session levels to sweep (default: auto up through "
+                        "fleet slot capacity and past it)")
+    p.add_argument("--fleet-workers", type=int, default=32,
+                   help="fleet mode: driver threads (each interleaves many "
+                        "live sessions; concurrency = resident slots, not "
+                        "threads)")
     p.add_argument("--clients", type=int, default=8, help="closed-loop workers")
     p.add_argument("--rate", type=float, default=200.0,
                    help="open-loop request arrivals/s; sessions mode: "

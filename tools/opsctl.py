@@ -25,7 +25,12 @@ from GET ``/replay/stats`` — and for a SHARD FLEET it aggregates: pass the
 admin surfaces via ``--replay-addrs a:p,b:p,...`` or probe the coordinator,
 whose ``replay_shard`` registrations are auto-discovered; the digest then
 shows every shard's tables plus a fleet-aggregate line (total residency,
-summed limiter block time, staleness span). ``profile`` talks to a LEARNER ADMIN surface
+summed limiter block time, staleness span). Probing a coordinator also
+prints the SERVING-FLEET digest: every ``serve_gateway`` registration's
+gateway block (sessions/slots occupancy, shed rate, model generation +
+served version, read off each gateway's own ``/serve/status``) plus an
+aggregate line whose served-version spread says whether a fleet rollout has
+converged. ``profile`` talks to a LEARNER ADMIN surface
 (``rl_train --admin-port``): captures --steps iterations of jax.profiler
 trace on the live learner and prints the ranked per-bucket attribution
 table (obs/traceview.py).
@@ -113,6 +118,89 @@ def _discover_replay_admins(addr: str, timeout: float = 5.0) -> list:
         if admin_port:
             admins.append(f"{rec['ip']}:{admin_port}")
     return sorted(set(admins))
+
+
+def _try_post(addr: str, path: str, body: dict, timeout: float = 5.0):
+    """Optional POST probe (serve frontends answer /serve/status on POST):
+    None on unreachable/unserved — never exits."""
+    try:
+        req = urllib.request.Request(
+            f"http://{addr}{path}", data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return json.loads(resp.read())
+    except (urllib.error.URLError, ConnectionError, TimeoutError, OSError,
+            ValueError):
+        return None
+
+
+def _discover_serve_gateways(addr: str) -> list:
+    """Serving-fleet discovery for ``status``: when the probed address is a
+    coordinator, its ``serve_gateway`` registrations name every live
+    gateway plus the HTTP port each advertised. Returns
+    ``[(tcp_addr, http_addr, meta)]``; [] when the address isn't a
+    coordinator or no gateway registered — never exits."""
+    body = _try_post(addr, "/coordinator/peers", {"token": "serve_gateway"})
+    seen = {}
+    for rec in ((body or {}).get("info") or []):
+        meta = rec.get("meta") or {}
+        tcp_addr = f"{rec['ip']}:{rec['port']}"
+        http_port = meta.get("http_port")
+        http_addr = f"{rec['ip']}:{http_port}" if http_port else None
+        seen[tcp_addr] = (tcp_addr, http_addr, meta)
+    return [seen[k] for k in sorted(seen)]
+
+
+def _print_serve_fleet(gateways: list) -> None:
+    """Serving-fleet digest for ``status``: one block per discovered
+    gateway (sessions/occupancy, shed rate, model generation + served
+    version — read off its own /serve/status) and a fleet aggregate line
+    (total sessions/slots, weighted shed rate, the served-version spread that
+    says whether a rollout has converged)."""
+    if not gateways:
+        return
+    print("serving fleet:")
+    agg = {"sessions": 0, "slots": 0, "shed_num": 0.0, "shed_den": 0.0,
+           "versions": set(), "unreachable": 0}
+    for tcp_addr, http_addr, meta in gateways:
+        players = ",".join(meta.get("players") or []) or "-"
+        st = _try_post(http_addr, "/serve/status", {}) if http_addr else None
+        info = (st or {}).get("info") if isinstance(st, dict) else None
+        if not info or (st or {}).get("code") != 0:
+            agg["unreachable"] += 1
+            print(f"  [{tcp_addr}] players={players} UNREACHABLE "
+                  f"(http={http_addr})")
+            continue
+        sess = info.get("sessions") or {}
+        active = sess.get("active", 0)
+        slots = sess.get("num_slots", 0)
+        occ = active / slots if slots else 0.0
+        reqs = info.get("requests") or {}
+        total = sum(reqs.values())
+        gen = info.get("generation", (info.get("registry") or {}).get("generation"))
+        # convergence is about the VERSION each gateway is on — generation
+        # numbers are per-gateway monotonic counters (a canaried gateway
+        # legitimately runs one ahead after promote)
+        version = (info.get("registry") or {}).get("current") \
+            or info.get("served_version")
+        print(f"  [{tcp_addr}] players={players} sessions={active}/{slots} "
+              f"occ={occ:5.2f} shed_rate={info.get('shed_rate', 0.0):.4f} "
+              f"gen={gen} serving={version} "
+              f"q={info.get('queue_depth', 0)}"
+              + (" DRAINING" if info.get("draining") else ""))
+        agg["sessions"] += active
+        agg["slots"] += slots
+        agg["shed_num"] += reqs.get("shed", 0.0)
+        agg["shed_den"] += total
+        agg["versions"].add(version)
+    occ = agg["sessions"] / agg["slots"] if agg["slots"] else 0.0
+    shed = agg["shed_num"] / agg["shed_den"] if agg["shed_den"] else 0.0
+    versions = sorted(str(v) for v in agg["versions"])
+    converged = "converged" if len(versions) <= 1 else f"SPLIT {versions}"
+    print(f"  aggregate: {len(gateways)} gateways  "
+          f"{agg['sessions']}/{agg['slots']} sessions (occ={occ:.2f})  "
+          f"shed_rate={shed:.4f}  versions={converged}"
+          + (f"  unreachable={agg['unreachable']}" if agg["unreachable"] else ""))
 
 
 def _print_replay(per_shard: dict) -> None:
@@ -285,6 +373,10 @@ def cmd_status(args) -> int:
         replay = _try_get(args.addr, "/replay/stats")
         if replay:
             _print_replay({args.addr: replay})
+    # serving-fleet digest: gateways auto-discovered from a probed
+    # coordinator's serve_gateway registrations (each block read off the
+    # gateway's own /serve/status)
+    _print_serve_fleet(_discover_serve_gateways(args.addr))
     _print_perf_digest(args.addr)
     _print_actor_digest(args.addr)
     return {"ok": 0, "warning": 1}.get(status, 2)

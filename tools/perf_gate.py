@@ -222,9 +222,11 @@ def collect_trajectory(repo: str = _REPO) -> List[dict]:
                        + glob.glob(os.path.join(repo, "BENCH_LOCAL_r*.json"))
                        + glob.glob(os.path.join(repo, "ROLLOUT_r*.json"))
                        + glob.glob(os.path.join(repo, "REPLAY_SHARD_r*.json"))
+                       + glob.glob(os.path.join(repo, "FLEET_r*.json"))
                        + glob.glob(os.path.join(repo, "artifacts", "perf_baseline*.json"))
                        + glob.glob(os.path.join(repo, "artifacts", "rollout_*.json"))
-                       + glob.glob(os.path.join(repo, "artifacts", "replay_*.json"))):
+                       + glob.glob(os.path.join(repo, "artifacts", "replay_*.json"))
+                       + glob.glob(os.path.join(repo, "artifacts", "fleet_*.json"))):
         try:
             doc = load_artifact(path)
         except (OSError, ValueError):
@@ -234,6 +236,19 @@ def collect_trajectory(repo: str = _REPO) -> List[dict]:
             "metric": doc.get("metric", "?"), "value": doc.get("value"),
             "unit": doc.get("unit", ""), "status": _status_of(doc),
         })
+        curve = doc.get("fleet_curve") or []
+        if curve:
+            # the serve-fleet artifact carries the capacity sweep in-band;
+            # surface the at-capacity shed knee as its own trajectory row
+            knee = max(curve, key=lambda r: r.get("level", 0))
+            rows.append({
+                "round": _round_of(path), "artifact": os.path.basename(path),
+                "metric": (f"fleet session shed rate at "
+                           f"{knee.get('level')} offered sessions "
+                           f"({doc.get('gateways')} gateways)"),
+                "value": knee.get("session_shed_rate"), "unit": "",
+                "status": _status_of(doc),
+            })
         fast = doc.get("replay_fast_path") or {}
         if fast.get("vs_tcp_loopback"):
             # the sharded-replay artifact carries the colocated fast-path
