@@ -58,7 +58,9 @@ ACTOR_DEFAULTS = Config(
             # ``mirror`` additionally keeps the shuttle push alive
             # (migration/dual-write drills); ``priority`` seeds the table
             # priority for fresh trajectories; ``compress`` is this side's
-            # wire-compression preference (negotiated per connection).
+            # wire-compression preference (negotiated per connection);
+            # ``transport`` picks the data-plane leg (auto negotiates shm
+            # rings with colocated stores, tcp forces the socket).
             "replay": {
                 "enabled": False,
                 "addr": "",
@@ -66,6 +68,7 @@ ACTOR_DEFAULTS = Config(
                 "priority": 1.0,
                 "timeout_s": 60.0,
                 "compress": True,
+                "transport": "auto",
             },
             # rollout inference plane (docs/serving.md, Sebulba split):
             # ``inline`` keeps today's per-actor BatchedInference; ``local``
@@ -79,6 +82,9 @@ ACTOR_DEFAULTS = Config(
                 "slots": 0,
                 "max_delay_s": 0.005,
                 "timeout_s": 30.0,
+                # remote-backend transport: auto negotiates shm rings per
+                # gateway connection when colocated (docs/data_plane.md)
+                "transport": "auto",
             },
         }
     }
@@ -646,6 +652,7 @@ class Actor:
         if self._replay_client is None:
             target = self._replay_target()
             compress = bool(self._replay_cfg().get("compress", True))
+            transport = str(self._replay_cfg().get("transport", "auto"))
             if isinstance(target, str):  # inproc fast path
                 from ..replay import LocalReplayClient
 
@@ -653,12 +660,14 @@ class Actor:
             elif len(target) == 1:
                 from ..replay import InsertClient
 
-                self._replay_client = InsertClient(*target[0], compress=compress)
+                self._replay_client = InsertClient(*target[0], compress=compress,
+                                                   transport=transport)
             else:
                 from ..replay import ShardMap, ShardedInsertClient
 
                 self._replay_client = ShardedInsertClient(
-                    ShardMap([f"{h}:{p}" for h, p in target]), compress=compress)
+                    ShardMap([f"{h}:{p}" for h, p in target]), compress=compress,
+                    transport=transport)
         return self._replay_client
 
     def push_trajectory(self, player_id: str, traj) -> None:

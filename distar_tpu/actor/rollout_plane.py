@@ -326,7 +326,10 @@ class RolloutPlane:
                  slots: int = 0, max_delay_s: float = 0.005,
                  timeout_s: float = 30.0, queue_capacity: int = 1024,
                  idle_ttl_s: float = 300.0, model=None, engine_factory=None,
-                 coordinator_addr: str = ""):
+                 coordinator_addr: str = "", transport: str = "auto"):
+        #: remote-backend transport preference (shm rings for colocated
+        #: gateways — the Sebulba "never touch a socket on-host" leg)
+        self.transport = str(transport or "auto")
         if backend not in PLANE_BACKENDS:
             raise ValueError(
                 f"actor.plane.backend must be one of {PLANE_BACKENDS}, got {backend!r}"
@@ -392,10 +395,12 @@ class RolloutPlane:
                 host, _, port = self.coordinator_addr.rpartition(":")
                 return FleetClient(
                     coordinator_addr=(host or "127.0.0.1", int(port)),
-                    timeout_s=self.timeout_s, player=player_id or None)
+                    timeout_s=self.timeout_s, player=player_id or None,
+                    transport=self.transport)
             return FleetClient(gateway_map=GatewayMap.parse(self.addr),
                                timeout_s=self.timeout_s,
-                               player=player_id or None)
+                               player=player_id or None,
+                               transport=self.transport)
         from ..serve.tcp_frontend import ServeClient
 
         host, port = self._remote_addr()
@@ -404,7 +409,7 @@ class RolloutPlane:
         # rides through on re-materialized carries
         return ServeClient(
             host, port, timeout_s=self.timeout_s,
-            player=player_id or None,
+            player=player_id or None, transport=self.transport,
             retry_policy=RetryPolicy(
                 max_attempts=10, backoff_base_s=0.2, backoff_max_s=2.0,
                 deadline_s=max(4 * self.timeout_s, 30.0),

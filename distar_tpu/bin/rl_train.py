@@ -250,6 +250,7 @@ def _learner_replay_client(args, addrs: str):
     )
 
     compress = getattr(args, "replay_compress", True)
+    transport = getattr(args, "transport", "auto")
     if is_inproc_addr(addrs):
         return LocalReplayClient()
     if addrs.strip().lower() == "discover":
@@ -257,9 +258,10 @@ def _learner_replay_client(args, addrs: str):
     else:
         shard_map = ShardMap.parse(addrs)
     if len(shard_map) == 1:
-        return SampleClient(*_addr(shard_map.addrs[0]), compress=compress)
+        return SampleClient(*_addr(shard_map.addrs[0]), compress=compress,
+                            transport=transport)
     return ShardedSampleClient(shard_map, mode=args.replay_fanin,
-                               compress=compress)
+                               compress=compress, transport=transport)
 
 
 def run_replay(args) -> None:
@@ -280,11 +282,13 @@ def run_replay(args) -> None:
     def serve_loop(ctx):
         store = _build_replay_store(args, shard_id=shard_id)
         server = ReplayServer(store, port=args.port,
-                              compress=args.replay_compress)
+                              compress=args.replay_compress,
+                              transport=args.transport)
         server.start()
         admin = None
         if args.metrics_port is not None:
-            admin = ReplayAdminServer(store, port=args.metrics_port)
+            admin = ReplayAdminServer(store, port=args.metrics_port,
+                                      server=server)
             admin.start()
             print(f"replay admin on http://{admin.host}:{admin.port}/replay/stats",
                   flush=True)
@@ -376,10 +380,12 @@ def run_all(args) -> None:
                                         spill_dir=spill_dir)
             replay_servers.append(
                 ReplayServer(store, port=0,
-                             compress=args.replay_compress).start())
+                             compress=args.replay_compress,
+                             transport=args.transport).start())
         addrs = ",".join(f"{s.host}:{s.port}" for s in replay_servers)
         actor_replay_cfg = {"replay": {"enabled": True, "addr": addrs,
-                                       "compress": args.replay_compress}}
+                                       "compress": args.replay_compress,
+                                       "transport": args.transport}}
         print(f"replay store{'s' if len(replay_servers) > 1 else ''} "
               f"(in-process) on {addrs}", flush=True)
 
@@ -463,6 +469,7 @@ def _plane_cfg(args) -> dict:
         "addr": args.plane_addr,
         "slots": args.plane_slots,
         "coordinator_addr": args.coordinator_addr or "",
+        "transport": getattr(args, "transport", "auto"),
     }
 
 
@@ -546,7 +553,8 @@ def run_actor(args) -> None:
                 ShardMap.discover(_addr(args.coordinator_addr)).addrs)
             print(f"replay: discovered shard fleet {replay_addr}", flush=True)
         actor_cfg["replay"] = {"enabled": True, "addr": replay_addr,
-                               "compress": args.replay_compress}
+                               "compress": args.replay_compress,
+                               "transport": args.transport}
     actor = Actor(
         cfg={"actor": actor_cfg},
         league=league,
@@ -680,6 +688,15 @@ def main() -> None:
                         "pushes and learner samples through a direct "
                         "in-process handle, no sockets, no serialization "
                         "(the Sebulba layout's data plane)")
+    p.add_argument("--transport", default="auto",
+                   choices=("auto", "shm", "tcp"),
+                   help="data-plane transport for every colocated hop "
+                        "(replay push/sample, rollout-plane remote): auto "
+                        "negotiates shared-memory rings per connection "
+                        "when client and server share a host (TCP stays "
+                        "the control channel + fallback leg), shm is the "
+                        "same policy, tcp refuses rings everywhere "
+                        "(docs/data_plane.md transport negotiation)")
     p.add_argument("--no-replay-compress", dest="replay_compress",
                    action="store_false", default=True,
                    help="disable wire compression on replay data-plane "

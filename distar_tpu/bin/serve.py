@@ -116,6 +116,12 @@ def main() -> None:
     p.add_argument("--no-health", action="store_true",
                    help="disable the fleet-health subsystem (watchdog rules, "
                         "TSDB, crash recorder)")
+    p.add_argument("--transport", default="auto",
+                   choices=("auto", "shm", "tcp"),
+                   help="TCP-frontend transport policy: auto/shm negotiate "
+                        "shared-memory rings with colocated clients (the "
+                        "socket stays as control channel + fallback), tcp "
+                        "refuses rings (cross-host posture)")
     args = p.parse_args()
     player_ckpts = dict(s.split("=", 1) for s in args.player_checkpoint)
     if not args.mock and not args.checkpoint and not player_ckpts:
@@ -155,7 +161,8 @@ def main() -> None:
     target.start()
 
     http = ServeHTTPServer(target, host=args.host, port=args.http_port).start()
-    tcp = ServeTCPServer(target, host=args.host, port=args.tcp_port).start()
+    tcp = ServeTCPServer(target, host=args.host, port=args.tcp_port,
+                         transport=args.transport).start()
 
     beat = None
     if args.coordinator_addr:

@@ -2,6 +2,13 @@ from .adapter import Adapter
 from .coordinator import Coordinator, CoordinatorServer, coordinator_request
 from .serializer import dumps, loads
 from . import shuttle
+from .shm_ring import (
+    ShmError,
+    ShmPeer,
+    ShmPeerDeadError,
+    ShmRing,
+    shm_available,
+)
 from ..resilience import CommError  # typed transport error raised by this package
 
 __all__ = [
@@ -13,4 +20,9 @@ __all__ = [
     "dumps",
     "loads",
     "shuttle",
+    "ShmError",
+    "ShmPeer",
+    "ShmPeerDeadError",
+    "ShmRing",
+    "shm_available",
 ]

@@ -37,6 +37,11 @@ try:  # optional: the image may not ship a zstd binding — everything gates
 except ImportError:
     _zstd = None
 
+#: every codec NAME the protocol defines, available here or not — a hello
+#: preference list containing none of these is garbage (a hostile or
+#: desynced peer) and servers NACK it typed instead of silently degrading
+KNOWN_CODECS = ("lz4", "zlib", "zstd")
+
 #: negotiable wire codec names, preference-ordered for this host. "lz4" is
 #: the legacy default (native LZ4-block with a zlib-1 fallback encoder —
 #: one name, because a receiver handles both magics regardless); "zstd"
@@ -98,6 +103,18 @@ def dumps_sized(obj: Any, compress: bool = True,
 
 def dumps(obj: Any, compress: bool = True, codec: str = "lz4") -> bytes:
     return dumps_sized(obj, compress=compress, codec=codec)[0]
+
+
+def dump_stream(obj: Any, fileobj) -> None:
+    """Serialize ``obj`` uncompressed straight into a writable file-like —
+    the shm-ring zero-intermediate-copy path. Pickle protocol 5 streams
+    each large numpy buffer into ``fileobj.write`` as its own chunk, so a
+    ring-backed file receives the array bytes directly into the mapped
+    memory with no intermediate ``bytes`` object. The output is a valid
+    ``loads`` payload (``MAGIC_RAW`` framing); compression is deliberately
+    absent — both ends share RAM, the codec pass would only add copies."""
+    fileobj.write(MAGIC_RAW)
+    pickle.Pickler(fileobj, protocol=5).dump(obj)
 
 
 def loads_sized(blob: bytes) -> "tuple[Any, int]":

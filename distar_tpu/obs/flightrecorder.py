@@ -50,7 +50,28 @@ class FlightRecorder:
         self._prev_excepthook = None
         self._prev_sigterm = None
         self._dump_args: Dict[str, Any] = {}
+        self._crash_callbacks: List[Any] = []
         self.last_dump_path: Optional[str] = None
+
+    # ------------------------------------------------------- crash callbacks
+    def add_crash_callback(self, fn) -> None:
+        """Register a cleanup to run whenever a crash bundle is dumped —
+        resource reclamation that must happen even on an unclean exit (the
+        shm transport unlinks its live rings here so a crashed fleet
+        leaves no /dev/shm litter). Idempotent per callable; every failure
+        is swallowed (cleanup must never raise over the crash)."""
+        with self._lock:
+            if fn not in self._crash_callbacks:
+                self._crash_callbacks.append(fn)
+
+    def _run_crash_callbacks(self) -> None:
+        with self._lock:
+            callbacks = list(self._crash_callbacks)
+        for fn in callbacks:
+            try:
+                fn()
+            except Exception:
+                pass
 
     # ----------------------------------------------------------------- events
     def record(self, kind: str, **fields) -> dict:
@@ -75,6 +96,7 @@ class FlightRecorder:
         """Write the forensic bundle; returns its path. Every failure mode
         short of the filesystem itself is swallowed into the bundle — a crash
         dump must not raise over the crash it is documenting."""
+        self._run_crash_callbacks()
         reg = registry or get_registry()
         try:
             snapshot = reg.snapshot()

@@ -23,6 +23,17 @@ the server answers the ANDed setting plus the chosen codec name, and both
 directions honour them. A pre-negotiation server (or one that answers
 hello with an error) degrades to the legacy always-compressed lz4
 contract, so mixed-version fleets interoperate.
+
+Transport rides the same ``hello`` (``transport="auto"``, the default):
+when client and server share a host the server mints a shared-memory ring
+pair (``comm.shm_ring``) and every data frame moves over the rings —
+pickle straight into mapped memory, no socket, no codec — while the TCP
+socket stays connected as the control channel and fallback leg. Any shm
+fault (peer death mid-frame, oversized frame, CRC corruption) is typed:
+the client counts the fallback, drops the rings, and the SAME logical
+call completes over TCP — with inserts carrying their idempotency key
+across the legs, the fallback is exactly-once from the caller's seat.
+``transport="tcp"`` keeps the hello byte-identical to the pre-shm wire.
 """
 from __future__ import annotations
 
@@ -31,6 +42,7 @@ import threading
 import uuid
 from typing import Any, Dict, List, Optional, Tuple
 
+from ..comm import shm_ring
 from ..comm.serializer import maybe_decode, recv_msg, send_msg, supported_codecs
 from ..resilience import CircuitBreaker, RetryPolicy, retry_call
 from .errors import error_from_wire
@@ -48,7 +60,7 @@ class _ReplayClientBase:
                  retry_policy: Optional[RetryPolicy] = None,
                  breaker: Optional[CircuitBreaker] = None,
                  op_prefix: str = "replay", compress: bool = True,
-                 codec: str = "lz4"):
+                 codec: str = "lz4", transport: str = "auto"):
         self._addr = (host, port)
         self._timeout_s = timeout_s
         self._policy = retry_policy or DEFAULT_REPLAY_POLICY
@@ -67,42 +79,89 @@ class _ReplayClientBase:
                  if c in supported_codecs()]
         self._want_codecs = prefs or ["lz4"]
         self._neg_codec = "lz4"
+        #: transport preference; the per-connection outcome lands in _shm
+        #: (a live ring pair) — None means this connection runs framed TCP
+        shm_ring.offer_transports(transport)  # validate the name early
+        self._transport = transport
+        self._shm: Optional[shm_ring.ShmPeer] = None
         self.server_shard_id: str = ""
+
+    @property
+    def transport_active(self) -> str:
+        """The leg this connection's data frames currently ride."""
+        return "shm" if self._shm is not None else "tcp"
 
     def _connect(self) -> None:
         self.close()
         self._sock = socket.create_connection(self._addr, timeout=self._timeout_s)
         self._sock.settimeout(self._timeout_s)
+        hello = {"op": "hello", "compress": self._want_compress,
+                 "codecs": list(self._want_codecs)}
+        offers = shm_ring.offer_transports(self._transport)
+        if "shm" in offers:
+            # only a hello that can actually lead to rings carries the
+            # transport keys — a --transport tcp client stays byte-identical
+            # to the pre-shm wire
+            hello["transports"] = offers
+            hello["host"] = shm_ring.host_identity()
         try:
-            send_msg(self._sock, {"op": "hello", "compress": self._want_compress,
-                                  "codecs": list(self._want_codecs)},
-                     compress=False)
+            send_msg(self._sock, hello, compress=False)
             resp = recv_msg(self._sock)
         except (ConnectionError, OSError, ValueError):
             self.close()
             raise
+        if isinstance(resp, dict) and resp.get("code") == "bad_hello":
+            # the server recognized NOTHING we offered: a config/version
+            # fault that degrading would only hide — surface it typed
+            self.close()
+            raise error_from_wire(resp)
         if isinstance(resp, dict) and resp.get("code") == 0 and "compress" in resp:
             self._neg_compress = bool(resp["compress"])
             self._neg_codec = str(resp.get("codec") or "lz4")
             self.server_shard_id = str(resp.get("shard", "") or "")
+            if "shm" in offers:
+                self._shm = shm_ring.maybe_attach(resp, op=self._op_prefix)
         else:
             # pre-negotiation server: it answered hello with an error frame
             # and will compress every response — mirror the legacy contract
             self._neg_compress = True
             self._neg_codec = "lz4"
 
+    def _drop_shm(self) -> None:
+        shm, self._shm = self._shm, None
+        if shm is not None:
+            shm.close()
+
     def _call_once(self, req: dict) -> dict:
         with self._lock:
             if self._sock is None:
                 self._connect()
-            try:
-                send_msg(self._sock, req, compress=self._neg_compress,
-                         codec=self._neg_codec)
-                resp = recv_msg(self._sock)
-            except (ConnectionError, OSError, ValueError):
-                # stream no longer trustworthy: drop it so the retry dials
-                self.close()
-                raise
+            resp = None
+            if self._shm is not None:
+                try:
+                    resp = self._shm.request(req, timeout_s=self._timeout_s)
+                except shm_ring.ShmTimeout:
+                    # peer alive but wedged past the budget: same contract
+                    # as a socket timeout — drop everything, let the retry
+                    # dial (and renegotiate) fresh
+                    self._drop_shm()
+                    self.close()
+                    raise
+                except shm_ring.ShmError as e:
+                    # ring fault (peer death mid-frame, oversized frame,
+                    # corruption): typed + counted, then THIS call falls
+                    # back to the TCP leg below — zero loss for the caller
+                    shm_ring.note_fallback(e.reason)
+                    self._drop_shm()
+            if resp is None:
+                try:
+                    send_msg(self._sock, req, compress=self._neg_compress,
+                             codec=self._neg_codec)
+                    resp = recv_msg(self._sock)
+                except (ConnectionError, OSError, ValueError):
+                    # stream no longer trustworthy: drop it so the retry dials
+                    self.close()
+                    raise
         if resp.get("code") != 0:
             raise error_from_wire(resp)
         return resp
@@ -126,6 +185,7 @@ class _ReplayClientBase:
         return self._call({"op": "tables"})["tables"]
 
     def close(self) -> None:
+        self._drop_shm()
         sock, self._sock = self._sock, None
         if sock is not None:
             try:

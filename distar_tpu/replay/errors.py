@@ -61,9 +61,19 @@ class ItemCorruptError(ReplayError):
     code = "item_corrupt"
 
 
+class BadHelloError(ReplayError):
+    """The connection's ``hello`` offered preference lists with no
+    recognized name at all (garbage codec/transport names — a hostile or
+    desynced peer). Deliberately NOT retryable, and never silently
+    degraded: a peer that can't even name a real codec must be told so."""
+
+    code = "bad_hello"
+
+
 _WIRE_CODES = {
     cls.code: cls
-    for cls in (ReplayError, UnknownTableError, InvalidBatchError, ItemCorruptError)
+    for cls in (ReplayError, UnknownTableError, InvalidBatchError,
+                ItemCorruptError, BadHelloError)
 }
 
 

@@ -32,6 +32,16 @@ skip the frame-level compression pass regardless (recompressing lz output
 buys bytes-per-CPU nothing). ``distar_replay_{tx,rx}_bytes_{raw,wire}``
 counters account both directions so the compression ratio actually paid
 for is a scrapeable number, not a guess.
+
+Transport is negotiated in the same ``hello``: a client advertising
+``transports: [shm, tcp]`` plus this host's identity gets a shm ring pair
+minted (``comm.shm_ring``) and the connection's data frames move over the
+rings — zero socket, zero codec, pickle straight into mapped memory —
+while the TCP socket stays open as the control channel and fallback leg
+(a ring fault or peer death is detected typed and the client's next
+attempt rides TCP). A hello whose codec/transport preference lists
+contain no recognized name at all is answered with the typed
+``bad_hello`` NACK instead of silently degrading.
 """
 from __future__ import annotations
 
@@ -40,6 +50,7 @@ import socket
 import threading
 from typing import Optional
 
+from ..comm import shm_ring
 from ..comm.serializer import (
     Opaque,
     dumps_sized,
@@ -60,7 +71,8 @@ class ReplayServer:
 
     def __init__(self, store: ReplayStore, host: str = "127.0.0.1", port: int = 0,
                  default_timeout_s: float = 30.0, compress: bool = True,
-                 codecs: Optional[tuple] = None):
+                 codecs: Optional[tuple] = None, transport: str = "auto",
+                 ring_bytes: int = shm_ring.DEFAULT_RING_BYTES):
         self.store = store
         self.default_timeout_s = default_timeout_s
         #: server-side compression enablement; the per-connection setting is
@@ -69,6 +81,17 @@ class ReplayServer:
         #: codecs this server is willing to speak (restrictable per deploy);
         #: the per-connection codec is the client's first preference in here
         self.codecs = tuple(codecs) if codecs is not None else supported_codecs()
+        #: transport policy: "auto" negotiates shm with colocated clients,
+        #: "shm" the same (shm never *forces* — the TCP leg always remains),
+        #: "tcp" refuses rings entirely (the cross-host / drill posture)
+        if transport not in ("auto", "shm", "tcp"):
+            raise ValueError(f"transport must be auto|shm|tcp, got {transport!r}")
+        self.transport = transport
+        self.ring_bytes = int(ring_bytes)
+        #: live per-transport connection counts (the opsctl digest's
+        #: "active transport per connection" answer, served via /replay/stats)
+        self._transports = {"tcp": 0, "shm": 0}
+        self._transports_lock = threading.Lock()
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._listener.bind((host, port))
@@ -77,6 +100,7 @@ class ReplayServer:
         self._stop = threading.Event()
         self._accept_thread: Optional[threading.Thread] = None
         self._conns: set = set()
+        self._ring_services: set = set()
         self._conns_lock = threading.Lock()
         reg = get_registry()
         shard = getattr(store, "shard_id", "") or ""
@@ -120,11 +144,17 @@ class ReplayServer:
             pass
         with self._conns_lock:
             conns = list(self._conns)
+            rings = list(self._ring_services)
         for conn in conns:
             try:
                 conn.close()
             except OSError:
                 pass
+        # sever the shm leg SYNCHRONOUSLY: a closed socket does not stop a
+        # ring pump, and a stopped server must not keep answering data
+        # frames out of shared memory (the in-process kill-drill contract)
+        for svc in rings:
+            svc.stop()
         t = self._accept_thread
         if t is not None:
             t.join(5.0)
@@ -165,12 +195,22 @@ class ReplayServer:
         self._c_tx_wire.inc(len(blob))
         self._c_tx_raw.inc(raw_len)
 
+    def _count_transport(self, kind: str, delta: int) -> None:
+        with self._transports_lock:
+            self._transports[kind] = max(0, self._transports[kind] + delta)
+
+    def transport_counts(self) -> dict:
+        with self._transports_lock:
+            return dict(self._transports)
+
     def _serve_conn(self, conn: socket.socket) -> None:
         self._g_conns.inc()
         with self._conns_lock:
             self._conns.add(conn)
         compress = self.compress  # legacy clients never negotiate: stay on
         codec = "lz4"  # ...and never leave the legacy codec
+        ring_svc = None  # set when this connection negotiates shm
+        self._count_transport("tcp", +1)
         try:
             with conn:
                 while not self._stop.is_set():
@@ -185,6 +225,16 @@ class ReplayServer:
                         return
                     self._c_requests.inc()
                     if isinstance(req, dict) and req.get("op") == "hello":
+                        # a hello whose preference lists name NOTHING this
+                        # protocol knows is garbage: NACK typed, never
+                        # silently degrade (then drop the stream — a peer
+                        # that desynced once can't be trusted framed)
+                        nack = shm_ring.hello_nack(req)
+                        if nack:
+                            self._send_counted(
+                                conn, {"code": "bad_hello", "error": nack},
+                                compress, codec)
+                            return
                         # per-connection negotiation: both sides commit to
                         # the ANDed compression setting and the intersected
                         # codec choice for every later frame
@@ -192,6 +242,23 @@ class ReplayServer:
                         codec = negotiate_codec(req.get("codecs"), self.codecs)
                         reply = {"code": 0, "compress": compress, "codec": codec,
                                  "shard": getattr(self.store, "shard_id", "")}
+                        if ring_svc is None:
+                            # transport leg: mint a ring pair when client +
+                            # server share this host; data frames then move
+                            # over shm while this socket stays the control/
+                            # fallback channel
+                            extra, peer = shm_ring.negotiate_server(
+                                req, self.transport, self.ring_bytes,
+                                op="replay")
+                            reply.update(extra)
+                            if peer is not None:
+                                ring_svc = shm_ring.RingService(
+                                    peer, self._dispatch,
+                                    name="replay-shm-ring").start()
+                                with self._conns_lock:
+                                    self._ring_services.add(ring_svc)
+                                self._count_transport("tcp", -1)
+                                self._count_transport("shm", +1)
                         try:
                             self._send_counted(conn, reply, compress, codec)
                         except (ConnectionError, OSError):
@@ -203,8 +270,15 @@ class ReplayServer:
                     except (ConnectionError, OSError):
                         return
         finally:
+            if ring_svc is not None:
+                ring_svc.stop()
+                self._count_transport("shm", -1)
+            else:
+                self._count_transport("tcp", -1)
             with self._conns_lock:
                 self._conns.discard(conn)
+                if ring_svc is not None:
+                    self._ring_services.discard(ring_svc)
             self._g_conns.dec()
 
     def _dispatch(self, req) -> dict:
@@ -257,10 +331,14 @@ class ReplayAdminServer:
     fleet-health routes (``/healthz``, ``/alerts``, ``/timeseries``), and
     GET ``/replay/stats`` (tables + limiter + spill JSON, the opsctl feed)."""
 
-    def __init__(self, store: ReplayStore, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, store: ReplayStore, host: str = "127.0.0.1", port: int = 0,
+                 server: Optional[ReplayServer] = None):
         from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
         self.store = store
+        #: optional data-plane server handle: lets /replay/stats report the
+        #: live per-connection transport split (shm vs tcp) for opsctl
+        self.data_server = server
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -275,7 +353,10 @@ class ReplayAdminServer:
                     write_scrape_response(self)
                     return
                 if path == "/replay/stats":
-                    data = json.dumps(outer.store.stats(), default=str).encode()
+                    stats = outer.store.stats()
+                    if outer.data_server is not None:
+                        stats["transports"] = outer.data_server.transport_counts()
+                    data = json.dumps(stats, default=str).encode()
                     self.send_response(200)
                     self.send_header("Content-Type", "application/json")
                     self.send_header("Content-Length", str(len(data)))
@@ -337,6 +418,10 @@ def main(argv=None) -> int:
                    help="comma list restricting the codecs this shard will "
                         "negotiate (default: everything the host supports; "
                         "lz4 always remains the fallback)")
+    p.add_argument("--transport", default="auto", choices=("auto", "shm", "tcp"),
+                   help="data-plane transport policy: auto/shm negotiate "
+                        "shared-memory rings with colocated clients, tcp "
+                        "refuses rings (cross-host posture)")
     args = p.parse_args(argv)
 
     cfg = TableConfig(
@@ -352,7 +437,8 @@ def main(argv=None) -> int:
     recovered = store.recover()
     codecs = tuple(c for c in args.codecs.split(",") if c.strip()) or None
     server = ReplayServer(store, host=args.host, port=args.port,
-                          compress=args.compress, codecs=codecs).start()
+                          compress=args.compress, codecs=codecs,
+                          transport=args.transport).start()
     # CLI entrypoint output: the parseable serving line callers wait for
     print(f"REPLAY-SHARD {server.host} {server.port} "  # lint: allow-print
           f"recovered={recovered}", flush=True)

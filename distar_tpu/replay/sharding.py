@@ -143,11 +143,12 @@ class _ShardedBase:
 
     def __init__(self, shard_map: ShardMap, retry_policy: Optional[RetryPolicy] = None,
                  compress: bool = True, timeout_s: float = 60.0,
-                 codec: str = "lz4"):
+                 codec: str = "lz4", transport: str = "auto"):
         self.shard_map = shard_map
         self._retry_policy = retry_policy
         self._compress = compress
         self._codec = codec
+        self._transport = transport
         self._timeout_s = timeout_s
         self._clients: Dict[str, object] = {}
         self._lock = threading.Lock()
@@ -160,10 +161,17 @@ class _ShardedBase:
                 client = type(self)._client_cls(
                     host, port, timeout_s=self._timeout_s,
                     retry_policy=self._retry_policy, compress=self._compress,
-                    codec=self._codec,
+                    codec=self._codec, transport=self._transport,
                 )
                 self._clients[addr] = client
             return client
+
+    def transports(self) -> Dict[str, str]:
+        """Active transport per dialed shard connection (shm for colocated
+        shards, tcp for cross-host ones — mixed fleets are expected)."""
+        with self._lock:
+            return {addr: c.transport_active
+                    for addr, c in self._clients.items()}
 
     def ping(self) -> bool:
         return all(self.client_for(a).ping() for a in self.shard_map.addrs)

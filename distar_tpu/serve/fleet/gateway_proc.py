@@ -45,6 +45,9 @@ def main(argv=None) -> int:
     p.add_argument("--coordinator", default="",
                    help="coordinator host:port to register under serve_gateway")
     p.add_argument("--lease-s", type=float, default=10.0)
+    p.add_argument("--transport", default="auto", choices=("auto", "shm", "tcp"),
+                   help="TCP-frontend transport policy (auto/shm negotiate "
+                        "shared-memory rings with colocated clients)")
     args = p.parse_args(argv)
 
     players = [s.strip() for s in args.players.split(",") if s.strip()]
@@ -66,7 +69,8 @@ def main(argv=None) -> int:
     else:
         target = build_gateway("").start()
 
-    tcp = ServeTCPServer(target, host=args.host, port=args.port).start()
+    tcp = ServeTCPServer(target, host=args.host, port=args.port,
+                         transport=args.transport).start()
     http = ServeHTTPServer(target, host=args.host, port=args.http_port).start()
 
     beat = None

@@ -270,7 +270,8 @@ class FleetClient:
                  timeout_s: float = 30.0, player: Optional[str] = None,
                  retry_policy: Optional[RetryPolicy] = None,
                  client_factory: Optional[Callable[[str], Any]] = None,
-                 down_ttl_s: float = 10.0):
+                 down_ttl_s: float = 10.0, transport: str = "auto"):
+        self.transport = transport
         if router is None:
             if gateway_map is None:
                 if coordinator_addr is None:
@@ -297,8 +298,10 @@ class FleetClient:
         from ..tcp_frontend import ServeClient
 
         host, port = _split_addr(addr)
+        # transport negotiates per gateway: colocated members of a mixed
+        # fleet ride shm, remote ones fall out to framed TCP naturally
         return ServeClient(host, port, timeout_s=self.timeout_s,
-                           retry_policy=self._policy)
+                           retry_policy=self._policy, transport=self.transport)
 
     def _client_for(self, addr: str):
         with self._lock:

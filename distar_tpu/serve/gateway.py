@@ -222,7 +222,12 @@ class InferenceGateway:
     def status(self) -> dict:
         requests = {name: c.value for name, c in self._c_req.items()}
         total = sum(requests.values())
+        # live per-connection transport split (shm vs tcp), stamped on by
+        # the TCP frontend when one is mounted — the opsctl serving
+        # digest's "which leg is each connection on" answer
+        transports = getattr(self, "_tcp_transports", None)
         return {
+            **({"transports": transports()} if callable(transports) else {}),
             "draining": self._draining,
             "queue_depth": self.batcher.depth,
             "served_version": self._served_version,

@@ -109,8 +109,12 @@ class GatewayMux:
             for k, v in (st.get("requests") or {}).items():
                 requests[k] = requests.get(k, 0.0) + v
         total = sum(requests.values())
+        # the TCP frontend stamps its per-connection transport split on the
+        # object it fronts — for a mux that is the mux itself, not a player
+        transports = getattr(self, "_tcp_transports", None)
         return {
             **default,
+            **({"transports": transports()} if callable(transports) else {}),
             "sessions": sessions,
             "requests": requests,
             "shed_rate": round(requests.get("shed", 0.0) / total, 6) if total else 0.0,

@@ -9,6 +9,7 @@ connection is a session's natural home but nothing enforces it — the
 many sessions.
 
 Requests are ``{"op": ..., ...}`` dicts:
+  hello    {transports?, host?}            -> {code: 0, transport, shm_*?}
   act      {session_id, obs, timeout_s?, want_teacher?} -> {code: 0, outputs}
   act_many {requests: [{session_id, obs, want_teacher?}], timeout_s?}
                                            -> {code: 0, results: [entry]}
@@ -28,6 +29,15 @@ fleet's step, per-lane results (including per-lane typed sheds) come back
 in one frame, and different actors' cycles coalesce in the server's
 micro-batcher.
 
+``hello`` is the transport negotiation (``comm.shm_ring``): a client
+advertising ``transports: [shm, tcp]`` from the same host gets a
+shared-memory ring pair minted and its data frames — whole ``act_many``
+cycles included — move over the rings with the socket as control channel
+and fallback leg (the Podracer/Sebulba colocation recipe: actors and
+inference on one host never touch a socket). Garbage preference lists are
+NACK'd with the typed ``bad_hello`` code; legacy clients never say hello
+and keep the pre-shm wire exactly.
+
 Every request may carry an optional ``player`` field: multiplexed servers
 (``serve.mux.GatewayMux`` — one address, several player models) resolve it
 to the right model; single-model servers ignore it; absent means the
@@ -43,6 +53,7 @@ import socket
 import threading
 from typing import Optional
 
+from ..comm import shm_ring
 from ..comm.serializer import recv_msg, send_msg
 from ..obs import get_registry
 from ..resilience import RetryPolicy, retry_call
@@ -50,8 +61,22 @@ from .errors import ServeError, error_from_wire
 
 
 class ServeTCPServer:
-    def __init__(self, gateway, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, gateway, host: str = "127.0.0.1", port: int = 0,
+                 transport: str = "auto",
+                 ring_bytes: int = shm_ring.DEFAULT_RING_BYTES):
         self.gateway = gateway
+        if transport not in ("auto", "shm", "tcp"):
+            raise ValueError(f"transport must be auto|shm|tcp, got {transport!r}")
+        self.transport = transport
+        self.ring_bytes = int(ring_bytes)
+        self._transports = {"tcp": 0, "shm": 0}
+        self._transports_lock = threading.Lock()
+        # let gateway.status() (the opsctl serving digest's feed) report
+        # the live per-connection transport split without a frontend import
+        try:
+            gateway._tcp_transports = self.transport_counts
+        except AttributeError:
+            pass
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._listener.bind((host, port))
@@ -60,6 +85,7 @@ class ServeTCPServer:
         self._stop = threading.Event()
         self._accept_thread: Optional[threading.Thread] = None
         self._conns: set = set()
+        self._ring_services: set = set()
         self._conns_lock = threading.Lock()
         reg = get_registry()
         self._g_conns = reg.gauge(
@@ -93,11 +119,17 @@ class ServeTCPServer:
         # recv until every peer goes away, pinning the port past stop()
         with self._conns_lock:
             conns = list(self._conns)
+            rings = list(self._ring_services)
         for conn in conns:
             try:
                 conn.close()
             except OSError:
                 pass
+        # sever the shm leg SYNCHRONOUSLY: a closed socket does not stop a
+        # ring pump, and a stopped gateway must not keep answering data
+        # frames out of shared memory (the in-process kill-drill contract)
+        for svc in rings:
+            svc.stop()
         t = self._accept_thread
         if t is not None:
             t.join(5.0)
@@ -118,10 +150,34 @@ class ServeTCPServer:
                 target=self._serve_conn, args=(conn,), name="serve-tcp-conn", daemon=True
             ).start()
 
+    def _count_transport(self, kind: str, delta: int) -> None:
+        with self._transports_lock:
+            self._transports[kind] = max(0, self._transports[kind] + delta)
+
+    def transport_counts(self) -> dict:
+        with self._transports_lock:
+            return dict(self._transports)
+
+    def _handle_hello(self, req: dict, have_rings: bool) -> "tuple[dict, object]":
+        """Negotiate one connection's transport. Returns (reply, peer) —
+        ``peer`` is the server ring endpoint when shm was agreed."""
+        nack = shm_ring.hello_nack(req)
+        if nack:
+            return {"code": "bad_hello", "error": nack, "shed": False}, None
+        reply = {"code": 0, "transport": "tcp"}
+        if have_rings:  # one ring pair per connection, ever
+            return reply, None
+        extra, peer = shm_ring.negotiate_server(
+            req, self.transport, self.ring_bytes, op="serve")
+        reply.update(extra)
+        return reply, peer
+
     def _serve_conn(self, conn: socket.socket) -> None:
         self._g_conns.inc()
         with self._conns_lock:
             self._conns.add(conn)
+        ring_svc = None
+        self._count_transport("tcp", +1)
         try:
             with conn:
                 while not self._stop.is_set():
@@ -135,13 +191,36 @@ class ServeTCPServer:
                         send_msg(conn, {"code": "bad_frame", "error": repr(e), "shed": False})
                         return
                     self._c_frames.inc()
+                    if isinstance(req, dict) and req.get("op") == "hello":
+                        reply, peer = self._handle_hello(req, ring_svc is not None)
+                        if peer is not None:
+                            ring_svc = shm_ring.RingService(
+                                peer, self._dispatch, name="serve-shm-ring").start()
+                            with self._conns_lock:
+                                self._ring_services.add(ring_svc)
+                            self._count_transport("tcp", -1)
+                            self._count_transport("shm", +1)
+                        try:
+                            send_msg(conn, reply)
+                        except (ConnectionError, OSError):
+                            return
+                        if reply.get("code") == "bad_hello":
+                            return  # a desynced peer can't be trusted framed
+                        continue
                     try:
                         send_msg(conn, self._dispatch(req))
                     except (ConnectionError, OSError):
                         return
         finally:
+            if ring_svc is not None:
+                ring_svc.stop()
+                self._count_transport("shm", -1)
+            else:
+                self._count_transport("tcp", -1)
             with self._conns_lock:
                 self._conns.discard(conn)
+                if ring_svc is not None:
+                    self._ring_services.discard(ring_svc)
             self._g_conns.dec()
 
     def _dispatch(self, req) -> dict:
@@ -216,7 +295,7 @@ class ServeClient:
 
     def __init__(self, host: str, port: int, timeout_s: float = 30.0,
                  retry_policy: Optional[RetryPolicy] = None,
-                 player: Optional[str] = None):
+                 player: Optional[str] = None, transport: str = "auto"):
         self._addr = (host, port)
         self._timeout_s = timeout_s
         self._player = player
@@ -224,27 +303,69 @@ class ServeClient:
             max_attempts=3, backoff_base_s=0.2, backoff_max_s=2.0,
             deadline_s=4 * timeout_s,
         )
+        shm_ring.offer_transports(transport)  # validate the name early
+        self._transport = transport
+        self._shm: Optional[shm_ring.ShmPeer] = None
         self._sock: Optional[socket.socket] = None
         self._lock = threading.Lock()
         self._connect()
+
+    @property
+    def transport_active(self) -> str:
+        """The leg this connection's data frames currently ride."""
+        return "shm" if self._shm is not None else "tcp"
 
     def _connect(self) -> None:
         self.close()
         self._sock = socket.create_connection(self._addr, timeout=self._timeout_s)
         self._sock.settimeout(self._timeout_s)
+        offers = shm_ring.offer_transports(self._transport)
+        if "shm" not in offers:
+            return  # tcp-only clients keep the pre-shm wire byte-identical
+        try:
+            send_msg(self._sock, {"op": "hello", "transports": offers,
+                                  "host": shm_ring.host_identity()})
+            resp = recv_msg(self._sock)
+        except (ConnectionError, OSError, ValueError):
+            self.close()
+            raise
+        if isinstance(resp, dict) and resp.get("code") == 0:
+            self._shm = shm_ring.maybe_attach(resp, op="serve")
+        # a pre-negotiation gateway answers bad_request: stay on TCP
+
+    def _drop_shm(self) -> None:
+        shm, self._shm = self._shm, None
+        if shm is not None:
+            shm.close()
 
     def _call_once(self, req: dict) -> dict:
         with self._lock:
             if self._sock is None:
                 self._connect()
-            try:
-                send_msg(self._sock, req)
-                resp = recv_msg(self._sock)
-            except (ConnectionError, OSError, ValueError):
-                # the stream is no longer trustworthy (peer died mid-frame /
-                # garbage header): drop it so the retry dials fresh
-                self.close()
-                raise
+            resp = None
+            if self._shm is not None:
+                try:
+                    resp = self._shm.request(req, timeout_s=self._timeout_s)
+                except shm_ring.ShmTimeout:
+                    self._drop_shm()
+                    self.close()
+                    raise
+                except shm_ring.ShmError as e:
+                    # typed ring fault (peer death mid-frame, oversized
+                    # frame, corruption): counted, then THIS call falls
+                    # back to the TCP leg on the same connection
+                    shm_ring.note_fallback(e.reason)
+                    self._drop_shm()
+            if resp is None:
+                try:
+                    send_msg(self._sock, req)
+                    resp = recv_msg(self._sock)
+                except (ConnectionError, OSError, ValueError):
+                    # the stream is no longer trustworthy (peer died
+                    # mid-frame / garbage header): drop it so the retry
+                    # dials fresh
+                    self.close()
+                    raise
         if resp.get("code") != 0:
             raise error_from_wire(resp)
         return resp
@@ -326,6 +447,7 @@ class ServeClient:
         return self._call({"op": "ping"})["pong"]
 
     def close(self) -> None:
+        self._drop_shm()
         sock, self._sock = self._sock, None
         if sock is not None:
             try:

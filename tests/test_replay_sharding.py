@@ -310,7 +310,11 @@ def test_compression_negotiation_and_byte_metrics():
     server = ReplayServer(store, port=0).start()
     payload = b"\x00" * 100_000  # maximally compressible
     try:
-        on = InsertClient(server.host, server.port, compress=True)
+        # pin the TCP leg: this test measures the WIRE codec's byte
+        # accounting, which shm frames (negotiated by default when
+        # colocated) deliberately bypass
+        on = InsertClient(server.host, server.port, compress=True,
+                          transport="tcp")
         before_w = _registry_sum("distar_replay_rx_bytes_wire_total")
         before_r = _registry_sum("distar_replay_rx_bytes_raw_total")
         on.insert("T", payload, timeout_s=5.0)
@@ -320,7 +324,8 @@ def test_compression_negotiation_and_byte_metrics():
         assert raw_on > 100_000
         assert wire_on < raw_on / 10  # compression actually engaged
 
-        off = InsertClient(server.host, server.port, compress=False)
+        off = InsertClient(server.host, server.port, compress=False,
+                           transport="tcp")
         before_w = _registry_sum("distar_replay_rx_bytes_wire_total")
         off.insert("T", payload, timeout_s=5.0)
         wire_off = _registry_sum("distar_replay_rx_bytes_wire_total") - before_w
@@ -446,7 +451,9 @@ def test_retried_insert_after_lost_ack_does_not_double_apply(tmp_path):
 
     server._send_counted = drop_first_ack
     try:
-        client = InsertClient(server.host, server.port,
+        # pin the TCP leg: the chaos hook patches the TCP send path, which
+        # a colocated client would otherwise bypass over shm rings
+        client = InsertClient(server.host, server.port, transport="tcp",
                               retry_policy=RetryPolicy(max_attempts=4,
                                                        backoff_base_s=0.01,
                                                        deadline_s=10.0))

@@ -1,0 +1,461 @@
+"""Shared-memory ring transport (comm/shm_ring.py): the zero-copy data
+plane for colocated hops, its hello negotiation, and its typed failure
+model (docs/data_plane.md transport-negotiation section)."""
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from distar_tpu.comm import shm_ring
+from distar_tpu.comm.serializer import recv_msg, send_msg
+from distar_tpu.obs import get_registry
+from distar_tpu.replay import (
+    InsertClient,
+    ReplayServer,
+    ReplayStore,
+    SampleClient,
+    TableConfig,
+)
+from distar_tpu.replay.errors import BadHelloError
+
+
+def _cfg(**kw):
+    kw.setdefault("max_size", 128)
+    kw.setdefault("sampler", "uniform")
+    kw.setdefault("samples_per_insert", None)
+    kw.setdefault("min_size_to_sample", 1)
+    return TableConfig(**kw)
+
+
+def _mint(capacity=1 << 16):
+    server, fields = shm_ring.mint_ring_pair(capacity, op="test")
+    client = shm_ring.attach_ring_pair(fields, op="test")
+    return server, client, fields
+
+
+# ------------------------------------------------------------- ring basics
+def test_roundtrip_preserves_numpy_payloads():
+    server, client, _ = _mint(1 << 20)
+    try:
+        payload = {"obs": np.arange(5000, dtype=np.float32),
+                   "mask": np.ones((7, 3), dtype=bool), "n": 42}
+        client.send(payload)
+        got = server.recv(timeout_s=5.0)
+        assert got["n"] == 42
+        np.testing.assert_array_equal(got["obs"], payload["obs"])
+        np.testing.assert_array_equal(got["mask"], payload["mask"])
+        server.send({"code": 0})
+        assert client.recv(timeout_s=5.0) == {"code": 0}
+    finally:
+        client.close()
+        server.close()
+
+
+def test_wraparound_many_frames_through_small_ring():
+    """Hundreds of odd-sized frames through a 4 KiB ring: every frame
+    crosses the wrap point eventually and every byte survives."""
+    server, client, _ = _mint(4096)
+    done = []
+
+    def echo():
+        for _ in range(300):
+            server.send(server.recv(timeout_s=10.0))
+        done.append(True)
+
+    t = threading.Thread(target=echo, daemon=True)
+    t.start()
+    try:
+        for i in range(300):
+            blob = bytes([i % 256]) * ((i * 37) % 1800 + 1)
+            assert client.request(blob, timeout_s=10.0) == blob
+        t.join(10.0)
+        assert done
+    finally:
+        client.close()
+        server.close()
+
+
+def test_frame_larger_than_ring_rejected_typed_at_send():
+    server, client, _ = _mint(4096)
+    try:
+        with pytest.raises(shm_ring.ShmFrameTooLargeError):
+            client.send(b"z" * 8192)
+        # the ring is still usable: nothing of the oversized frame published
+        client.send(b"ok")
+        assert server.recv(timeout_s=5.0) == b"ok"
+    finally:
+        client.close()
+        server.close()
+
+
+def test_crc_corruption_detected_via_chaos_bitflip(chaos):
+    """Bit-rot in the mapped segment (ChaosInjector.bitflip on the
+    /dev/shm backing file) fails the frame CRC typed on read."""
+    server, client, fields = _mint(4096)
+    path = f"/dev/shm/{fields['shm_c2s']}"
+    if not os.path.exists(path):  # non-Linux shm mount: nothing to flip
+        pytest.skip("no /dev/shm backing file on this platform")
+    try:
+        client.send(b"a" * 3800)  # frame fills ~93% of the segment
+        chaos.bitflip(path, flips=8)
+        with pytest.raises(shm_ring.ShmError):
+            server.recv(timeout_s=2.0)
+    finally:
+        client.close()
+        server.close()
+
+
+def test_doorbell_wake_latency_bounded():
+    """A reader blocked on an empty ring wakes well inside the wait slice
+    once the writer publishes (the UDP doorbell, not the 250 ms poll)."""
+    server, client, _ = _mint()
+    woke = {}
+
+    def reader():
+        server.recv(timeout_s=10.0)
+        woke["t"] = time.monotonic()
+
+    t = threading.Thread(target=reader, daemon=True)
+    t.start()
+    try:
+        time.sleep(0.3)  # reader is parked well past its initial checks
+        t0 = time.monotonic()
+        client.send(b"ding")
+        t.join(5.0)
+        assert "t" in woke
+        assert woke["t"] - t0 < 0.2, "doorbell wake took a full poll slice"
+    finally:
+        client.close()
+        server.close()
+
+
+def test_ring_full_writer_blocks_then_resumes():
+    server, client, _ = _mint(4096)
+    try:
+        client.send(b"x" * 3000)  # fills most of the ring
+        result = {}
+
+        def write_second():
+            client.send(b"y" * 3000)  # cannot fit until the reader drains
+            result["sent"] = True
+
+        t = threading.Thread(target=write_second, daemon=True)
+        t.start()
+        time.sleep(0.15)
+        assert "sent" not in result  # genuinely blocked on the full ring
+        assert server.recv(timeout_s=5.0) == b"x" * 3000
+        t.join(5.0)
+        assert result.get("sent")
+        assert server.recv(timeout_s=5.0) == b"y" * 3000
+        wait = get_registry().snapshot().get(
+            "distar_shm_ring_full_wait_seconds_count", 0.0)
+        assert wait >= 1.0
+    finally:
+        client.close()
+        server.close()
+
+
+# -------------------------------------------------------------- liveness
+def test_cross_process_roundtrip_and_writer_death_seen_from_reader():
+    """A real subprocess attaches by name, echoes a frame, then dies
+    WITHOUT closing (os._exit): the reader detects the dead writer typed
+    within the heartbeat window."""
+    server, fields = shm_ring.mint_ring_pair(1 << 20, op="xp")
+    child = textwrap.dedent(f"""
+        import os, sys, time
+        sys.path.insert(0, {os.path.dirname(os.path.dirname(os.path.abspath(__file__)))!r})
+        from distar_tpu.comm import shm_ring
+        peer = shm_ring.attach_ring_pair({fields!r}, op="xp")
+        req = peer.recv(timeout_s=15)
+        peer.send({{"echo": req}})
+        time.sleep(0.2)
+        os._exit(9)  # crash: no close, no atexit, beat thread dies with us
+    """)
+    proc = subprocess.Popen([sys.executable, "-c", child])
+    try:
+        server.send({"n": 7}, timeout_s=10.0)
+        assert server.recv(timeout_s=10.0) == {"echo": {"n": 7}}
+        proc.wait(timeout=15)
+        t0 = time.monotonic()
+        with pytest.raises(shm_ring.ShmPeerDeadError):
+            server.recv(timeout_s=10.0)
+        assert time.monotonic() - t0 < 2 * shm_ring.DEFAULT_HEARTBEAT_WINDOW_S
+    finally:
+        proc.kill()
+        server.close()
+
+
+def test_reader_death_seen_from_writer():
+    """The opposite direction: the consuming side closes mid-stream and a
+    writer blocked on the full ring surfaces it typed (not a timeout)."""
+    server, client, _ = _mint(4096)
+    try:
+        server.close()  # reader of client's tx ring goes away
+        with pytest.raises(shm_ring.ShmPeerDeadError):
+            # needs to block for space -> sees the closed reader typed
+            for _ in range(10):
+                client.send(b"z" * 3000, timeout_s=5.0)
+    finally:
+        client.close()
+
+
+# ------------------------------------------------------------ negotiation
+def test_same_host_detection_false_on_spoofed_hostname():
+    """A hello claiming a *different* host identity (spoofed hostname /
+    wrong boot id) never gets rings, even when every other field checks
+    out; the genuine identity does."""
+    reply, peer = shm_ring.negotiate_server(
+        {"transports": ["shm", "tcp"], "host": "spoofed-host|not-our-boot-id"},
+        transport="auto")
+    assert reply == {"transport": "tcp"} and peer is None
+
+    reply, peer = shm_ring.negotiate_server(
+        {"transports": ["shm", "tcp"], "host": shm_ring.host_identity()},
+        transport="auto")
+    try:
+        assert reply["transport"] == "shm" and peer is not None
+    finally:
+        if peer is not None:
+            peer.close()
+
+
+def test_spoofed_host_over_live_server_stays_tcp():
+    server = ReplayServer(ReplayStore(table_factory=lambda n: _cfg()),
+                          port=0).start()
+    try:
+        with socket.create_connection((server.host, server.port), timeout=5) as s:
+            send_msg(s, {"op": "hello", "compress": True,
+                         "transports": ["shm", "tcp"],
+                         "host": "evil-host|some-boot-id"}, compress=False)
+            resp = recv_msg(s)
+        assert resp["code"] == 0
+        assert resp.get("transport") == "tcp"
+        assert "shm_c2s" not in resp
+    finally:
+        server.stop()
+
+
+def test_fallback_negotiation_when_shared_memory_unavailable(monkeypatch):
+    """A host without multiprocessing.shared_memory (injected) negotiates
+    tcp cleanly on both sides — no crash, no rings."""
+    monkeypatch.setattr(shm_ring, "_sm", None)
+    assert shm_ring.offer_transports("auto") == ["tcp"]
+    reply, peer = shm_ring.negotiate_server(
+        {"transports": ["shm", "tcp"], "host": shm_ring.host_identity()},
+        transport="auto")
+    assert reply == {"transport": "tcp"} and peer is None
+
+    server = ReplayServer(ReplayStore(table_factory=lambda n: _cfg()),
+                          port=0).start()
+    try:
+        client = InsertClient(server.host, server.port)
+        client.insert("T", {"v": 1}, timeout_s=5.0)
+        assert client.transport_active == "tcp"
+        client.close()
+    finally:
+        server.stop()
+
+
+def test_hostile_hello_garbage_transports_nacked_typed():
+    """Satellite regression (mirrors the 18-EB header test): a hello whose
+    transport names are ALL garbage must be NACK'd typed, never silently
+    degraded to a working transport."""
+    server = ReplayServer(ReplayStore(table_factory=lambda n: _cfg()),
+                          port=0).start()
+    try:
+        with socket.create_connection((server.host, server.port), timeout=5) as s:
+            send_msg(s, {"op": "hello", "compress": True,
+                         "transports": ["carrier-pigeon", "smoke-signals"]},
+                     compress=False)
+            resp = recv_msg(s)
+        assert resp["code"] == "bad_hello"
+        assert "carrier-pigeon" in resp["error"]
+    finally:
+        server.stop()
+
+
+def test_hostile_hello_garbage_codecs_nacked_typed():
+    server = ReplayServer(ReplayStore(table_factory=lambda n: _cfg()),
+                          port=0).start()
+    try:
+        with socket.create_connection((server.host, server.port), timeout=5) as s:
+            send_msg(s, {"op": "hello", "compress": True,
+                         "codecs": ["rot13", "base64"]}, compress=False)
+            resp = recv_msg(s)
+        assert resp["code"] == "bad_hello"
+        # a recognized-but-unavailable codec still degrades (NOT a NACK)
+        with socket.create_connection((server.host, server.port), timeout=5) as s:
+            send_msg(s, {"op": "hello", "compress": True,
+                         "codecs": ["zstd"]}, compress=False)
+            resp = recv_msg(s)
+        assert resp["code"] == 0
+    finally:
+        server.stop()
+
+
+def test_serve_hello_garbage_transports_nacked_typed():
+    """The serve plane NACKs the same way (one negotiation contract)."""
+    from distar_tpu.serve import InferenceGateway, MockModelEngine, ServeTCPServer
+
+    gw = InferenceGateway(MockModelEngine(2)).start()
+    srv = ServeTCPServer(gw, port=0).start()
+    try:
+        with socket.create_connection((srv.host, srv.port), timeout=5) as s:
+            send_msg(s, {"op": "hello", "transports": ["morse"]})
+            resp = recv_msg(s)
+        assert resp["code"] == "bad_hello"
+    finally:
+        srv.stop()
+        gw.drain_and_stop(2.0)
+
+
+def test_client_raises_typed_on_bad_hello():
+    """A client whose own hello is NACK'd surfaces BadHelloError instead
+    of silently degrading (config rot must be loud)."""
+    server = ReplayServer(ReplayStore(table_factory=lambda n: _cfg()),
+                          port=0).start()
+    try:
+        client = InsertClient(server.host, server.port)
+        client._want_codecs = ["rot13"]  # simulate a corrupted preference
+        with pytest.raises(BadHelloError):
+            client.insert("T", {"v": 1}, timeout_s=5.0)
+        client.close()
+    finally:
+        server.stop()
+
+
+# ---------------------------------------------------------- replay e2e
+def test_replay_insert_sample_over_shm_and_counters():
+    server = ReplayServer(ReplayStore(table_factory=lambda n: _cfg()),
+                          port=0).start()
+    try:
+        snap0 = get_registry().snapshot()
+        ins = InsertClient(server.host, server.port)
+        smp = SampleClient(server.host, server.port)
+        item = {"x": np.arange(2048, dtype=np.float32)}
+        ins.insert("T", item, timeout_s=5.0)
+        assert ins.transport_active == "shm"
+        items, info = smp.sample("T", batch_size=1, timeout_s=5.0)
+        assert smp.transport_active == "shm"
+        np.testing.assert_array_equal(items[0]["x"], item["x"])
+        assert server.transport_counts()["shm"] == 2
+        snap = get_registry().snapshot()
+        assert snap.get("distar_shm_tx_frames_total", 0.0) > snap0.get(
+            "distar_shm_tx_frames_total", 0.0)
+        assert snap.get("distar_shm_rx_bytes_total", 0.0) > snap0.get(
+            "distar_shm_rx_bytes_total", 0.0)
+        ins.close()
+        smp.close()
+    finally:
+        server.stop()
+
+
+def test_ring_fault_falls_back_to_tcp_leg_with_zero_loss():
+    """Kill ONLY the ring service mid-connection (ring fault, TCP leg
+    alive): the client's next call completes over TCP on the SAME
+    connection — typed, counted, nothing lost."""
+    server = ReplayServer(ReplayStore(table_factory=lambda n: _cfg()),
+                          port=0).start()
+    try:
+        ins = InsertClient(server.host, server.port)
+        assert ins.insert("T", {"v": 0}, timeout_s=5.0) == 0
+        assert ins.transport_active == "shm"
+        before = sum(v for k, v in get_registry().snapshot().items()
+                     if k.startswith("distar_shm_fallbacks_total"))
+        for svc in list(server._ring_services):  # the injected ring fault
+            svc.stop()
+        assert ins.insert("T", {"v": 1}, timeout_s=5.0) == 1  # same call path
+        assert ins.transport_active == "tcp"
+        after = sum(v for k, v in get_registry().snapshot().items()
+                    if k.startswith("distar_shm_fallbacks_total"))
+        assert after == before + 1
+        store_sizes = server.store.stats()["tables"]["T"]["size"]
+        assert store_sizes == 2  # both inserts landed exactly once
+        ins.close()
+    finally:
+        server.stop()
+
+
+def test_subprocess_shard_roundtrip_over_shm():
+    """End-to-end against a REAL shard subprocess (distinct PID): insert
+    and sample both ride rings; the payload round-trips bit-exact."""
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "distar_tpu.replay.server", "--port", "0",
+         "--min-size", "1", "--transport", "shm"],
+        stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL, text=True)
+    try:
+        parts = proc.stdout.readline().split()
+        assert parts[0] == "REPLAY-SHARD", parts
+        host, port = parts[1], int(parts[2])
+        ins = InsertClient(host, port)
+        smp = SampleClient(host, port)
+        item = {"traj": np.random.default_rng(0).normal(size=4096).astype(np.float32)}
+        ins.insert("T", item, timeout_s=10.0)
+        assert ins.transport_active == "shm"
+        items, _ = smp.sample("T", batch_size=1, timeout_s=10.0)
+        np.testing.assert_array_equal(items[0]["traj"], item["traj"])
+        ins.close()
+        smp.close()
+    finally:
+        try:
+            proc.stdin.close()
+            proc.wait(timeout=10)
+        except Exception:
+            proc.kill()
+
+
+# ------------------------------------------------------------- lifecycle
+def test_rings_unlinked_on_close_and_on_crash_hook(tmp_path):
+    """Leak check: segments vanish on clean close, and the resilience
+    crash hook (FlightRecorder dump) unlinks whatever is still live."""
+    server, client, fields = _mint()
+    names = [fields["shm_c2s"], fields["shm_s2c"]]
+    client.close()
+    server.close()
+    for name in names:
+        with pytest.raises((FileNotFoundError, shm_ring.ShmError)):
+            shm_ring.ShmRing.attach(name)
+
+    # crash path: rings live when the process dies -> the flight-recorder
+    # bundle dump runs shm_ring.unlink_all via add_crash_callback
+    server2, fields2 = shm_ring.mint_ring_pair(1 << 16, op="crash")
+    from distar_tpu.obs import get_flight_recorder
+
+    get_flight_recorder().dump(str(tmp_path), reason="test-crash")
+    for name in (fields2["shm_c2s"], fields2["shm_s2c"]):
+        with pytest.raises((FileNotFoundError, shm_ring.ShmError)):
+            shm_ring.ShmRing.attach(name)
+    server2.close()  # idempotent on already-unlinked rings
+
+
+def test_serve_client_over_shm_and_gateway_status():
+    from distar_tpu.serve import InferenceGateway, MockModelEngine, ServeTCPServer
+    from distar_tpu.serve.tcp_frontend import ServeClient
+
+    gw = InferenceGateway(MockModelEngine(4, params={"version": "v1", "bias": 0.0}),
+                          max_delay_s=0.002).start()
+    gw.load_version("v1", params={"version": "v1", "bias": 0.0}, activate=True)
+    srv = ServeTCPServer(gw, port=0).start()
+    try:
+        c = ServeClient(srv.host, srv.port)
+        assert c.transport_active == "shm"
+        out = c.act("s1", {"x": np.ones((4,), np.float32)})
+        assert out
+        results = c.act_many(
+            [{"session_id": "s1", "obs": {"x": np.ones((4,), np.float32)}}])
+        assert len(results) == 1 and not isinstance(results[0], Exception)
+        assert gw.status()["transports"]["shm"] == 1
+        tcp_client = ServeClient(srv.host, srv.port, transport="tcp")
+        assert tcp_client.transport_active == "tcp"
+        c.close()
+        tcp_client.close()
+    finally:
+        srv.stop()
+        gw.drain_and_stop(2.0)

@@ -15,6 +15,7 @@ faults tests already prove survivable:
   python tools/chaos.py multichip-drill --dir /tmp/mc_drill \\
         [--mesh dp=4,fsdp=2] [--resume-mesh dp=8] [--kill-after 2] [--iters 5]
   python tools/chaos.py serve-drill --gateways 3 [--sessions 48] [--steps 8]
+  python tools/chaos.py shm-drill --dir /tmp/shm_drill [--items 60] [--seed 0]
 
 ``corrupt`` damages a checkpoint in place (the resume path must fall back);
 ``kill`` sends a signal to a role process (the supervisor/orchestrator must
@@ -449,6 +450,159 @@ def cmd_serve_drill(args) -> int:
     return 0 if ok else 1
 
 
+def cmd_shm_drill(args) -> int:
+    """Kill the shm-transport peer mid-frame; prove typed detection + TCP
+    fallback with zero acked-item loss.
+
+    A real replay shard subprocess serves the drill over negotiated shm
+    rings (tiny rings forced via DISTAR_SHM_RING_BYTES, so the writer is
+    usually mid-frame, blocked for space). Mid-traffic the shard is
+    SIGKILL'd — no close flags, no unlink, only the heartbeat going
+    stale: the drill's writer sees its dead ring *reader* typed
+    (ShmPeerDeadError within the heartbeat window) and the drill's
+    sampler, parked in recv, sees the dead ring *writer* the same way —
+    both directions of the failure model. The counted fallback then rides
+    the resilience retry policy onto a restarted shard that only speaks
+    TCP (same port, same spill directory), and the run completes there:
+    every acked insert must be sampleable afterwards (spill recovery for
+    the committed tail + idempotent retries for the in-flight one), the
+    replay-drill accounting. Exit 0 only when shm was genuinely active
+    before the kill, the fallback was typed+counted, the finish leg is
+    tcp, and zero acked items are lost."""
+    import subprocess
+    import threading
+
+    from distar_tpu.obs import get_registry
+    from distar_tpu.replay import InsertClient, SampleClient
+
+    def spawn(port: int, transport: str):
+        env = dict(os.environ)
+        env["DISTAR_SHM_RING_BYTES"] = str(args.ring_bytes)
+        cmd = [sys.executable, "-m", "distar_tpu.replay.server",
+               "--port", str(port), "--transport", transport,
+               "--spill-dir", args.dir, "--sampler", "fifo",
+               "--min-size", "1", "--max-size", str(max(args.items * 2, 64)),
+               "--spill-max", str(max(args.items * 2, 64))]
+        proc = subprocess.Popen(cmd, stdin=subprocess.PIPE,
+                                stdout=subprocess.PIPE,
+                                stderr=subprocess.DEVNULL, text=True)
+        parts = proc.stdout.readline().split()
+        if len(parts) < 3 or parts[0] != "REPLAY-SHARD":
+            raise RuntimeError(f"shard failed to start: {parts}")
+        return proc, parts[1], int(parts[2])
+
+    inj = ChaosInjector(seed=args.seed)
+    proc, host, port = spawn(0, "shm")
+    payload = os.urandom(args.ring_bytes // 2 + 512)  # frames span the ring
+    inserter = InsertClient(host, port, timeout_s=10.0)
+    acked, dup, lock = set(), [0], threading.Lock()
+
+    def fallbacks() -> float:
+        return sum(v for k, v in get_registry().snapshot().items()
+                   if k.startswith("distar_shm_fallbacks_total"))
+
+    # phase 1: half the items acked over live rings
+    half = args.items // 2
+    for i in range(half):
+        inserter.insert("drill", {"k": f"k{i}", "b": payload}, timeout_s=10.0)
+        with lock:
+            acked.add(f"k{i}")
+    transport_before = inserter.transport_active
+    fallbacks_before = fallbacks()
+
+    # phase 2: continuous traffic from BOTH seats, then the chaos moment.
+    # The sampler parks in a blocking sample (its ring *writer* is the
+    # server); the inserter streams frames (its ring *reader* is the
+    # server) — the SIGKILL is seen typed from both directions.
+    sampler = SampleClient(host, port, timeout_s=10.0)
+    sampled, stop = set(), threading.Event()
+
+    def insert_rest():
+        # paced so the SIGKILL lands mid-stream (an idle writer would
+        # finish before the chaos moment and dodge the drill)
+        for i in range(half, args.items):
+            while True:
+                try:
+                    inserter.insert("drill", {"k": f"k{i}", "b": payload},
+                                    timeout_s=10.0)
+                    with lock:
+                        acked.add(f"k{i}")
+                    break
+                except Exception:
+                    if stop.is_set():
+                        return
+                    time.sleep(0.2)
+            time.sleep(0.05)
+
+    def sample_some():
+        while not stop.is_set():
+            try:
+                items, _ = sampler.sample("drill", batch_size=1, timeout_s=5.0)
+            except Exception:
+                time.sleep(0.2)
+                continue
+            with lock:
+                for it in items:
+                    if it["k"] in sampled:
+                        dup[0] += 1
+                    sampled.add(it["k"])
+
+    threads = [threading.Thread(target=insert_rest, daemon=True),
+               threading.Thread(target=sample_some, daemon=True)]
+    for t in threads:
+        t.start()
+    time.sleep(0.5)  # traffic in flight on the rings
+    inj.kill_role(proc.pid, sig=signal.SIGKILL, name=f"replay-shm:{host}:{port}")
+    proc.wait(timeout=10)
+    time.sleep(1.0)  # inside the retry budget: clients are detecting/backing off
+
+    # phase 3: restart on the SAME port, TCP-only, over the same spill dir —
+    # the fallback leg must complete the run unassisted
+    proc2, host, port = spawn(port, "tcp")
+    threads[0].join(60.0)
+    deadline = time.monotonic() + 30.0
+    while time.monotonic() < deadline:
+        with lock:
+            if acked <= sampled:
+                break
+        time.sleep(0.2)
+    stop.set()
+    threads[1].join(10.0)
+    lost = sorted(acked - sampled)
+    # read BEFORE close() (close drops the rings and would fake a "tcp")
+    transport_after = {"insert": inserter.transport_active,
+                       "sample": sampler.transport_active}
+    verdict = {
+        "items": args.items,
+        "acked": len(acked),
+        "sampled_unique": len(sampled),
+        "duplicates_after_restart": dup[0],
+        "lost_acked": len(lost),
+        "transport_before_kill": transport_before,
+        "transport_after_fallback": transport_after,
+        "typed_fallbacks_counted": fallbacks() - fallbacks_before,
+        "events": [e["kind"] for e in inj.events],
+    }
+    inserter.close()
+    sampler.close()
+    try:
+        proc2.stdin.close()
+        proc2.wait(timeout=10)
+    except Exception:
+        proc2.kill()
+    print(json.dumps(verdict))
+    ok = (transport_before == "shm"
+          and transport_after == {"insert": "tcp", "sample": "tcp"}
+          and verdict["typed_fallbacks_counted"] >= 2  # both client seats
+          and len(acked) == args.items
+          and not lost)
+    print("verdict: peer killed mid-frame detected typed on both ring "
+          "directions; clients fell back to the TCP leg and finished with "
+          "zero acked-item loss"
+          if ok else f"verdict: DRILL FAILED {verdict}")
+    return 0 if ok else 1
+
+
 def cmd_latest(args) -> int:
     mgr = CheckpointManager(args.dir)
     gens = mgr.generations()
@@ -514,6 +668,16 @@ def main() -> int:
     s.add_argument("--slots", type=int, default=64, help="slots per gateway")
     s.add_argument("--seed", type=int, default=0)
 
+    h = sub.add_parser("shm-drill",
+                       help="SIGKILL the shm-ring peer mid-frame; prove "
+                            "typed detection + TCP fallback, zero acked loss")
+    h.add_argument("--dir", required=True, help="spill directory")
+    h.add_argument("--items", type=int, default=60,
+                   help="acked inserts across the kill")
+    h.add_argument("--ring-bytes", type=int, default=8192,
+                   help="forced tiny ring so frames span it (mid-frame kills)")
+    h.add_argument("--seed", type=int, default=0)
+
     m = sub.add_parser("multichip-drill",
                        help="kill a multichip learner after a sharded save; "
                             "prove resume on a DIFFERENT mesh shape")
@@ -536,6 +700,7 @@ def main() -> int:
             "reset": cmd_reset, "latest": cmd_latest,
             "replay-drill": cmd_replay_drill,
             "serve-drill": cmd_serve_drill,
+            "shm-drill": cmd_shm_drill,
             "multichip-drill": cmd_multichip_drill}[args.command](args)
 
 

@@ -183,10 +183,15 @@ def _print_serve_fleet(gateways: list) -> None:
         # legitimately runs one ahead after promote)
         version = (info.get("registry") or {}).get("current") \
             or info.get("served_version")
+        # per-connection transport split (shm rings vs framed TCP): which
+        # leg each colocated client actually negotiated on this gateway
+        tr = info.get("transports") or {}
+        tr_s = f" transports=shm:{tr.get('shm', 0)}/tcp:{tr.get('tcp', 0)}" \
+            if tr else ""
         print(f"  [{tcp_addr}] players={players} sessions={active}/{slots} "
               f"occ={occ:5.2f} shed_rate={info.get('shed_rate', 0.0):.4f} "
               f"gen={gen} serving={version} "
-              f"q={info.get('queue_depth', 0)}"
+              f"q={info.get('queue_depth', 0)}{tr_s}"
               + (" DRAINING" if info.get("draining") else ""))
         agg["sessions"] += active
         agg["slots"] += slots
@@ -253,6 +258,12 @@ def _print_replay(per_shard: dict) -> None:
             agg["spill_live"] += spill.get("live", 0) or 0
             print(f"  {tag}spill: {spill.get('live')}/{spill.get('max_items')} live "
                   f"({spill.get('root')})")
+        tr = stats.get("transports")
+        if tr:
+            # the active transport per data-plane connection: colocated
+            # clients negotiate shm rings, remote ones stay framed TCP
+            print(f"  {tag}transports: shm:{tr.get('shm', 0)} "
+                  f"tcp:{tr.get('tcp', 0)}")
     if fleet:
         occ = agg["size"] / agg["max"] if agg["max"] else 0.0
         stale = (f"staleness={agg['stale_min']}..{agg['stale_max']}s "

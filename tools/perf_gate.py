@@ -223,10 +223,12 @@ def collect_trajectory(repo: str = _REPO) -> List[dict]:
                        + glob.glob(os.path.join(repo, "ROLLOUT_r*.json"))
                        + glob.glob(os.path.join(repo, "REPLAY_SHARD_r*.json"))
                        + glob.glob(os.path.join(repo, "FLEET_r*.json"))
+                       + glob.glob(os.path.join(repo, "SHM_r*.json"))
                        + glob.glob(os.path.join(repo, "artifacts", "perf_baseline*.json"))
                        + glob.glob(os.path.join(repo, "artifacts", "rollout_*.json"))
                        + glob.glob(os.path.join(repo, "artifacts", "replay_*.json"))
-                       + glob.glob(os.path.join(repo, "artifacts", "fleet_*.json"))):
+                       + glob.glob(os.path.join(repo, "artifacts", "fleet_*.json"))
+                       + glob.glob(os.path.join(repo, "artifacts", "shm_*.json"))):
         try:
             doc = load_artifact(path)
         except (OSError, ValueError):
@@ -249,6 +251,25 @@ def collect_trajectory(repo: str = _REPO) -> List[dict]:
                 "value": knee.get("session_shed_rate"), "unit": "",
                 "status": _status_of(doc),
             })
+        if doc.get("shm_vs_tcp"):
+            # the shm-transport artifact carries the three-way ratios
+            # in-band; surface wall AND cpu ratios as trajectory rows
+            rows.append({
+                "round": _round_of(path), "artifact": os.path.basename(path),
+                "metric": "shm ring vs framed-TCP loopback, real subprocesses "
+                          "(wall clock)",
+                "value": doc["shm_vs_tcp"], "unit": "x",
+                "status": _status_of(doc),
+            })
+            if doc.get("shm_vs_tcp_cpu"):
+                rows.append({
+                    "round": _round_of(path),
+                    "artifact": os.path.basename(path),
+                    "metric": "shm ring vs framed-TCP loopback "
+                              "(cpu-seconds per item, core-count independent)",
+                    "value": doc["shm_vs_tcp_cpu"], "unit": "x",
+                    "status": _status_of(doc),
+                })
         fast = doc.get("replay_fast_path") or {}
         if fast.get("vs_tcp_loopback"):
             # the sharded-replay artifact carries the colocated fast-path
