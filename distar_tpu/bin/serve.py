@@ -166,16 +166,27 @@ def main() -> None:
 
     beat = None
     if args.coordinator_addr:
+        from ..comm.discovery import unregister_endpoint
         from ..serve.fleet import register_gateway
 
         chost, _, cport = args.coordinator_addr.rpartition(":")
+        coord = (chost or "127.0.0.1", int(cport))
         beat = register_gateway(
-            (chost or "127.0.0.1", int(cport)), tcp.host, tcp.port,
+            coord, tcp.host, tcp.port,
             meta={"players": players, "slots": args.slots,
                   "http_port": http.port, "version": args.version,
                   "mock": bool(args.mock)},
             lease_s=args.lease_s or None,
         )
+
+        def _deregister(beat=beat, coord=coord, host=tcp.host, port=tcp.port):
+            beat.stop_event.set()
+            unregister_endpoint(coord, host, port)
+
+        # graceful drain's step 1 (begin_drain calls it): leave discovery
+        # NOW so routers stop pinning new sessions here, instead of
+        # heartbeating on until the lease dies
+        target.deregister = _deregister
     logger.info(
         f"serving: http={http.host}:{http.port} tcp={tcp.host}:{tcp.port} "
         f"slots={args.slots} max_delay={args.max_delay_ms}ms "
@@ -193,8 +204,10 @@ def main() -> None:
     signal.signal(signal.SIGTERM, _shutdown)
     signal.signal(signal.SIGINT, _shutdown)
     done.wait()
+    # begin_drain (inside drain_and_stop) deregisters the lease first —
+    # the fleet stops routing here immediately, not a lease TTL later
     if beat is not None:
-        beat.stop_event.set()  # stop refreshing: the lease lapses fleet-side
+        beat.stop_event.set()
     http.stop()
     tcp.stop()
     target.drain_and_stop(args.drain_timeout_s)

@@ -36,7 +36,26 @@ class Coordinator:
         self._strikes: Dict[str, int] = defaultdict(int)
         self._leases: Dict[str, float] = {}  # "ip:port" -> expiry ts
         self._last_sweep = 0.0
+        self._evict_callbacks: list = []
         self._lock = threading.RLock()
+
+    def add_evict_callback(self, fn) -> None:
+        """Subscribe to endpoint departures: ``fn("ip:port")`` runs whenever
+        an endpoint's records leave the broker through the lease sweep or an
+        explicit ``unregister`` (NOT per-fetch strikes — a struck endpoint
+        may still be alive). Callbacks run under the broker lock and must be
+        quick and never call back into the coordinator; exceptions are
+        swallowed. This is how ``TelemetryIngest`` learns to evict a dead
+        member's TSDB series instead of hoarding them against the cap."""
+        with self._lock:
+            self._evict_callbacks.append(fn)
+
+    def _notify_evicted(self, key: str) -> None:
+        for fn in self._evict_callbacks:
+            try:
+                fn(key)
+            except Exception:  # noqa: BLE001 - observers must not break the broker
+                pass
 
     def register(self, token: str, ip: str, port: int, meta: Optional[dict] = None,
                  lease_s: Optional[float] = None) -> bool:
@@ -101,6 +120,25 @@ class Coordinator:
         for key in expired:
             self._purge_endpoint(key)
             evictions.inc()
+            self._notify_evicted(key)
+
+    def unregister(self, ip: str, port: int) -> int:
+        """Graceful departure: drop every record for ``ip:port`` NOW (a
+        draining member must leave discovery before it starts shedding, not
+        ``lease_s`` later when the lease lapses). Returns the number of
+        records removed; fires the same eviction observers as the lease
+        sweep so downstream state (TSDB series) is reclaimed either way."""
+        key = f"{ip}:{port}"
+        with self._lock:
+            removed = self._purge_endpoint(key)
+            from ..obs import get_registry
+
+            get_registry().counter(
+                "distar_coordinator_unregisters_total",
+                "endpoints that deregistered gracefully (drain path)",
+            ).inc()
+            self._notify_evicted(key)
+            return removed
 
     def ask(self, token: str) -> Optional[dict]:
         """Pop the oldest ready record for a token (None when empty)."""
@@ -205,12 +243,23 @@ class CoordinatorServer:
 
             return get_fleet_health().ingest.ingest(msg)
 
+        def _evict_telemetry(key: str) -> None:
+            # an endpoint left (lease lapsed or graceful unregister): free
+            # its TSDB series so a churning fleet can't exhaust the series
+            # cap permanently (the ingest maps endpoint -> shipped sources)
+            from ..obs import get_fleet_health
+
+            get_fleet_health().ingest.evict_endpoint(key)
+
+        co.add_evict_callback(_evict_telemetry)
+
         routes = {
             "register": lambda b: co.register(**b),
             "ask": lambda b: co.ask(b["token"]),
             "peers": lambda b: co.peers(b["token"]),
             "strike": lambda b: co.strike(b["ip"], b["port"]),
             "heartbeat": lambda b: co.heartbeat(**b),
+            "unregister": lambda b: co.unregister(b["ip"], b["port"]),
             # absent max_age_s -> the coordinator's own default filter, so
             # HTTP callers and in-process callers see identical accounting
             "stats": lambda b: (
@@ -236,6 +285,21 @@ class CoordinatorServer:
 
                 if self.path.rstrip("/") == "/metrics":
                     write_scrape_response(self, refresh=co.publish_metrics)
+                    return
+                if self.path.rstrip("/") == "/autoscaler":
+                    # elastic-control-plane digest (opsctl status reads it):
+                    # answered from the process-global autoscaler when one
+                    # runs in this coordinator, 404 otherwise
+                    from ..fleet import get_autoscaler
+                    from ..obs import write_json_response
+
+                    scaler = get_autoscaler()
+                    if scaler is None:
+                        self.send_response(404)
+                        self.send_header("Content-Length", "0")
+                        self.end_headers()
+                        return
+                    write_json_response(self, scaler.status())
                     return
                 if handle_health_get(self, self.path):
                     return

@@ -59,6 +59,47 @@ def register_endpoint(coordinator_addr: Tuple[str, int], token: str, host: str,
     return t
 
 
+def unregister_endpoint(coordinator_addr: Tuple[str, int], host: str,
+                        port: int) -> int:
+    """Graceful departure: drop this endpoint from the broker NOW instead of
+    waiting for its lease to lapse — the first step of every drain (a member
+    must leave discovery *before* it starts shedding, or routers keep
+    pinning new work to it for a whole lease TTL). Returns the number of
+    records removed. Raises ``CommError`` on an unreachable broker; drain
+    paths treat that as best-effort (the lease still lapses)."""
+    from .coordinator import coordinator_request
+
+    chost, cport = coordinator_addr
+    reply = coordinator_request(chost, cport, "unregister",
+                                {"ip": host, "port": port})
+    return int(reply.get("info") or 0)
+
+
+def start_refresh(coordinator_addr: Tuple[str, int], token: str,
+                  apply_fn, interval_s: float = 5.0,
+                  stop_event: Optional[threading.Event] = None) -> threading.Thread:
+    """Live membership, client side: periodically re-read the fleet under
+    ``token`` and hand the records to ``apply_fn(records)`` — joins and
+    drains become visible to a long-lived client without a restart (the
+    standalone router's refresh-loop idiom, shared). A failed read (broker
+    blip) keeps the previous view; ``apply_fn`` exceptions are swallowed
+    too — a refresher must never take its client down. Returns the daemon
+    thread; set ``thread.stop_event`` to end it."""
+    stop = stop_event or threading.Event()
+
+    def loop():
+        while not stop.wait(interval_s):
+            try:
+                apply_fn(discover_endpoints(coordinator_addr, token))
+            except Exception:  # noqa: BLE001 - keep serving on a stale view
+                continue
+
+    t = threading.Thread(target=loop, name=f"{token}-refresh", daemon=True)
+    t.stop_event = stop  # type: ignore[attr-defined]
+    t.start()
+    return t
+
+
 def discover_endpoints(coordinator_addr: Tuple[str, int], token: str) -> List[dict]:
     """The live fleet registered under ``token``: a non-destructive read of
     the coordinator's ``peers`` route. Returns the raw records

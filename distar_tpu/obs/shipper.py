@@ -34,20 +34,49 @@ class TelemetryIngest:
     def __init__(self, store: TimeSeriesStore, registry: Optional[MetricsRegistry] = None):
         self.store = store
         self._registry = registry
+        # source -> the service endpoint ("ip:port") the shipper declared;
+        # how coordinator lease evictions map back to TSDB sources
+        self._endpoints: dict = {}
+        self._lock = threading.Lock()
 
     def ingest(self, msg: dict) -> int:
-        """Fold one shipped message ``{source, ts, snapshot, interval_s?}``
-        into per-source series; returns the number of scalars recorded."""
+        """Fold one shipped message ``{source, ts, snapshot, interval_s?,
+        endpoint?}`` into per-source series; returns the number of scalars
+        recorded. ``endpoint`` (the shipper's registered service address)
+        links the source to its coordinator lease, so a lease eviction can
+        reclaim the series (``evict_endpoint``)."""
         if not isinstance(msg, dict) or not isinstance(msg.get("snapshot"), dict):
             raise ValueError("telemetry message must be {source, ts, snapshot}")
         source = str(msg.get("source") or "unknown")
         ts = float(msg.get("ts") or time.time())
+        endpoint = msg.get("endpoint")
+        if endpoint:
+            with self._lock:
+                self._endpoints[source] = str(endpoint)
         n = self.store.record_snapshot(msg["snapshot"], ts=ts, source=source)
         reg = self._registry or get_registry()
         reg.counter(
             "distar_telemetry_ingest_total", "shipped snapshots ingested", source=source
         ).inc()
         return n
+
+    def evict_endpoint(self, endpoint: str) -> int:
+        """A registered endpoint left the broker (lease expiry or graceful
+        unregister): reclaim every TSDB series its shipped sources hold, so
+        membership churn frees series-cap room instead of exhausting it.
+        Returns the number of series evicted."""
+        with self._lock:
+            sources = [s for s, e in self._endpoints.items() if e == endpoint]
+            for s in sources:
+                del self._endpoints[s]
+        return sum(self.store.evict_source(s) for s in sources)
+
+    def evict_source(self, source: str) -> int:
+        """Direct source eviction (callers that track membership themselves,
+        e.g. the autoscaler's member probes)."""
+        with self._lock:
+            self._endpoints.pop(source, None)
+        return self.store.evict_source(source)
 
     def sources(self) -> dict:
         return self.store.sources()
@@ -67,7 +96,8 @@ class TelemetryShipper:
                  ingest: Optional[TelemetryIngest] = None,
                  interval_s: float = 5.0,
                  registry: Optional[MetricsRegistry] = None,
-                 timeout_s: float = 5.0):
+                 timeout_s: float = 5.0,
+                 endpoint: Optional[str] = None):
         assert (coordinator_addr is None) != (ingest is None), \
             "exactly one of coordinator_addr / ingest"
         assert interval_s > 0
@@ -77,18 +107,25 @@ class TelemetryShipper:
         self._ingest = ingest
         self._registry = registry
         self._timeout_s = timeout_s
+        #: the service endpoint ("ip:port") this process registered under a
+        #: coordinator lease, if any — stamped on every message so the
+        #: broker can reclaim this source's series when the lease goes
+        self.endpoint = endpoint
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
     # ------------------------------------------------------------------- wire
     def _message(self) -> dict:
         reg = self._registry or get_registry()
-        return {
+        msg = {
             "source": self.source,
             "ts": time.time(),
             "interval_s": self.interval_s,
             "snapshot": reg.snapshot(),
         }
+        if self.endpoint:
+            msg["endpoint"] = self.endpoint
+        return msg
 
     def ship_once(self) -> int:
         """Snapshot + push one message; returns scalars shipped. Raises on

@@ -38,6 +38,7 @@ class TimeSeriesStore:
         self._series: Dict[SeriesKey, deque] = {}
         self._source_seen: Dict[str, float] = {}
         self._dropped = 0  # series refused past the max_series cap
+        self._evicted = 0  # series reclaimed when their source departed
 
     # ------------------------------------------------------------------ write
     def record(self, name: str, value: float, ts: Optional[float] = None,
@@ -74,6 +75,29 @@ class TimeSeriesStore:
             if self.record(name, value, ts=ts, source=source):
                 n += 1
         return n
+
+    def evict_source(self, source: str) -> int:
+        """Reclaim every series a departed source left behind. Without this
+        an elastic fleet exhausts the ``max_series`` cap permanently: each
+        drained/evicted member's series sit in their rings forever and
+        ``record`` refuses every NEW series from its replacement. The
+        coordinator calls this (via ``TelemetryIngest.evict_endpoint``)
+        whenever an endpoint's lease expires or it deregisters. Returns the
+        number of series evicted (counted in
+        ``distar_obs_series_evicted_total``)."""
+        with self._lock:
+            dead = [k for k in self._series if k[0] == source]
+            for k in dead:
+                del self._series[k]
+            self._source_seen.pop(source, None)
+            self._evicted += len(dead)
+        if dead:
+            get_registry().counter(
+                "distar_obs_series_evicted_total",
+                "TSDB series reclaimed because their source's lease expired "
+                "or it deregistered",
+            ).inc(len(dead))
+        return len(dead)
 
     # ------------------------------------------------------------------- read
     def names(self, source: Optional[str] = None) -> List[str]:
@@ -175,6 +199,7 @@ class TimeSeriesStore:
                 "max_series": self._max_series,
                 "points_per_series": self._points,
                 "dropped_series": self._dropped,
+                "evicted_series": self._evicted,
             }
 
 

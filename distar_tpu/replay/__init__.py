@@ -15,6 +15,7 @@ from .errors import (
     ItemCorruptError,
     RateLimitTimeout,
     ReplayError,
+    StoreDrainingError,
     UnknownTableError,
     error_from_wire,
 )
@@ -47,6 +48,7 @@ __all__ = [
     "ItemCorruptError",
     "RateLimitTimeout",
     "ReplayError",
+    "StoreDrainingError",
     "UnknownTableError",
     "error_from_wire",
     "LocalReplayClient",

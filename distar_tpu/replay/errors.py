@@ -61,6 +61,16 @@ class ItemCorruptError(ReplayError):
     code = "item_corrupt"
 
 
+class StoreDrainingError(ReplayError):
+    """The store is retiring gracefully: new inserts are refused while the
+    resident tail drains out to samplers. Deliberately NOT retryable against
+    the same shard — waiting cannot un-drain it; sharded clients route the
+    key to a survivor instead (and the drained shard leaves the map at the
+    next membership refresh)."""
+
+    code = "draining"
+
+
 class BadHelloError(ReplayError):
     """The connection's ``hello`` offered preference lists with no
     recognized name at all (garbage codec/transport names — a hostile or
@@ -73,7 +83,7 @@ class BadHelloError(ReplayError):
 _WIRE_CODES = {
     cls.code: cls
     for cls in (ReplayError, UnknownTableError, InvalidBatchError,
-                ItemCorruptError, BadHelloError)
+                ItemCorruptError, BadHelloError, StoreDrainingError)
 }
 
 

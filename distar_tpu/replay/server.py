@@ -332,13 +332,18 @@ class ReplayAdminServer:
     GET ``/replay/stats`` (tables + limiter + spill JSON, the opsctl feed)."""
 
     def __init__(self, store: ReplayStore, host: str = "127.0.0.1", port: int = 0,
-                 server: Optional[ReplayServer] = None):
+                 server: Optional[ReplayServer] = None,
+                 on_drain: Optional[callable] = None):
         from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
         self.store = store
         #: optional data-plane server handle: lets /replay/stats report the
         #: live per-connection transport split (shm vs tcp) for opsctl
         self.data_server = server
+        #: drain hook the serving entrypoint installs: runs BEFORE the
+        #: store flips to draining (deregister the coordinator lease first —
+        #: a draining shard must leave discovery before it starts refusing)
+        self.on_drain = on_drain
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -369,6 +374,33 @@ class ReplayAdminServer:
                 self.send_header("Content-Length", "0")
                 self.end_headers()
 
+            def do_POST(self):
+                path = self.path.split("?", 1)[0].rstrip("/")
+                if path != "/drain":
+                    self.send_response(404)
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+                    return
+                # graceful retirement: deregister first (leave discovery
+                # NOW), then refuse new inserts typed while the resident
+                # tail keeps draining to samplers; the serving process
+                # exits once residency reaches zero or its drain timeout
+                try:
+                    if outer.on_drain is not None:
+                        try:
+                            outer.on_drain()
+                        except Exception:  # noqa: BLE001 - lease still lapses
+                            pass
+                    info = outer.store.begin_drain()
+                    data = json.dumps({"code": 0, "info": info}).encode()
+                except Exception as e:  # noqa: BLE001 - probe must not wedge us
+                    data = json.dumps({"code": 1, "info": repr(e)}).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
         self._server = ThreadingHTTPServer((host, port), Handler)
         self.host, self.port = self._server.server_address
         self._thread: Optional[threading.Thread] = None
@@ -395,6 +427,7 @@ def main(argv=None) -> int:
     import argparse
     import signal
     import sys
+    import time
 
     from .spill import SpillRing
     from .store import TableConfig
@@ -422,6 +455,20 @@ def main(argv=None) -> int:
                    help="data-plane transport policy: auto/shm negotiate "
                         "shared-memory rings with colocated clients, tcp "
                         "refuses rings (cross-host posture)")
+    p.add_argument("--coordinator", default="",
+                   help="coordinator host:port to register under the "
+                        "replay_shard token (lease/heartbeat; sharded "
+                        "clients and opsctl discover the fleet there)")
+    p.add_argument("--lease-s", type=float, default=10.0)
+    p.add_argument("--admin-port", type=int, default=-1,
+                   help=">= 0 starts the HTTP admin surface (/replay/stats, "
+                        "/metrics, POST /drain) on that port (0 = ephemeral; "
+                        "default off). Advertised as admin_port meta on the "
+                        "coordinator registration.")
+    p.add_argument("--drain-timeout-s", type=float, default=30.0,
+                   help="graceful-retirement budget: after POST /drain, "
+                        "exit once every resident item drained out, or when "
+                        "this many seconds passed — whichever comes first")
     args = p.parse_args(argv)
 
     cfg = TableConfig(
@@ -439,12 +486,48 @@ def main(argv=None) -> int:
     server = ReplayServer(store, host=args.host, port=args.port,
                           compress=args.compress, codecs=codecs,
                           transport=args.transport).start()
+
+    deregister = None
+    admin = None
+    if args.coordinator:
+        from ..comm.discovery import unregister_endpoint
+        from .sharding import register_shard
+
+        chost, _, cport = args.coordinator.rpartition(":")
+        coord = (chost or "127.0.0.1", int(cport))
+
+    if args.admin_port >= 0:
+        admin = ReplayAdminServer(store, host=args.host, port=args.admin_port,
+                                  server=server).start()
+
+    if args.coordinator:
+        beat = register_shard(
+            coord, server.host, server.port,
+            meta={"shard_id": args.shard_id,
+                  **({"admin_port": admin.port} if admin is not None else {})},
+            lease_s=args.lease_s,
+        )
+
+        def deregister(beat=beat, coord=coord, host=server.host,
+                       port=server.port):
+            beat.stop_event.set()
+            try:
+                unregister_endpoint(coord, host, port)
+            except Exception:  # noqa: BLE001 - best-effort; lease still lapses
+                pass
+
+        if admin is not None:
+            # drain step 1: leave discovery before refusing any insert
+            admin.on_drain = deregister
+
     # CLI entrypoint output: the parseable serving line callers wait for
     print(f"REPLAY-SHARD {server.host} {server.port} "  # lint: allow-print
-          f"recovered={recovered}", flush=True)
+          f"recovered={recovered}"
+          + (f" admin={admin.port}" if admin is not None else ""), flush=True)
     stop = threading.Event()
     for sig in (signal.SIGTERM, signal.SIGINT):
         signal.signal(sig, lambda *_: stop.set())
+    drain_deadline = None
     try:
         import select
 
@@ -454,9 +537,22 @@ def main(argv=None) -> int:
             ready, _, _ = select.select([sys.stdin], [], [], 0.5)
             if ready and not sys.stdin.buffer.read(1):
                 break
+            # graceful-retirement exit: once POST /drain flipped the store,
+            # serve until the resident tail drained out (samples keep
+            # flowing), bounded by --drain-timeout-s
+            if store.draining:
+                if drain_deadline is None:
+                    drain_deadline = time.monotonic() + args.drain_timeout_s
+                if (store.resident_items() == 0
+                        or time.monotonic() > drain_deadline):
+                    break
     except (OSError, ValueError, KeyboardInterrupt):
         pass
+    if deregister is not None:
+        deregister()
     server.stop()
+    if admin is not None:
+        admin.stop()
     if spill is not None:
         spill.flush()
     return 0

@@ -43,6 +43,7 @@ from .errors import (
     InvalidBatchError,
     RateLimitTimeout,
     ReplayError,
+    StoreDrainingError,
     UnknownTableError,
 )
 
@@ -152,6 +153,56 @@ class _ShardedBase:
         self._timeout_s = timeout_s
         self._clients: Dict[str, object] = {}
         self._lock = threading.Lock()
+        #: shards observed mid-drain (typed ``draining`` answers): routed
+        #: around until a membership refresh drops them from the map
+        self._draining: set = set()
+        self._refresher = None
+
+    # -------------------------------------------------------- live membership
+    def set_shard_map(self, shard_map: ShardMap) -> None:
+        """Install a freshly discovered map (live membership: joins appear,
+        drained/lease-evicted shards disappear — the ≤1/(N+1) consistent-
+        hash remap bounds how many keys move). Clients held against
+        departed shards are closed; drain marks for addresses no longer in
+        the map are pruned."""
+        with self._lock:
+            self.shard_map = shard_map
+            self._draining &= set(shard_map.addrs)
+            dead = [a for a in self._clients if a not in shard_map.addrs]
+            closed = [self._clients.pop(a) for a in dead]
+        for c in closed:
+            try:
+                c.close()
+            except Exception:  # noqa: BLE001 - teardown best-effort
+                pass
+
+    def start_refresh(self, coordinator_addr: Tuple[str, int],
+                      interval_s: float = 10.0,
+                      token: str = SHARD_TOKEN) -> None:
+        """Periodically re-discover the shard fleet from the coordinator
+        (the shared ``comm.discovery`` refresh idiom) so this long-lived
+        client sees scale-ups and drains without a restart. Empty reads are
+        ignored (a restarting broker must not wipe a working map)."""
+        from ..comm.discovery import start_refresh
+
+        def apply(records):
+            addrs = sorted({f"{r['ip']}:{r['port']}" for r in records})
+            if addrs and addrs != self.shard_map.addrs:
+                self.set_shard_map(ShardMap(addrs))
+
+        if self._refresher is None:
+            self._refresher = start_refresh(coordinator_addr, token, apply,
+                                            interval_s=interval_s)
+
+    def note_draining(self, addr: str) -> None:
+        """Route around ``addr`` until the membership refresh retires it."""
+        with self._lock:
+            self._draining.add(addr)
+        get_registry().counter(
+            "distar_replay_drains_observed_total",
+            "typed draining answers that moved routing off a retiring shard",
+            shard=addr,
+        ).inc()
 
     def client_for(self, addr: str):
         with self._lock:
@@ -200,6 +251,9 @@ class _ShardedBase:
         return {"shards": self.fleet_stats()}
 
     def close(self) -> None:
+        if self._refresher is not None:
+            self._refresher.stop_event.set()
+            self._refresher = None
         with self._lock:
             clients, self._clients = list(self._clients.values()), {}
         for c in clients:
@@ -227,15 +281,20 @@ class ShardedInsertClient(_ShardedBase):
         self._seq = 0
         self._seq_lock = threading.Lock()
         self._key_base = f"{os.getpid():x}-{stable_hash(str(time.time())) & 0xFFFF:04x}"
-        reg = get_registry()
-        self._c_routed = {
-            addr: reg.counter(
+        # counters are minted lazily: live membership means shards can join
+        # after construction
+        self._c_routed: Dict[str, object] = {}
+        self._overlay_rings: Dict[tuple, HashRing] = {}
+
+    def _routed_counter(self, addr: str):
+        c = self._c_routed.get(addr)
+        if c is None:
+            c = self._c_routed[addr] = get_registry().counter(
                 "distar_replay_shard_inserts_total",
                 "inserts routed to each shard by the consistent-hash ring",
                 shard=addr,
             )
-            for addr in self.shard_map.addrs
-        }
+        return c
 
     def next_key(self) -> str:
         with self._seq_lock:
@@ -243,17 +302,40 @@ class ShardedInsertClient(_ShardedBase):
             return f"{self._key_base}-{self._seq}"
 
     def shard_for(self, table: str, key: str) -> str:
-        return self.shard_map.shard_for(table, key)
+        """Owner for ``(table, key)`` under the CURRENT map, routed around
+        shards observed mid-drain (a drained shard deregisters, so the next
+        membership refresh makes the overlay permanent)."""
+        m = self.shard_map
+        with self._lock:
+            draining = self._draining & set(m.addrs)
+        if not draining or len(draining) >= len(m.addrs):
+            return m.shard_for(table, key)
+        cache_key = (tuple(m.addrs), frozenset(draining))
+        ring = self._overlay_rings.get(cache_key)
+        if ring is None:
+            self._overlay_rings.clear()  # one live overlay at a time
+            ring = self._overlay_rings[cache_key] = HashRing(
+                [a for a in m.addrs if a not in draining])
+        return ring.lookup(f"{table}/{key}")
 
     def insert(self, table: str, item, priority: float = 1.0,
                timeout_s: Optional[float] = None, key: Optional[str] = None) -> int:
-        addr = self.shard_for(table, key if key is not None else self.next_key())
-        seq = self.client_for(addr).insert(
-            table, item, priority=priority, timeout_s=timeout_s)
-        counter = self._c_routed.get(addr)
-        if counter is not None:
-            counter.inc()
-        return seq
+        key = key if key is not None else self.next_key()
+        # a shard answering the typed ``draining`` error is retiring: mark
+        # it, re-route this key on the overlay ring (every future key skips
+        # it too) and re-issue — at most once per fleet member
+        for _ in range(max(len(self.shard_map), 1)):
+            addr = self.shard_for(table, key)
+            try:
+                seq = self.client_for(addr).insert(
+                    table, item, priority=priority, timeout_s=timeout_s)
+            except StoreDrainingError:
+                self.note_draining(addr)
+                continue
+            self._routed_counter(addr).inc()
+            return seq
+        raise StoreDrainingError(
+            f"every shard in the {len(self.shard_map)}-member fleet is draining")
 
 
 class ShardedSampleClient(_ShardedBase):
@@ -282,20 +364,26 @@ class ShardedSampleClient(_ShardedBase):
         self._rr = 0
         self._weights: Dict[str, float] = {}
         self._weights_ts = 0.0
-        reg = get_registry()
-        self._c_samples = {
-            addr: reg.counter(
+        # minted lazily: live membership means shards can join mid-run
+        self._c_samples: Dict[str, object] = {}
+        self._c_skips: Dict[str, object] = {}
+
+    def _sample_counter(self, addr: str):
+        c = self._c_samples.get(addr)
+        if c is None:
+            c = self._c_samples[addr] = get_registry().counter(
                 "distar_replay_fanin_samples_total",
                 "items served to the fan-in sampler, per shard", shard=addr)
-            for addr in self.shard_map.addrs
-        }
-        self._c_skips = {
-            addr: reg.counter(
+        return c
+
+    def _skip_counter(self, addr: str):
+        c = self._c_skips.get(addr)
+        if c is None:
+            c = self._c_skips[addr] = get_registry().counter(
                 "distar_replay_fanin_skips_total",
                 "fan-in rotations that skipped a shard (pacing/fault/breaker)",
                 shard=addr)
-            for addr in self.shard_map.addrs
-        }
+        return c
 
     # ----------------------------------------------------------- shard order
     def _refresh_weights(self, max_age_s: float = 5.0) -> None:
@@ -349,19 +437,19 @@ class ShardedSampleClient(_ShardedBase):
                     raise  # config error: waiting/rotating cannot fix it
                 except RateLimitTimeout as e:
                     last_state = {"shard": addr, **(e.state or {})}
-                    self._c_skips[addr].inc()
+                    self._skip_counter(addr).inc()
                     continue
                 except UnknownTableError:
                     unknown_tables += 1
-                    self._c_skips[addr].inc()
+                    self._skip_counter(addr).inc()
                     continue
                 except (ReplayError, CircuitOpenError, RetryableError,
                         ConnectionError, OSError):
-                    self._c_skips[addr].inc()
+                    self._skip_counter(addr).inc()
                     continue
                 for d in info:
                     d["shard"] = addr
-                self._c_samples[addr].inc(len(items))
+                self._sample_counter(addr).inc(len(items))
                 return items, info
             if unknown_tables == len(self.shard_map):
                 raise UnknownTableError(

@@ -46,7 +46,12 @@ from dataclasses import dataclass
 from typing import Any, Callable, Dict, List, Optional
 
 from ..obs import get_registry
-from .errors import InvalidBatchError, RateLimitTimeout, UnknownTableError
+from .errors import (
+    InvalidBatchError,
+    RateLimitTimeout,
+    StoreDrainingError,
+    UnknownTableError,
+)
 
 SAMPLERS = ("prioritized", "uniform", "fifo")
 
@@ -191,6 +196,17 @@ class RateLimiter:
         """Wake waiters after a table mutation the commit paths didn't see
         (eviction freeing size, shutdown)."""
         with self._cv:
+            self._cv.notify_all()
+
+    def release_pacing(self) -> None:
+        """Drain mode: stop enforcing the samples-per-insert ratio (and drop
+        the min-size gate to 1) so the resident tail can drain out to
+        samplers even though inserts have stopped — a paced drain would
+        otherwise park the last learners forever against a counter that will
+        never advance."""
+        with self._cv:
+            self.spi = None
+            self.min_size = 1
             self._cv.notify_all()
 
     def state(self) -> dict:
@@ -520,6 +536,7 @@ class ReplayStore:
         self._recover_encoded = recover_encoded
         self._tables: Dict[str, ReplayTable] = {}
         self._idem: Dict[str, int] = {}  # idem key -> acked seq (insertion-ordered)
+        self._draining = False
         self._lock = threading.Lock()
         self._c_dedup = get_registry().counter(
             "distar_replay_insert_dedup_total",
@@ -583,6 +600,20 @@ class ReplayStore:
         commit. The cache is process-lifetime only; a retry that crosses a
         store restart still lands as the documented at-least-once
         duplicate."""
+        if self._draining:
+            # graceful retirement: a retry of an ALREADY-acked insert is
+            # still answered from the idem cache (the ack must hold across
+            # the drain edge), but genuinely new work is refused typed so
+            # routing moves it to a surviving shard
+            if idem is not None:
+                with self._lock:
+                    cached = self._idem.get(idem)
+                if cached is not None:
+                    self._c_dedup.inc()
+                    return cached
+            raise StoreDrainingError(
+                "store is draining; new inserts are refused (route to a "
+                "surviving shard)")
         if idem is not None:
             with self._lock:
                 cached = self._idem.get(idem)
@@ -628,8 +659,38 @@ class ReplayStore:
             n += 1
         return n
 
+    # ---------------------------------------------------------------- drain
+    def begin_drain(self) -> dict:
+        """Enter graceful retirement: refuse NEW inserts with the typed
+        ``draining`` wire error (idem-cached retries of already-acked
+        inserts still answer their seq) while samples keep being served, so
+        the resident tail drains out to the learner fan-in instead of being
+        shed wholesale. Idempotent; the serving process exits once
+        ``resident_items()`` reaches zero (or its drain timeout lapses) and
+        the spill has flushed."""
+        if not self._draining:
+            self._draining = True
+            for name in self.tables():
+                self.table(name).limiter.release_pacing()
+            get_registry().counter(
+                "distar_replay_drains_total",
+                "graceful drains started on this store",
+                **({"shard": self.shard_id} if self.shard_id else {}),
+            ).inc()
+        return {"draining": True, "resident": self.resident_items()}
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def resident_items(self) -> int:
+        """Items still resident across every table — what a drain waits on."""
+        return sum(self.table(name).stats().get("size", 0)
+                   for name in self.tables())
+
     def stats(self) -> dict:
         out = {"tables": {name: self.table(name).stats() for name in self.tables()}}
+        out["draining"] = self._draining
         if self.shard_id:
             out["shard"] = self.shard_id
         if self._spill is not None:

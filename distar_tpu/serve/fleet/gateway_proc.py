@@ -19,6 +19,7 @@ import argparse
 import signal
 import sys
 import threading
+import time
 
 
 def main(argv=None) -> int:
@@ -45,6 +46,10 @@ def main(argv=None) -> int:
     p.add_argument("--coordinator", default="",
                    help="coordinator host:port to register under serve_gateway")
     p.add_argument("--lease-s", type=float, default=10.0)
+    p.add_argument("--drain-timeout-s", type=float, default=30.0,
+                   help="graceful-retirement budget: after POST /drain, exit "
+                        "once every resident session migrated off, or when "
+                        "this many seconds passed — whichever comes first")
     p.add_argument("--transport", default="auto", choices=("auto", "shm", "tcp"),
                    help="TCP-frontend transport policy (auto/shm negotiate "
                         "shared-memory rings with colocated clients)")
@@ -75,14 +80,24 @@ def main(argv=None) -> int:
 
     beat = None
     if args.coordinator:
+        from ...comm.discovery import unregister_endpoint
+
         chost, _, cport = args.coordinator.rpartition(":")
+        coord = (chost or "127.0.0.1", int(cport))
         beat = register_gateway(
-            (chost or "127.0.0.1", int(cport)), tcp.host, tcp.port,
+            coord, tcp.host, tcp.port,
             meta={"players": players, "slots": args.slots,
                   "http_port": http.port, "version": args.version,
                   "mock": True},
             lease_s=args.lease_s,
         )
+
+        def _deregister(beat=beat, coord=coord, host=tcp.host, port=tcp.port):
+            beat.stop_event.set()
+            unregister_endpoint(coord, host, port)
+
+        # drain's step 1: leave discovery NOW, not a lease TTL later
+        target.deregister = _deregister
 
     # CLI entrypoint output: the parseable serving line callers wait for
     print(f"SERVE-GATEWAY {tcp.host} {tcp.port} {http.port}",  # lint: allow-print
@@ -90,6 +105,7 @@ def main(argv=None) -> int:
     stop = threading.Event()
     for sig in (signal.SIGTERM, signal.SIGINT):
         signal.signal(sig, lambda *_: stop.set())
+    drain_deadline = [None]
     try:
         import select
 
@@ -97,6 +113,17 @@ def main(argv=None) -> int:
             ready, _, _ = select.select([sys.stdin], [], [], 0.5)
             if ready and not sys.stdin.buffer.read(1):
                 break
+            # graceful-retirement exit: once a POST /drain (or TCP drain op)
+            # flipped us to draining, run until every resident session has
+            # migrated off (the router ends them here as it re-pins), then
+            # leave — bounded by --drain-timeout-s so a client that never
+            # migrates can't pin a retiring process forever
+            if getattr(target, "draining", False):
+                if drain_deadline[0] is None:
+                    drain_deadline[0] = time.monotonic() + args.drain_timeout_s
+                if (target.resident_sessions() == 0
+                        or time.monotonic() > drain_deadline[0]):
+                    break
     except (OSError, ValueError, KeyboardInterrupt):
         pass
     if beat is not None:

@@ -39,7 +39,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 from ...obs import get_registry
 from ...replay.sharding import HashRing, stable_hash
 from ...resilience import CircuitOpenError, RetryableError, RetryPolicy
-from ..errors import ServeError
+from ..errors import CapacityError, DrainingError, ServeError
 from .discovery import GatewayMap
 
 #: exceptions that mean "this gateway is unreachable", never an application
@@ -120,6 +120,21 @@ class FleetRouter:
         """A call against ``addr`` succeeded — clear any down mark early."""
         with self._lock:
             self._down.pop(addr, None)
+
+    def mark_draining(self, addr: str, ttl_s: float = 60.0) -> None:
+        """The gateway answered ``DrainingError``: it is retiring
+        gracefully. Route new work AND existing pins off it (the re-pin is
+        the migration; the retiring gateway finishes its in-flight work
+        itself). A long TTL, not a permanent mark: the next membership
+        refresh drops the address entirely, and a re-offer against a
+        still-draining gateway just observes the drain again — harmless."""
+        with self._lock:
+            self._down[addr] = time.monotonic() + float(ttl_s)
+        get_registry().counter(
+            "distar_fleet_drains_observed_total",
+            "DrainingError answers that moved routing off a retiring gateway",
+            gateway=addr,
+        ).inc()
 
     def refresh(self, gateway_map: GatewayMap) -> None:
         """Install a freshly discovered map (lease-evicted gateways are
@@ -206,6 +221,35 @@ class FleetRouter:
         stable = [a for a in live if a not in self._canary_addrs] or live
         return self._ring(stable).lookup(session_id)
 
+    def spill_over(self, session_id: str, addr: str) -> bool:
+        """A FRESH session (no server-side carry yet) was capacity-shed at
+        its ring-picked gateway: move its pin to the next live gateway so
+        the fleet's free slots absorb it — arrival admission becomes a
+        fleet-wide property, not a per-gateway accident of the hash split
+        (and a just-joined gateway actually receives the overflow that
+        triggered the scale-up). Sessions with a materialized carry NEVER
+        move this way — affinity outranks capacity. Returns False when
+        there is nowhere else to try (the shed then passes through)."""
+        now = time.monotonic()
+        with self._lock:
+            if self._steps.get(session_id, 0) > 0:
+                return False  # carry materialized: affinity wins
+            live = [a for a in self.map.addrs if self._down.get(a, 0.0) <= now]
+            if len(live) <= 1:
+                return False
+            cur = self._pins.get(session_id, addr)
+            i = live.index(cur) if cur in live else -1
+            nxt = live[(i + 1) % len(live)]
+            if nxt == cur:
+                return False
+            self._pins[session_id] = nxt
+        get_registry().counter(
+            "distar_fleet_capacity_spillovers_total",
+            "fresh sessions re-pinned past a capacity-full gateway to the "
+            "next live one",
+        ).inc()
+        return True
+
     def note_step(self, session_id: str, step: Optional[int]) -> None:
         """Feed every answer's ``session_step`` back: when it runs backwards
         the server-side carry restarted from zero — one migration."""
@@ -270,7 +314,8 @@ class FleetClient:
                  timeout_s: float = 30.0, player: Optional[str] = None,
                  retry_policy: Optional[RetryPolicy] = None,
                  client_factory: Optional[Callable[[str], Any]] = None,
-                 down_ttl_s: float = 10.0, transport: str = "auto"):
+                 down_ttl_s: float = 10.0, transport: str = "auto",
+                 refresh_s: float = 10.0):
         self.transport = transport
         if router is None:
             if gateway_map is None:
@@ -290,6 +335,49 @@ class FleetClient:
         self._client_factory = client_factory
         self._clients: Dict[str, Any] = {}
         self._lock = threading.Lock()
+        # live membership: with a coordinator in hand, re-discover the fleet
+        # every refresh_s so joins (autoscaler scale-ups) and drains become
+        # visible WITHOUT a restart — the comm.discovery refresh idiom
+        self._refresher = None
+        if coordinator_addr is not None and refresh_s > 0:
+            from ...comm.discovery import start_refresh
+            from .discovery import GATEWAY_TOKEN
+
+            self._refresher = start_refresh(
+                coordinator_addr, GATEWAY_TOKEN, self._apply_records,
+                interval_s=refresh_s)
+
+    def _apply_records(self, records) -> None:
+        """Fold a freshly discovered fleet into the live router. An empty
+        read is kept OUT (indistinguishable from a restarting broker that
+        lost its records — a stale map beats an empty one). A departed
+        address that still holds session pins gets the drain handoff:
+        those sessions are ENDED there best-effort (a draining gateway
+        still answers ``end``, so its residency actually reaches zero and
+        it can exit; a crashed one ignores us harmlessly) before their
+        next step re-pins them to a survivor. Clients held against
+        departed gateways are then closed."""
+        meta = {f"{r['ip']}:{r['port']}": dict(r.get("meta") or {})
+                for r in records}
+        if not meta:
+            return
+        departed = [a for a in self.router.map.addrs if a not in meta]
+        pinned = {a: self.router.pins_on(a) for a in departed}
+        self.router.refresh(GatewayMap(sorted(meta), meta=meta))
+        for addr in departed:
+            with self._lock:
+                client = self._clients.get(addr)
+            if client is not None and pinned.get(addr):
+                self._drain_handoff(addr, client, pinned[addr],
+                                    mark=False)
+        with self._lock:
+            dead = [a for a in self._clients if a not in meta]
+            closed = [self._clients.pop(a) for a in dead]
+        for c in closed:
+            try:
+                c.close()
+            except Exception:  # noqa: BLE001 - teardown best-effort
+                pass
 
     # ------------------------------------------------------------ connections
     def _dial(self, addr: str):
@@ -357,6 +445,7 @@ class FleetClient:
         player = self._player(player)
         results: List[Any] = [None] * len(requests)
         lanes = list(range(len(requests)))
+        spills: Dict[int, int] = {}  # per-lane capacity spill-overs this call
         # every lane traverses at most the whole fleet once, plus one pick
         for _ in range(len(self.router.map) + 1):
             if not lanes:
@@ -381,16 +470,63 @@ class FleetClient:
                     retry.extend(idxs)
                     continue
                 self.router.note_ok(addr)
+                handoff: List[int] = []
                 for i, entry in zip(idxs, entries):
+                    if isinstance(entry, DrainingError):
+                        # graceful retirement, not backpressure: this lane's
+                        # session migrates to a survivor (the PR 10 re-route
+                        # path), it does NOT bounce back to the caller
+                        handoff.append(i)
+                        continue
+                    if (isinstance(entry, CapacityError)
+                            and spills.get(i, 0) < len(self.router.map) - 1
+                            and self.router.spill_over(
+                                requests[i]["session_id"], addr)):
+                        # fresh session, full gateway, fleet not full:
+                        # re-pinned to the next live gateway and re-issued
+                        # (a fleet-wide-full session runs out of spills and
+                        # sheds through typed, exactly as before)
+                        spills[i] = spills.get(i, 0) + 1
+                        retry.append(i)
+                        continue
                     results[i] = entry
                     if isinstance(entry, dict):
                         self.router.note_step(
                             requests[i]["session_id"], entry.get("session_step"))
+                if handoff:
+                    self._drain_handoff(
+                        addr, client, [requests[i]["session_id"] for i in handoff])
+                    retry.extend(handoff)
             lanes = retry
         for i in lanes:  # passes exhausted with gateways still failing
             if results[i] is None:
                 results[i] = ServeError("gateway fleet unreachable for lane")
         return results
+
+    def _drain_handoff(self, addr: str, client, session_ids,
+                       mark: bool = True) -> None:
+        """A gateway is retiring under these sessions: take routing off it
+        (``mark=False`` when a membership refresh already removed it), then
+        END each session there (freeing its slot, so the retiring process's
+        ``resident_sessions`` actually drains to zero) before the caller's
+        next step re-pins it to a survivor — where the carry
+        re-materializes from zero and the migration is counted exactly
+        (session_step runs backwards)."""
+        if mark:
+            self.router.mark_draining(addr)
+        ended = 0
+        for sid in session_ids:
+            try:
+                if client.end(sid, player=self.player):
+                    ended += 1
+            except Exception:  # noqa: BLE001 - the drain timeout frees it anyway
+                pass
+        if ended:
+            get_registry().counter(
+                "distar_fleet_drain_handoff_sessions_total",
+                "sessions ended on a draining gateway before re-pinning to "
+                "a survivor (exact-accounting half of a graceful migration)",
+            ).inc(ended)
 
     # -------------------------------------------------------- session control
     def _routed_call(self, addr: str, opname: str, fn: Callable):
@@ -492,6 +628,9 @@ class FleetClient:
                    for v in self._broadcast("ping", lambda c: c.ping()).values())
 
     def close(self) -> None:
+        if self._refresher is not None:
+            self._refresher.stop_event.set()
+            self._refresher = None
         with self._lock:
             clients, self._clients = list(self._clients.values()), {}
         for c in clients:
@@ -612,24 +751,24 @@ def main(argv=None) -> int:
     for sig in (signal.SIGTERM, signal.SIGINT):
         signal.signal(sig, lambda *_: stop.set())
 
-    def refresh_loop():
+    if coordinator is not None:
+        # live membership via the shared comm.discovery refresh idiom (the
+        # same loop every FleetClient/sharded-replay client now runs), plus
+        # convergence on the published canary split (rollout controller's
+        # canary_start/promote publish it)
+        from ...comm.discovery import start_refresh
+        from .discovery import GATEWAY_TOKEN
         from .rollout import fetch_canary
 
-        while coordinator is not None and not stop.wait(args.refresh_s):
-            try:
-                fleet.router.refresh(GatewayMap.discover(coordinator))
-                # converge on the published canary split (rollout
-                # controller's canary_start/promote publish it)
-                cfg = fetch_canary(coordinator)
-                if cfg is not None:
-                    fleet.router.set_canary(cfg.get("addrs") or [],
-                                            float(cfg.get("pct") or 0.0))
-            except Exception:  # noqa: BLE001 - keep serving on a stale map
-                continue
+        def apply_records(records):
+            fleet._apply_records(records)
+            cfg = fetch_canary(coordinator)
+            if cfg is not None:
+                fleet.router.set_canary(cfg.get("addrs") or [],
+                                        float(cfg.get("pct") or 0.0))
 
-    refresher = threading.Thread(target=refresh_loop, name="router-refresh",
-                                 daemon=True)
-    refresher.start()
+        start_refresh(coordinator, GATEWAY_TOKEN, apply_records,
+                      interval_s=args.refresh_s, stop_event=stop)
     try:
         import select
 

@@ -21,7 +21,7 @@ import numpy as np
 
 from ..obs import Span, finish_trace, get_registry, mark_hop, start_trace
 from .batcher import MicroBatcher, PendingRequest
-from .errors import ServeError, ShedError
+from .errors import DrainingError, ServeError, ShedError
 from .registry import ModelRegistry
 from .sessions import SessionTable
 
@@ -66,6 +66,14 @@ class InferenceGateway:
         self._applied_generation = 0
         self._served_version: Optional[str] = None
         self._draining = False
+        #: entrypoints that registered this gateway with a coordinator set
+        #: this to a callable that stops the heartbeat AND unregisters the
+        #: lease; drain invokes it FIRST (a draining gateway must leave
+        #: discovery before it starts shedding, or routers keep pinning new
+        #: sessions to it until the lease dies)
+        self.deregister = None
+        self._deregistered = False
+        self._drain_lock = threading.Lock()
         reg = get_registry()
         self._c_req = {
             outcome: reg.counter(
@@ -93,9 +101,54 @@ class InferenceGateway:
         self.batcher.start()
         return self
 
+    def _deregister_once(self) -> None:
+        with self._drain_lock:
+            if self._deregistered:
+                return
+            self._deregistered = True
+            fn = self.deregister
+        if fn is not None:
+            try:
+                fn()
+            except Exception:  # noqa: BLE001 - best-effort; the lease still lapses
+                pass
+
+    def begin_drain(self) -> dict:
+        """Enter graceful retirement — the drain state machine's first two
+        steps, ordered deliberately:
+
+          1. deregister the coordinator lease (leave discovery NOW, so
+             routers stop pinning new sessions here);
+          2. shed every NEW request with the typed ``DrainingError`` while
+             requests already admitted flush and complete on the live
+             batcher (this is ``start_draining``, not ``drain_and_stop``:
+             the batcher thread keeps running).
+
+        Resident sticky sessions then migrate client-side: a ``FleetClient``
+        seeing ``DrainingError`` re-pins the session to a survivor and ends
+        it here, so ``resident_sessions()`` drains to zero — the process
+        exit condition the serving entrypoints poll. Idempotent."""
+        self._deregister_once()
+        if not self._draining:
+            self._draining = True
+            get_registry().counter(
+                "distar_serve_drains_total",
+                "graceful drains started on this gateway",
+            ).inc()
+        return {"draining": True, "resident": self.resident_sessions()}
+
+    def resident_sessions(self) -> int:
+        """Sessions still holding a slot — the number a drain waits on."""
+        return self.sessions.stats()["active"]
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
     def drain_and_stop(self, timeout: Optional[float] = 30.0) -> None:
-        """Stop admissions, serve out the queue, stop the batcher thread."""
-        self._draining = True
+        """Stop admissions (deregistering the lease first), serve out the
+        queue, stop the batcher thread."""
+        self.begin_drain()
         self.batcher.drain_and_stop(timeout)
 
     # ----------------------------------------------------------- client API
@@ -124,6 +177,14 @@ class InferenceGateway:
         cycle into the same fixed-shape flush."""
         timeout_s = self.default_timeout_s if timeout_s is None else timeout_s
         t0 = time.perf_counter()
+        if self._draining:
+            # graceful retirement: NEW work sheds typed at the door (before
+            # any session slot is touched) while already-admitted requests
+            # finish on the live batcher; fleet clients treat this as the
+            # migrate-my-session signal, not as backpressure
+            self._c_req["shed"].inc(len(requests))
+            err = DrainingError("gateway is draining; sessions are migrating")
+            return [err for _ in requests]
         results: List[Any] = [None] * len(requests)
         pending: List[tuple] = []
         for i, r in enumerate(requests):
@@ -179,6 +240,8 @@ class InferenceGateway:
         every id atomically, shedding the WHOLE reservation typed
         (``CapacityError``) when the table can't host it — actors fail fast
         at job start instead of shedding mid-episode."""
+        if self._draining:
+            raise DrainingError("gateway is draining; no new reservations")
         return self.sessions.reserve(list(session_ids))
 
     def session_hidden(self, session_id: str):

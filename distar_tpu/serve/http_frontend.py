@@ -15,8 +15,16 @@ Routes:
   POST /serve/load    {version, source, activate?}
   POST /serve/swap    {version}
   POST /serve/status  {}
+  POST /serve/drain   {}   -> begin graceful retirement (idempotent)
   GET  /metrics       Prometheus scrape (shared obs helper)
   GET  /healthz /alerts /timeseries   fleet-health JSON (shared obs helper)
+
+Drain contract (mirror of the TCP side): ``POST /drain`` deregisters the
+coordinator lease and flips the gateway to shed-new/finish-in-flight; from
+then on a shed NEW request answers HTTP **503** with the typed
+``DrainingError`` wire body (every other typed serve error keeps the
+legacy 200-with-wire-dict shape), while requests admitted before the drain
+complete normally.
 """
 from __future__ import annotations
 
@@ -27,7 +35,7 @@ from typing import Optional
 
 import numpy as np
 
-from .errors import ServeError
+from .errors import DrainingError, ServeError
 
 
 def jsonable(obj):
@@ -82,6 +90,12 @@ class ServeHTTPServer:
                 return {"generation": gw.activate_version(body["version"])}
             if name == "status":
                 return gw.status()
+            if name == "drain":
+                # drain is ADDRESS-level (never per-player): begin graceful
+                # retirement of the whole serving process
+                if not hasattr(root, "begin_drain"):
+                    raise ServeError("target has no drain surface")
+                return root.begin_drain()
             return None
 
         class Handler(BaseHTTPRequestHandler):
@@ -103,6 +117,7 @@ class ServeHTTPServer:
             def do_POST(self):
                 name = self.path.strip("/").split("/")[-1]
                 length = int(self.headers.get("Content-Length", 0))
+                status = 200
                 try:
                     body = json.loads(self.rfile.read(length) or b"{}")
                     info = routes(name, body)
@@ -111,12 +126,18 @@ class ServeHTTPServer:
                         if info is None
                         else {"code": 0, "info": info}
                     )
+                except DrainingError as e:
+                    # the drain contract: shed-while-draining is visible at
+                    # the HTTP layer too (load balancers and dumb probes key
+                    # on the status line, not the body) — 503 + typed body
+                    payload = e.to_wire()
+                    status = 503
                 except ServeError as e:
                     payload = e.to_wire()
                 except Exception as e:
                     payload = {"code": 1, "info": repr(e)}
                 data = json.dumps(payload, default=str).encode()
-                self.send_response(200)
+                self.send_response(status)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(data)))
                 self.end_headers()

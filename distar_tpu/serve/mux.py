@@ -41,6 +41,10 @@ class GatewayMux:
             raise ValueError(
                 f"default player {self.default_player!r} not in "
                 f"{sorted(self.gateways)}")
+        #: one registration per ADDRESS: the mux owns the coordinator lease
+        #: (player gateways behind it must not deregister independently)
+        self.deregister = None
+        self._deregistered = False
 
     # ---------------------------------------------------------------- routing
     def resolve(self, player: Optional[str]):
@@ -129,6 +133,34 @@ class GatewayMux:
             gw.start()
         return self
 
+    def _deregister_once(self) -> None:
+        fn, self.deregister = self.deregister, None
+        if fn is not None and not self._deregistered:
+            self._deregistered = True
+            try:
+                fn()
+            except Exception:  # noqa: BLE001 - best-effort; the lease still lapses
+                pass
+
+    def begin_drain(self) -> dict:
+        """Graceful retirement of the whole address: deregister the ONE
+        coordinator lease first (the regression this fixes: a draining mux
+        used to keep heartbeating, so routers kept pinning new sessions to
+        it until the lease died), then put every player gateway into
+        shed-new/finish-in-flight draining. Idempotent."""
+        self._deregister_once()
+        for gw in self.gateways.values():
+            gw.begin_drain()
+        return {"draining": True, "resident": self.resident_sessions()}
+
+    def resident_sessions(self) -> int:
+        return sum(gw.resident_sessions() for gw in self.gateways.values())
+
+    @property
+    def draining(self) -> bool:
+        return any(gw._draining for gw in self.gateways.values())
+
     def drain_and_stop(self, timeout: Optional[float] = 30.0) -> None:
+        self.begin_drain()
         for gw in self.gateways.values():
             gw.drain_and_stop(timeout)

@@ -22,6 +22,7 @@ Requests are ``{"op": ..., ...}`` dicts:
   load     {version, source|params, activate?} -> {code: 0, info}
   swap     {version}                       -> {code: 0, generation}
   status   {}                              -> {code: 0, status}
+  drain    {}                              -> {code: 0, draining, resident}
   ping     {}                              -> {code: 0, pong: True}
 
 ``act_many`` is the rollout-plane cycle op: one frame carries a whole env
@@ -267,6 +268,14 @@ class ServeTCPServer:
                 return {"code": 0, "generation": gw.activate_version(req["version"])}
             if op == "status":
                 return {"code": 0, "status": gw.status()}
+            if op == "drain":
+                # address-level graceful retirement (never per-player)
+                root = self.gateway
+                if not hasattr(root, "begin_drain"):
+                    return {"code": "bad_request",
+                            "error": "target has no drain surface",
+                            "shed": False}
+                return {"code": 0, **root.begin_drain()}
             if op == "ping":
                 return {"code": 0, "pong": True}
             return {"code": "bad_request", "error": f"unknown op {op!r}", "shed": False}
@@ -442,6 +451,13 @@ class ServeClient:
 
     def status(self) -> dict:
         return self._call({"op": "status"})["status"]
+
+    def drain(self) -> dict:
+        """Ask the gateway to begin graceful retirement (idempotent);
+        returns ``{"draining": True, "resident": N}``."""
+        resp = self._call({"op": "drain"})
+        return {"draining": bool(resp.get("draining")),
+                "resident": int(resp.get("resident", 0))}
 
     def ping(self) -> bool:
         return self._call({"op": "ping"})["pong"]

@@ -1,0 +1,709 @@
+"""Elastic fleet control plane (ISSUE 12): autoscaler, graceful drain,
+live membership, TSDB series eviction, and the core-pinning honesty gate.
+
+Covers the `distar_tpu/fleet/` contracts plus the drain surfaces grown onto
+serve/replay (docs/serving.md + docs/data_plane.md elasticity sections):
+deregister-BEFORE-shed ordering against a live coordinator, the HTTP
+503-with-typed-body drain mirror, client-side drain handoff with exact
+migration accounting, live membership refresh on both fleets, the replay
+draining overlay, ScalePolicy hysteresis/cooldown, and perf_gate's refusal
+of forged ``scaling_valid`` claims. In-process servers keep tier-1 fast;
+the full subprocess drill is ``tools/chaos.py elastic-drill`` (slow test).
+"""
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from distar_tpu.comm.coordinator import Coordinator, CoordinatorServer
+from distar_tpu.comm.discovery import (
+    discover_endpoints,
+    start_refresh,
+    unregister_endpoint,
+)
+from distar_tpu.fleet import (
+    Autoscaler,
+    ScalePolicy,
+    SIG_GW_ACTIVE,
+    SIG_GW_SLOTS,
+    pinning,
+    set_autoscaler,
+)
+from distar_tpu.obs import (
+    TelemetryIngest,
+    TelemetryShipper,
+    TimeSeriesStore,
+    get_registry,
+)
+from distar_tpu.replay import (
+    ReplayServer,
+    ReplayStore,
+    ShardMap,
+    ShardedInsertClient,
+    StoreDrainingError,
+    TableConfig,
+)
+from distar_tpu.serve import (
+    DrainingError,
+    GatewayMux,
+    InferenceGateway,
+    MockModelEngine,
+    ServeClient,
+    ServeHTTPServer,
+    ServeTCPServer,
+)
+from distar_tpu.serve.fleet import FleetClient, GatewayMap, register_gateway
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+import perf_gate  # noqa: E402
+
+
+def _obs(i: int = 0) -> dict:
+    return {"x": np.full((2, 2), float(i), dtype=np.float32)}
+
+
+def _gateway(slots: int = 8, delay_s: float = 0.0) -> InferenceGateway:
+    params = {"version": "v1", "bias": 0.0}
+    gw = InferenceGateway(MockModelEngine(slots, params=params, delay_s=delay_s),
+                          max_batch=slots, max_delay_s=0.002)
+    gw.load_version("v1", params=params, activate=True)
+    return gw.start()
+
+
+def _snap(name: str) -> float:
+    return get_registry().snapshot().get(name, 0.0)
+
+
+# ------------------------------------------------------ coordinator departures
+def test_coordinator_unregister_purges_now_and_notifies():
+    co = Coordinator()
+    seen = []
+    co.add_evict_callback(seen.append)
+    co.register("t", "10.0.0.1", 9, lease_s=60.0)
+    assert co.peers("t")
+    assert co.unregister("10.0.0.1", 9) == 1
+    assert co.peers("t") == []
+    assert seen == ["10.0.0.1:9"]
+
+
+def test_coordinator_lease_expiry_notifies_evict_callbacks():
+    co = Coordinator()
+    seen = []
+    co.add_evict_callback(seen.append)
+    co.register("t", "10.0.0.2", 7, lease_s=0.05)
+    time.sleep(0.1)
+    co._last_sweep = 0.0  # allow an immediate sweep
+    assert co.peers("t") == []
+    assert seen == ["10.0.0.2:7"]
+
+
+# ------------------------------------------------------------ TSDB eviction
+def test_tsdb_evict_source_frees_series_cap():
+    store = TimeSeriesStore(points_per_series=8, max_series=3)
+    for i in range(3):
+        assert store.record(f"m{i}", 1.0, source="old")
+    assert not store.record("m_new", 1.0, source="new")  # cap refuses
+    before = _snap("distar_obs_series_evicted_total")
+    assert store.evict_source("old") == 3
+    assert _snap("distar_obs_series_evicted_total") - before == 3
+    assert store.record("m_new", 1.0, source="new")  # room again
+    st = store.stats()
+    assert st["evicted_series"] == 3 and st["series"] == 1
+    assert "old" not in store.sources()
+
+
+def test_ingest_evicts_by_endpoint_and_shipper_stamps_it():
+    store = TimeSeriesStore()
+    ingest = TelemetryIngest(store)
+    shipper = TelemetryShipper("gw-7", ingest=ingest, endpoint="10.0.0.3:88")
+    get_registry().counter("distar_tsdb_samples_total", "x").inc()  # something to ship
+    assert shipper.ship_once() > 0
+    assert "gw-7" in store.sources()
+    assert ingest.evict_endpoint("10.0.0.3:88") > 0
+    assert "gw-7" not in store.sources()
+    assert ingest.evict_endpoint("10.0.0.3:88") == 0  # idempotent
+
+
+# ------------------------------------------------------------- serve drain
+def test_gateway_drain_deregisters_before_shedding_live_coordinator():
+    """Satellite regression: a draining gateway must leave discovery FIRST
+    (it used to keep heartbeating, so routers kept pinning new sessions to
+    it until the lease died)."""
+    co = CoordinatorServer(Coordinator(default_lease_s=30.0))
+    co.start()
+    gw = _gateway(slots=4, delay_s=0.2)
+    tcp = ServeTCPServer(gw, port=0).start()
+    try:
+        beat = register_gateway((co.host, co.port), tcp.host, tcp.port,
+                                meta={"slots": 4}, lease_s=30.0)
+        order = []
+
+        def dereg():
+            order.append(("dereg", gw._draining))
+            beat.stop_event.set()
+            unregister_endpoint((co.host, co.port), tcp.host, tcp.port)
+
+        gw.deregister = dereg
+        assert discover_endpoints((co.host, co.port), "serve_gateway")
+
+        # an in-flight request admitted before the drain must finish
+        inflight = {}
+
+        def act():
+            inflight["out"] = gw.act("pre", _obs())
+
+        t = threading.Thread(target=act)
+        t.start()
+        time.sleep(0.05)  # admitted, engine sleeping
+        info = gw.begin_drain()
+        assert info["draining"]
+        # ordering: deregister ran BEFORE the draining flag flipped
+        assert order == [("dereg", False)]
+        # left discovery immediately, not a lease TTL later
+        assert discover_endpoints((co.host, co.port), "serve_gateway") == []
+        t.join(5.0)
+        assert inflight["out"]["model_version"] == "v1"  # in-flight finished
+        with pytest.raises(DrainingError):
+            gw.act("post", _obs())
+        with pytest.raises(DrainingError):
+            gw.reserve_sessions(["post2"])
+        assert gw.begin_drain()["draining"]  # idempotent
+    finally:
+        tcp.stop()
+        gw.drain_and_stop(2.0)
+        co.stop()
+
+
+def test_mux_drain_deregisters_once_and_drains_every_player():
+    mux = GatewayMux({"MP0": _gateway(2), "MP1": _gateway(2)})
+    calls = []
+    mux.deregister = lambda: calls.append(1)
+    mux.begin_drain()
+    mux.begin_drain()
+    assert calls == [1]
+    assert mux.draining
+    with pytest.raises(DrainingError):
+        mux.act("s", _obs())
+    mux.drain_and_stop(2.0)
+
+
+def test_http_drain_route_503_with_typed_body():
+    """Satellite: the HTTP frontend mirror of the TCP drain contract."""
+    gw = _gateway(slots=4, delay_s=0.2)
+    http = ServeHTTPServer(gw, port=0).start()
+
+    def post(route, body):
+        req = urllib.request.Request(
+            f"http://{http.host}:{http.port}/serve/{route}",
+            data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            return resp.status, json.loads(resp.read())
+
+    try:
+        # in-flight request admitted pre-drain, finishing post-drain
+        inflight = {}
+
+        def act():
+            inflight["resp"] = post("act", {"session_id": "pre",
+                                            "obs": {"x": [[1.0, 1.0]]}})
+
+        t = threading.Thread(target=act)
+        t.start()
+        time.sleep(0.05)
+        status, body = post("drain", {})
+        assert status == 200 and body["code"] == 0 and body["info"]["draining"]
+        t.join(5.0)
+        assert inflight["resp"][0] == 200 and inflight["resp"][1]["code"] == 0
+        # a NEW request while draining: HTTP 503 with the typed wire body
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            post("act", {"session_id": "post", "obs": {"x": [[1.0, 1.0]]}})
+        assert ei.value.code == 503
+        wire = json.loads(ei.value.read())
+        assert wire["code"] == "draining" and wire["shed"] is True
+        # control surfaces stay answerable while draining
+        status, body = post("status", {})
+        assert status == 200 and body["info"]["draining"] is True
+    finally:
+        http.stop()
+        gw.drain_and_stop(2.0)
+
+
+def test_tcp_drain_op_and_typed_shed():
+    gw = _gateway(slots=4)
+    tcp = ServeTCPServer(gw, port=0).start()
+    client = ServeClient(tcp.host, tcp.port, timeout_s=5.0)
+    try:
+        out = client.drain()
+        assert out["draining"] is True
+        with pytest.raises(DrainingError):
+            client.act("s", _obs())
+    finally:
+        client.close()
+        tcp.stop()
+        gw.drain_and_stop(2.0)
+
+
+# --------------------------------------------------- fleet client migration
+class _Fleet:
+    def __init__(self, n: int, slots: int = 8):
+        self.gateways = [_gateway(slots) for _ in range(n)]
+        self.servers = [ServeTCPServer(gw, port=0).start() for gw in self.gateways]
+        self.addrs = [f"{s.host}:{s.port}" for s in self.servers]
+
+    def close(self):
+        for s in self.servers:
+            s.stop()
+        for gw in self.gateways:
+            gw.drain_and_stop(2.0)
+
+
+def test_fleet_client_drain_handoff_exact_accounting():
+    """A draining gateway's resident sessions migrate to survivors with
+    zero caller-visible errors: DrainingError never surfaces, the sessions
+    are ENDED on the victim (its residency reaches zero), and the
+    migration counter moves EXACTLY once per resident session."""
+    fleet = _Fleet(2, slots=12)  # the survivor must hold EVERY session
+    fc = FleetClient(gateway_map=GatewayMap(fleet.addrs), timeout_s=5.0)
+    sids = [f"m-{i}" for i in range(10)]
+    try:
+        for _ in range(2):  # materialize carries everywhere
+            results = fc.act_many([{"session_id": s, "obs": _obs()} for s in sids])
+            assert all(isinstance(r, dict) for r in results), results
+        victim_idx = max(
+            range(2), key=lambda i: len(fc.router.pins_on(fleet.addrs[i])))
+        victim = fleet.addrs[victim_idx]
+        resident = len(fc.router.pins_on(victim))
+        assert resident > 0
+        mig0 = _snap("distar_fleet_session_migrations_total")
+        hand0 = _snap("distar_fleet_drain_handoff_sessions_total")
+        fleet.gateways[victim_idx].begin_drain()
+        results = fc.act_many([{"session_id": s, "obs": _obs()} for s in sids])
+        assert all(isinstance(r, dict) for r in results), results
+        assert _snap("distar_fleet_session_migrations_total") - mig0 == resident
+        assert _snap("distar_fleet_drain_handoff_sessions_total") - hand0 == resident
+        # the victim's slots were freed by the handoff ends
+        assert fleet.gateways[victim_idx].resident_sessions() == 0
+        assert len(fc.router.pins_on(victim)) == 0
+    finally:
+        fc.close()
+        fleet.close()
+
+
+def test_fleet_client_capacity_spillover_fills_the_fleet():
+    """Arrival admission is a FLEET property: a fresh session shed for
+    capacity at its ring pick spills to the next live gateway; only a
+    fleet-wide-full arrival sheds through typed."""
+    fleet = _Fleet(2, slots=2)
+    fc = FleetClient(gateway_map=GatewayMap(fleet.addrs), timeout_s=5.0)
+    try:
+        results = fc.act_many(
+            [{"session_id": f"c-{i}", "obs": _obs()} for i in range(4)])
+        assert all(isinstance(r, dict) for r in results), results
+        pins = fc.router.stats()["pins_per_gateway"]
+        assert sorted(pins.values()) == [2, 2]  # both gateways full
+        res = fc.act_many([{"session_id": "c-full", "obs": _obs()}])
+        from distar_tpu.serve.errors import CapacityError
+        assert isinstance(res[0], CapacityError)  # fleet full: typed shed
+    finally:
+        fc.close()
+        fleet.close()
+
+
+def test_fleet_client_live_membership_join_without_restart():
+    """The comm.discovery refresh idiom: a gateway joining AFTER the client
+    was built becomes routable with no client reconstruction."""
+    co = CoordinatorServer(Coordinator(default_lease_s=30.0))
+    co.start()
+    fleet = _Fleet(2, slots=4)
+    beats = []
+    host0, port0 = fleet.addrs[0].rsplit(":", 1)
+    beats.append(register_gateway((co.host, co.port), host0, int(port0),
+                                  meta={"slots": 4}, lease_s=30.0))
+    fc = FleetClient(coordinator_addr=(co.host, co.port), timeout_s=5.0,
+                     refresh_s=0.2)
+    try:
+        assert len(fc.router.map) == 1
+        host1, port1 = fleet.addrs[1].rsplit(":", 1)
+        beats.append(register_gateway((co.host, co.port), host1, int(port1),
+                                      meta={"slots": 4}, lease_s=30.0))
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline and len(fc.router.map) < 2:
+            time.sleep(0.1)
+        assert sorted(fc.router.map.addrs) == sorted(fleet.addrs)
+    finally:
+        for b in beats:
+            b.stop_event.set()
+        fc.close()
+        fleet.close()
+        co.stop()
+
+
+# ------------------------------------------------------------- replay drain
+def _fifo_cfg(_name):
+    return TableConfig(max_size=64, sampler="fifo", samples_per_insert=None,
+                       min_size_to_sample=1)
+
+
+def test_replay_store_drain_refuses_new_keeps_idem_and_drains_tail():
+    store = ReplayStore(table_factory=_fifo_cfg)
+    seq = store.insert("t", {"i": 0}, idem="k0", timeout_s=5.0)
+    store.insert("t", {"i": 1}, timeout_s=5.0)
+    info = store.begin_drain()
+    assert info["draining"] and info["resident"] == 2
+    with pytest.raises(StoreDrainingError):
+        store.insert("t", {"i": 2}, timeout_s=5.0)
+    # an idem retry of an ALREADY-acked insert still answers across the edge
+    assert store.insert("t", {"i": 0}, idem="k0", timeout_s=5.0) == seq
+    # the resident tail keeps draining to samplers
+    got = [s.data["i"] for s in store.sample("t", batch_size=2, timeout_s=5.0)]
+    assert sorted(got) == [0, 1]
+    assert store.resident_items() == 0
+    assert store.stats()["draining"] is True
+
+
+def test_replay_store_drain_releases_spi_pacing():
+    """A paced table must NOT park its last samplers forever once inserts
+    stop: drain releases the samples-per-insert gate so the tail drains."""
+    cfg = TableConfig(max_size=64, sampler="fifo", samples_per_insert=1.0,
+                      min_size_to_sample=4, error_buffer=1.0)
+    store = ReplayStore(table_factory=lambda n: cfg)
+    for i in range(3):  # below min_size: samples would block forever
+        store.insert("t", {"i": i}, timeout_s=5.0)
+    store.begin_drain()
+    got = {s.data["i"] for s in store.sample("t", batch_size=1, timeout_s=2.0)}
+    got |= {s.data["i"] for s in store.sample("t", batch_size=2, timeout_s=2.0)}
+    assert got == {0, 1, 2}
+
+
+def test_sharded_insert_reroutes_around_draining_shard():
+    """The typed draining answer moves routing to a survivor immediately
+    (overlay ring), before any membership refresh happens."""
+    stores = [ReplayStore(table_factory=_fifo_cfg, shard_id=f"s{i}")
+              for i in range(2)]
+    servers = [ReplayServer(s, port=0).start() for s in stores]
+    addrs = [f"{s.host}:{s.port}" for s in servers]
+    client = ShardedInsertClient(ShardMap(addrs), timeout_s=5.0)
+    try:
+        keys = [f"k{i}" for i in range(12)]
+        owner = {k: client.shard_for("t", k) for k in keys}
+        assert len(set(owner.values())) == 2  # both shards owned keys
+        victim_idx = 0
+        stores[victim_idx].begin_drain()
+        before = _snap("distar_replay_drains_observed_total"
+                       f"{{shard={addrs[victim_idx]}}}")
+        for k in keys:
+            client.insert("t", {"k": k}, key=k, timeout_s=5.0)
+        # every key landed on the survivor (the draining shard kept none)
+        assert stores[victim_idx].resident_items() == 0
+        assert stores[1 - victim_idx].resident_items() == len(keys)
+        assert _snap("distar_replay_drains_observed_total"
+                     f"{{shard={addrs[victim_idx]}}}") - before >= 1
+        # the overlay re-routes FUTURE keys too, without another error
+        assert client.shard_for("t", "later") == addrs[1 - victim_idx]
+    finally:
+        client.close()
+        for s in servers:
+            s.stop()
+
+
+def test_sharded_client_live_refresh_swaps_map():
+    co = CoordinatorServer(Coordinator(default_lease_s=30.0))
+    co.start()
+    stores = [ReplayStore(table_factory=_fifo_cfg) for _ in range(2)]
+    servers = [ReplayServer(s, port=0).start() for s in stores]
+    from distar_tpu.replay import register_shard
+
+    beats = [register_shard((co.host, co.port), servers[0].host,
+                            servers[0].port, lease_s=30.0)]
+    client = ShardedInsertClient(
+        ShardMap.discover((co.host, co.port)), timeout_s=5.0)
+    client.start_refresh((co.host, co.port), interval_s=0.2)
+    try:
+        assert len(client.shard_map) == 1
+        beats.append(register_shard((co.host, co.port), servers[1].host,
+                                    servers[1].port, lease_s=30.0))
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline and len(client.shard_map) < 2:
+            time.sleep(0.1)
+        assert len(client.shard_map) == 2
+        # drop one: unregister + refresh shrinks the map back
+        unregister_endpoint((co.host, co.port), servers[1].host, servers[1].port)
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline and len(client.shard_map) > 1:
+            time.sleep(0.1)
+        assert len(client.shard_map) == 1
+    finally:
+        for b in beats:
+            b.stop_event.set()
+        client.close()
+        for s in servers:
+            s.stop()
+        co.stop()
+
+
+# ------------------------------------------------------------- autoscaler
+class _StubFleet:
+    def draining_addrs(self):
+        return []
+
+    gave_up = False
+
+
+class _StubSupervisor:
+    def __init__(self, fleets):
+        self._fleets = dict(fleets)
+        self.calls = []
+
+    def fleets(self):
+        return sorted(self._fleets)
+
+    def fleet(self, name):
+        return _StubFleet()
+
+    def actual(self, name):
+        return self._fleets[name]
+
+    def scale_up(self, name, n=1):
+        self._fleets[name] += n
+        self.calls.append(("up", name, n))
+        return [f"new{i}" for i in range(n)]
+
+    def scale_down(self, name, n=1):
+        self._fleets[name] -= n
+        self.calls.append(("down", name, n))
+        return [f"old{i}" for i in range(n)]
+
+
+def _feed(store, active, slots, source="gateway:a"):
+    store.record(SIG_GW_ACTIVE, float(active), source=source)
+    store.record(SIG_GW_SLOTS, float(slots), source=source)
+
+
+def test_autoscaler_hysteresis_cooldown_and_limits():
+    store = TimeSeriesStore()
+    sup = _StubSupervisor({"gateway": 1})
+    scaler = Autoscaler(
+        store, sup,
+        policies=[ScalePolicy(name="res", fleet="gateway",
+                              signal=SIG_GW_ACTIVE, divide_by=SIG_GW_SLOTS,
+                              up_when=0.85, down_when=0.30, for_count=2)],
+        limits={"gateway": (1, 2)}, cooldown_s=50.0)
+    _feed(store, 8, 8)
+    t = 1000.0
+    # hysteresis: one breached evaluation is NOT enough
+    assert scaler.evaluate_once(now=t) == []
+    decisions = scaler.evaluate_once(now=t + 1)
+    assert [d["direction"] for d in decisions] == ["up"]
+    assert sup.calls == [("up", "gateway", 1)]
+    assert "res=" in decisions[0]["reason"]
+    # cooldown: still breached, no second action inside the window
+    _feed(store, 16, 16)
+    assert scaler.evaluate_once(now=t + 2) == []
+    assert scaler.evaluate_once(now=t + 3) == []
+    # max limit: past cooldown, at the cap, no action either
+    assert scaler.evaluate_once(now=t + 60) == []
+    assert scaler.evaluate_once(now=t + 61) == []
+    assert sup.actual("gateway") == 2
+    # load drop: down needs its own streak, then acts once, floor-clamped
+    _feed(store, 2, 16)
+    assert scaler.evaluate_once(now=t + 120) == []
+    down = scaler.evaluate_once(now=t + 121)
+    assert [d["direction"] for d in down] == ["down"]
+    assert sup.actual("gateway") == 1
+    # at the floor: even a sustained down-breach cannot go below min
+    assert scaler.evaluate_once(now=t + 200) == []
+    assert scaler.evaluate_once(now=t + 201) == []
+    assert sup.actual("gateway") == 1
+    st = scaler.status()
+    assert st["last_decision"]["direction"] == "down"
+    assert st["policies"]["res"]["value"] == pytest.approx(2 / 16)
+
+
+def test_autoscaler_no_data_is_no_action():
+    store = TimeSeriesStore()
+    sup = _StubSupervisor({"gateway": 1})
+    scaler = Autoscaler(store, sup, policies=[
+        ScalePolicy(name="res", fleet="gateway", signal=SIG_GW_ACTIVE,
+                    divide_by=SIG_GW_SLOTS, up_when=0.85, down_when=0.30,
+                    for_count=1)])
+    assert scaler.evaluate_once(now=0.0) == []
+    assert sup.calls == []
+
+
+def test_coordinator_autoscaler_route_and_opsctl_digest(capsys):
+    store = TimeSeriesStore()
+    sup = _StubSupervisor({"gateway": 2})
+    scaler = Autoscaler(store, sup, policies=[
+        ScalePolicy(name="res", fleet="gateway", signal=SIG_GW_ACTIVE,
+                    divide_by=SIG_GW_SLOTS, up_when=0.85, down_when=0.30)])
+    prev = set_autoscaler(scaler)
+    co = CoordinatorServer(Coordinator())
+    co.start()
+    try:
+        with urllib.request.urlopen(
+                f"http://{co.host}:{co.port}/autoscaler", timeout=5) as resp:
+            body = json.loads(resp.read())
+        assert body["fleets"]["gateway"]["actual"] == 2
+        assert "res" in body["policies"]
+        import opsctl
+
+        opsctl._print_autoscaler(f"{co.host}:{co.port}")
+        out = capsys.readouterr().out
+        assert "autoscaler:" in out and "[gateway]" in out and "res" in out
+    finally:
+        set_autoscaler(prev)
+        co.stop()
+    # with no autoscaler installed the route 404s
+    co2 = CoordinatorServer(Coordinator())
+    co2.start()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(f"http://{co2.host}:{co2.port}/autoscaler",
+                                   timeout=5)
+        assert ei.value.code == 404
+    finally:
+        co2.stop()
+
+
+# ---------------------------------------------------------------- pinning
+def test_pinning_refuses_honestly_on_small_hosts(monkeypatch):
+    monkeypatch.setattr(os, "sched_getaffinity", lambda pid: {0}, raising=False)
+    p = pinning.plan(2)
+    assert not p.pinned and "time-share" in p.refused_reason
+    prov = p.provenance()
+    assert prov["pinned"] is False and prov["host_cores"] == 1
+    assert prov["tool"] == "tools/pin.py"
+    assert not pinning.scaling_valid(prov)
+
+
+def test_pinning_plans_disjoint_cores_on_multicore(monkeypatch):
+    monkeypatch.setattr(os, "sched_getaffinity",
+                        lambda pid: {0, 1, 2, 3}, raising=False)
+    p = pinning.plan(3, reserve_client=1)
+    assert p.pinned and p.host_cores == 4
+    flat = [c for cores in p.assignments for c in cores]
+    assert len(flat) == len(set(flat)) == 3  # one core each, disjoint
+    assert p.client_cores and not (set(p.client_cores) & set(flat))
+    prov = p.provenance({"pid1": [0], "pid2": [1], "pid3": [2]})
+    assert pinning.scaling_valid(prov)
+    assert pinning.scaling_valid(prov, min_cores=4)
+    assert not pinning.scaling_valid(prov, min_cores=5)
+
+
+def test_pin_pid_self_roundtrip():
+    if not pinning.can_pin():
+        pytest.skip("no sched_setaffinity on this platform")
+    cores = sorted(os.sched_getaffinity(0))
+    assert pinning.pin_pid(0, cores)  # pin to the full current mask: no-op
+    assert sorted(os.sched_getaffinity(0)) == cores
+
+
+def test_pin_fleet_refusal_is_inband_on_this_host(monkeypatch):
+    monkeypatch.setattr(os, "sched_getaffinity", lambda pid: {0}, raising=False)
+    prov = pinning.pin_fleet([os.getpid()])
+    assert prov["pinned"] is False and "refused_reason" in prov
+
+
+# ----------------------------------------------------- perf_gate scaling gate
+def test_perf_gate_refuses_forged_scaling_claims(tmp_path):
+    forged = {"metric": "x", "value": 1.0, "scaling_valid": True,
+              "host_cores": 1}
+    assert perf_gate.scaling_offences(forged)
+    path = tmp_path / "forged.json"
+    path.write_text(json.dumps(forged))
+    rc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "perf_gate.py"),
+         "scaling", "--artifact", str(path)],
+        capture_output=True, text=True).returncode
+    assert rc == 2
+    # multi-core but NO provenance block: still forged
+    assert perf_gate.scaling_offences(
+        {"scaling_valid": True, "host_cores": 4})
+    # provenance that refused: forged
+    assert perf_gate.scaling_offences(
+        {"scaling_valid": True, "host_cores": 4,
+         "pinning": {"pinned": False, "host_cores": 4,
+                     "refused_reason": "x"}})
+    # the honest true claim passes
+    clean = {"scaling_valid": True, "host_cores": 4,
+             "pinning": {"tool": "tools/pin.py", "pinned": True,
+                         "host_cores": 4,
+                         "assignments": {"pid1": [0], "pid2": [1]},
+                         "client_cores": [2, 3]}}
+    assert perf_gate.scaling_offences(clean) == []
+    # ...and the honest false claim always passes
+    assert perf_gate.scaling_offences(
+        {"scaling_valid": False, "host_cores": 1}) == []
+
+
+def test_perf_gate_scaling_sweep_of_committed_artifacts_is_clean():
+    """Tier-1 acceptance: no committed artifact carries a forged scaling
+    claim (every committed scaling_valid:true must have pinning provenance)."""
+    hits = perf_gate.scaling_sweep(REPO)
+    assert hits == [], f"forged scaling claims committed: {hits}"
+
+
+def test_perf_gate_check_hard_fails_on_scaling_precondition(tmp_path):
+    base = {"metric": "x", "value": 1.0}
+    cand = {"metric": "x", "value": 1.0, "scaling_valid": True,
+            "host_cores": 1}
+    bp, cp = tmp_path / "b.json", tmp_path / "c.json"
+    bp.write_text(json.dumps(base))
+    cp.write_text(json.dumps(cand))
+    rc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "perf_gate.py"),
+         "check", "--baseline", str(bp), "--candidate", str(cp)],
+        capture_output=True, text=True).returncode
+    assert rc == 2
+
+
+# -------------------------------------------------------- discovery refresh
+def test_start_refresh_applies_records_and_survives_errors():
+    co = CoordinatorServer(Coordinator())
+    co.start()
+    co.coordinator.register("tok", "10.0.0.9", 1, lease_s=60.0)
+    seen = []
+    boom = [True]
+
+    def apply(records):
+        if boom[0]:
+            boom[0] = False
+            raise RuntimeError("first application fails")
+        seen.append([f"{r['ip']}:{r['port']}" for r in records])
+
+    t = start_refresh((co.host, co.port), "tok", apply, interval_s=0.1)
+    try:
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline and not seen:
+            time.sleep(0.05)
+        assert seen and seen[0] == ["10.0.0.9:1"]
+    finally:
+        t.stop_event.set()
+        co.stop()
+
+
+# ------------------------------------------------------------ slow: drill
+@pytest.mark.slow
+def test_elastic_drill_exits_zero(tmp_path):
+    """The full acceptance drill: spike -> live scale-up -> graceful drain
+    with exact accounting -> SIGKILL mid-drain -> zero acked loss."""
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "chaos.py"),
+         "elastic-drill", "--dir", str(tmp_path / "spill"),
+         "--items", "40", "--sessions", "12"],
+        capture_output=True, text=True, timeout=420, cwd=REPO)
+    assert out.returncode == 0, out.stdout[-3000:] + out.stderr[-2000:]
+    verdict = json.loads(out.stdout.strip().splitlines()[-2])
+    assert verdict["failures"] == []
+    assert verdict["phase_b"]["lost_acked"] == 0
+    assert verdict["pinning"]["pinned"] in (True, False)  # in-band either way

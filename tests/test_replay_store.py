@@ -435,6 +435,11 @@ def test_bench_replay_emits_standard_json(monkeypatch, capsys):
     # subprocesses, negotiated-compression A/B, zero-copy fast path
     assert point["cpu_derived"] is True and point["device"] == "cpu"
     assert isinstance(point["scaling_valid"], bool) and point["host_cores"] >= 1
+    # pinning provenance (tools/pin.py harness): the scaling_valid flag must
+    # agree with it — perf_gate's scaling gate enforces the same contract
+    assert point["pinning"]["pinned"] in (True, False)
+    assert point["scaling_valid"] == (
+        point["pinning"]["pinned"] and point["pinning"]["host_cores"] >= 3)
     assert [r["shards"] for r in point["replay_shard_sweep"]] == [1, 2]
     assert all(r["aggregate_items_per_s"] > 0 for r in point["replay_shard_sweep"])
     comp = point["replay_compression"]

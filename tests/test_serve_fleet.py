@@ -581,7 +581,12 @@ def test_loadgen_fleet_mode_smoke():
                           fleet_levels="4,16,20", fleet_workers=4,
                           requests_per_session=2, timeout_s=15.0)
     assert summary["unit"] == "sessions" and summary["gateways"] == 2
-    assert {"host_cores", "scaling_valid", "cpu_derived"} <= set(summary)
+    assert {"host_cores", "scaling_valid", "cpu_derived", "pinning"} <= set(summary)
+    # scaling_valid is now a PROVEN claim: it must agree with the pinning
+    # provenance block (perf_gate's scaling gate enforces the same)
+    assert summary["scaling_valid"] == (
+        summary["pinning"]["pinned"]
+        and summary["pinning"]["host_cores"] >= summary["gateways"] + 1)
     curve = summary["fleet_curve"]
     assert [r["level"] for r in curve] == [4, 16, 20]
     # the over-capacity level sheds; resident sessions never exceed slots

@@ -16,6 +16,8 @@ faults tests already prove survivable:
         [--mesh dp=4,fsdp=2] [--resume-mesh dp=8] [--kill-after 2] [--iters 5]
   python tools/chaos.py serve-drill --gateways 3 [--sessions 48] [--steps 8]
   python tools/chaos.py shm-drill --dir /tmp/shm_drill [--items 60] [--seed 0]
+  python tools/chaos.py elastic-drill --dir /tmp/el_drill [--sessions 14] \\
+        [--slots 8] [--items 60]
 
 ``corrupt`` damages a checkpoint in place (the resume path must fall back);
 ``kill`` sends a signal to a role process (the supervisor/orchestrator must
@@ -365,10 +367,13 @@ def cmd_serve_drill(args) -> int:
             raise RuntimeError(f"gateway failed to start: {parts}")
         return proc, f"{parts[1]}:{parts[2]}"
 
+    from distar_tpu.fleet import pinning
+
     inj = ChaosInjector(seed=args.seed)
     spawned = [spawn() for _ in range(args.gateways)]
     procs = [p for p, _ in spawned]
     addrs = [a for _, a in spawned]
+    pin_prov = pinning.pin_fleet([p.pid for p in procs], reserve_client=1)
     fc = FleetClient(gateway_map=GatewayMap(addrs), timeout_s=10.0,
                      down_ttl_s=60.0)
     obs = {"x": np.ones((4, 4), dtype=np.float32)}
@@ -430,7 +435,7 @@ def cmd_serve_drill(args) -> int:
             proc.kill()
     verdict = {
         "gateways": args.gateways, "sessions": args.sessions,
-        "steps": args.steps, "killed": killed[0],
+        "steps": args.steps, "pinning": pin_prov, "killed": killed[0],
         "finished_sessions": finished,
         "migrations": migrations,
         "sheds_retried": sheds[0],
@@ -491,8 +496,11 @@ def cmd_shm_drill(args) -> int:
             raise RuntimeError(f"shard failed to start: {parts}")
         return proc, parts[1], int(parts[2])
 
+    from distar_tpu.fleet import pinning
+
     inj = ChaosInjector(seed=args.seed)
     proc, host, port = spawn(0, "shm")
+    pin_prov = pinning.pin_fleet([proc.pid], reserve_client=1)
     payload = os.urandom(args.ring_bytes // 2 + 512)  # frames span the ring
     inserter = InsertClient(host, port, timeout_s=10.0)
     acked, dup, lock = set(), [0], threading.Lock()
@@ -574,6 +582,7 @@ def cmd_shm_drill(args) -> int:
                        "sample": sampler.transport_active}
     verdict = {
         "items": args.items,
+        "pinning": pin_prov,
         "acked": len(acked),
         "sampled_unique": len(sampled),
         "duplicates_after_restart": dup[0],
@@ -601,6 +610,315 @@ def cmd_shm_drill(args) -> int:
           "zero acked-item loss"
           if ok else f"verdict: DRILL FAILED {verdict}")
     return 0 if ok else 1
+
+
+def cmd_elastic_drill(args) -> int:
+    """The elastic-fleet acceptance drill (ISSUE 12): load spike ->
+    autoscaler scale-up observed LIVE by clients -> graceful cooldown drain
+    with exact migration accounting -> SIGKILL a replay member MID-DRAIN
+    with zero acked-item loss.
+
+    Phase A (serve): one mock gateway under the coordinator + autoscaler;
+    more sessions than its slots arrive (typed capacity sheds = the load
+    spike). The gateway-residency policy breaches, the autoscaler spawns a
+    second gateway, and the drill's FleetClient — running the live
+    membership refresher, never reconstructed — observes the join and its
+    shed sessions land on the new member (capacity spill-over). Load then
+    drops; after hysteresis + cooldown the autoscaler drains the newest
+    gateway gracefully: every session resident there is ended-and-re-pinned
+    by the client (DrainingError handoff), counted EXACTLY (migrations ==
+    the victim's pinned sessions at decision time), with zero non-shed
+    errors, and the victim process exits on its own.
+
+    Phase B (replay): a 3-shard spill-backed fleet under the same
+    coordinator; keyed acked inserts spread over the ring. One shard is
+    drained (deregister-then-refuse) and — before its resident tail can
+    drain — SIGKILL'd mid-drain. Survivors absorb the insert stream (the
+    draining overlay + membership refresh re-route keys), a replacement
+    over the victim's spill directory on the SAME port recovers exactly its
+    tail, and the fan-in sampler accounts for every acked key. Exit 0 only
+    when every contract holds. Core pinning is attempted via the
+    tools/pin.py harness and reported in-band (refused on small hosts)."""
+    import threading
+
+    import numpy as np
+
+    from distar_tpu.comm.coordinator import Coordinator, CoordinatorServer
+    from distar_tpu.fleet import (
+        Autoscaler, FleetSupervisor, MemberProbe, ScalePolicy, SubprocessFleet,
+        gateway_cmd, pinning, replay_cmd,
+    )
+    from distar_tpu.obs import TimeSeriesStore, get_registry
+    from distar_tpu.replay import ShardMap, ShardedInsertClient, ShardedSampleClient
+    from distar_tpu.serve import ShedError
+    from distar_tpu.serve.fleet import FleetClient
+
+    slots = args.slots
+    sessions = args.sessions
+    verdict = {"phase_a": {}, "phase_b": {}}
+    failures = []
+
+    coordinator = CoordinatorServer(Coordinator(default_lease_s=10.0))
+    coordinator.start()
+    coord_addr = f"{coordinator.host}:{coordinator.port}"
+
+    supervisor = FleetSupervisor()
+    gw_fleet = SubprocessFleet(
+        "gateway", "gateway",
+        gateway_cmd(slots=slots, coordinator=coord_addr,
+                    extra=["--drain-timeout-s", "20"]),
+        drain_timeout_s=25.0)
+    rp_fleet = SubprocessFleet(
+        "replay", "replay",
+        replay_cmd(spill_root=args.dir, coordinator=coord_addr,
+                   extra=["--drain-timeout-s", "20",
+                          "--max-size", str(max(args.items * 2, 64)),
+                          "--spill-max", str(max(args.items * 2, 64))]),
+        drain_timeout_s=25.0)
+    supervisor.add_fleet(gw_fleet).add_fleet(rp_fleet).start()
+
+    store = TimeSeriesStore()
+    probe = MemberProbe(store, supervisor)
+    scaler = Autoscaler(
+        store, supervisor,
+        policies=[ScalePolicy(
+            name="gateway_residency", fleet="gateway",
+            signal="distar_serve_sessions_active",
+            divide_by="distar_serve_session_slots",
+            up_when=0.85, down_when=0.30, window_s=6.0, for_count=2)],
+        limits={"gateway": (1, 2), "replay": (3, 4)},
+        cooldown_s=4.0, interval_s=0.5, probe=probe)
+
+    try:
+        # ---------------- phase A: spike -> scale-up -> graceful drain
+        supervisor.scale_up("gateway", 1)
+        for _ in range(3):
+            supervisor.scale_up("replay", 1)
+        pin_prov = pinning.pin_fleet(gw_fleet.pids() + rp_fleet.pids(),
+                                     reserve_client=1)
+        verdict["pinning"] = pin_prov
+        scaler.start()
+
+        fc = FleetClient(coordinator_addr=(coordinator.host, coordinator.port),
+                         timeout_s=10.0, refresh_s=0.5)
+        obs = {"x": np.ones((4, 4), dtype=np.float32)}
+        sids = [f"el-{i}" for i in range(sessions)]
+        errors, live = [], set()
+
+        def step_all(rounds: int, budget_s: float, want_all: bool) -> None:
+            deadline = time.monotonic() + budget_s
+            for _ in range(rounds):
+                pending = [s for s in sids if s in live or want_all]
+                while pending and time.monotonic() < deadline:
+                    results = fc.act_many(
+                        [{"session_id": s, "obs": obs} for s in pending],
+                        timeout_s=8.0)
+                    nxt = []
+                    for s, r in zip(pending, results):
+                        if isinstance(r, ShedError):
+                            nxt.append(s)  # spike backpressure: retry
+                        elif isinstance(r, Exception):
+                            errors.append((s, repr(r)))
+                        else:
+                            live.add(s)
+                    pending = nxt
+                    if pending:
+                        time.sleep(0.2)
+
+        # the spike: more sessions than the 1-gateway fleet can hold; shed
+        # lanes keep retrying while residency pins the policy at 1.0
+        spike = threading.Thread(target=step_all, args=(60, 60.0, True),
+                                 daemon=True)
+        spike.start()
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < 45.0:
+            if len(fc.router.map) >= 2 and len(live) == sessions:
+                break
+            time.sleep(0.5)
+        spike.join(30.0)
+        scaled_to = len(fc.router.map.addrs)
+        verdict["phase_a"]["scaled_to_gateways"] = scaled_to
+        verdict["phase_a"]["sessions_live_after_join"] = len(live)
+        verdict["phase_a"]["scale_up_decision"] = next(
+            (d for d in scaler.status()["decisions"] if d["direction"] == "up"),
+            None)
+        if scaled_to < 2 or len(live) != sessions:
+            failures.append(
+                f"scale-up not observed live: {scaled_to} gateways, "
+                f"{len(live)}/{sessions} sessions")
+
+        # load drop: end sessions, preferring the OLDEST gateway's, so the
+        # newest (the scale-down victim) keeps residents to migrate
+        pins = fc.router.stats()["pins_per_gateway"]
+        newest = supervisor.fleet("gateway").active_members()[-1].addr
+        keep = [s for s in sids if fc.router._pins.get(s) == newest][:4]
+        for s in sids:
+            if s not in keep:
+                try:
+                    fc.end(s)
+                except Exception:  # noqa: BLE001 - counted via errors below
+                    errors.append((s, "end failed"))
+                live.discard(s)
+
+        # baseline counters BEFORE the decision can land: the refresher's
+        # drain handoff fires within one refresh tick of the drain
+        snap0 = get_registry().snapshot()
+        mig0 = snap0.get("distar_fleet_session_migrations_total", 0.0)
+        hand0 = snap0.get("distar_fleet_drain_handoff_sessions_total", 0.0)
+        # wait for the cooldown scale-down decision (stepping paused, so
+        # the victim's pin count is exact at decision time — the handoff
+        # ends sessions on the victim but never unpins)
+        down = None
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < 45.0 and down is None:
+            down = next((d for d in scaler.status()["decisions"]
+                         if d["direction"] == "down"), None)
+            time.sleep(0.3)
+        if down is None:
+            failures.append("no scale-down decision within budget")
+            victim, victim_pins = None, 0
+        else:
+            victim = down["members"][0]
+            victim_pins = len(fc.router.pins_on(victim))
+
+        # resume stepping the survivors: their next act on the draining
+        # gateway hands off (end-there + re-pin), carries re-materialize
+        step_all(6, 40.0, False)
+        snap1 = get_registry().snapshot()
+        migrations = snap1.get("distar_fleet_session_migrations_total", 0.0) - mig0
+        handoffs = snap1.get("distar_fleet_drain_handoff_sessions_total", 0.0) - hand0
+        # the victim must exit on its own once drained
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < 30.0 and victim is not None:
+            if victim not in [m.addr for m in gw_fleet.members()]:
+                break
+            time.sleep(0.3)
+        victim_gone = victim is not None and \
+            victim not in [m.addr for m in gw_fleet.members()]
+        verdict["phase_a"].update({
+            "pins_before_drain": pins, "drain_victim": victim,
+            "victim_resident_at_decision": victim_pins,
+            "migrations": migrations, "drain_handoffs": handoffs,
+            "victim_exited": victim_gone,
+            "non_shed_errors": len(errors),
+        })
+        if errors:
+            failures.append(f"non-shed errors leaked: {errors[:5]}")
+        if down is not None and not (
+                migrations == handoffs == victim_pins and victim_pins > 0):
+            failures.append(
+                f"migration accounting inexact: migrations={migrations} "
+                f"handoffs={handoffs} resident={victim_pins}")
+        if down is not None and not victim_gone:
+            failures.append("drained gateway did not exit")
+        for s in keep:
+            try:
+                fc.end(s)
+            except Exception:  # noqa: BLE001 - teardown
+                pass
+        fc.close()
+
+        # ---------------- phase B: SIGKILL a replay member MID-DRAIN
+        inj = ChaosInjector(seed=args.seed)
+        inserter = ShardedInsertClient(
+            ShardMap.discover((coordinator.host, coordinator.port)))
+        inserter.start_refresh((coordinator.host, coordinator.port),
+                               interval_s=0.5)
+        keys = [f"k{i}" for i in range(args.items)]
+        owner = {k: inserter.shard_for("drill", k) for k in keys}
+        half = args.items // 2
+        for k in keys[:half]:
+            inserter.insert("drill", {"k": k}, key=k, timeout_s=10.0)
+
+        members = rp_fleet.active_members()
+        victim_m = max(members,
+                       key=lambda m: sum(1 for k in keys if owner[k] == m.addr))
+        victim_addr, victim_pid = victim_m.addr, victim_m.proc.pid
+        victim_port = int(victim_addr.rsplit(":", 1)[1])
+        victim_dir = os.path.join(args.dir, f"s{victim_m.meta['index']}")
+        victim_resident = sum(1 for k in keys[:half] if owner[k] == victim_addr)
+
+        rp_fleet.drain(victim_m)  # deregister-then-refuse; tail stays (no sampler)
+        # the insert stream keeps running THROUGH the drain: draining
+        # answers re-route each key to a survivor (overlay), and the
+        # membership refresh soon drops the victim from the map entirely
+        for k in keys[half:]:
+            inserter.insert("drill", {"k": k}, timeout_s=10.0, key=k)
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < 10.0 and \
+                victim_addr in inserter.shard_map.addrs:
+            time.sleep(0.2)
+        map_dropped = victim_addr not in inserter.shard_map.addrs
+
+        # the chaos moment: SIGKILL mid-drain (resident tail NOT drained)
+        inj.kill_role(victim_pid, sig=signal.SIGKILL,
+                      name=f"replay-mid-drain:{victim_addr}")
+        time.sleep(1.0)
+
+        # replacement over the victim's spill on the SAME port (identity =
+        # host:port, so its ring segment comes back with it)
+        import subprocess
+        cmd = replay_cmd(spill_root=args.dir, coordinator=coord_addr,
+                         extra=["--max-size", str(max(args.items * 2, 64)),
+                                "--spill-max", str(max(args.items * 2, 64))])(
+            int(victim_m.meta["index"]))
+        cmd[cmd.index("--port") + 1] = str(victim_port)
+        proc2 = subprocess.Popen(cmd, stdin=subprocess.PIPE,
+                                 stdout=subprocess.PIPE,
+                                 stderr=subprocess.DEVNULL, text=True)
+        parts = proc2.stdout.readline().split()
+        recovered = int(dict(t.split("=", 1) for t in parts[3:]
+                             if "=" in t).get("recovered", -1))
+
+        sampler = ShardedSampleClient(
+            ShardMap.discover((coordinator.host, coordinator.port)))
+        sampler.start_refresh((coordinator.host, coordinator.port),
+                              interval_s=0.5)
+        got = set()
+        deadline = time.monotonic() + 45.0
+        while time.monotonic() < deadline and len(got) < len(keys):
+            try:
+                items, _info = sampler.sample("drill", batch_size=1,
+                                              timeout_s=1.0)
+            except Exception:
+                time.sleep(0.2)
+                continue
+            got.update(it["k"] for it in items)
+        lost = sorted(set(keys) - got)
+        verdict["phase_b"] = {
+            "items": args.items, "killed_mid_drain": victim_addr,
+            "victim_resident_at_kill": victim_resident,
+            "map_dropped_victim_before_kill": map_dropped,
+            "recovered_from_spill": recovered,
+            "sampled_unique": len(got), "lost_acked": len(lost),
+        }
+        if lost:
+            failures.append(f"acked items lost: {lost[:10]}")
+        if recovered < victim_resident:
+            failures.append(
+                f"spill recovered {recovered} < victim's resident tail "
+                f"{victim_resident}")
+        if not map_dropped:
+            failures.append("live membership never dropped the draining shard")
+        inserter.close()
+        sampler.close()
+        try:
+            proc2.stdin.close()
+            proc2.wait(timeout=10)
+        except Exception:  # noqa: BLE001 - teardown
+            proc2.kill()
+    finally:
+        scaler.stop()
+        supervisor.stop()
+        coordinator.stop()
+
+    verdict["failures"] = failures
+    print(json.dumps(verdict, default=str))
+    print("verdict: load spike scaled the fleet up live, cooldown drained "
+          "a member with exact migration accounting, and a mid-drain "
+          "SIGKILL lost zero acked items"
+          if not failures else f"verdict: DRILL FAILED {failures}")
+    return 0 if not failures else 1
 
 
 def cmd_latest(args) -> int:
@@ -678,6 +996,20 @@ def main() -> int:
                    help="forced tiny ring so frames span it (mid-frame kills)")
     h.add_argument("--seed", type=int, default=0)
 
+    e = sub.add_parser("elastic-drill",
+                       help="load spike -> autoscaler scale-up observed "
+                            "live -> graceful cooldown drain with exact "
+                            "migration accounting -> SIGKILL mid-drain with "
+                            "zero acked replay loss")
+    e.add_argument("--dir", required=True, help="replay spill root")
+    e.add_argument("--slots", type=int, default=8, help="slots per gateway")
+    e.add_argument("--sessions", type=int, default=14,
+                   help="resident sessions offered (pick > --slots so the "
+                        "spike actually sheds)")
+    e.add_argument("--items", type=int, default=60,
+                   help="acked replay inserts across the drain/kill")
+    e.add_argument("--seed", type=int, default=0)
+
     m = sub.add_parser("multichip-drill",
                        help="kill a multichip learner after a sharded save; "
                             "prove resume on a DIFFERENT mesh shape")
@@ -701,6 +1033,7 @@ def main() -> int:
             "replay-drill": cmd_replay_drill,
             "serve-drill": cmd_serve_drill,
             "shm-drill": cmd_shm_drill,
+            "elastic-drill": cmd_elastic_drill,
             "multichip-drill": cmd_multichip_drill}[args.command](args)
 
 

@@ -151,14 +151,19 @@ class _HttpTarget:
         self._base = f"http://{addr}/serve"
 
     def _post(self, route: str, body: dict) -> dict:
+        import urllib.error
         import urllib.request
 
         req = urllib.request.Request(
             f"{self._base}/{route}", data=json.dumps(body).encode(),
             headers={"Content-Type": "application/json"}, method="POST",
         )
-        with urllib.request.urlopen(req, timeout=30) as resp:
-            out = json.loads(resp.read())
+        try:
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                out = json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            # the drain contract answers 503 with the typed wire body
+            out = json.loads(e.read() or b"{}")
         if out.get("code") != 0:
             if out.get("shed"):
                 raise ShedError(out.get("error", ""))
@@ -236,14 +241,23 @@ def run_fleet_loadgen(
     honesty flags (``host_cores``, ``scaling_valid``): on a small CI host
     the whole fleet time-shares the cores, so the curve proves the routed
     fleet EXECUTES at each level, not that it scales."""
+    from distar_tpu.fleet import pinning
     from distar_tpu.serve.fleet import FleetClient, GatewayMap
 
-    host_cores = len(os.sched_getaffinity(0)) if hasattr(os, "sched_getaffinity") \
-        else (os.cpu_count() or 1)
+    host_cores = pinning.host_cores()
     if tcp:
         procs, addrs = [], [a.strip() for a in tcp.split(",") if a.strip()]
+        # an external fleet's pids are unknown — pinning cannot be claimed
+        pin_prov = pinning.PinPlan(
+            pinned=False, host_cores=host_cores,
+            refused_reason="external --tcp fleet: member pids unknown to "
+                           "the harness").provenance()
     else:
         procs, addrs = _spawn_gateway_fleet(gateways, slots, mock_delay_s)
+        # the core-pinning harness: each gateway on its own core, the
+        # driving client on the reserved remainder — or an explicit refusal
+        # that keeps scaling_valid false in-band on small hosts
+        pin_prov = pinning.pin_fleet([p.pid for p in procs], reserve_client=1)
     capacity = slots * len(addrs)
     if fleet_levels:
         levels = [int(x) for x in fleet_levels.split(",") if x.strip()]
@@ -355,9 +369,13 @@ def run_fleet_loadgen(
         "device": "cpu",
         "cpu_derived": True,
         "host_cores": host_cores,
-        # the fleet needs cores to scale onto — gateways + the client side;
-        # on a smaller host the curve still proves routed capacity executes
-        "scaling_valid": host_cores >= len(addrs) + 1,
+        # scaling_valid is now a PROVEN claim: true only when the pin
+        # harness actually gave every gateway its own core (provenance
+        # below, verified by perf_gate's scaling gate); on a smaller host
+        # the curve still proves routed capacity executes, flagged false
+        "scaling_valid": pinning.scaling_valid(pin_prov,
+                                               min_cores=len(addrs) + 1),
+        "pinning": pin_prov,
         "gateways": len(addrs),
         "slots_per_gateway": slots,
         "fleet_slot_capacity": capacity,

@@ -30,7 +30,10 @@ prints the SERVING-FLEET digest: every ``serve_gateway`` registration's
 gateway block (sessions/slots occupancy, shed rate, model generation +
 served version, read off each gateway's own ``/serve/status``) plus an
 aggregate line whose served-version spread says whether a fleet rollout has
-converged. ``profile`` talks to a LEARNER ADMIN surface
+converged. A coordinator hosting an ``Autoscaler`` (GET /autoscaler) adds
+the AUTOSCALER digest: per-fleet target vs actual membership with
+in-progress drains, per-policy value/threshold/hysteresis state, and the
+last scaling decision with its reason. ``profile`` talks to a LEARNER ADMIN surface
 (``rl_train --admin-port``): captures --steps iterations of jax.profiler
 trace on the live learner and prints the ranked per-bucket attribution
 table (obs/traceview.py).
@@ -274,6 +277,45 @@ def _print_replay(per_shard: dict) -> None:
               f"{stale}spill_live={agg['spill_live']}")
 
 
+def _print_autoscaler(addr: str) -> None:
+    """Autoscaler digest for ``status``: per-fleet target-vs-actual
+    membership + in-progress drains, per-policy state (current value vs
+    thresholds and hysteresis streaks), and the last scaling decision with
+    its reason — read off the coordinator's GET /autoscaler route (absent
+    when no autoscaler runs there)."""
+    body = _try_get(addr, "/autoscaler")
+    if not body:
+        return
+    print("autoscaler:")
+    for fleet in sorted(body.get("fleets") or {}):
+        f = body["fleets"][fleet]
+        drains = ",".join(f.get("draining") or []) or "-"
+        cd = f.get("cooldown_remaining_s", 0.0)
+        print(f"  [{fleet}] actual={f.get('actual')} "
+              f"bounds={f.get('min')}..{f.get('max')} draining={drains} "
+              f"cooldown={cd}s"
+              + ("  GAVE-UP (respawn budget exhausted)" if f.get("gave_up")
+                 else ""))
+    for name in sorted(body.get("policies") or {}):
+        p = body["policies"][name]
+        value = p.get("value")
+        value_s = f"{value:.4g}" if isinstance(value, (int, float)) else "no-data"
+        bounds = []
+        if p.get("up_when") is not None:
+            bounds.append(f"up>{p['up_when']:g}")
+        if p.get("down_when") is not None:
+            bounds.append(f"down<{p['down_when']:g}")
+        print(f"  policy {name:<24} fleet={p.get('fleet'):<8} "
+              f"value={value_s:<10} {' '.join(bounds):<20} "
+              f"streaks={p.get('up_streak')}/{p.get('down_streak')} "
+              f"(need {p.get('for_count')})")
+    last = body.get("last_decision")
+    if last:
+        print(f"  last decision: scale_{last.get('direction')} "
+              f"{last.get('fleet')} {last.get('from')}->{last.get('to')} "
+              f"at {_fmt_ts(last.get('ts'))}  ({last.get('reason')})")
+
+
 # the per-role perf series worth a one-line digest (flattened TSDB keys;
 # token = learner class name, sources = fleet processes)
 _PERF_DIGEST_NAMES = tuple(
@@ -388,6 +430,9 @@ def cmd_status(args) -> int:
     # coordinator's serve_gateway registrations (each block read off the
     # gateway's own /serve/status)
     _print_serve_fleet(_discover_serve_gateways(args.addr))
+    # elastic-control-plane digest (present when the probed coordinator
+    # hosts an autoscaler): policy state, target vs actual, live drains
+    _print_autoscaler(args.addr)
     _print_perf_digest(args.addr)
     _print_actor_digest(args.addr)
     return {"ok": 0, "warning": 1}.get(status, 2)

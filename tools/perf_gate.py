@@ -19,10 +19,20 @@ the `BENCH_*`/`MULTICHIP_*` files every round produces):
               artifacts/*_r*.json) into a markdown table, optionally
               rewritten in place between the PERF.md trajectory markers.
 
+  scaling     sweep every committed artifact for forged scaling claims: an
+              artifact may say ``scaling_valid: true`` ONLY with recorded
+              ``host_cores >= 2`` AND the pinning provenance block the
+              tools/pin.py harness writes (``pinning: {pinned: true, ...}``
+              — each fleet process on its own core). Anything else —
+              including a hand-forged single-core "true" — is refused exit
+              2, the same hard-fail class as the impossible-timing recheck.
+              The scaling gate is ALSO a ``check`` precondition.
+
 Usage:
   python tools/perf_gate.py check --baseline artifacts/perf_baseline_cpu.json \\
          --candidate fresh.json [--tolerance 0.5]
   python tools/perf_gate.py trajectory [--write PERF.md]
+  python tools/perf_gate.py scaling [--artifact one.json]
 """
 from __future__ import annotations
 
@@ -105,6 +115,83 @@ def impossible_timing(artifact: dict) -> List[str]:
     return offences
 
 
+# -------------------------------------------------------- the scaling check
+def scaling_offences(artifact: dict) -> List[str]:
+    """Forged-scaling-claim check: ``scaling_valid: true`` is a PHYSICAL
+    claim — N fleet processes each held their own core — so it requires (a)
+    recorded ``host_cores >= 2`` and (b) the pinning provenance block the
+    tools/pin.py harness writes (``pinning.pinned == true`` with its own
+    ``host_cores >= 2`` and per-process assignments). A single-core host, a
+    refused plan, or a missing block all keep the honest default
+    ``scaling_valid: false`` — claiming otherwise is an offence. Artifacts
+    that don't claim scaling (false/absent) are always clean."""
+    if not artifact.get("scaling_valid"):
+        return []
+    offences: List[str] = []
+    cores = artifact.get("host_cores")
+    if not isinstance(cores, int) or cores < 2:
+        offences.append(
+            f"scaling_valid: true with host_cores={cores!r} — a fleet "
+            "cannot scale onto fewer than 2 cores")
+    pin = artifact.get("pinning")
+    if not isinstance(pin, dict):
+        offences.append(
+            "scaling_valid: true without a pinning provenance block "
+            "(run the fleet under the tools/pin.py harness)")
+        return offences
+    if not pin.get("pinned"):
+        offences.append(
+            "scaling_valid: true but pinning.pinned is false "
+            f"({pin.get('refused_reason', 'no reason recorded')!r})")
+    pin_cores = pin.get("host_cores")
+    if not isinstance(pin_cores, int) or pin_cores < 2:
+        offences.append(
+            f"scaling_valid: true but the pinning block saw "
+            f"host_cores={pin_cores!r}")
+    if pin.get("pinned") and not pin.get("assignments"):
+        offences.append(
+            "pinning.pinned is true but no per-process assignments were "
+            "recorded")
+    return offences
+
+
+def scaling_sweep(repo: str = _REPO) -> List[Tuple[str, List[str]]]:
+    """Every committed artifact with scaling offences: the tier-1 sweep
+    (tests/test_perf_gate.py) keeps a forged row from ever landing."""
+    paths = sorted(
+        glob.glob(os.path.join(repo, "*_r*.json"))
+        + glob.glob(os.path.join(repo, "artifacts", "*.json")))
+    out: List[Tuple[str, List[str]]] = []
+    for path in paths:
+        try:
+            doc = load_artifact(path)
+        except (OSError, ValueError):
+            continue
+        offences = scaling_offences(doc)
+        if offences:
+            out.append((path, offences))
+    return out
+
+
+def cmd_scaling(args) -> int:
+    if args.artifact:
+        offences = scaling_offences(load_artifact(args.artifact))
+        hits = [(args.artifact, offences)] if offences else []
+        swept = 1
+    else:
+        hits = scaling_sweep()
+        swept = len(glob.glob(os.path.join(_REPO, "*_r*.json"))
+                    + glob.glob(os.path.join(_REPO, "artifacts", "*.json")))
+    for path, offences in hits:
+        for o in offences:
+            print(f"FORGED SCALING CLAIM: {os.path.relpath(path, _REPO)}: {o}")
+    if hits:
+        print("perf_gate scaling: FAIL")
+        return 2
+    print(f"perf_gate scaling: PASS ({swept} artifacts swept)")
+    return 0
+
+
 # ------------------------------------------------------------------ checking
 def compare(baseline: dict, candidate: dict, tolerance: float) -> Tuple[List[str], List[str]]:
     """(regressions, notes). A config regresses when its step time grew (or
@@ -153,10 +240,11 @@ def cmd_check(args) -> int:
     baseline = load_artifact(args.baseline)
     candidate = load_artifact(args.candidate)
     offences = impossible_timing(candidate)
+    offences += [f"scaling: {o}" for o in scaling_offences(candidate)]
     if offences:
         for o in offences:
             print(f"PRECONDITION: {o}")
-        print("perf_gate: FAIL (impossible-timing precondition)")
+        print("perf_gate: FAIL (impossible-timing/scaling precondition)")
         return 2
     regressions, notes = compare(baseline, candidate, args.tolerance)
     for n in notes:
@@ -181,6 +269,8 @@ def _status_of(artifact: dict) -> str:
         return "SUSPECT (in-band flag)"
     if impossible_timing(artifact):
         return "SUSPECT (impossible timing)"
+    if scaling_offences(artifact):
+        return "SUSPECT (unproven scaling claim)"
     if artifact.get("metric") is None:  # wrapper with no parsed result line
         return "no result"
     err = artifact.get("error")
@@ -346,8 +436,13 @@ def main() -> int:
     pt.add_argument("--write", default="",
                     help="rewrite this file's trajectory block in place "
                          "(e.g. PERF.md); default prints to stdout")
+    ps = sub.add_parser("scaling",
+                        help="refuse forged scaling_valid claims (exit 2)")
+    ps.add_argument("--artifact", default="",
+                    help="check one artifact instead of sweeping the repo")
     args = p.parse_args()
-    return cmd_check(args) if args.command == "check" else cmd_trajectory(args)
+    return {"check": cmd_check, "trajectory": cmd_trajectory,
+            "scaling": cmd_scaling}[args.command](args)
 
 
 if __name__ == "__main__":
