@@ -116,6 +116,14 @@ def main() -> None:
     p.add_argument("--no-health", action="store_true",
                    help="disable the fleet-health subsystem (watchdog rules, "
                         "TSDB, crash recorder)")
+    p.add_argument("--no-trace", action="store_true",
+                   help="disable distributed-tracing span minting on the "
+                        "request path (the overhead A/B posture; tail "
+                        "sampling bounds retention when left on)")
+    p.add_argument("--telemetry-interval-s", type=float, default=5.0,
+                   help="cadence of registry-snapshot + tail-sampled-trace "
+                        "shipping to the coordinator (requires "
+                        "--coordinator-addr; 0 disables)")
     p.add_argument("--transport", default="auto",
                    choices=("auto", "shm", "tcp"),
                    help="TCP-frontend transport policy: auto/shm negotiate "
@@ -123,6 +131,10 @@ def main() -> None:
                         "socket stays as control channel + fallback), tcp "
                         "refuses rings (cross-host posture)")
     args = p.parse_args()
+    if args.no_trace:
+        from ..obs import set_tracing
+
+        set_tracing(False)
     player_ckpts = dict(s.split("=", 1) for s in args.player_checkpoint)
     if not args.mock and not args.checkpoint and not player_ckpts:
         p.error("--checkpoint (or --player-checkpoint) is required unless --mock")
@@ -187,6 +199,20 @@ def main() -> None:
         # NOW so routers stop pinning new sessions here, instead of
         # heartbeating on until the lease dies
         target.deregister = _deregister
+
+    shipper = None
+    if args.coordinator_addr and args.telemetry_interval_s > 0:
+        # ship registry snapshots + tail-sampled request traces + latency
+        # exemplars to the broker: the coordinator's rulebook sees this
+        # gateway's latency series, and its trace store can answer
+        # "show me THIS slow request" across the fleet (opsctl trace)
+        from ..obs import TelemetryShipper
+
+        shipper = TelemetryShipper(
+            source=f"serve:{tcp.port}", coordinator_addr=coord,
+            interval_s=args.telemetry_interval_s,
+            endpoint=f"{tcp.host}:{tcp.port}",
+        ).start()
     logger.info(
         f"serving: http={http.host}:{http.port} tcp={tcp.host}:{tcp.port} "
         f"slots={args.slots} max_delay={args.max_delay_ms}ms "
@@ -206,6 +232,8 @@ def main() -> None:
     done.wait()
     # begin_drain (inside drain_and_stop) deregisters the lease first —
     # the fleet stops routing here immediately, not a lease TTL later
+    if shipper is not None:
+        shipper.stop()
     if beat is not None:
         beat.stop_event.set()
     http.stop()

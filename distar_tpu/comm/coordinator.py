@@ -308,8 +308,17 @@ class CoordinatorServer:
                 self.end_headers()
 
             def do_POST(self):
+                from ..obs import finish_trace, format_traceparent, join_trace, parse_traceparent
+
                 name = self.path.strip("/").split("/")[-1]
                 length = int(self.headers.get("Content-Length", 0))
+                # w3c traceparent propagation (the broker is an HTTP hop in
+                # discovery/league flows too): a caller-supplied header
+                # joins this route's span under the caller's trace_id
+                wire = parse_traceparent(self.headers.get("traceparent"))
+                ctx = join_trace(wire, f"coordinator_{name}") \
+                    if wire is not None else None
+                outcome = "ok"
                 try:
                     raw = self.rfile.read(length)
                     ctype = self.headers.get("Content-Type", "")
@@ -331,9 +340,13 @@ class CoordinatorServer:
                     )
                 except Exception as e:
                     payload = {"code": 1, "info": repr(e)}
+                    outcome = "error"
                 data = json.dumps(payload, default=str).encode()
                 self.send_response(200)
                 self.send_header("Content-Type", "application/json")
+                if ctx is not None:
+                    self.send_header("traceparent", format_traceparent(ctx))
+                    finish_trace(ctx, "coordinator_done", outcome=outcome)
                 self.send_header("Content-Length", str(len(data)))
                 self.end_headers()
                 self.wfile.write(data)

@@ -591,7 +591,15 @@ class RingWriter:
                 lambda: self.ring.capacity - (staged_end - self.read_pos()) > 0,
                 timeout_s, self._check_reader_alive, f"{self.op}:send")
         finally:
-            self._h_full_wait.observe(time.monotonic() - t0)
+            waited = time.monotonic() - t0
+            self._h_full_wait.observe(waited)
+            if waited > 0.0005:
+                # backpressure attribution: the blocked producer charges its
+                # ring-full wait to the request span riding this thread
+                # (clients install theirs via obs.set_active_trace)
+                from ..obs.trace import annotate_active
+
+                annotate_active("blocked_s", waited)
 
     # ------------------------------------------------------------------ api
     def send(self, obj: Any, timeout_s: float = 30.0) -> int:

@@ -33,15 +33,38 @@ from .exporters import (
 )
 from .trace import (
     Span,
+    annotate,
+    annotate_active,
+    current_trace,
     finish_trace,
+    format_traceparent,
     hop_names,
     is_trace,
+    is_wire_ctx,
+    join_trace,
     mark_hop,
     mint_span_id,
+    parse_traceparent,
+    set_active_trace,
+    set_tracing,
     start_trace,
+    trace_record,
+    tracing_enabled,
     unwrap_payload,
+    wire_ctx,
     wrap_payload,
 )
+from .tracestore import (
+    ExemplarStore,
+    TraceBuffer,
+    TraceIngest,
+    get_exemplar_store,
+    get_trace_buffer,
+    note_exemplar,
+    set_exemplar_store,
+    set_trace_buffer,
+)
+from .waterfall import build_waterfall, render_listing, render_waterfall
 from .profiler import ProfilerSession, record_step_phases
 from .perf import (
     PerfMonitor,
@@ -79,14 +102,37 @@ __all__ = [
     "write_json_response",
     "write_scrape_response",
     "Span",
+    "annotate",
+    "annotate_active",
+    "current_trace",
     "finish_trace",
+    "format_traceparent",
     "hop_names",
     "is_trace",
+    "is_wire_ctx",
+    "join_trace",
     "mark_hop",
     "mint_span_id",
+    "parse_traceparent",
+    "set_active_trace",
+    "set_tracing",
     "start_trace",
+    "trace_record",
+    "tracing_enabled",
     "unwrap_payload",
+    "wire_ctx",
     "wrap_payload",
+    "ExemplarStore",
+    "TraceBuffer",
+    "TraceIngest",
+    "get_exemplar_store",
+    "get_trace_buffer",
+    "note_exemplar",
+    "set_exemplar_store",
+    "set_trace_buffer",
+    "build_waterfall",
+    "render_listing",
+    "render_waterfall",
     "ProfilerSession",
     "record_step_phases",
     "PerfMonitor",

@@ -105,12 +105,17 @@ def write_json_response(handler, obj, status: int = 200) -> None:
 
 def handle_health_get(handler, path: str) -> bool:
     """Answer the fleet-health GET routes shared by every HTTP surface
-    (coordinator broker, serve gateway):
+    (coordinator broker, serve gateway, replay admin):
 
       GET /healthz                           overall state + per-source staleness
                                              (HTTP 503 while any rule is firing)
       GET /alerts                            per-rule states + transition history
       GET /timeseries?name=&window_s=&source=  windowed stats + raw points
+      GET /traces?name=&min_ms=&outcome=&limit=  retained trace listings
+                                             (shipped ingest + this process's
+                                             tail-sampled buffer)
+      GET /trace/<id>                        one trace's span records + the
+                                             assembled waterfall report
 
     Returns False when ``path`` is not a health route (caller 404s). Route
     failures answer 500 — an ops probe must never wedge the serving process."""
@@ -118,7 +123,8 @@ def handle_health_get(handler, path: str) -> bool:
 
     parsed = urlparse(path)
     route = parsed.path.rstrip("/")
-    if route not in ("/healthz", "/alerts", "/timeseries"):
+    if route not in ("/healthz", "/alerts", "/timeseries", "/traces") \
+            and not route.startswith("/trace/"):
         return False
     try:
         from .health import get_fleet_health
@@ -130,6 +136,54 @@ def handle_health_get(handler, path: str) -> bool:
                                 status=503 if body["status"] == "firing" else 200)
         elif route == "/alerts":
             write_json_response(handler, fleet.evaluator.alerts())
+        elif route == "/traces":
+            from .tracestore import _listing, get_trace_buffer
+
+            q = parse_qs(parsed.query)
+            name = (q.get("name") or [None])[0] or None
+            outcome = (q.get("outcome") or [None])[0] or None
+            min_ms = float((q.get("min_ms") or ["0"])[0])
+            limit = int((q.get("limit") or ["50"])[0])
+            rows = fleet.traces.query(name=name, min_ms=min_ms,
+                                      outcome=outcome, limit=limit)
+            # the process's OWN tail-sampled buffer answers too, so a lone
+            # gateway/store is inspectable without a coordinator in front
+            for rec in get_trace_buffer().records():
+                if name and rec.get("name") != name:
+                    continue
+                if outcome and rec.get("outcome", "ok") != outcome:
+                    continue
+                if float(rec.get("dur_s", 0.0)) * 1000.0 < min_ms:
+                    continue
+                rows.append(_listing(rec, "local"))
+            rows.sort(key=lambda r: r["dur_ms"], reverse=True)
+            write_json_response(handler, {
+                "traces": rows[:limit],
+                "ingest": fleet.traces.stats(),
+                "buffer": get_trace_buffer().stats(),
+            })
+        elif route.startswith("/trace/"):
+            from .tracestore import get_trace_buffer
+            from .waterfall import build_waterfall
+
+            trace_id = route.rsplit("/", 1)[1]
+            spans = fleet.traces.get(trace_id)
+            seen = {r.get("span_id") for r in spans}
+            for rec in get_trace_buffer().get(trace_id):
+                if rec.get("span_id") not in seen:
+                    rec = dict(rec)
+                    rec["source"] = "local"
+                    spans.append(rec)
+            if not spans:
+                write_json_response(
+                    handler, {"error": f"no spans for trace {trace_id!r}"},
+                    status=404)
+                return True
+            write_json_response(handler, {
+                "trace_id": trace_id,
+                "spans": spans,
+                "waterfall": build_waterfall(spans),
+            })
         else:
             q = parse_qs(parsed.query)
             name = (q.get("name") or [""])[0]

@@ -151,6 +151,15 @@ class HealthEvaluator:
             "series": st.last_series,
             "summary": rule.summary or rule.name,
         }
+        # exemplar: when the rule's metric matches a latency family that
+        # records trace exemplars, the alert names a retrievable offending
+        # trace_id (GET /trace/<id>, opsctl trace --id) — the event rides
+        # into the flight recorder, so crash bundles carry it too
+        from .tracestore import get_exemplar_store
+
+        exemplar = get_exemplar_store().lookup(rule.metric)
+        if exemplar is not None:
+            event["exemplar_trace_id"] = exemplar["trace_id"]
         self._history.append(event)
         recorder = self.recorder or get_flight_recorder()
         recorder.record("alert", **{k: v for k, v in event.items() if k != "type"})
@@ -169,14 +178,26 @@ class HealthEvaluator:
                 worst: Optional[float] = None
                 worst_series: Optional[str] = None
                 for name in names:
-                    q = self.store.query(name, window_s=rule.window_s,
-                                         source=rule.source)
-                    if q is None:
-                        continue
-                    v = rule.breached(q)
-                    if v is not None and (worst is None or not math.isfinite(v)
-                                          or (math.isfinite(worst) and v > worst)):
-                        worst, worst_series = v, f"{q['source']}:{name}"
+                    if rule.source is not None:
+                        sources = [rule.source]
+                    else:
+                        # EVERY source holding the series, not just the
+                        # freshest: "a rule breaches when ANY matching
+                        # series breaches" — with one series name shipped
+                        # by N fleet members (N gateways' p99), querying
+                        # only the last shipper masked a breaching member
+                        # behind a healthy one that shipped a beat later
+                        sources = list(self.store.points(
+                            name, window_s=rule.window_s)) or [None]
+                    for src in sources:
+                        q = self.store.query(name, window_s=rule.window_s,
+                                             source=src)
+                        if q is None:
+                            continue
+                        v = rule.breached(q)
+                        if v is not None and (worst is None or not math.isfinite(v)
+                                              or (math.isfinite(worst) and v > worst)):
+                            worst, worst_series = v, f"{q['source']}:{name}"
                 if worst is not None:
                     st.last_value, st.last_series = worst, worst_series
                     st.breach_streak += 1
@@ -278,7 +299,8 @@ def default_rulebook(roles: Iterable[str] = ("learner", "actor", "coordinator",
                      slo_e2e_s: float = 30.0,
                      queue_saturation: float = 384.0,
                      shed_rate_per_s: float = 5.0,
-                     stall_window_s: float = 60.0) -> List[HealthRule]:
+                     stall_window_s: float = 60.0,
+                     slo_serve_latency_s: float = 5.0) -> List[HealthRule]:
     """The stock fleet rulebook, filtered by which roles this process hosts
     (or, on the coordinator, observes via shipped telemetry — pass all)."""
     roles = set(roles)
@@ -338,6 +360,15 @@ def default_rulebook(roles: Iterable[str] = ("learner", "actor", "coordinator",
             severity="warning",
             summary="gateway shedding load faster than the tolerated rate",
         ))
+        book.append(HealthRule(
+            name="serve_latency_slo",
+            metric="distar_serve_request_latency_seconds_p99",
+            agg="last", op=">", threshold=slo_serve_latency_s,
+            window_s=30.0, for_count=2, severity="warning",
+            summary="gateway p99 request latency breached the serving SLO "
+                    "(the alert carries an exemplar trace_id — retrieve the "
+                    "waterfall: opsctl trace --id <id>)",
+        ))
     if "replay" in roles:
         book.append(HealthRule(
             name="replay_table_saturation",
@@ -376,7 +407,14 @@ class FleetHealth:
         self.sampler = RegistrySampler(
             self.store, registry=registry, interval_s=sample_interval_s, source=source
         )
-        self.ingest = TelemetryIngest(self.store, registry=registry)
+        # fleet trace store: shipped span records land here (bounded per
+        # source, evicted with the source's TSDB series); GET /traces and
+        # GET /trace/<id> answer from it
+        from .tracestore import TraceIngest
+
+        self.traces = TraceIngest(registry=registry)
+        self.ingest = TelemetryIngest(self.store, registry=registry,
+                                      traces=self.traces)
         self.evaluator = HealthEvaluator(
             self.store, rules if rules is not None else default_rulebook(),
             recorder=self.recorder, registry=registry, interval_s=eval_interval_s,

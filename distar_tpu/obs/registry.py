@@ -216,6 +216,9 @@ _registry: Optional[MetricsRegistry] = None
 def get_registry() -> MetricsRegistry:
     """The process-wide default registry (created on first use)."""
     global _registry
+    reg = _registry
+    if reg is not None:  # lock-free fast path: hot paths call this per event
+        return reg
     with _registry_lock:
         if _registry is None:
             _registry = MetricsRegistry()

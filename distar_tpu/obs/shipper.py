@@ -29,11 +29,20 @@ SERIALIZED_CONTENT_TYPE = "application/x-distar-serialized"
 
 
 class TelemetryIngest:
-    """Coordinator-side sink: fold shipped snapshots into the fleet store."""
+    """Coordinator-side sink: fold shipped snapshots into the fleet store.
 
-    def __init__(self, store: TimeSeriesStore, registry: Optional[MetricsRegistry] = None):
+    Messages may additionally carry ``traces`` (tail-sampled span records
+    from the shipper process's ``TraceBuffer``) and ``exemplars`` (its
+    latency-exemplar snapshot); both fold into the shared trace machinery
+    (``obs/tracestore.py``) when a ``TraceIngest`` is attached, so the
+    coordinator serves ``GET /traces`` for the whole fleet and its health
+    rules can name offending trace_ids in alert events."""
+
+    def __init__(self, store: TimeSeriesStore, registry: Optional[MetricsRegistry] = None,
+                 traces=None):
         self.store = store
         self._registry = registry
+        self.traces = traces  # Optional[tracestore.TraceIngest]
         # source -> the service endpoint ("ip:port") the shipper declared;
         # how coordinator lease evictions map back to TSDB sources
         self._endpoints: dict = {}
@@ -41,10 +50,11 @@ class TelemetryIngest:
 
     def ingest(self, msg: dict) -> int:
         """Fold one shipped message ``{source, ts, snapshot, interval_s?,
-        endpoint?}`` into per-source series; returns the number of scalars
-        recorded. ``endpoint`` (the shipper's registered service address)
-        links the source to its coordinator lease, so a lease eviction can
-        reclaim the series (``evict_endpoint``)."""
+        endpoint?, traces?, exemplars?}`` into per-source series; returns
+        the number of scalars recorded. ``endpoint`` (the shipper's
+        registered service address) links the source to its coordinator
+        lease, so a lease eviction can reclaim the series
+        (``evict_endpoint``) — and the source's traces with them."""
         if not isinstance(msg, dict) or not isinstance(msg.get("snapshot"), dict):
             raise ValueError("telemetry message must be {source, ts, snapshot}")
         source = str(msg.get("source") or "unknown")
@@ -54,6 +64,12 @@ class TelemetryIngest:
             with self._lock:
                 self._endpoints[source] = str(endpoint)
         n = self.store.record_snapshot(msg["snapshot"], ts=ts, source=source)
+        if self.traces is not None and msg.get("traces"):
+            self.traces.ingest(source, msg["traces"])
+        if msg.get("exemplars"):
+            from .tracestore import get_exemplar_store
+
+            get_exemplar_store().merge(msg["exemplars"])
         reg = self._registry or get_registry()
         reg.counter(
             "distar_telemetry_ingest_total", "shipped snapshots ingested", source=source
@@ -69,6 +85,9 @@ class TelemetryIngest:
             sources = [s for s, e in self._endpoints.items() if e == endpoint]
             for s in sources:
                 del self._endpoints[s]
+        if self.traces is not None:
+            for s in sources:
+                self.traces.evict_source(s)
         return sum(self.store.evict_source(s) for s in sources)
 
     def evict_source(self, source: str) -> int:
@@ -76,6 +95,8 @@ class TelemetryIngest:
         e.g. the autoscaler's member probes)."""
         with self._lock:
             self._endpoints.pop(source, None)
+        if self.traces is not None:
+            self.traces.evict_source(source)
         return self.store.evict_source(source)
 
     def sources(self) -> dict:
@@ -125,6 +146,17 @@ class TelemetryShipper:
         }
         if self.endpoint:
             msg["endpoint"] = self.endpoint
+        # tail-sampled trace records + latency exemplars ride the same
+        # periodic push (best-effort like the rest of telemetry: a lost
+        # POST loses the batch, never blocks the role)
+        from .tracestore import get_exemplar_store, get_trace_buffer
+
+        traces = get_trace_buffer().unshipped()
+        if traces:
+            msg["traces"] = traces
+        exemplars = get_exemplar_store().snapshot()
+        if exemplars:
+            msg["exemplars"] = exemplars
         return msg
 
     def ship_once(self) -> int:

@@ -44,6 +44,13 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from ..comm import shm_ring
 from ..comm.serializer import maybe_decode, recv_msg, send_msg, supported_codecs
+from ..obs import (
+    finish_trace,
+    set_active_trace,
+    start_trace,
+    tracing_enabled,
+    wire_ctx,
+)
 from ..resilience import CircuitBreaker, RetryPolicy, retry_call
 from .errors import error_from_wire
 
@@ -175,6 +182,33 @@ class _ReplayClientBase:
             policy=self._policy, breaker=self._breaker,
         )
 
+    def _traced_call(self, req: dict, name: str) -> dict:
+        """Data-plane RPC under a client span: the compact wire trace field
+        rides the frame (TCP or shm leg alike — it's inside the pickled
+        request), the store's server span joins it, and shm ring-full waits
+        annotate this span via the active-trace threadlocal. The span
+        resolves ``shed`` when the limiter paced us out (retryable wire
+        answers), ``error`` on real faults."""
+        ctx = None
+        if tracing_enabled():
+            ctx = start_trace(name, table=str(req.get("table", "")))
+            req = dict(req)
+            req["trace"] = wire_ctx(ctx)
+        on_shm = self._shm is not None  # only the shm leg reads the active trace
+        prev = set_active_trace(ctx) if on_shm else None
+        try:
+            resp = self._call(req)
+        except BaseException as e:
+            shed = getattr(e, "code", "") in ("rate_limited", "draining")
+            finish_trace(ctx, "client_done",
+                         outcome="shed" if shed else "error")
+            raise
+        finally:
+            if on_shm:
+                set_active_trace(prev)
+        finish_trace(ctx, "client_done")
+        return resp
+
     def ping(self) -> bool:
         return self._call({"op": "ping"})["pong"]
 
@@ -218,7 +252,7 @@ class InsertClient(_ReplayClientBase):
                "priority": priority, "idem": uuid.uuid4().hex}
         if timeout_s is not None:
             req["timeout_s"] = timeout_s
-        return self._call(req)["seq"]
+        return self._traced_call(req, "replay_insert")["seq"]
 
 
 class SampleClient(_ReplayClientBase):
@@ -234,7 +268,7 @@ class SampleClient(_ReplayClientBase):
         req = {"op": "sample", "table": table, "batch_size": batch_size}
         if timeout_s is not None:
             req["timeout_s"] = timeout_s
-        resp = self._call(req)
+        resp = self._traced_call(req, "replay_sample")
         # spill re-serves arrive as pre-encoded Opaque payloads (the server
         # skipped recompression); unwrap here so consumers never see them
         return [maybe_decode(i) for i in resp["items"]], resp["info"]

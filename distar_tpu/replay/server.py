@@ -61,7 +61,13 @@ from ..comm.serializer import (
     sock_recv_exact,
     supported_codecs,
 )
-from ..obs import get_registry
+from ..obs import (
+    finish_trace,
+    get_registry,
+    join_trace,
+    set_active_trace,
+    tracing_enabled,
+)
 from .errors import ReplayError
 from .store import ReplayStore
 
@@ -286,6 +292,29 @@ class ReplayServer:
             return {"code": "bad_request", "error": f"not a request dict: {type(req)}"}
         op = req["op"]
         timeout_s = float(req.get("timeout_s", self.default_timeout_s))
+        # server-side span joining the client's wire trace field (both
+        # transports — the field is inside the pickled frame either way);
+        # installed as this handler thread's ACTIVE trace so the table's
+        # rate limiter attributes its block time (blocked_s) to the request
+        ctx = None
+        if op in ("insert", "sample") and req.get("trace") and tracing_enabled():
+            ctx = join_trace(req.get("trace"), f"replay_{op}",
+                             table=str(req.get("table", "")),
+                             shard=getattr(self.store, "shard_id", "") or "")
+        prev = set_active_trace(ctx) if ctx is not None else None
+        try:
+            out = self._dispatch_op(req, op, timeout_s)
+        finally:
+            if ctx is not None:
+                set_active_trace(prev)
+        if ctx is not None:
+            code = out.get("code")
+            outcome = ("ok" if code == 0 else
+                       "shed" if code in ("rate_limited", "draining") else "error")
+            finish_trace(ctx, "replay_done", outcome=outcome)
+        return out
+
+    def _dispatch_op(self, req: dict, op: str, timeout_s: float) -> dict:
         try:
             if op == "insert":
                 seq = self.store.insert(

@@ -45,7 +45,7 @@ import time
 from dataclasses import dataclass
 from typing import Any, Callable, Dict, List, Optional
 
-from ..obs import get_registry
+from ..obs import annotate_active, get_registry
 from .errors import (
     InvalidBatchError,
     RateLimitTimeout,
@@ -179,6 +179,10 @@ class RateLimiter:
         if waited > 0.0005:
             self._block_s[side] += waited
             self._c_block[side].inc(waited)
+            # attribute the flow-control wait to the request being served
+            # (the replay server installs its span as this handler thread's
+            # active trace) — the waterfall's blocked_s segment
+            annotate_active("blocked_s", waited)
         if not ok:
             raise RateLimitTimeout(side, timeout_s or 0.0, self.state())
 

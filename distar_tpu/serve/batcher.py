@@ -35,7 +35,8 @@ class PendingRequest:
 
     __slots__ = (
         "session_id", "slot", "obs", "enqueue_ts", "deadline_ts", "ctx",
-        "want_teacher", "result", "error", "_event", "_state", "_lock",
+        "want_teacher", "result", "error", "service_s", "queue_s", "_event",
+        "_state", "_lock",
     )
 
     def __init__(self, session_id: str, slot: int, obs, deadline_ts: Optional[float],
@@ -47,6 +48,8 @@ class PendingRequest:
         self.deadline_ts = deadline_ts
         self.ctx = ctx  # obs.trace context riding the request
         self.want_teacher = want_teacher  # piggyback teacher logits on the flush
+        self.service_s = 0.0  # the flush's engine-forward share (trace attribution)
+        self.queue_s = 0.0  # admission-to-flush residency (trace attribution)
         self.result = None
         self.error: Optional[ServeError] = None
         self._event = threading.Event()
@@ -169,7 +172,13 @@ class MicroBatcher:
                 continue
             now = time.time()
             for r in batch:
-                self._h_wait.observe(max(0.0, now - r.enqueue_ts))
+                wait = max(0.0, now - r.enqueue_ts)
+                self._h_wait.observe(wait)
+                # queue-wait attribution: stashed for the waiter's thread to
+                # annotate at completion (the waterfall separates "sat in
+                # the micro-batcher" from "ran the engine"; this loop is the
+                # serial flush path, so it only stamps the number)
+                r.queue_s = wait
             self._h_occupancy.observe(len(batch))
             self._c_flush[reason].inc()
             try:

@@ -46,6 +46,17 @@ def main(argv=None) -> int:
     p.add_argument("--coordinator", default="",
                    help="coordinator host:port to register under serve_gateway")
     p.add_argument("--lease-s", type=float, default=10.0)
+    p.add_argument("--telemetry-interval-s", type=float, default=2.0,
+                   help="cadence of registry-snapshot + tail-sampled-trace "
+                        "shipping to the coordinator (requires "
+                        "--coordinator; 0 disables)")
+    p.add_argument("--no-trace", action="store_true",
+                   help="disable request-span minting (the overhead A/B / "
+                        "byte-identical-wire posture)")
+    p.add_argument("--trace-keep-one-in", type=int, default=0,
+                   help="override the tail sampler's random 1-in-N keep "
+                        "rate (1 = retain every span — the drill/debug "
+                        "posture; 0 = stock default)")
     p.add_argument("--drain-timeout-s", type=float, default=30.0,
                    help="graceful-retirement budget: after POST /drain, exit "
                         "once every resident session migrated off, or when "
@@ -54,6 +65,15 @@ def main(argv=None) -> int:
                    help="TCP-frontend transport policy (auto/shm negotiate "
                         "shared-memory rings with colocated clients)")
     args = p.parse_args(argv)
+
+    if args.no_trace:
+        from ...obs import set_tracing
+
+        set_tracing(False)
+    if args.trace_keep_one_in > 0:
+        from ...obs import TraceBuffer, set_trace_buffer
+
+        set_trace_buffer(TraceBuffer(random_one_in=args.trace_keep_one_in))
 
     players = [s.strip() for s in args.players.split(",") if s.strip()]
 
@@ -79,6 +99,7 @@ def main(argv=None) -> int:
     http = ServeHTTPServer(target, host=args.host, port=args.http_port).start()
 
     beat = None
+    shipper = None
     if args.coordinator:
         from ...comm.discovery import unregister_endpoint
 
@@ -98,6 +119,18 @@ def main(argv=None) -> int:
 
         # drain's step 1: leave discovery NOW, not a lease TTL later
         target.deregister = _deregister
+
+        if args.telemetry_interval_s > 0:
+            # telemetry + tail-sampled trace records + exemplars ship to the
+            # broker: this gateway's server spans join client spans in the
+            # coordinator trace store (GET /traces, opsctl trace)
+            from ...obs import TelemetryShipper
+
+            shipper = TelemetryShipper(
+                source=f"gateway:{tcp.port}", coordinator_addr=coord,
+                interval_s=args.telemetry_interval_s,
+                endpoint=f"{tcp.host}:{tcp.port}",
+            ).start()
 
     # CLI entrypoint output: the parseable serving line callers wait for
     print(f"SERVE-GATEWAY {tcp.host} {tcp.port} {http.port}",  # lint: allow-print
@@ -126,6 +159,8 @@ def main(argv=None) -> int:
                     break
     except (OSError, ValueError, KeyboardInterrupt):
         pass
+    if shipper is not None:
+        shipper.stop()
     if beat is not None:
         beat.stop_event.set()
     tcp.stop()

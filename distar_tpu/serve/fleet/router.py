@@ -36,7 +36,15 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
-from ...obs import get_registry
+from ...obs import (
+    annotate,
+    finish_trace,
+    get_registry,
+    is_trace,
+    join_trace,
+    start_trace,
+    tracing_enabled,
+)
 from ...replay.sharding import HashRing, stable_hash
 from ...resilience import CircuitOpenError, RetryableError, RetryPolicy
 from ..errors import CapacityError, DrainingError, ServeError
@@ -418,16 +426,18 @@ class FleetClient:
 
     # -------------------------------------------------------------- data path
     def act(self, session_id: str, obs, timeout_s: Optional[float] = None,
-            want_teacher: bool = False, player: Optional[str] = None) -> dict:
+            want_teacher: bool = False, player: Optional[str] = None,
+            trace: Optional[dict] = None) -> dict:
         """One agent step with affinity + failover: served by the session's
         pinned gateway, re-routed to a survivor when that gateway is
         unreachable (the carry re-materializes from zero over there —
         counted). Raises typed ``ServeError``s exactly like a direct
-        ``ServeClient``."""
-        out = self.act_many(
-            [{"session_id": session_id, "obs": obs, "want_teacher": want_teacher}],
-            timeout_s=timeout_s, player=player,
-        )[0]
+        ``ServeClient``. ``trace`` supplies a caller-minted span for the
+        lane (re-route/retry time annotates it; finished here)."""
+        req = {"session_id": session_id, "obs": obs, "want_teacher": want_teacher}
+        if trace is not None:
+            req["trace_ctx"] = trace
+        out = self.act_many([req], timeout_s=timeout_s, player=player)[0]
         if isinstance(out, ServeError):
             raise out
         return out
@@ -443,6 +453,16 @@ class FleetClient:
         ``ServeError`` values."""
         requests = list(requests)
         player = self._player(player)
+        # per-lane client spans, minted BEFORE routing so fleet-level work —
+        # re-routes, drain handoffs, capacity spill-overs — is attributed
+        # (``retry_s``) to the request that paid for it; the per-gateway
+        # ServeClient stamps the compact wire field from the same context,
+        # so the winning gateway's span joins under this lane's span
+        if tracing_enabled():
+            for r in requests:
+                if r.get("trace_ctx") is None:
+                    r["trace_ctx"] = start_trace(
+                        "serve_client", session=r.get("session_id", "?"))
         results: List[Any] = [None] * len(requests)
         lanes = list(range(len(requests)))
         spills: Dict[int, int] = {}  # per-lane capacity spill-overs this call
@@ -450,6 +470,15 @@ class FleetClient:
         for _ in range(len(self.router.map) + 1):
             if not lanes:
                 break
+            round_t0 = time.monotonic()
+
+            def _note_retry(idxs) -> None:
+                # the re-route IS the retry: wall-clock this round burned
+                # before the lane re-issues lands on its span as retry_s
+                spent = time.monotonic() - round_t0
+                for i in idxs:
+                    annotate(requests[i].get("trace_ctx"), "retry_s", spent)
+
             by_addr: Dict[str, List[int]] = {}
             for i in lanes:
                 try:
@@ -467,6 +496,7 @@ class FleetClient:
                         player=player)
                 except TRANSPORT_ERRORS:
                     self._gateway_failed(addr)
+                    _note_retry(idxs)
                     retry.extend(idxs)
                     continue
                 self.router.note_ok(addr)
@@ -487,6 +517,7 @@ class FleetClient:
                         # (a fleet-wide-full session runs out of spills and
                         # sheds through typed, exactly as before)
                         spills[i] = spills.get(i, 0) + 1
+                        _note_retry([i])
                         retry.append(i)
                         continue
                     results[i] = entry
@@ -496,11 +527,24 @@ class FleetClient:
                 if handoff:
                     self._drain_handoff(
                         addr, client, [requests[i]["session_id"] for i in handoff])
+                    _note_retry(handoff)
                     retry.extend(handoff)
             lanes = retry
         for i in lanes:  # passes exhausted with gateways still failing
             if results[i] is None:
                 results[i] = ServeError("gateway fleet unreachable for lane")
+        # lane spans resolve with the FINAL outcome (a shed that spilled to
+        # a survivor and succeeded records ok, not the intermediate shed)
+        for r, entry in zip(requests, results):
+            ctx = r.get("trace_ctx")
+            if not is_trace(ctx):
+                continue
+            if isinstance(entry, ServeError):
+                entry.trace_id = ctx["trace_id"]
+                finish_trace(ctx, "client_done",
+                             outcome="shed" if entry.shed else "error")
+            else:
+                finish_trace(ctx, "client_done")
         return results
 
     def _drain_handoff(self, addr: str, client, session_ids,
@@ -663,11 +707,27 @@ class RouterGatewayAdapter:
             return self
         return RouterGatewayAdapter(self.fleet, player=player)
 
-    def act(self, session_id: str, obs, timeout_s=None, want_teacher=False):
+    def _join(self, wire):
+        """A remote caller's wire trace field becomes this router process's
+        own span (name ``router``) — the hop between client and gateway is
+        then visible in the waterfall instead of folded into 'network'."""
+        if wire is None or not tracing_enabled():
+            return None
+        return join_trace(wire, "router")
+
+    def act(self, session_id: str, obs, timeout_s=None, want_teacher=False,
+            trace=None):
         return self.fleet.act(session_id, obs, timeout_s=timeout_s,
-                              want_teacher=want_teacher, player=self._player)
+                              want_teacher=want_teacher, player=self._player,
+                              trace=self._join(trace))
 
     def act_many(self, requests, timeout_s=None):
+        requests = list(requests)
+        for r in requests:
+            wire = r.get("trace")
+            ctx = self._join(wire)
+            if ctx is not None:
+                r["trace_ctx"] = ctx
         return self.fleet.act_many(requests, timeout_s=timeout_s,
                                    player=self._player)
 

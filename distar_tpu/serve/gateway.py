@@ -19,7 +19,15 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
-from ..obs import Span, finish_trace, get_registry, mark_hop, start_trace
+from ..obs import (
+    Span,
+    annotate,
+    finish_trace,
+    get_registry,
+    join_trace,
+    note_exemplar,
+    tracing_enabled,
+)
 from .batcher import MicroBatcher, PendingRequest
 from .errors import DrainingError, ServeError, ShedError
 from .registry import ModelRegistry
@@ -153,14 +161,15 @@ class InferenceGateway:
 
     # ----------------------------------------------------------- client API
     def act(self, session_id: str, obs: Dict[str, Any], timeout_s: Optional[float] = None,
-            want_teacher: bool = False):
+            want_teacher: bool = False, trace=None):
         """One agent step: returns the engine's per-slot output dict plus
         ``model_version``. Raises a typed ``ServeError`` (``ShedError``
-        subclasses are retryable load sheds)."""
-        out = self.act_many(
-            [{"session_id": session_id, "obs": obs, "want_teacher": want_teacher}],
-            timeout_s=timeout_s,
-        )[0]
+        subclasses are retryable load sheds). ``trace`` is the caller's
+        compact wire trace-context field — the gateway's span joins it."""
+        req = {"session_id": session_id, "obs": obs, "want_teacher": want_teacher}
+        if trace is not None:
+            req["trace"] = trace
+        out = self.act_many([req], timeout_s=timeout_s)[0]
         if isinstance(out, ServeError):
             raise out
         return out
@@ -189,11 +198,19 @@ class InferenceGateway:
         pending: List[tuple] = []
         for i, r in enumerate(requests):
             session_id = r["session_id"]
-            ctx = start_trace("serve_request", session=session_id)
+            # server-side span: JOINS the caller's trace when the request
+            # carries the compact wire field (client-minted span becomes the
+            # parent — one trace_id across client/router/gateway), minted
+            # fresh for untraced legacy callers
+            ctx = None
+            if tracing_enabled():
+                ctx = join_trace(r.get("trace"), "serve_request",
+                                 session=session_id)
             try:
                 slot = self.sessions.acquire(session_id)
             except ShedError as e:  # CapacityError: no slot, nothing to evict
                 self._c_req["shed"].inc()
+                finish_trace(ctx, "shed", outcome="shed")
                 results[i] = e
                 continue
             with self._template_lock:
@@ -209,6 +226,7 @@ class InferenceGateway:
             except ShedError as e:
                 self._c_req["shed"].inc()
                 self.sessions.release(session_id)
+                finish_trace(ctx, "shed", outcome="shed")
                 results[i] = e
                 continue
             self._g_inflight.inc()
@@ -221,14 +239,33 @@ class InferenceGateway:
                     # abandon so a late delivery is discarded
                     if req.abandon():
                         self._c_req["timeout"].inc()
+                        finish_trace(req.ctx, "timeout", outcome="error")
                         results[i] = ServeError(f"no response within {timeout_s}s")
                         continue
                 if req.error is not None:
-                    self._c_req["shed" if req.error.shed else "error"].inc()
+                    shed = req.error.shed
+                    self._c_req["shed" if shed else "error"].inc()
+                    finish_trace(req.ctx, "shed" if shed else "error",
+                                 outcome="shed" if shed else "error")
                     results[i] = req.error
                     continue
                 self._c_req["ok"].inc()
-                self._h_latency.observe(time.perf_counter() - t0)
+                latency = time.perf_counter() - t0
+                self._h_latency.observe(latency)
+                if req.ctx is not None:
+                    # close the server span HERE (the waiter's thread) with
+                    # the flush's queue/service attribution — the flush
+                    # thread only stamped the cheap facts
+                    annotate(req.ctx, "queue_s", req.queue_s)
+                    annotate(req.ctx, "service_s", req.service_s)
+                    finish_trace(req.ctx, "serve_done")
+                    if req.ctx.get("_kept"):
+                        # exemplar: the latency series names its last
+                        # RETAINED witness (a dropped trace_id would 404 on
+                        # retrieval) — a firing p99 SLO alert then names a
+                        # retrievable trace
+                        note_exemplar("distar_serve_request_latency_seconds",
+                                      req.ctx.get("trace_id"), latency)
                 results[i] = req.result
             finally:
                 self._g_inflight.dec()
@@ -322,12 +359,24 @@ class InferenceGateway:
         template = self._template
         prepared: List[dict] = [template] * self.engine.num_slots
         active = [False] * self.engine.num_slots
+        flush_ts = time.time()
         for r in batch:
             prepared[r.slot] = r.obs
             active[r.slot] = True
-            mark_hop(r.ctx, "serve_flush")
-        with Span("serve_forward"):
+            if r.ctx is not None:
+                # bare hop append, NO histogram: the flush thread is the
+                # gateway's serial bottleneck, so per-request trace work
+                # here costs throughput one-for-one — everything heavier
+                # (service annotation, finish, exemplar) runs on the
+                # waiter's thread, which overlaps the next forward
+                r.ctx["hops"].append({"hop": "serve_flush", "ts": flush_ts})
+        with Span("serve_forward") as fwd:
             outs = self.engine.forward(prepared, active)
+        for r in batch:
+            # service-time attribution: the whole batched forward serves
+            # every lane of the flush (fixed-shape batching — a lane cannot
+            # pay less than the flush it rode); annotated at completion
+            r.service_s = fwd.elapsed
         # teacher logits piggyback on the same flush (one extra batched
         # forward serving every lane that asked, not one per caller); lanes
         # that didn't ask must not advance their teacher carry
@@ -347,5 +396,8 @@ class InferenceGateway:
             # episode-local forward count: clients detect a server-side
             # carry reset (gateway restart, eviction) when it runs backwards
             out["session_step"] = self.sessions.note_step(r.session_id)
-            finish_trace(r.ctx, "serve_done")
-            r.complete(result=out)
+            if not r.complete(result=out):
+                # waiter already abandoned (its timeout fired): nobody will
+                # finish this span downstream — close it here so the trace
+                # is retained with the truth
+                finish_trace(r.ctx, "abandoned", outcome="error")

@@ -66,7 +66,7 @@ class ServeHTTPServer:
     def __init__(self, gateway, host: str = "127.0.0.1", port: int = 0):
         root = gateway
 
-        def routes(name: str, body: dict):
+        def routes(name: str, body: dict, trace=None):
             # multiplexed gateways resolve the optional ``player`` field
             # (absent = default player; single-model gateways ignore it)
             gw = root
@@ -74,7 +74,8 @@ class ServeHTTPServer:
                 gw = gw.resolve(body.get("player"))
             if name == "act":
                 out = gw.act(
-                    body["session_id"], arrayify(body["obs"]), body.get("timeout_s")
+                    body["session_id"], arrayify(body["obs"]), body.get("timeout_s"),
+                    trace=trace,
                 )
                 return jsonable(out)
             if name == "reset":
@@ -115,12 +116,28 @@ class ServeHTTPServer:
                 self.end_headers()
 
             def do_POST(self):
+                from ..obs import (
+                    finish_trace,
+                    format_traceparent,
+                    join_trace,
+                    parse_traceparent,
+                    wire_ctx,
+                )
+
                 name = self.path.strip("/").split("/")[-1]
                 length = int(self.headers.get("Content-Length", 0))
                 status = 200
+                # w3c traceparent propagation: a caller-supplied header joins
+                # this frontend's span under the caller's trace_id, and the
+                # gateway span joins under THAT — client-minted and
+                # server-side spans assemble into one waterfall
+                wire = parse_traceparent(self.headers.get("traceparent"))
+                ctx = join_trace(wire, f"http_{name}") if wire is not None else None
+                outcome = "ok"
                 try:
                     body = json.loads(self.rfile.read(length) or b"{}")
-                    info = routes(name, body)
+                    info = routes(name, body,
+                                  trace=wire_ctx(ctx) if ctx is not None else None)
                     payload = (
                         {"code": 404, "info": f"no route {name}"}
                         if info is None
@@ -132,13 +149,22 @@ class ServeHTTPServer:
                     # on the status line, not the body) — 503 + typed body
                     payload = e.to_wire()
                     status = 503
+                    outcome = "shed"
                 except ServeError as e:
                     payload = e.to_wire()
+                    outcome = "shed" if e.shed else "error"
                 except Exception as e:
                     payload = {"code": 1, "info": repr(e)}
+                    outcome = "error"
+                if ctx is not None and isinstance(payload, dict):
+                    payload.setdefault("trace_id", ctx["trace_id"])
                 data = json.dumps(payload, default=str).encode()
                 self.send_response(status)
                 self.send_header("Content-Type", "application/json")
+                if ctx is not None:
+                    # echo the joined context so HTTP callers can correlate
+                    self.send_header("traceparent", format_traceparent(ctx))
+                    finish_trace(ctx, "http_done", outcome=outcome)
                 self.send_header("Content-Length", str(len(data)))
                 self.end_headers()
                 self.wfile.write(data)

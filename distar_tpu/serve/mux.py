@@ -68,9 +68,10 @@ class GatewayMux:
         return self.gateways[self.default_player]
 
     # -------------------------------------------- default-player delegation
-    def act(self, session_id, obs, timeout_s=None, want_teacher=False):
+    def act(self, session_id, obs, timeout_s=None, want_teacher=False,
+            trace=None):
         return self._default.act(session_id, obs, timeout_s,
-                                 want_teacher=want_teacher)
+                                 want_teacher=want_teacher, trace=trace)
 
     def act_many(self, requests, timeout_s=None):
         return self._default.act_many(requests, timeout_s=timeout_s)

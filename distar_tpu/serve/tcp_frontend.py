@@ -56,7 +56,15 @@ from typing import Optional
 
 from ..comm import shm_ring
 from ..comm.serializer import recv_msg, send_msg
-from ..obs import get_registry
+from ..obs import (
+    finish_trace,
+    get_registry,
+    is_trace,
+    set_active_trace,
+    start_trace,
+    tracing_enabled,
+    wire_ctx,
+)
 from ..resilience import RetryPolicy, retry_call
 from .errors import ServeError, error_from_wire
 
@@ -239,7 +247,8 @@ class ServeTCPServer:
                 gw = gw.resolve(req.get("player"))
             if op == "act":
                 out = gw.act(req["session_id"], req["obs"], req.get("timeout_s"),
-                             want_teacher=bool(req.get("want_teacher", False)))
+                             want_teacher=bool(req.get("want_teacher", False)),
+                             trace=req.get("trace"))
                 return {"code": 0, "outputs": out}
             if op == "act_many":
                 results = gw.act_many(req["requests"], req.get("timeout_s"))
@@ -392,29 +401,114 @@ class ServeClient:
         return req
 
     def act(self, session_id: str, obs, timeout_s: Optional[float] = None,
-            want_teacher: bool = False, player: Optional[str] = None) -> dict:
+            want_teacher: bool = False, player: Optional[str] = None,
+            trace: Optional[dict] = None) -> dict:
+        """One agent step. A client-side span rides the wire as the compact
+        ``trace`` field, so the gateway's server span joins under the same
+        trace_id (client -> gateway in one waterfall). ``trace`` lets the
+        caller supply its own context (it then owns finishing it — the
+        FleetClient/loadgen contract); otherwise one is minted and finished
+        here. Typed errors gain ``.trace_id`` either way."""
+        owned = None
+        ctx = trace
+        if ctx is None and tracing_enabled():
+            ctx = owned = start_trace("serve_client", session=session_id)
         req = {"op": "act", "session_id": session_id, "obs": obs}
         if timeout_s is not None:
             req["timeout_s"] = timeout_s
         if want_teacher:
             req["want_teacher"] = True
-        return self._call(self._stamp(req, player))["outputs"]
+        if is_trace(ctx):
+            req["trace"] = wire_ctx(ctx)
+        # active trace: transport-level blocking (shm ring-full) attributes
+        # its wait to this request's span — only the shm leg consumes it
+        on_shm = self._shm is not None
+        prev = set_active_trace(ctx) if on_shm else None
+        try:
+            out = self._call(self._stamp(req, player))["outputs"]
+        except BaseException as e:
+            if is_trace(ctx) and isinstance(e, ServeError):
+                e.trace_id = ctx["trace_id"]
+            finish_trace(owned, "client_done",
+                         outcome="shed" if getattr(e, "shed", False) else "error")
+            raise
+        finally:
+            if on_shm:
+                set_active_trace(prev)
+        if is_trace(ctx) and isinstance(out, dict):
+            out.setdefault("trace_id", ctx["trace_id"])
+        finish_trace(owned, "client_done")
+        return out
 
     def act_many(self, requests, timeout_s: Optional[float] = None,
                  player: Optional[str] = None) -> list:
         """One cycle of requests in one frame; returns a per-request list of
         output dicts or typed ``ServeError`` INSTANCES (per-lane sheds come
         back as values, not raises — partial success keeps its lanes).
+        Each request dict may carry a caller-minted full context under
+        ``trace_ctx`` (client-side only — never serialized; the caller owns
+        finishing it); lanes without one get a span minted and finished
+        here. Either way only the compact wire field crosses the socket.
         NOTE: a transport retry re-executes the WHOLE cycle server-side
         (at-least-once), which advances succeeded lanes' carries once more —
         acceptable on the restart path, where carries re-materialize from
         zero anyway."""
-        req = {"op": "act_many", "requests": list(requests)}
+        requests = list(requests)
+        if not tracing_enabled() and not any("trace_ctx" in r for r in requests):
+            # untraced fast path: byte-identical to the pre-tracing wire,
+            # zero per-lane copies
+            req = {"op": "act_many", "requests": requests}
+            if timeout_s is not None:
+                req["timeout_s"] = timeout_s
+            entries = self._call(self._stamp(req, player))["results"]
+            return [e["ok"] if isinstance(e, dict) and "ok" in e
+                    else error_from_wire(e) for e in entries]
+        ctxs, owned, wire_reqs = [], [], []
+        for r in requests:
+            ctx = r.get("trace_ctx")
+            mine = None
+            if ctx is None and tracing_enabled():
+                ctx = mine = start_trace("serve_client",
+                                         session=r.get("session_id", "?"))
+            wr = {k: v for k, v in r.items() if k != "trace_ctx"}
+            if is_trace(ctx):
+                wr["trace"] = wire_ctx(ctx)
+            else:
+                ctx = None
+            ctxs.append(ctx)
+            owned.append(mine)
+            wire_reqs.append(wr)
+        req = {"op": "act_many", "requests": wire_reqs}
         if timeout_s is not None:
             req["timeout_s"] = timeout_s
-        entries = self._call(self._stamp(req, player))["results"]
-        return [e["ok"] if isinstance(e, dict) and "ok" in e else error_from_wire(e)
-                for e in entries]
+        on_shm = self._shm is not None
+        prev = set_active_trace(
+            next((c for c in ctxs if c is not None), None)) if on_shm else None
+        try:
+            entries = self._call(self._stamp(req, player))["results"]
+        except BaseException:
+            for mine in owned:
+                finish_trace(mine, "client_done", outcome="error")
+            raise
+        finally:
+            if on_shm:
+                set_active_trace(prev)
+        out = []
+        for ctx, mine, e in zip(ctxs, owned, entries):
+            if isinstance(e, dict) and "ok" in e:
+                val = e["ok"]
+                if ctx is not None and isinstance(val, dict):
+                    val.setdefault("trace_id", ctx["trace_id"])
+                finish_trace(mine, "client_done")
+                out.append(val)
+            else:
+                err = error_from_wire(e)
+                if ctx is not None:
+                    err.trace_id = ctx["trace_id"]
+                finish_trace(mine, "client_done",
+                             outcome="shed" if err.shed else "error")
+                out.append(err)
+        return out
 
     def reserve(self, session_ids, player: Optional[str] = None) -> dict:
         """Bulk session pre-allocation; typed ``CapacityError`` on shortfall

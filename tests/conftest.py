@@ -17,6 +17,15 @@ flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
 
+# implicit request-span minting OFF suite-wide (the DISTAR_PERF_AOT=0
+# precedent): hundreds of serve/replay tests would otherwise each pay the
+# tracing hot path for zero test value on a 1-core CI host. Explicit
+# ``start_trace``/``finish_trace`` calls (the PR 1 trajectory pipeline)
+# are unaffected; tracing tests opt back in via ``obs.set_tracing(True)``
+# (tests/test_trace_fleet.py) and its subprocesses via DISTAR_TRACE=1.
+# Must be set BEFORE distar_tpu.obs imports (the flag is read at import).
+os.environ.setdefault("DISTAR_TRACE", "0")
+
 import jax
 
 jax.config.update("jax_platforms", "cpu")

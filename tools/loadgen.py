@@ -65,6 +65,67 @@ from distar_tpu.serve import (  # noqa: E402
 )
 
 
+class _TraceTap:
+    """Per-run trace bookkeeping (``--trace``): mints a root span per
+    request, finishes it with the outcome, and remembers the trace_ids of
+    the slowest and shedded requests so the summary links straight to
+    retrievable waterfalls (``opsctl trace --id <id>``)."""
+
+    def __init__(self, enabled: bool):
+        self.enabled = bool(enabled)
+        # per-thread buckets, merged at summary time: the tap must not add
+        # a contended lock to every request of the very bench that measures
+        # tracing overhead
+        self._local = threading.local()
+        self._buckets: List[dict] = []
+        self._buckets_lock = threading.Lock()
+
+    def _bucket(self) -> dict:
+        b = getattr(self._local, "b", None)
+        if b is None:
+            b = self._local.b = {"ok": [], "shed": []}
+            with self._buckets_lock:
+                self._buckets.append(b)
+        return b
+
+    def mint(self, session: str):
+        if not self.enabled:
+            return None
+        from distar_tpu.obs import start_trace
+
+        return start_trace("loadgen_request", session=session)
+
+    def done(self, ctx, dt: Optional[float] = None, outcome: str = "ok") -> None:
+        if ctx is None:
+            return
+        from distar_tpu.obs import finish_trace
+
+        finish_trace(ctx, "loadgen_done", outcome=outcome)
+        b = self._bucket()
+        if outcome == "ok" and dt is not None:
+            ok = b["ok"]
+            ok.append((dt, ctx["trace_id"]))
+            if len(ok) > 4096:  # keep the tail bounded mid-run
+                ok.sort(key=lambda p: -p[0])
+                del ok[256:]
+        elif outcome == "shed":
+            shed = b["shed"]
+            shed.append(ctx["trace_id"])
+            del shed[:-16]
+
+    def summary(self) -> dict:
+        if not self.enabled:
+            return {}
+        with self._buckets_lock:
+            buckets = list(self._buckets)
+        ok = [p for b in buckets for p in b["ok"]]
+        shed = [t for b in buckets for t in b["shed"]]
+        top = sorted(ok, key=lambda p: -p[0])[:5]
+        return {"slowest_traces": [
+            {"trace_id": t, "latency_s": round(d, 6)} for d, t in top],
+            "shed_traces": shed[-5:]}
+
+
 class _Stats:
     def __init__(self):
         self.lat: List[float] = []
@@ -106,8 +167,11 @@ class _InprocTarget:
         self.gateway.load_version("v1", params={"version": "v1", "bias": 0.0},
                                   activate=True)
 
-    def act(self, session: str, obs, timeout_s: float):
-        return self.gateway.act(session, obs, timeout_s)
+    def act(self, session: str, obs, timeout_s: float, trace=None):
+        from distar_tpu.obs import wire_ctx
+
+        return self.gateway.act(session, obs, timeout_s,
+                                trace=wire_ctx(trace) if trace else None)
 
     def end(self, session: str) -> None:
         self.gateway.end_session(session)
@@ -132,8 +196,8 @@ class _TcpTarget:
             c = self._local.c = self._mk()
         return c
 
-    def act(self, session: str, obs, timeout_s: float):
-        return self._client().act(session, obs, timeout_s)
+    def act(self, session: str, obs, timeout_s: float, trace=None):
+        return self._client().act(session, obs, timeout_s, trace=trace)
 
     def end(self, session: str) -> None:
         self._client().end(session)
@@ -150,13 +214,14 @@ class _HttpTarget:
     def __init__(self, addr: str):
         self._base = f"http://{addr}/serve"
 
-    def _post(self, route: str, body: dict) -> dict:
+    def _post(self, route: str, body: dict, headers: Optional[dict] = None) -> dict:
         import urllib.error
         import urllib.request
 
         req = urllib.request.Request(
             f"{self._base}/{route}", data=json.dumps(body).encode(),
-            headers={"Content-Type": "application/json"}, method="POST",
+            headers={"Content-Type": "application/json", **(headers or {})},
+            method="POST",
         )
         try:
             with urllib.request.urlopen(req, timeout=30) as resp:
@@ -170,12 +235,19 @@ class _HttpTarget:
             raise RuntimeError(out.get("error") or out.get("info"))
         return out["info"]
 
-    def act(self, session: str, obs, timeout_s: float):
+    def act(self, session: str, obs, timeout_s: float, trace=None):
+        headers = {}
+        if trace is not None:
+            from distar_tpu.obs import format_traceparent
+
+            tp = format_traceparent(trace)
+            if tp:
+                headers["traceparent"] = tp
         return self._post("act", {
             "session_id": session,
             "obs": {k: np.asarray(v).tolist() for k, v in obs.items()},
             "timeout_s": timeout_s,
-        })
+        }, headers=headers)
 
     def end(self, session: str) -> None:
         self._post("end", {"session_id": session})
@@ -234,6 +306,7 @@ def run_fleet_loadgen(
     timeout_s: float = 10.0,
     tcp: Optional[str] = None,
     artifact: Optional[str] = None,
+    trace: bool = False,
 ) -> dict:
     """The multi-gateway capacity harness (``--mode fleet``); importable —
     the fleet smoke test and the FLEET_r* artifact runs call this. Returns
@@ -267,6 +340,7 @@ def run_fleet_loadgen(
         levels = sorted({max(1, capacity // 6), max(1, capacity // 2),
                          capacity, capacity + max(1, capacity // 4)})
     artifact_lines: List[dict] = []
+    tap = _TraceTap(trace)
     from distar_tpu.serve.fleet import FleetRouter
 
     # ONE router (pins, migration accounting, down-list) shared by
@@ -289,33 +363,39 @@ def run_fleet_loadgen(
             arrived = threading.Barrier(fleet_workers + 1)
             sampled = threading.Barrier(fleet_workers + 1)
 
+            def traced_act(fc, sid: str) -> str:
+                ctx = tap.mint(sid)
+                t0 = time.perf_counter()
+                try:
+                    fc.act(sid, obs, timeout_s, trace=ctx)
+                    dt = time.perf_counter() - t0
+                    stats.record(dt, "ok")
+                    tap.done(ctx, dt, "ok")
+                    return "ok"
+                except ShedError:
+                    stats.record(None, "shed")
+                    tap.done(ctx, outcome="shed")
+                    return "shed"
+                except Exception:
+                    stats.record(None, "error")
+                    tap.done(ctx, outcome="error")
+                    return "error"
+
             def worker(w: int, sids: List[str]) -> None:
                 fc = clients[w]
                 mine = live_sessions[w]
                 for sid in sids:  # arrival pass: allocate the sticky slot
-                    t0 = time.perf_counter()
-                    try:
-                        fc.act(sid, obs, timeout_s)
-                        stats.record(time.perf_counter() - t0, "ok")
+                    kind = traced_act(fc, sid)
+                    if kind == "ok":
                         mine.append(sid)
-                    except ShedError:
-                        stats.record(None, "shed")
+                    elif kind == "shed":
                         with lock:
                             shed_arrival[0] += 1
-                    except Exception:
-                        stats.record(None, "error")
                 arrived.wait()
                 sampled.wait()  # main thread reads live residency here
                 for _step in range(max(requests_per_session - 1, 0)):
                     for sid in mine:
-                        t0 = time.perf_counter()
-                        try:
-                            fc.act(sid, obs, timeout_s)
-                            stats.record(time.perf_counter() - t0, "ok")
-                        except ShedError:
-                            stats.record(None, "shed")
-                        except Exception:
-                            stats.record(None, "error")
+                        traced_act(fc, sid)
                 for sid in mine:
                     try:
                         fc.end(sid)
@@ -383,6 +463,9 @@ def run_fleet_loadgen(
         "fleet_curve": curve,
         "migrations": snap.get("distar_fleet_session_migrations_total", 0.0),
         "errors_total": sum(r["errors"] for r in curve),
+        # --trace: the bench artifact links straight to retrievable
+        # waterfalls (opsctl trace --id <trace_id>)
+        **tap.summary(),
     }
     emit(summary, artifact_lines)
     if artifact:
@@ -412,6 +495,7 @@ def run_loadgen(
     gateways: int = 3,
     fleet_levels: str = "",
     fleet_workers: int = 32,
+    trace: bool = False,
 ) -> dict:
     """Importable driver (the slow soak test calls this). Returns the
     summary dict that is also the last stdout JSON line."""
@@ -422,7 +506,7 @@ def run_loadgen(
             fleet_workers=fleet_workers,
             requests_per_session=requests_per_session,
             mock_delay_s=mock_delay_s, timeout_s=timeout_s, tcp=tcp,
-            artifact=artifact)
+            artifact=artifact, trace=trace)
     if tcp:
         target = _TcpTarget(tcp)
     elif http:
@@ -431,19 +515,25 @@ def run_loadgen(
         target = _InprocTarget(slots, mock_delay_s, max_delay_s, queue_capacity,
                                idle_ttl_s=idle_ttl_s)
     stats = _Stats()
+    tap = _TraceTap(trace)
     artifact_lines: List[dict] = []
     stop_at = time.perf_counter() + duration_s
     swapped = threading.Event()
 
     def one(session: str, i: int) -> None:
+        ctx = tap.mint(session)
         t0 = time.perf_counter()
         try:
-            target.act(session, _make_obs(i), timeout_s)
-            stats.record(time.perf_counter() - t0, "ok")
+            target.act(session, _make_obs(i), timeout_s, trace=ctx)
+            dt = time.perf_counter() - t0
+            stats.record(dt, "ok")
+            tap.done(ctx, dt, "ok")
         except ShedError:
             stats.record(None, "shed")
+            tap.done(ctx, outcome="shed")
         except Exception:
             stats.record(None, "error")
+            tap.done(ctx, outcome="error")
 
     def maybe_swap(done_frac: float) -> None:
         if swap_at and done_frac >= swap_at and not swapped.is_set():
@@ -468,13 +558,17 @@ def run_loadgen(
             sessions_started[0] += 1
         i = 0
         while i < requests_per_session:
+            ctx = tap.mint(sid)
             t0 = time.perf_counter()
             try:
-                target.act(sid, _make_obs(i), timeout_s)
-                stats.record(time.perf_counter() - t0, "ok")
+                target.act(sid, _make_obs(i), timeout_s, trace=ctx)
+                dt = time.perf_counter() - t0
+                stats.record(dt, "ok")
+                tap.done(ctx, dt, "ok")
                 i += 1
             except ShedError:
                 stats.record(None, "shed")
+                tap.done(ctx, outcome="shed")
                 if i == 0:  # no slot for this session: the farm is full
                     with sess_lock:
                         sessions_shed[0] += 1
@@ -482,6 +576,7 @@ def run_loadgen(
                 time.sleep(0.01)
             except Exception:
                 stats.record(None, "error")
+                tap.done(ctx, outcome="error")
                 return
         try:
             target.end(sid)
@@ -552,6 +647,9 @@ def run_loadgen(
         # the eval-farm sizing number: what fraction of offered work the
         # gateway refused (typed sheds / everything offered)
         "shed_rate": round(stats.shed / max(total, 1), 4),
+        # --trace: trace_ids of the slowest/shedded requests, retrievable
+        # as waterfalls via opsctl trace --id <id>
+        **tap.summary(),
     }
     if mode == "sessions":
         summary["sessions"] = {
@@ -618,8 +716,48 @@ def main() -> None:
     p.add_argument("--tcp", help="host:port of a running serve TCP frontend")
     p.add_argument("--http", help="host:port of a running serve HTTP frontend")
     p.add_argument("--artifact", help="also write the JSON lines to this path")
+    p.add_argument("--trace", action="store_true",
+                   help="mint a distributed-trace span per request; the "
+                        "summary then names the trace_ids of the slowest "
+                        "and shedded requests (opsctl trace --id <id>)")
+    p.add_argument("--coordinator", default="",
+                   help="with --trace: ship this process's tail-sampled "
+                        "client spans (and telemetry) to the coordinator at "
+                        "host:port, so the summary's trace_ids resolve to "
+                        "FULL waterfalls — client span joined with the "
+                        "gateway spans the fleet ships — via opsctl trace")
+    p.add_argument("--no-trace-minting", action="store_true",
+                   help="force span minting OFF process-wide (the overhead "
+                        "A/B posture — also disables server-side joins in "
+                        "the in-process gateway)")
     args = p.parse_args()
-    run_loadgen(**{k.replace("-", "_"): v for k, v in vars(args).items()})
+    if args.no_trace_minting:
+        from distar_tpu.obs import set_tracing
+
+        set_tracing(False)
+    shipper = None
+    if args.coordinator and args.trace:
+        from distar_tpu.obs import TelemetryShipper
+
+        chost, _, cport = args.coordinator.rpartition(":")
+        shipper = TelemetryShipper(
+            source=f"loadgen:{os.getpid()}",
+            coordinator_addr=(chost or "127.0.0.1", int(cport)),
+            interval_s=1.0).start()
+    kwargs = {k.replace("-", "_"): v for k, v in vars(args).items()}
+    kwargs.pop("no_trace_minting", None)
+    kwargs.pop("coordinator", None)
+    try:
+        run_loadgen(**kwargs)
+    finally:
+        if shipper is not None:
+            shipper.stop()
+            try:
+                # final flush: the tail kept since the last tick must reach
+                # the broker before this short-lived process exits
+                shipper.ship_once()
+            except Exception:
+                pass
 
 
 if __name__ == "__main__":

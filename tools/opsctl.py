@@ -10,6 +10,9 @@ serve HTTP frontend, or a training role started with --metrics-port):
         --name distar_learner_step_seconds_p50 [--window 300] [--source local]
   python tools/opsctl.py profile      --addr <learner-admin host:port> \\
         [--steps 2] [--timeout 600]
+  python tools/opsctl.py trace        --addr 127.0.0.1:8423 \\
+        [--name serve_request] [--min-ms 50] [--outcome shed] [--limit 20]
+  python tools/opsctl.py trace        --addr 127.0.0.1:8423 --id <trace_id>
 
 ``status`` exits 0 when healthy, 1 when any rule is warning, 2 when firing —
 scriptable for cron probes; it also prints a per-role step-time/MFU digest
@@ -37,6 +40,14 @@ last scaling decision with its reason. ``profile`` talks to a LEARNER ADMIN surf
 (``rl_train --admin-port``): captures --steps iterations of jax.profiler
 trace on the live learner and prints the ranked per-bucket attribution
 table (obs/traceview.py).
+``trace`` is the distributed-tracing consumer: without ``--id`` it lists
+the retained traces (coordinator trace store + the probed process's own
+tail-sampled buffer; filter by ``--name/--min-ms/--outcome`` — sheds and
+errors are always retained by the tail sampler, so ``--outcome shed``
+answers "show me a request we refused"); with ``--id`` it fetches one
+trace's spans and renders the waterfall + ranked critical-path table
+(obs/waterfall.py) — client/router/gateway spans joined under one
+trace_id, with queue-wait vs service-time decomposition per process.
 """
 from __future__ import annotations
 
@@ -493,6 +504,47 @@ def cmd_query(args) -> int:
     return 0
 
 
+def cmd_trace(args) -> int:
+    """Distributed-trace consumer: list retained traces, or render one
+    trace's waterfall + ranked critical path. Exit 0 on success, 1 when
+    nothing matched (scriptable: a bench can assert its slow request is
+    retrievable)."""
+    from distar_tpu.obs.waterfall import build_waterfall, render_listing, render_waterfall
+
+    if args.id:
+        body = _try_get(args.addr, f"/trace/{args.id}", timeout=10.0)
+        if not body or not body.get("spans"):
+            print(f"no spans for trace {args.id!r} at {args.addr}")
+            return 1
+        if args.json:
+            print(json.dumps(body, indent=1))
+            return 0
+        report = body.get("waterfall") or build_waterfall(body["spans"])
+        print(render_waterfall(report))
+        return 0
+    qs = [f"limit={args.limit}"]
+    if args.name:
+        qs.append(f"name={urllib.parse.quote(args.name)}")
+    if args.min_ms:
+        qs.append(f"min_ms={args.min_ms}")
+    if args.outcome:
+        qs.append(f"outcome={urllib.parse.quote(args.outcome)}")
+    body = _try_get(args.addr, "/traces?" + "&".join(qs), timeout=10.0)
+    if body is None:
+        raise SystemExit(f"GET /traces failed at {args.addr} (no trace surface?)")
+    rows = body.get("traces") or []
+    if args.json:
+        print(json.dumps(body, indent=1))
+        return 0 if rows else 1
+    print(render_listing(rows), end="")
+    ing = body.get("ingest") or {}
+    buf = body.get("buffer") or {}
+    print(f"(ingest: {ing.get('records', 0)} records / "
+          f"{ing.get('sources', 0)} sources; local buffer: "
+          f"{buf.get('resident', 0)}/{buf.get('maxlen', 0)})")
+    return 0 if rows else 1
+
+
 def cmd_profile(args) -> int:
     """On-demand fleet profiling: POST /learner/profile?steps=N on a live
     learner's admin surface, print the ranked bucket table. Blocks while
@@ -526,7 +578,8 @@ def cmd_profile(args) -> int:
 def main() -> int:
     p = argparse.ArgumentParser(description=__doc__,
                                 formatter_class=argparse.RawDescriptionHelpFormatter)
-    p.add_argument("command", choices=("status", "tail-alerts", "query", "profile"))
+    p.add_argument("command", choices=("status", "tail-alerts", "query",
+                                       "profile", "trace"))
     p.add_argument("--addr", default="127.0.0.1:8423", help="host:port of a health surface")
     p.add_argument("--interval", type=float, default=2.0, help="tail-alerts poll cadence")
     p.add_argument("--once", action="store_true",
@@ -547,6 +600,15 @@ def main() -> int:
                    help="profile: iterations of device trace to capture")
     p.add_argument("--timeout", type=float, default=600.0,
                    help="profile: learner-side capture+analysis budget (s)")
+    p.add_argument("--id", default="",
+                   help="trace: render this trace_id's waterfall instead of "
+                        "listing")
+    p.add_argument("--min-ms", type=float, default=0.0,
+                   help="trace: list only traces at least this slow")
+    p.add_argument("--outcome", default="",
+                   help="trace: filter by outcome (ok/shed/error)")
+    p.add_argument("--limit", type=int, default=20,
+                   help="trace: max listings")
     args = p.parse_args()
     if args.command == "status":
         return cmd_status(args)
@@ -554,6 +616,8 @@ def main() -> int:
         return cmd_tail_alerts(args)
     if args.command == "profile":
         return cmd_profile(args)
+    if args.command == "trace":
+        return cmd_trace(args)
     if not args.name:
         p.error("query requires --name")
     return cmd_query(args)

@@ -57,7 +57,23 @@ TRAJ_END = "<!-- perf-trajectory:end -->"
 # ------------------------------------------------------------------- loading
 def load_artifact(path: str) -> dict:
     with open(path) as f:
-        doc = json.load(f)
+        text = f.read()
+    try:
+        doc = json.loads(text)
+    except ValueError:
+        # bench-driver JSON-LINES artifact (loadgen/trace_overhead
+        # convention: one row per line, the LAST line is the summary) —
+        # these used to be skipped silently, which kept e.g. FLEET_r12 out
+        # of the trajectory and the scaling sweep
+        docs = []
+        for line in text.splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            docs.append(json.loads(line))
+        if not docs:
+            raise
+        doc = docs[-1]
     # driver wrapper format {n, cmd, rc, tail, parsed} -> the parsed result
     if isinstance(doc, dict) and "parsed" in doc and "tail" in doc:
         return {"_wrapper": doc, **(doc.get("parsed") or {})}
@@ -314,11 +330,13 @@ def collect_trajectory(repo: str = _REPO) -> List[dict]:
                        + glob.glob(os.path.join(repo, "REPLAY_SHARD_r*.json"))
                        + glob.glob(os.path.join(repo, "FLEET_r*.json"))
                        + glob.glob(os.path.join(repo, "SHM_r*.json"))
+                       + glob.glob(os.path.join(repo, "TRACE_r*.json"))
                        + glob.glob(os.path.join(repo, "artifacts", "perf_baseline*.json"))
                        + glob.glob(os.path.join(repo, "artifacts", "rollout_*.json"))
                        + glob.glob(os.path.join(repo, "artifacts", "replay_*.json"))
                        + glob.glob(os.path.join(repo, "artifacts", "fleet_*.json"))
-                       + glob.glob(os.path.join(repo, "artifacts", "shm_*.json"))):
+                       + glob.glob(os.path.join(repo, "artifacts", "shm_*.json"))
+                       + glob.glob(os.path.join(repo, "artifacts", "trace_*.json"))):
         try:
             doc = load_artifact(path)
         except (OSError, ValueError):
@@ -360,6 +378,17 @@ def collect_trajectory(repo: str = _REPO) -> List[dict]:
                     "value": doc["shm_vs_tcp_cpu"], "unit": "x",
                     "status": _status_of(doc),
                 })
+        if doc.get("envelope_pct") is not None:
+            # the tracing-overhead artifact: surface the A/B verdict as its
+            # own row (the untraced arm is the comparison baseline)
+            rows.append({
+                "round": _round_of(path), "artifact": os.path.basename(path),
+                "metric": "tracing on-vs-off within the stated "
+                          f"{doc.get('envelope_pct'):g}% envelope",
+                "value": 1.0 if doc.get("within_envelope") else 0.0,
+                "unit": "bool",
+                "status": _status_of(doc),
+            })
         fast = doc.get("replay_fast_path") or {}
         if fast.get("vs_tcp_loopback"):
             # the sharded-replay artifact carries the colocated fast-path
