@@ -1,0 +1,26 @@
+"""Project-invariant static analyzer.
+
+One parsed-module cache, per-checker AST visitors, a committed baseline of
+grandfathered findings, and JSON + ranked-markdown reports — the mechanical
+enforcement of the invariants this codebase learned the hard way (callbacks
+dispatched outside locks, shm views released on every path, host numpy never
+donated into jitted steps, typed wire errors instead of bare excepts). See
+docs/analysis.md for the rule catalog and the incident each rule encodes.
+
+Driver: ``python tools/analyze.py`` (tier-1 runs it via
+tests/test_analysis.py::test_analysis_repo_clean). The dynamic witness for
+the lock rules is ``analysis/lockwatch.py`` (``DISTAR_LOCKWATCH=1``).
+"""
+from .core import (  # noqa: F401
+    Analyzer,
+    AnalysisResult,
+    Checker,
+    Finding,
+    ParsedModule,
+    apply_baseline,
+    collect_files,
+    default_checkers,
+    load_baseline,
+    render_markdown,
+    save_baseline,
+)

@@ -1,0 +1,482 @@
+"""Analyzer framework: parsed-module cache, checkers, pragmas, baseline, reports.
+
+The three ad-hoc lints (no-print, metric-names, sockets) each walked the tree
+and parsed every file themselves; every new invariant would have added another
+full parse pass. Here the tree is parsed ONCE into ``ParsedModule`` objects
+(AST + source lines + pragma index + parent links) and every checker visits
+the shared cache. Checkers are small classes emitting ``Finding``s; the
+framework owns suppression (``# analysis: allow(<rule>) — <why>`` pragmas),
+the committed baseline of grandfathered findings (shrink-only: a baseline
+entry that no longer fires is itself an error), and rendering (JSON + ranked
+markdown). Exit-code contract (tools/analyze.py): 0 = clean,
+1 = baselined-only, 2 = new findings or stale baseline entries.
+"""
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+SEVERITIES = ("error", "warning")
+
+#: scoped suppression: ``# analysis: allow(rule-a,rule-b) — reason`` on the
+#: offending line or the line directly above it. The reason is REQUIRED —
+#: an unexplained suppression is itself a finding (pragma-no-reason).
+PRAGMA_RE = re.compile(
+    r"#\s*analysis:\s*allow\(\s*([A-Za-z0-9_,\s-]+?)\s*\)\s*(?:[—–-]+\s*(\S.*))?$"
+)
+
+#: legacy single-rule markers kept working so the pre-framework opt-outs
+#: (and their documented syntax) never break: marker -> rules it suppresses
+LEGACY_MARKERS = {
+    "# lint: allow-print": ("no-print",),
+    "# lint: allow-bare-except": ("socket-bare-except",),
+    "# lint: allow-no-timeout": ("socket-no-timeout",),
+}
+
+SKIP_DIRS = {"__pycache__", "_proto_gen", ".git", ".claude"}
+
+
+@dataclass
+class Finding:
+    """One rule violation. ``(rule, path, ident)`` is the baseline
+    fingerprint — ``ident`` defaults to the message and must stay stable
+    across unrelated edits (so never put line numbers in it)."""
+
+    rule: str
+    severity: str
+    path: str  # repo-relative (posix) when under the repo, else absolute
+    line: int
+    message: str
+    abspath: str = ""
+    ident: str = ""
+
+    def __post_init__(self):
+        assert self.severity in SEVERITIES, self.severity
+        if not self.ident:
+            self.ident = self.message
+
+    def fingerprint(self) -> Tuple[str, str, str]:
+        return (self.rule, self.path, self.ident)
+
+    def to_dict(self) -> dict:
+        return {
+            "rule": self.rule,
+            "severity": self.severity,
+            "path": self.path,
+            "line": self.line,
+            "message": self.message,
+        }
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}/{self.severity}] {self.message}"
+
+
+class ParsedModule:
+    """One parsed source file: AST, raw lines, pragma index, parent links.
+
+    Parsed lazily exactly once and shared by every checker (the whole point
+    of the framework: one parse pass instead of one per lint)."""
+
+    def __init__(self, abspath: str, relpath: str):
+        self.abspath = abspath
+        self.relpath = relpath  # forward-slash, repo-relative when possible
+        with open(abspath, "rb") as f:
+            self.source = f.read()
+        self.text = self.source.decode("utf-8", errors="replace")
+        self.lines = self.text.splitlines()
+        self.tree: Optional[ast.Module] = None
+        self.syntax_error: Optional[SyntaxError] = None
+        try:
+            self.tree = ast.parse(self.source, filename=abspath)
+        except SyntaxError as e:
+            self.syntax_error = e
+        self._parents: Optional[Dict[ast.AST, ast.AST]] = None
+        self._pragmas: Optional[Dict[int, List[Tuple[Tuple[str, ...], str]]]] = None
+
+    # ------------------------------------------------------------------ pragmas
+    @property
+    def pragmas(self) -> Dict[int, List[Tuple[Tuple[str, ...], str]]]:
+        """line -> [(rules, reason)] for every suppression comment."""
+        if self._pragmas is None:
+            out: Dict[int, List[Tuple[Tuple[str, ...], str]]] = {}
+            for i, line in enumerate(self.lines, start=1):
+                if "#" not in line:
+                    continue
+                m = PRAGMA_RE.search(line)
+                if m:
+                    rules = tuple(r.strip() for r in m.group(1).split(",") if r.strip())
+                    out.setdefault(i, []).append((rules, (m.group(2) or "").strip()))
+                for marker, rules in LEGACY_MARKERS.items():
+                    if marker in line:
+                        out.setdefault(i, []).append((rules, "legacy lint marker"))
+            self._pragmas = out
+        return self._pragmas
+
+    def pragma_for(self, line: int, rule: str) -> Optional[str]:
+        """Reason string when ``rule`` is suppressed at ``line`` (same line or
+        the line directly above); None otherwise. Empty reason returns ''."""
+        for at in (line, line - 1):
+            for rules, reason in self.pragmas.get(at, ()):
+                if rule in rules:
+                    return reason
+        return None
+
+    def line_text(self, line: int) -> str:
+        return self.lines[line - 1] if 0 < line <= len(self.lines) else ""
+
+    # ------------------------------------------------------------------ parents
+    @property
+    def parents(self) -> Dict[ast.AST, ast.AST]:
+        if self._parents is None:
+            parents: Dict[ast.AST, ast.AST] = {}
+            if self.tree is not None:
+                for node in ast.walk(self.tree):
+                    for child in ast.iter_child_nodes(node):
+                        parents[child] = node
+            self._parents = parents
+        return self._parents
+
+    def ancestors(self, node: ast.AST) -> Iterable[ast.AST]:
+        cur = self.parents.get(node)
+        while cur is not None:
+            yield cur
+            cur = self.parents.get(cur)
+
+    def enclosing_function(self, node: ast.AST) -> Optional[ast.AST]:
+        for a in self.ancestors(node):
+            if isinstance(a, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+                return a
+        return None
+
+    def enclosing_class(self, node: ast.AST) -> Optional[ast.ClassDef]:
+        for a in self.ancestors(node):
+            if isinstance(a, ast.ClassDef):
+                return a
+        return None
+
+
+# ----------------------------------------------------------------- AST helpers
+def call_name(node: ast.Call) -> str:
+    """Terminal name of the called thing ('recv' for sock.recv(...))."""
+    func = node.func
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return ""
+
+
+def dotted_name(expr: ast.AST) -> str:
+    """Best-effort dotted rendering ('self._lock', 'jax.device_get');
+    '' for anything that isn't a plain name/attribute chain."""
+    parts: List[str] = []
+    while isinstance(expr, ast.Attribute):
+        parts.append(expr.attr)
+        expr = expr.value
+    if isinstance(expr, ast.Name):
+        parts.append(expr.id)
+    elif not parts:
+        return ""
+    else:
+        parts.append("?")
+    return ".".join(reversed(parts))
+
+
+def walk_scope(node: ast.AST, skip_nested_defs: bool = True) -> Iterable[ast.AST]:
+    """Walk ``node``'s subtree; when ``skip_nested_defs``, do not descend into
+    nested function/lambda bodies (code there runs LATER, not here — a closure
+    defined under a lock does not execute under it)."""
+    stack = list(ast.iter_child_nodes(node))
+    while stack:
+        cur = stack.pop()
+        yield cur
+        if skip_nested_defs and isinstance(
+            cur, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+        ):
+            continue
+        stack.extend(ast.iter_child_nodes(cur))
+
+
+def is_library_path(relpath: str) -> bool:
+    """True for files inside the distar_tpu package, excluding CLI
+    entrypoints (bin/) — where the no-print rule applies."""
+    parts = relpath.replace(os.sep, "/").split("/")
+    if "distar_tpu" not in parts:
+        return False
+    after = parts[parts.index("distar_tpu") + 1:]
+    return "bin" not in after
+
+
+# --------------------------------------------------------------------- checker
+class Checker:
+    """Base checker: visit each parsed module, then a cross-module finalize.
+
+    ``rules`` maps rule-id -> default severity (the framework's report
+    groups by these). Checkers should emit findings through ``finding()`` so
+    severity defaults stay in one place."""
+
+    name = "checker"
+    rules: Dict[str, str] = {}
+
+    def finding(self, rule: str, mod: ParsedModule, line: int, message: str,
+                ident: str = "", severity: Optional[str] = None) -> Finding:
+        return Finding(
+            rule=rule,
+            severity=severity or self.rules[rule],
+            path=mod.relpath,
+            line=line,
+            message=message,
+            abspath=mod.abspath,
+            ident=ident,
+        )
+
+    def check_module(self, mod: ParsedModule) -> Iterable[Finding]:  # pragma: no cover
+        return ()
+
+    def finalize(self) -> Iterable[Finding]:
+        return ()
+
+
+# -------------------------------------------------------------------- analyzer
+def collect_files(paths: Sequence[str], repo_root: Optional[str] = None) -> List[str]:
+    """Expand files/dirs into a sorted list of .py files (skipping
+    __pycache__/_proto_gen). Non-.py files named explicitly are ignored."""
+    out: List[str] = []
+    for p in paths:
+        p = os.path.abspath(os.path.join(repo_root, p) if repo_root and not os.path.isabs(p) else p)
+        if os.path.isfile(p):
+            if p.endswith(".py"):
+                out.append(p)
+            continue
+        for dirpath, dirnames, filenames in os.walk(p):
+            dirnames[:] = sorted(d for d in dirnames if d not in SKIP_DIRS)
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    out.append(os.path.join(dirpath, fn))
+    return sorted(set(out))
+
+
+@dataclass
+class AnalysisResult:
+    findings: List[Finding] = field(default_factory=list)  # new (not baselined)
+    baselined: List[Finding] = field(default_factory=list)
+    suppressed: List[Tuple[Finding, str]] = field(default_factory=list)  # (finding, reason)
+    stale_baseline: List[dict] = field(default_factory=list)  # entries that no longer fire
+    files: int = 0
+    parse_errors: List[str] = field(default_factory=list)
+
+    @property
+    def exit_code(self) -> int:
+        if self.findings or self.stale_baseline:
+            return 2
+        if self.baselined:
+            return 1
+        return 0
+
+    def to_dict(self) -> dict:
+        return {
+            "files": self.files,
+            "exit_code": self.exit_code,
+            "findings": [f.to_dict() for f in self.findings],
+            "baselined": [f.to_dict() for f in self.baselined],
+            "suppressed": [
+                dict(f.to_dict(), reason=reason) for f, reason in self.suppressed
+            ],
+            "stale_baseline": list(self.stale_baseline),
+            "parse_errors": list(self.parse_errors),
+        }
+
+
+class Analyzer:
+    """Run a set of checkers over a file list with one shared parse cache."""
+
+    def __init__(self, repo_root: Optional[str] = None,
+                 checkers: Optional[Sequence[Checker]] = None,
+                 rules: Optional[Sequence[str]] = None):
+        self.repo_root = os.path.abspath(repo_root or repo_root_of(__file__))
+        self.checkers = list(checkers) if checkers is not None else default_checkers(self.repo_root)
+        self.rules = set(rules) if rules else None
+        self._cache: Dict[str, ParsedModule] = {}
+
+    def parse(self, abspath: str) -> ParsedModule:
+        mod = self._cache.get(abspath)
+        if mod is None:
+            try:
+                rel = os.path.relpath(abspath, self.repo_root)
+            except ValueError:  # different drive (windows); keep absolute
+                rel = abspath
+            relpath = abspath if rel.startswith("..") else rel.replace(os.sep, "/")
+            mod = ParsedModule(abspath, relpath)
+            self._cache[abspath] = mod
+        return mod
+
+    def run(self, files: Sequence[str],
+            baseline: Optional[List[dict]] = None) -> AnalysisResult:
+        result = AnalysisResult(files=len(files))
+        mods: List[ParsedModule] = []
+        for f in files:
+            mod = self.parse(f)
+            if mod.syntax_error is not None:
+                result.parse_errors.append(f"{mod.relpath}: {mod.syntax_error}")
+                continue
+            mods.append(mod)
+        raw: List[Finding] = []
+        for checker in self.checkers:
+            for mod in mods:
+                raw.extend(checker.check_module(mod))
+            raw.extend(checker.finalize())
+        if self.rules is not None:
+            raw = [f for f in raw if f.rule in self.rules]
+        # pragma suppression (framework-owned so every checker gets it free)
+        kept: List[Finding] = []
+        for f in raw:
+            mod = self._cache.get(f.abspath)
+            reason = mod.pragma_for(f.line, f.rule) if mod is not None else None
+            if reason is None:
+                kept.append(f)
+            elif reason == "":
+                # an unexplained suppression is itself a finding: the pragma
+                # contract is allow(<rule>) — <why>, and the why is the point
+                kept.append(Finding(
+                    rule="pragma-no-reason", severity="error", path=f.path,
+                    line=f.line, abspath=f.abspath,
+                    message=f"pragma suppressing {f.rule} has no reason — "
+                            f"write `# analysis: allow({f.rule}) — <why>`",
+                ))
+            else:
+                result.suppressed.append((f, reason))
+        kept.sort(key=lambda f: (f.path, f.line, f.rule))
+        new, matched, stale = apply_baseline(kept, baseline or [])
+        result.findings = new
+        result.baselined = matched
+        result.stale_baseline = stale
+        return result
+
+
+def repo_root_of(anchor: str) -> str:
+    """The repo root, assuming <root>/distar_tpu/analysis/core.py layout."""
+    return os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(anchor))))
+
+
+def default_checkers(repo_root: str) -> List[Checker]:
+    from .hygiene import HygieneChecker, MetricChecker
+    from .jaxrules import JaxHazardChecker
+    from .lifecycle import LifecycleChecker
+    from .locks import LockChecker
+    from .wire import WireChecker
+
+    return [
+        LockChecker(),
+        LifecycleChecker(),
+        WireChecker(),
+        JaxHazardChecker(),
+        HygieneChecker(),
+        MetricChecker(repo_root),
+    ]
+
+
+# -------------------------------------------------------------------- baseline
+def load_baseline(path: str) -> List[dict]:
+    if not os.path.exists(path):
+        return []
+    with open(path) as f:
+        data = json.load(f)
+    entries = data.get("entries", data) if isinstance(data, dict) else data
+    assert isinstance(entries, list), f"baseline {path}: expected a list"
+    return entries
+
+
+def save_baseline(path: str, findings: Sequence[Finding], note: str = "") -> None:
+    entries = [
+        {"rule": f.rule, "path": f.path, "ident": f.ident}
+        for f in sorted(findings, key=lambda f: (f.path, f.rule, f.ident))
+    ]
+    payload = {
+        "note": note or (
+            "Grandfathered findings. Shrink-only: entries that stop firing "
+            "MUST be removed (tools/analyze.py exits 2 on stale entries)."
+        ),
+        "entries": entries,
+    }
+    with open(path, "w") as f:
+        json.dump(payload, f, indent=1, sort_keys=True)
+        f.write("\n")
+
+
+def apply_baseline(findings: Sequence[Finding], baseline: Sequence[dict]
+                   ) -> Tuple[List[Finding], List[Finding], List[dict]]:
+    """Multiset-match findings against baseline entries on
+    (rule, path, ident). Returns (new, baselined, stale_entries) — stale =
+    baseline entries that matched nothing, which is an ERROR by contract:
+    the baseline may only shrink, never silently hold dead debt."""
+    budget: Dict[Tuple[str, str, str], int] = {}
+    for e in baseline:
+        key = (e.get("rule", ""), e.get("path", ""), e.get("ident", e.get("message", "")))
+        budget[key] = budget.get(key, 0) + 1
+    new: List[Finding] = []
+    matched: List[Finding] = []
+    for f in findings:
+        key = f.fingerprint()
+        if budget.get(key, 0) > 0:
+            budget[key] -= 1
+            matched.append(f)
+        else:
+            new.append(f)
+    stale = [
+        {"rule": r, "path": p, "ident": i, "count": n}
+        for (r, p, i), n in sorted(budget.items()) if n > 0
+    ]
+    return new, matched, stale
+
+
+# --------------------------------------------------------------------- reports
+def render_markdown(result: AnalysisResult, title: str = "analysis report") -> str:
+    """Ranked markdown: findings by rule x severity (errors first, biggest
+    families first), then the finding list, then baseline debt."""
+    lines = [f"# {title}", ""]
+    sev_rank = {"error": 0, "warning": 1}
+    by_rule: Dict[Tuple[str, str], int] = {}
+    for f in result.findings:
+        by_rule[(f.rule, f.severity)] = by_rule.get((f.rule, f.severity), 0) + 1
+    lines.append(
+        f"files: {result.files} · new findings: {len(result.findings)} · "
+        f"baselined debt: {len(result.baselined)} · "
+        f"pragma-suppressed: {len(result.suppressed)} · "
+        f"stale baseline entries: {len(result.stale_baseline)}"
+    )
+    lines.append("")
+    if by_rule:
+        lines += ["| rule | severity | count |", "|---|---|---|"]
+        for (rule, sev), n in sorted(
+            by_rule.items(), key=lambda kv: (sev_rank[kv[0][1]], -kv[1], kv[0][0])
+        ):
+            lines.append(f"| {rule} | {sev} | {n} |")
+        lines.append("")
+        for f in sorted(result.findings,
+                        key=lambda f: (sev_rank[f.severity], f.path, f.line)):
+            lines.append(f"- `{f.path}:{f.line}` **{f.rule}** ({f.severity}): {f.message}")
+        lines.append("")
+    if result.stale_baseline:
+        lines.append("## stale baseline entries (remove them — shrink-only)")
+        for e in result.stale_baseline:
+            lines.append(f"- {e['path']}: {e['rule']}: {e['ident']} (x{e['count']})")
+        lines.append("")
+    if result.baselined:
+        debt: Dict[str, int] = {}
+        for f in result.baselined:
+            debt[f.rule] = debt.get(f.rule, 0) + 1
+        lines.append("## baselined debt by rule")
+        for rule, n in sorted(debt.items(), key=lambda kv: -kv[1]):
+            lines.append(f"- {rule}: {n}")
+        lines.append("")
+    if result.parse_errors:
+        lines.append("## parse errors")
+        lines += [f"- {e}" for e in result.parse_errors]
+        lines.append("")
+    verdict = {0: "CLEAN", 1: "BASELINED-ONLY", 2: "NEW FINDINGS"}[result.exit_code]
+    lines.append(f"verdict: **{verdict}** (exit {result.exit_code})")
+    return "\n".join(lines) + "\n"

@@ -1,0 +1,206 @@
+"""JAX hazard checker: donation, device sync in loops, nondeterminism in jit.
+
+Encodes three incidents:
+
+* PR 2's heap corruption — donating a buffer that numpy's allocator owns
+  ("corrupted double-linked list" aborts): a jit built with
+  ``donate_argnums`` must never be fed host ``np.*`` arrays directly; restored
+  state routes through ``_place_state``/``assemble_global``/``device_put``
+  first — rule ``jax-donated-host-leaf``;
+* PR 8's decollate regression — one ``jax.device_get`` per leaf per loop
+  iteration serializes a device sync per element; fetch the whole pytree once
+  outside the loop and hand out views — rule ``jax-device-get-in-loop``;
+* trace-time nondeterminism — ``time.time()``/``random.*`` inside a jitted
+  function or a ``pure_callback`` body bakes one trace-time value into the
+  compiled program (or breaks cache keys) — rule ``jax-nondeterministic-jit``.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set
+
+from .core import Checker, Finding, ParsedModule, call_name, dotted_name, walk_scope
+
+NP_CTORS = {
+    "asarray", "array", "zeros", "ones", "empty", "full", "stack",
+    "concatenate", "copy", "frombuffer", "ascontiguousarray",
+}
+_NP_MODULES = {"np", "numpy"}
+
+#: dotted prefixes that launder a host array into an XLA-owned buffer
+PLACEMENT_CALLS = {"_place_state", "assemble_global", "device_put"}
+
+NONDET_CALLS = {
+    "time.time", "time.monotonic", "time.perf_counter", "time.time_ns",
+    "time.monotonic_ns", "datetime.now", "datetime.utcnow", "uuid.uuid4",
+    "random.random", "random.randint", "random.choice", "random.uniform",
+}
+_NONDET_PREFIXES = ("np.random.", "numpy.random.")
+
+
+def _is_np_ctor(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    func = node.func
+    if isinstance(func, ast.Attribute) and func.attr in NP_CTORS:
+        root = dotted_name(func.value)
+        return root.split(".", 1)[0] in _NP_MODULES
+    return False
+
+
+def _jit_call(node: ast.AST) -> Optional[ast.Call]:
+    """The jit-constructing Call inside ``node``, unwrapping partial(...)."""
+    if not isinstance(node, ast.Call):
+        return None
+    name = call_name(node)
+    if name == "jit":
+        return node
+    if name == "partial":
+        for arg in node.args:
+            if isinstance(arg, (ast.Attribute, ast.Name)) and \
+                    dotted_name(arg).rsplit(".", 1)[-1] == "jit":
+                return node
+        for arg in node.args:
+            inner = _jit_call(arg)
+            if inner is not None:
+                return inner
+    return None
+
+
+def _is_donated_jit(node: ast.AST) -> bool:
+    call = _jit_call(node)
+    return call is not None and any(
+        kw.arg in ("donate_argnums", "donate_argnames") for kw in call.keywords
+    )
+
+
+class JaxHazardChecker(Checker):
+    name = "jax"
+    rules = {
+        "jax-donated-host-leaf": "error",
+        "jax-device-get-in-loop": "warning",
+        "jax-nondeterministic-jit": "error",
+    }
+
+    def check_module(self, mod: ParsedModule) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        donated: Set[str] = set()      # names/attrs bound to donated jits
+        jitted_fns: List[ast.AST] = []  # function defs that trace under jit
+
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Assign) and _is_donated_jit(node.value):
+                for tgt in node.targets:
+                    d = dotted_name(tgt)
+                    if d:
+                        donated.add(d)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if any(
+                    dotted_name(dec).rsplit(".", 1)[-1] == "jit"
+                    or _jit_call(dec) is not None
+                    for dec in node.decorator_list
+                ):
+                    jitted_fns.append(node)
+            elif isinstance(node, ast.Call) and call_name(node) == "pure_callback":
+                # jax.pure_callback(fn, ...) executes fn at trace/runtime on
+                # host — its body must still be deterministic per input
+                if node.args and isinstance(node.args[0], ast.Name):
+                    target = node.args[0].id
+                    for fn in ast.walk(mod.tree):
+                        if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                                and fn.name == target:
+                            jitted_fns.append(fn)
+
+        findings.extend(self._check_donated_calls(mod, donated))
+        findings.extend(self._check_device_get_loops(mod))
+        for fn in jitted_fns:
+            findings.extend(self._check_nondet(mod, fn))
+        return findings
+
+    # ------------------------------------------------------------- donation
+    def _check_donated_calls(self, mod: ParsedModule, donated: Set[str]
+                             ) -> Iterable[Finding]:
+        if not donated:
+            return
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = dotted_name(node.func)
+            if callee not in donated:
+                continue
+            fn = mod.enclosing_function(node)
+            np_locals = self._np_locals(fn) if fn is not None else set()
+            for arg in node.args:
+                hazard = None
+                if _is_np_ctor(arg):
+                    hazard = f"{dotted_name(arg.func)}(...) result"
+                elif isinstance(arg, ast.Name) and arg.id in np_locals:
+                    hazard = f"host array {arg.id!r}"
+                if hazard:
+                    yield self.finding(
+                        "jax-donated-host-leaf", mod, node.lineno,
+                        f"{callee} was built with donate_argnums and is called "
+                        f"with {hazard} — donating a numpy-owned buffer is "
+                        f"heap corruption (PR 2); route it through "
+                        f"_place_state/assemble_global/device_put first",
+                        ident=f"donated call {callee} host arg",
+                    )
+
+    @staticmethod
+    def _np_locals(fn: ast.AST) -> Set[str]:
+        """Names assigned from np constructors in this function, minus names
+        later laundered through a placement call."""
+        hosts: Set[str] = set()
+        assigns = [n for n in walk_scope(fn, skip_nested_defs=True)
+                   if isinstance(n, ast.Assign)]
+        # source order matters: `x = np.zeros(...)` then `x = device_put(x)`
+        # launders x — processing out of order would re-taint it
+        for node in sorted(assigns, key=lambda n: n.lineno):
+            if _is_np_ctor(node.value):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        hosts.add(tgt.id)
+            elif isinstance(node.value, ast.Call) and \
+                    call_name(node.value) in PLACEMENT_CALLS:
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        hosts.discard(tgt.id)
+        return hosts
+
+    # -------------------------------------------------------- device_get loops
+    def _check_device_get_loops(self, mod: ParsedModule) -> Iterable[Finding]:
+        for node in ast.walk(mod.tree):
+            if not (isinstance(node, ast.Call) and call_name(node) == "device_get"):
+                continue
+            # nearest loop ancestor, unless a function boundary intervenes
+            # (a closure called from a loop is the call site's problem)
+            in_loop = False
+            for a in mod.ancestors(node):
+                if isinstance(a, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+                    break
+                if isinstance(a, (ast.For, ast.While)):
+                    in_loop = True
+                    break
+            if in_loop:
+                yield self.finding(
+                        "jax-device-get-in-loop", mod, node.lineno,
+                        "jax.device_get inside a loop — one device sync per "
+                        "iteration (PR 8's per-leaf regression); fetch the "
+                        "whole pytree once outside the loop and slice views",
+                        ident="device_get in loop",
+                    )
+
+    # --------------------------------------------------------- nondeterminism
+    def _check_nondet(self, mod: ParsedModule, fn: ast.AST) -> Iterable[Finding]:
+        for node in walk_scope(fn, skip_nested_defs=False):
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = dotted_name(node.func)
+            if dotted in NONDET_CALLS or dotted.startswith(_NONDET_PREFIXES):
+                yield self.finding(
+                    "jax-nondeterministic-jit", mod, node.lineno,
+                    f"{dotted}() inside a jitted/pure_callback body "
+                    f"({fn.name}) — the value is baked in at trace time, not "
+                    f"evaluated per step; pass it in as an argument or use "
+                    f"jax.random with explicit keys",
+                    ident=f"nondet {dotted} in {fn.name}",
+                )

@@ -1,0 +1,153 @@
+"""Resource-lifecycle checker: everything opened must have a reachable close.
+
+Encodes PR 11's ``SharedMemory`` teardown lesson (a segment or exported view
+not released on every path BufferErrors the whole process at interpreter
+shutdown) and the serve/replay ``stop()`` contract (PR 4: a stopped server
+must actually release its listener and join its threads, or the next bind
+fails and tests leak threads).
+
+Rules:
+
+* ``resource-unreleased`` — a ``self.X = socket/SharedMemory/open/Popen/...``
+  attribute with NO release call (``close``/``unlink``/``shutdown``/...) on
+  ``self.X`` anywhere in the class. Aliasing (``t = self.X``) and passing
+  ``self.X`` to another call count as delegated cleanup — the rule targets
+  resources that provably have no release path at all.
+* ``thread-unjoined`` — a ``self.X = threading.Thread(...)`` attribute that is
+  never ``join``ed: an error when the thread is non-daemon (it blocks
+  interpreter exit), a finding even for daemon threads when the class has a
+  ``stop``/``close``/``shutdown`` method (the class claims a lifecycle, so
+  stop-then-return must not race the still-running thread).
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from .core import Checker, Finding, ParsedModule, call_name, dotted_name
+
+#: terminal constructor name -> (kind, release-verbs)
+RESOURCE_CTORS: Dict[str, Tuple[str, Tuple[str, ...]]] = {
+    "socket": ("socket", ("close", "shutdown", "detach")),
+    "create_connection": ("socket", ("close", "shutdown", "detach")),
+    "SharedMemory": ("shared memory segment", ("close", "unlink")),
+    "Popen": ("subprocess", ("wait", "terminate", "kill", "communicate")),
+    "Timer": ("timer thread", ("cancel", "join")),
+    "open": ("file handle", ("close",)),
+}
+
+_STOPPISH = {"stop", "close", "shutdown", "__exit__", "__del__", "stop_autosave", "drain"}
+
+
+def _self_attr(expr: ast.AST) -> Optional[str]:
+    if (isinstance(expr, ast.Attribute) and isinstance(expr.value, ast.Name)
+            and expr.value.id == "self"):
+        return expr.attr
+    return None
+
+
+class LifecycleChecker(Checker):
+    name = "lifecycle"
+    rules = {
+        "resource-unreleased": "error",
+        "thread-unjoined": "warning",
+    }
+
+    def check_module(self, mod: ParsedModule) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.ClassDef):
+                findings.extend(self._check_class(mod, node))
+        return findings
+
+    # ------------------------------------------------------------------ class
+    def _check_class(self, mod: ParsedModule, cls: ast.ClassDef) -> Iterable[Finding]:
+        # attr -> (kind, releases, line, is_thread, daemon)
+        created: Dict[str, Tuple[str, Tuple[str, ...], int, bool, bool]] = {}
+        for stmt in ast.walk(cls):
+            if not isinstance(stmt, ast.Assign) or not isinstance(stmt.value, ast.Call):
+                continue
+            attr = next((a for t in stmt.targets if (a := _self_attr(t))), None)
+            if attr is None:
+                continue
+            ctor = call_name(stmt.value)
+            if ctor == "Thread":
+                daemon = any(
+                    kw.arg == "daemon" and isinstance(kw.value, ast.Constant)
+                    and kw.value.value is True
+                    for kw in stmt.value.keywords
+                )
+                created[attr] = ("thread", ("join",), stmt.lineno, True, daemon)
+            elif ctor in RESOURCE_CTORS:
+                kind, rel = RESOURCE_CTORS[ctor]
+                created[attr] = (kind, rel, stmt.lineno, False, False)
+        if not created:
+            return
+
+        released: Set[str] = set()
+        daemon_set: Set[str] = set()   # self.X.daemon = True after construction
+        has_stop = any(
+            isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)) and n.name in _STOPPISH
+            for n in cls.body
+        )
+        for node in ast.walk(cls):
+            if isinstance(node, ast.Call):
+                # self.X.release()/join()/... — the direct path
+                if isinstance(node.func, ast.Attribute):
+                    attr = _self_attr(node.func.value)
+                    if attr in created and node.func.attr in created[attr][1]:
+                        released.add(attr)
+                # delegated cleanup: self.X passed into any call
+                for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                    a = _self_attr(arg)
+                    if a in created:
+                        released.add(a)
+                # getattr(self, "X") is how optional-attr teardown reads it
+                if (call_name(node) == "getattr" and len(node.args) >= 2
+                        and isinstance(node.args[1], ast.Constant)
+                        and node.args[1].value in created):
+                    released.add(node.args[1].value)
+            # aliased cleanup: t = self.X — including the tuple-swap idiom
+            # `sock, self._sock = self._sock, None` (assume aliases close)
+            elif isinstance(node, ast.Assign):
+                for sub in ast.walk(node.value):
+                    a = _self_attr(sub)
+                    if a in created:
+                        released.add(a)
+                for tgt in node.targets:
+                    if (isinstance(tgt, ast.Attribute) and tgt.attr == "daemon"
+                            and (sa := _self_attr(tgt.value)) is not None):
+                        daemon_set.add(sa)
+            # with self.X: — context-managed release
+            elif isinstance(node, ast.With):
+                for item in node.items:
+                    a = _self_attr(item.context_expr)
+                    if a in created:
+                        released.add(a)
+
+        for attr, (kind, rel, line, is_thread, daemon) in sorted(created.items()):
+            if attr in released:
+                continue
+            daemon = daemon or attr in daemon_set
+            if is_thread:
+                if daemon and not has_stop:
+                    continue  # fire-and-forget daemon helper: acceptable
+                sev = "warning" if daemon else "error"
+                why = (
+                    "stop() returns while the thread may still run"
+                    if daemon else
+                    "a non-daemon thread with no join path blocks interpreter exit"
+                )
+                yield self.finding(
+                    "thread-unjoined", mod, line,
+                    f"{cls.name}.{attr} thread is never joined — {why}",
+                    ident=f"{cls.name}.{attr} unjoined", severity=sev,
+                )
+            else:
+                yield self.finding(
+                    "resource-unreleased", mod, line,
+                    f"{cls.name}.{attr} ({kind}) has no reachable release — "
+                    f"call {'/'.join(rel)} in stop()/__exit__/finally "
+                    f"(leaked handles strand peers and fail re-binds)",
+                    ident=f"{cls.name}.{attr} unreleased",
+                )

@@ -1,0 +1,184 @@
+"""Lock-discipline checker: what may happen inside a ``with self._lock:`` body.
+
+Encodes two incidents and one classic hazard:
+
+* PR 4's ``AlertRemediator`` lesson — user callbacks must be dispatched
+  OUTSIDE the lock that guards the callback list (a callback that re-enters
+  the subsystem deadlocks; one that blocks starves every other waiter) —
+  rule ``lock-callback-dispatch``;
+* the shuttle/serve deadline work — blocking calls (socket recv/accept,
+  ``Event.wait``, ``sleep``, ``join``, comm/retry calls) while holding a lock
+  turn a slow peer into a fleet-wide stall — rule ``lock-held-blocking``;
+* inconsistent nested acquisition order of two named locks within one module
+  is the textbook ABBA deadlock — rule ``lock-order-inversion`` (the dynamic
+  witness is analysis/lockwatch.py).
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from .core import Checker, Finding, ParsedModule, call_name, dotted_name, walk_scope
+
+#: attribute/name spellings that mean "this is a lock/condition object"
+LOCK_NAME_RE = re.compile(r"(^|_)(lock|locks|mutex|mu|cv|cond|condition)$")
+
+#: terminal call names that block the calling thread
+BLOCKING_CALLS = {
+    "recv", "recv_into", "recvfrom", "accept", "connect", "sendall",
+    "select", "sleep", "urlopen", "create_connection",
+    # project comm/retry primitives: each one can ride a multi-second
+    # backoff/deadline budget (resilience/policy.py) — never under a lock
+    "coordinator_request", "retry_call", "league_request", "supervise_call",
+    "ship_once",
+}
+
+#: called-name spellings that mean "user callback dispatch"
+CALLBACK_RE = re.compile(r"(^|_)(callback|callbacks|cb|cbs|hook|hooks|listener|listeners)$")
+
+_LOCK_CTORS = {"Lock", "RLock", "Condition"}
+_THREADISH_RE = re.compile(r"(^|_)(thread|threads|worker|workers|proc|procs|process)")
+
+
+def _is_lock_expr(expr: ast.AST, known_locks: Set[str]) -> Optional[str]:
+    """Dotted name when ``expr`` looks like a lock acquisition target."""
+    dotted = dotted_name(expr)
+    if not dotted:
+        return None
+    terminal = dotted.rsplit(".", 1)[-1]
+    if LOCK_NAME_RE.search(terminal) or dotted in known_locks:
+        return dotted
+    return None
+
+
+class LockChecker(Checker):
+    name = "locks"
+    rules = {
+        "lock-held-blocking": "error",
+        "lock-callback-dispatch": "error",
+        "lock-order-inversion": "error",
+    }
+
+    def _known_locks(self, mod: ParsedModule) -> Tuple[Set[str], Set[str]]:
+        """(lock attrs/names assigned from threading.Lock/RLock/Condition,
+        thread attrs assigned from threading.Thread) in this module."""
+        locks: Set[str] = set()
+        threads: Set[str] = set()
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Assign) or not isinstance(node.value, ast.Call):
+                continue
+            ctor = call_name(node.value)
+            for tgt in node.targets:
+                dotted = dotted_name(tgt)
+                if not dotted:
+                    continue
+                if ctor in _LOCK_CTORS:
+                    locks.add(dotted)
+                elif ctor == "Thread":
+                    threads.add(dotted)
+        return locks, threads
+
+    def check_module(self, mod: ParsedModule) -> Iterable[Finding]:
+        known_locks, known_threads = self._known_locks(mod)
+        findings: List[Finding] = []
+        # edges: (class-scoped outer lock, inner lock) -> first line observed
+        edges: Dict[Tuple[str, str], int] = {}
+
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.With):
+                continue
+            held = [
+                d for item in node.items
+                if (d := _is_lock_expr(item.context_expr, known_locks))
+            ]
+            if not held:
+                continue
+            cls = mod.enclosing_class(node)
+            scope = cls.name if cls is not None else ""
+            # one lexical level only: nested withs record their own edges
+            for child in walk_scope(node):
+                if isinstance(child, ast.With):
+                    for item in child.items:
+                        inner = _is_lock_expr(item.context_expr, known_locks)
+                        if inner and inner not in held:
+                            for h in held:
+                                edges.setdefault(
+                                    (f"{scope}:{h}", f"{scope}:{inner}"),
+                                    child.lineno,
+                                )
+                    continue
+                if not isinstance(child, ast.Call):
+                    continue
+                findings.extend(
+                    self._check_call_under_lock(mod, child, held, known_threads)
+                )
+
+        for (a, b), line in sorted(edges.items(), key=lambda kv: kv[1]):
+            if a >= b or (b, a) not in edges:
+                continue
+            an, bn = a.split(":", 1)[1], b.split(":", 1)[1]
+            findings.append(self.finding(
+                "lock-order-inversion", mod, line,
+                f"locks {an!r} and {bn!r} are acquired in both orders in this "
+                f"module (here and near line {edges[(b, a)]}) — pick one order "
+                f"or merge the critical sections (ABBA deadlock)",
+                ident=f"inversion {an} <-> {bn}",
+            ))
+        return findings
+
+    def _check_call_under_lock(self, mod: ParsedModule, call: ast.Call,
+                               held: List[str], known_threads: Set[str]
+                               ) -> Iterable[Finding]:
+        name = call_name(call)
+        func = call.func
+        receiver = dotted_name(func.value) if isinstance(func, ast.Attribute) else ""
+        held_txt = "/".join(sorted(set(held)))
+
+        # --- user-callback dispatch under the lock (PR 4's incident class)
+        cb_target = ""
+        if isinstance(func, ast.Name) and CALLBACK_RE.search(func.id):
+            cb_target = func.id
+        elif isinstance(func, ast.Attribute) and CALLBACK_RE.search(func.attr):
+            cb_target = dotted_name(func)
+        elif isinstance(func, ast.Subscript):
+            sub = dotted_name(func.value)
+            if sub and CALLBACK_RE.search(sub.rsplit(".", 1)[-1]):
+                cb_target = sub + "[...]"
+        if cb_target:
+            yield self.finding(
+                "lock-callback-dispatch", mod, call.lineno,
+                f"user callback {cb_target!r} dispatched while holding "
+                f"{held_txt} — snapshot the list under the lock, call outside "
+                f"it (a re-entrant callback deadlocks here)",
+                ident=f"callback {cb_target} under {held_txt}",
+            )
+            return
+
+        # --- blocking primitives under the lock
+        blocking = None
+        if name in BLOCKING_CALLS:
+            # ".connect(" on non-socket receivers (signal connect etc.) is
+            # rare in this tree; accept the terminal-name heuristic and let
+            # pragmas carry the exceptions.
+            blocking = name
+        elif name == "join":
+            # str.join / os.path.join are not blocking; thread/process join is
+            recv_term = receiver.rsplit(".", 1)[-1] if receiver else ""
+            if receiver in known_threads or _THREADISH_RE.search(recv_term):
+                blocking = "join"
+        elif name in ("wait", "wait_for"):
+            # cond.wait() on the HELD condition releases it while waiting —
+            # that is the condition-variable idiom, not a hazard. Waiting on
+            # anything else (an Event, another condition) holds our lock the
+            # whole time.
+            if receiver and receiver not in held:
+                blocking = f"{receiver}.{name}"
+        if blocking:
+            yield self.finding(
+                "lock-held-blocking", mod, call.lineno,
+                f"blocking call {blocking!r} while holding {held_txt} — every "
+                f"other thread contending this lock stalls for the full wait; "
+                f"move the blocking call outside the critical section",
+                ident=f"blocking {blocking} under {held_txt}",
+            )

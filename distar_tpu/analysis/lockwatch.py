@@ -1,0 +1,453 @@
+"""Lock-order sanitizer: the dynamic witness for the static lock rules.
+
+Opt-in (``DISTAR_LOCKWATCH=1`` wires it into the test session via
+tests/conftest.py): wraps ``threading.Lock``/``RLock`` construction so every
+lock CREATED FROM distar_tpu code becomes a recording proxy, then watches
+
+* the per-thread lock-order graph — an edge A→B is recorded whenever a
+  thread acquires B while holding A (keyed by each lock's creation site);
+  cycles in that graph are potential ABBA deadlocks even if the run never
+  actually deadlocked — the dynamic analogue of the static
+  ``lock-order-inversion`` rule;
+* held-while-blocking — patched blocking primitives (``time.sleep``,
+  ``Event.wait``, ``socket.recv/accept/connect/sendall``, ``select.select``)
+  note every call made while the thread holds a watched lock — the dynamic
+  analogue of ``lock-held-blocking``.
+
+Locks created outside the filter (stdlib, jax, site-packages) get REAL lock
+objects — zero overhead and no interference where we aren't looking.
+``Condition`` integration is exact: the RLock proxy implements
+``_acquire_restore``/``_release_save``/``_is_owned`` so ``cond.wait()``
+correctly shows the lock as RELEASED while waiting.
+
+Reports aggregate sites to file granularity for baseline stability
+(tools/lockwatch_baseline.json: justified pairs only — the file may only
+shrink, like the static baseline).
+"""
+from __future__ import annotations
+
+import _thread
+import json
+import os
+import sys
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+__all__ = ["install", "uninstall", "reset", "report", "load_baseline",
+           "unbaselined", "render_report", "installed"]
+
+_state_lock = _thread.allocate_lock()  # raw: never recurses into proxies
+_installed = False
+_orig: Dict[str, object] = {}
+
+#: path substrings a lock's creation site must match to be watched
+_filters: Tuple[str, ...] = ("distar_tpu",)
+
+# creation-site -> count of locks minted there
+_created: Dict[str, int] = {}
+# (site_a, site_b) -> count: thread acquired b while holding a
+_edges: Dict[Tuple[str, str], int] = {}
+# (held_site, blocker) -> [count, caller_site] — caller resolved only on
+# the FIRST occurrence: the frame walk is far too expensive to run per
+# recv chunk inside a client's request-lock hot loop
+_blocking: Dict[Tuple[str, str], list] = {}
+
+_tls = threading.local()
+
+
+def _held() -> List["_LockProxy"]:
+    stack = getattr(_tls, "stack", None)
+    if stack is None:
+        stack = _tls.stack = []
+    return stack
+
+
+def _site() -> Optional[str]:
+    """file.py:lineno of the first frame outside threading/lockwatch."""
+    f = sys._getframe(2)
+    while f is not None:
+        fn = f.f_code.co_filename
+        if not (fn.endswith("threading.py") or fn.endswith("lockwatch.py")):
+            rel = fn
+            for marker in ("/distar_tpu/", "/tests/", "/tools/"):
+                i = fn.rfind(marker)
+                if i >= 0:
+                    rel = fn[i + 1:]
+                    break
+            return f"{rel}:{f.f_lineno}"
+        f = f.f_back
+    return None
+
+
+def _watched_site() -> Optional[str]:
+    site = _site()
+    if site is None:
+        return None
+    if not any(flt in site for flt in _filters):
+        return None
+    return site
+
+
+def _note_attempt(proxy: "_LockProxy") -> None:
+    """Record order edges at acquisition ATTEMPT time: a genuine ABBA
+    deadlock is exactly the case where the inner acquire never succeeds, so
+    success-only recording would miss the one scenario that matters."""
+    stack = _held()
+    if stack:
+        with _state_lock:
+            for holder in stack:
+                if holder.site != proxy.site:
+                    key = (holder.site, proxy.site)
+                    _edges[key] = _edges.get(key, 0) + 1
+
+
+def _note_acquired(proxy: "_LockProxy") -> None:
+    _held().append(proxy)
+
+
+def _note_acquire(proxy: "_LockProxy") -> None:
+    _note_attempt(proxy)
+    _note_acquired(proxy)
+
+
+def _note_release(proxy: "_LockProxy") -> None:
+    stack = _held()
+    for i in range(len(stack) - 1, -1, -1):
+        if stack[i] is proxy:
+            del stack[i]
+            return
+
+
+def _note_blocking(blocker: str) -> None:
+    stack = _held()
+    if not stack:
+        return
+    with _state_lock:
+        fresh = [h.site for h in stack if (h.site, blocker) not in _blocking]
+        for holder in stack:
+            key = (holder.site, blocker)
+            rec = _blocking.get(key)
+            if rec is not None:
+                rec[0] += 1
+    if not fresh:
+        return
+    caller = _site() or "?"  # outside the state lock: the walk is slow
+    with _state_lock:
+        for site in fresh:
+            key = (site, blocker)
+            rec = _blocking.get(key)
+            if rec is None:
+                _blocking[key] = [1, caller]
+            else:
+                rec[0] += 1
+
+
+class _LockProxy:
+    """Recording wrapper around one real Lock."""
+
+    _reentrant = False
+
+    def __init__(self, inner, site: str):
+        self._inner = inner
+        self.site = site
+        self._count = 0  # owner's recursion depth (RLock only)
+
+    def acquire(self, blocking=True, timeout=-1):
+        reentering = self._reentrant and self._owned()
+        if blocking and not reentering:
+            # edges record the INTENT to wait: try-locks (blocking=False)
+            # are deadlock-free by construction and stay out of the graph
+            _note_attempt(self)
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            if reentering:
+                self._count += 1
+            else:
+                self._count = 1
+                _note_acquired(self)
+        return got
+
+    acquire_lock = acquire
+
+    def release(self):
+        if self._count <= 1:
+            self._count = 0
+            _note_release(self)
+        else:
+            self._count -= 1
+        self._inner.release()
+
+    release_lock = release
+
+    def locked(self):
+        return self._inner.locked()
+
+    def _owned(self) -> bool:
+        return any(p is self for p in _held())
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def __repr__(self):
+        return f"<lockwatch {type(self._inner).__name__} @ {self.site}>"
+
+
+class _RLockProxy(_LockProxy):
+    """RLock flavor: reentrancy + the Condition fast-path protocol."""
+
+    _reentrant = True
+
+    # threading.Condition prefers these when present; keeping our
+    # bookkeeping inside them means cond.wait() shows the lock RELEASED
+    # while waiting (no false held-while-blocking, no stale edges)
+    def _release_save(self):
+        state = self._inner._release_save()
+        self._count = 0
+        _note_release(self)
+        return state
+
+    def _acquire_restore(self, state):
+        self._inner._acquire_restore(state)
+        self._count = 1
+        _note_acquire(self)
+
+    def _is_owned(self):
+        return self._inner._is_owned()
+
+
+# ---------------------------------------------------------------- patching
+def _make_lock_factory(real_factory, proxy_cls):
+    def factory():
+        inner = real_factory()
+        site = _watched_site()
+        if site is None:
+            return inner  # outside the filter: zero overhead, zero risk
+        with _state_lock:
+            _created[site] = _created.get(site, 0) + 1
+        return proxy_cls(inner, site)
+
+    return factory
+
+
+def _wrap_blocking(func, name):
+    def wrapper(*args, **kwargs):
+        _note_blocking(name)
+        return func(*args, **kwargs)
+
+    wrapper.__name__ = getattr(func, "__name__", name)
+    wrapper._lockwatch_orig = func
+    return wrapper
+
+
+def install(filters: Tuple[str, ...] = ("distar_tpu",)) -> None:
+    """Patch lock construction + blocking primitives. Idempotent."""
+    global _installed, _filters
+    import select
+    import socket
+
+    if _installed:
+        return
+    _filters = tuple(filters)
+    _orig["Lock"] = threading.Lock
+    _orig["RLock"] = threading.RLock
+    threading.Lock = _make_lock_factory(_orig["Lock"], _LockProxy)
+    threading.RLock = _make_lock_factory(_orig["RLock"], _RLockProxy)
+
+    _orig["sleep"] = time.sleep
+    time.sleep = _wrap_blocking(time.sleep, "time.sleep")
+    _orig["Event.wait"] = threading.Event.wait
+
+    def _event_wait(self, timeout=None, _orig_wait=_orig["Event.wait"]):
+        # Thread.start() waits on the new thread's _started event — a
+        # bounded in-process startup handshake, not the unbounded
+        # peer-dependent wait this watch hunts; exempt exactly that caller
+        caller = sys._getframe(1).f_code
+        if not (caller.co_name == "start"
+                and caller.co_filename.endswith("threading.py")):
+            _note_blocking("Event.wait")
+        return _orig_wait(self, timeout)
+
+    threading.Event.wait = _event_wait
+    _orig["select"] = select.select
+    select.select = _wrap_blocking(select.select, "select.select")
+    for meth in ("accept", "recv", "recv_into", "recvfrom", "sendall", "connect"):
+        _orig[f"socket.{meth}"] = getattr(socket.socket, meth)
+        setattr(socket.socket, meth,
+                _wrap_blocking(getattr(socket.socket, meth), f"socket.{meth}"))
+    _installed = True
+
+
+def uninstall() -> None:
+    global _installed
+    import select
+    import socket
+
+    if not _installed:
+        return
+    threading.Lock = _orig["Lock"]
+    threading.RLock = _orig["RLock"]
+    time.sleep = _orig["sleep"]
+    threading.Event.wait = _orig["Event.wait"]
+    select.select = _orig["select"]
+    for meth in ("accept", "recv", "recv_into", "recvfrom", "sendall", "connect"):
+        setattr(socket.socket, meth, _orig[f"socket.{meth}"])
+    _orig.clear()
+    _installed = False
+
+
+def installed() -> bool:
+    return _installed
+
+
+def reset() -> None:
+    with _state_lock:
+        _created.clear()
+        _edges.clear()
+        _blocking.clear()
+
+
+# ---------------------------------------------------------------- reporting
+def _file_of(site: str) -> str:
+    return site.rsplit(":", 1)[0]
+
+
+def report() -> dict:
+    """Aggregate the recorded graphs.
+
+    ``inversions``: site pairs acquired in both orders (the actionable ABBA
+    core; longer cycles reduce to at least one inverted pair across runs).
+    ``cycles``: every cycle found by DFS over the site-level order graph.
+    ``held_blocking``: blocking primitive calls under a held watched lock.
+    """
+    with _state_lock:
+        edges = dict(_edges)
+        blocking = dict(_blocking)
+        created = dict(_created)
+
+    inversions = []
+    seen = set()
+    for (a, b), n in edges.items():
+        if (b, a) in edges and (b, a) not in seen and a != b:
+            seen.add((a, b))
+            inversions.append({
+                "a": a, "b": b,
+                "count_ab": n, "count_ba": edges[(b, a)],
+            })
+
+    # DFS cycle detection over the order graph (site granularity)
+    graph: Dict[str, List[str]] = {}
+    for (a, b) in edges:
+        graph.setdefault(a, []).append(b)
+    cycles: List[List[str]] = []
+    WHITE, GRAY, BLACK = 0, 1, 2
+    color = {n: WHITE for n in graph}
+    stack: List[str] = []
+
+    def dfs(n: str) -> None:
+        color[n] = GRAY
+        stack.append(n)
+        for m in graph.get(n, ()):
+            if color.get(m, WHITE) == GRAY:
+                cycles.append(stack[stack.index(m):] + [m])
+            elif color.get(m, WHITE) == WHITE:
+                dfs(m)
+        stack.pop()
+        color[n] = BLACK
+
+    for n in sorted(graph):
+        if color.get(n, WHITE) == WHITE:
+            dfs(n)
+
+    held = [
+        {"lock": lock, "blocker": blocker, "caller": rec[1], "count": rec[0]}
+        for (lock, blocker), rec in sorted(blocking.items())
+    ]
+    return {
+        "locks_watched": sum(created.values()),
+        "lock_sites": len(created),
+        "edges": len(edges),
+        "inversions": sorted(inversions, key=lambda d: (d["a"], d["b"])),
+        "cycles": cycles,
+        "held_blocking": held,
+    }
+
+
+# ----------------------------------------------------------------- baseline
+def load_baseline(path: str) -> dict:
+    if not os.path.exists(path):
+        return {"held_blocking": [], "inversions": []}
+    with open(path) as f:
+        data = json.load(f)
+    data.setdefault("held_blocking", [])
+    data.setdefault("inversions", [])
+    return data
+
+
+def unbaselined(rep: dict, baseline: dict) -> dict:
+    """Pairs not covered by a justified baseline entry. Baseline matching is
+    FILE-granular (line numbers drift): a held_blocking entry is
+    {lock_file, blocker, why}; an inversion entry is {a_file, b_file, why}.
+    Every entry must carry a non-empty ``why``."""
+    hb_allowed = {
+        (e.get("lock_file", ""), e.get("blocker", ""))
+        for e in baseline["held_blocking"] if e.get("why")
+    }
+    inv_allowed = set()
+    for e in baseline["inversions"]:
+        if e.get("why"):
+            inv_allowed.add((e.get("a_file", ""), e.get("b_file", "")))
+            inv_allowed.add((e.get("b_file", ""), e.get("a_file", "")))
+    bad_hb = [
+        h for h in rep["held_blocking"]
+        if (_file_of(h["lock"]), h["blocker"]) not in hb_allowed
+    ]
+    bad_inv = [
+        i for i in rep["inversions"]
+        if (_file_of(i["a"]), _file_of(i["b"])) not in inv_allowed
+    ]
+    # stale entries: baseline lines whose pair never fired (shrink-only)
+    fired_hb = {(_file_of(h["lock"]), h["blocker"]) for h in rep["held_blocking"]}
+    fired_inv = set()
+    for i in rep["inversions"]:
+        fired_inv.add((_file_of(i["a"]), _file_of(i["b"])))
+        fired_inv.add((_file_of(i["b"]), _file_of(i["a"])))
+    stale = [
+        e for e in baseline["held_blocking"]
+        if (e.get("lock_file", ""), e.get("blocker", "")) not in fired_hb
+    ] + [
+        e for e in baseline["inversions"]
+        if (e.get("a_file", ""), e.get("b_file", "")) not in fired_inv
+    ]
+    return {"held_blocking": bad_hb, "inversions": bad_inv, "stale": stale}
+
+
+def render_report(rep: dict, bad: Optional[dict] = None) -> str:
+    lines = [
+        f"lockwatch: {rep['locks_watched']} locks from {rep['lock_sites']} sites, "
+        f"{rep['edges']} order edges, {len(rep['inversions'])} inversions, "
+        f"{len(rep['held_blocking'])} held-while-blocking pairs",
+    ]
+    for i in rep["inversions"]:
+        lines.append(
+            f"  INVERSION {i['a']} <-> {i['b']} "
+            f"(x{i['count_ab']}/x{i['count_ba']}) — potential ABBA deadlock")
+    for h in rep["held_blocking"]:
+        lines.append(
+            f"  HELD-BLOCKING {h['blocker']} at {h['caller']} while holding "
+            f"lock created {h['lock']} (x{h['count']})")
+    if bad is not None:
+        n = len(bad["held_blocking"]) + len(bad["inversions"])
+        if n == 0 and not bad["stale"]:
+            lines.append("  baseline: OK — every pair justified, nothing stale")
+        else:
+            lines.append(
+                f"  baseline: {n} UNBASELINED pair(s), {len(bad['stale'])} "
+                f"stale entr(ies) — fix the code or justify in "
+                f"tools/lockwatch_baseline.json")
+    return "\n".join(lines)

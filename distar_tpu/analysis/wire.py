@@ -1,0 +1,234 @@
+"""Wire-error taxonomy checker: typed errors on every wire, always registered.
+
+Encodes the serve/replay error contract (PR 2/PR 5): every failure a peer can
+see crosses the wire as ``{"code": <registered>, ...}`` so clients dispatch on
+the taxonomy instead of string-matching reprs, and PR 4's retry fabric: a
+``RetryableError`` silently swallowed (no counter, no log, no re-raise) is an
+outage you can never see.
+
+Rules:
+
+* ``wire-code-unregistered`` — an ``errors.py`` class defines ``code = "x"``
+  but is absent from that module's ``_WIRE_CODES`` registry (and is never
+  special-cased by ``.code`` reference), so ``error_from_wire`` can only
+  rehydrate it as the degraded base class.
+* ``wire-code-unknown`` — a string literal used as a wire error code (in a
+  ``{"code": "x", ...}`` reply or a ``payload["code"] == "x"`` dispatch) that
+  no errors-registry module registers.
+* ``handler-boundary-swallow`` — an ``except Exception`` at a frontend
+  handler boundary (do_GET/do_POST/_handle*) whose body neither answers the
+  peer nor re-raises (pass-only / bare-raise-only): the connection dies or
+  the bug disappears, both worse than a typed reply.
+* ``retryable-swallowed`` — a handler catching the retryable taxonomy
+  (CommError/RetryableError/RateLimitTimeout/...) and dropping it without a
+  counter/log/re-raise.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from .core import Checker, Finding, ParsedModule, call_name, dotted_name, walk_scope
+
+HANDLER_RE = re.compile(r"^(do_[A-Z]+|handle(_.*)?|_handle(_.*)?|_serve_conn.*|_conn_loop)$")
+
+RETRYABLE_NAMES = {
+    "RetryableError", "CommError", "RateLimitTimeout", "CircuitOpenError",
+    "ShmError", "ShmPeerDeadError", "ShedError",
+}
+
+#: codes that are HTTP-ish plumbing, not taxonomy members
+_IGNORED_CODES: Set[str] = set()
+
+_LOGGING_CALLS = {
+    "inc", "observe", "set", "record", "add_event", "warning", "error",
+    "exception", "info", "debug", "log", "write", "append", "put", "emit",
+}
+
+
+def _handler_name(fn: ast.AST) -> bool:
+    return isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)) and bool(
+        HANDLER_RE.match(fn.name)
+    )
+
+
+def _exc_names(type_node: Optional[ast.AST]) -> Set[str]:
+    if type_node is None:
+        return set()
+    nodes = type_node.elts if isinstance(type_node, ast.Tuple) else [type_node]
+    out = set()
+    for n in nodes:
+        d = dotted_name(n)
+        if d:
+            out.add(d.rsplit(".", 1)[-1])
+    return out
+
+
+class WireChecker(Checker):
+    name = "wire"
+    rules = {
+        "wire-code-unregistered": "error",
+        "wire-code-unknown": "error",
+        "handler-boundary-swallow": "error",
+        "retryable-swallowed": "warning",
+    }
+
+    def __init__(self):
+        #: code literal -> defining module (from every errors.py scanned)
+        self._registered_codes: Dict[str, str] = {}
+        #: deferred literal-usage sites, resolved once all registries are read
+        self._code_uses: List[Tuple[ParsedModule, int, str, str]] = []
+
+    # ---------------------------------------------------------------- per-file
+    def check_module(self, mod: ParsedModule) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        if mod.relpath.endswith("errors.py"):
+            findings.extend(self._check_registry(mod))
+        self._collect_code_uses(mod)
+        findings.extend(self._check_handlers(mod))
+        return findings
+
+    # ------------------------------------------------------- errors.py registry
+    def _check_registry(self, mod: ParsedModule) -> Iterable[Finding]:
+        coded: Dict[str, Tuple[str, int]] = {}  # class -> (code, line)
+        for node in mod.tree.body:
+            if not isinstance(node, ast.ClassDef):
+                continue
+            for stmt in node.body:
+                if (isinstance(stmt, ast.Assign) and len(stmt.targets) == 1
+                        and isinstance(stmt.targets[0], ast.Name)
+                        and stmt.targets[0].id == "code"
+                        and isinstance(stmt.value, ast.Constant)
+                        and isinstance(stmt.value.value, str)):
+                    coded[node.name] = (stmt.value.value, stmt.lineno)
+        if not coded:
+            return
+        registered: Set[str] = set()
+        referenced: Set[str] = set()  # special-cased via ClassName.code
+        for node in ast.walk(mod.tree):
+            if (isinstance(node, ast.Assign)
+                    and any(isinstance(t, ast.Name) and t.id == "_WIRE_CODES"
+                            for t in node.targets)):
+                for sub in ast.walk(node.value):
+                    if isinstance(sub, ast.Name):
+                        registered.add(sub.id)
+            elif (isinstance(node, ast.Attribute) and node.attr == "code"
+                    and isinstance(node.value, ast.Name)):
+                referenced.add(node.value.id)
+        for cls_name, (code, line) in sorted(coded.items()):
+            self._registered_codes.setdefault(code, mod.relpath)
+            if cls_name not in registered and cls_name not in referenced:
+                yield self.finding(
+                    "wire-code-unregistered", mod, line,
+                    f"{cls_name} defines wire code {code!r} but is not in this "
+                    f"module's _WIRE_CODES registry — error_from_wire() will "
+                    f"degrade it to the base class on every peer",
+                    ident=f"{cls_name} code {code}",
+                )
+
+    # ------------------------------------------------------ code-literal usage
+    def _collect_code_uses(self, mod: ParsedModule) -> None:
+        if mod.relpath.endswith("errors.py"):
+            return  # registries define codes; usage rules apply elsewhere
+        for node in ast.walk(mod.tree):
+            # {"code": "literal", ...} replies
+            if isinstance(node, ast.Dict):
+                for k, v in zip(node.keys, node.values):
+                    if (isinstance(k, ast.Constant) and k.value == "code"
+                            and isinstance(v, ast.Constant)
+                            and isinstance(v.value, str)):
+                        self._code_uses.append(
+                            (mod, v.lineno, v.value, "wire reply built with"))
+            # payload["code"] == "literal" / payload.get("code") == "literal"
+            elif isinstance(node, ast.Compare) and len(node.comparators) == 1:
+                sides = [node.left, node.comparators[0]]
+                lit = next((s.value for s in sides
+                            if isinstance(s, ast.Constant)
+                            and isinstance(s.value, str)), None)
+                other = next((s for s in sides if not isinstance(s, ast.Constant)), None)
+                if lit is None or other is None:
+                    continue
+                is_code_lookup = (
+                    (isinstance(other, ast.Subscript)
+                     and isinstance(other.slice, ast.Constant)
+                     and other.slice.value == "code")
+                    or (isinstance(other, ast.Call) and call_name(other) == "get"
+                        and other.args
+                        and isinstance(other.args[0], ast.Constant)
+                        and other.args[0].value == "code")
+                )
+                if is_code_lookup:
+                    self._code_uses.append((mod, node.lineno, lit, "dispatched on"))
+
+    def finalize(self) -> Iterable[Finding]:
+        known = set(self._registered_codes) | _IGNORED_CODES
+        seen: Set[Tuple[str, int, str]] = set()
+        for mod, line, code, how in self._code_uses:
+            key = (mod.relpath, line, code)
+            if key in seen or code in known:
+                continue
+            seen.add(key)
+            yield self.finding(
+                "wire-code-unknown", mod, line,
+                f"wire error code {how} unregistered literal {code!r} — "
+                f"register a typed class in the plane's errors.py so "
+                f"error_from_wire() can rehydrate it",
+                ident=f"unknown code {code}",
+            )
+        self._code_uses = []
+
+    # -------------------------------------------------------- handler boundary
+    def _check_handlers(self, mod: ParsedModule) -> Iterable[Finding]:
+        for fn in ast.walk(mod.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            is_handler = _handler_name(fn)
+            for node in walk_scope(fn, skip_nested_defs=True):
+                if not isinstance(node, ast.ExceptHandler):
+                    continue
+                names = _exc_names(node.type)
+                body = node.body
+                only_pass = all(isinstance(s, ast.Pass) for s in body)
+                only_bare_raise = (
+                    len(body) == 1 and isinstance(body[0], ast.Raise)
+                    and body[0].exc is None
+                )
+                if is_handler and "Exception" in names and (only_pass or only_bare_raise):
+                    what = "swallows it silently" if only_pass else "re-raises it bare"
+                    yield self.finding(
+                        "handler-boundary-swallow", mod, node.lineno,
+                        f"frontend handler {fn.name}() catches Exception and "
+                        f"{what} — answer the peer a typed wire error "
+                        f"(see serve/errors.py) instead",
+                        ident=f"{fn.name} broad except",
+                    )
+                    continue
+                # teardown paths (close/stop/__exit__/__del__) legitimately
+                # swallow typed errors: the resource may already be gone
+                teardown = fn.name in ("close", "stop", "__exit__", "__del__",
+                                       "shutdown", "unlink")
+                if not teardown and names & RETRYABLE_NAMES and self._swallows(node):
+                    dropped = "/".join(sorted(names & RETRYABLE_NAMES))
+                    yield self.finding(
+                        "retryable-swallowed", mod, node.lineno,
+                        f"{dropped} caught and dropped with no counter, log or "
+                        f"re-raise — a retryable failure that leaves no trace "
+                        f"is an invisible outage; count it "
+                        f"(registry.counter(...).inc()) or let it propagate",
+                        ident=f"swallowed {dropped}",
+                    )
+
+    @staticmethod
+    def _swallows(handler: ast.ExceptHandler) -> bool:
+        """True when the except body leaves no trace AT ALL: no raise, no
+        return (exiting the loop/thread is a reaction), and no call of any
+        kind except a bare sleep — a fallback helper, a counter inc, a log
+        line all count as handling. The rule targets ``except CommError:
+        pass``-shaped drops, not every terse handler."""
+        for node in walk_scope(handler, skip_nested_defs=True):
+            if isinstance(node, (ast.Raise, ast.Return)):
+                return False
+            if isinstance(node, ast.Call) and call_name(node) != "sleep":
+                return False
+        return True

@@ -113,14 +113,21 @@ _F64 = struct.Struct("<d")
 class ShmError(CommError):
     """Typed shm-transport failure. Subclasses ``CommError`` (retryable +
     ``ConnectionError``) so every legacy transport-fault handler catches
-    it; ``reason`` labels the fallback counter."""
+    it; ``reason`` labels the fallback counter. ``code``/``to_wire`` follow
+    the serve/replay wire-error contract — both planes register
+    ``shm_error`` (their ``RingServiceError``) so a ring-pump reply
+    rehydrates typed on every peer."""
 
     reason = "shm_error"
+    code = "shm_error"
 
     def __init__(self, message: str, op: str = "", reason: str = ""):
         super().__init__(message, op=op)
         if reason:
             self.reason = reason
+
+    def to_wire(self) -> dict:
+        return {"code": self.code, "error": str(self)}
 
 
 class ShmPeerDeadError(ShmError):
@@ -783,6 +790,11 @@ class ShmPeer:
         if self._closed.is_set():
             return
         self._closed.set()
+        # join BEFORE tearing the rings down: a beat mid-flight would race
+        # the unlink below (tolerated by its except, but joining removes
+        # the window entirely); the loop re-checks _closed every wait tick
+        if self._beat_thread is not threading.current_thread():
+            self._beat_thread.join(timeout=DEFAULT_HEARTBEAT_WINDOW_S)
         self.writer.close()
         self.reader.close()
         self.bell.ring()  # nudge a blocked peer so it re-checks the flags
@@ -895,7 +907,7 @@ class RingService:
                 try:
                     resp = self._dispatch(req)
                 except Exception as e:  # dispatch bug must not kill the pump
-                    resp = {"code": "shm_error", "error": repr(e)}
+                    resp = ShmError(repr(e), op=self._thread.name).to_wire()
                 try:
                     self._peer.send(resp, timeout_s=30.0)
                 except ShmError:

@@ -366,6 +366,10 @@ class FakeSC2Server:
             self._listener.close()
         except OSError:
             pass
+        # reap the accept loop: the poke above guarantees it observes _stop,
+        # so this join is fast — stop() returning with the loop still
+        # between accept() and its _stop check would race a re-bind
+        self._accept_thread.join(timeout=5.0)
 
     def _accept_loop(self) -> None:
         while not self._stop.is_set():

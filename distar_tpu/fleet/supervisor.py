@@ -209,6 +209,7 @@ class SubprocessFleet:
                 get_registry().counter(
                     "distar_resilience_task_giveups_total",
                     "supervised tasks abandoned (restart budget exhausted)",
+                    # analysis: allow(metric-label-cardinality) — fleet names come from the operator's static FleetSupervisor config (serve/replay), never from request data
                     task=f"fleet:{self.name}",
                 ).inc()
                 continue

@@ -101,4 +101,9 @@ class LearnerAdminServer:
 
     def stop(self):
         self._server.shutdown()
+        # reap the serve loop before closing its socket under it: stop()
+        # returning with the thread still running races server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
         self._server.server_close()

@@ -73,6 +73,12 @@ class DevicePrefetcher:
                 self._q.get_nowait()
         except queue.Empty:
             pass
+        # reap the producer: close() returning while it may still be mid
+        # place_fn races learner teardown. Best-effort with a SHORT bound:
+        # a live producer exits within ms of the stop flag, while one
+        # blocked in next(self._it) can't be interrupted at all — waiting
+        # longer buys nothing (it dies with the process as before)
+        self._thread.join(timeout=0.5)
 
 
 _SENTINEL = object()

@@ -194,3 +194,9 @@ class ShardFeeder:
                 self._q.get_nowait()
         except queue.Empty:
             pass
+        # reap the producer: close() returning while it may still be mid
+        # pull/collate/place races learner teardown (it would touch freed
+        # device state); the drain above unblocked any pending put. Short
+        # bound: a producer blocked in next(self._it) can't be interrupted
+        # — waiting longer buys nothing (it dies with the process as before)
+        self._thread.join(timeout=0.5)

@@ -71,6 +71,30 @@ class StoreDrainingError(ReplayError):
     code = "draining"
 
 
+class BadFrameError(ReplayError):
+    """The peer sent an unparseable frame (garbage header/codec): the framed
+    stream can no longer be trusted and the connection closes after the
+    reply."""
+
+    code = "bad_frame"
+
+
+class BadRequestError(ReplayError):
+    """The request was not a well-formed op dict, or named an op this store
+    does not speak. Not retryable: re-sending the same request cannot fix
+    it."""
+
+    code = "bad_request"
+
+
+class RingServiceError(ReplayError):
+    """The shm ring pump answered for a dispatch bug (comm/shm_ring.py
+    ``RingService``): the request reached the store but its handler raised
+    something untyped."""
+
+    code = "shm_error"
+
+
 class BadHelloError(ReplayError):
     """The connection's ``hello`` offered preference lists with no
     recognized name at all (garbage codec/transport names — a hostile or
@@ -83,7 +107,8 @@ class BadHelloError(ReplayError):
 _WIRE_CODES = {
     cls.code: cls
     for cls in (ReplayError, UnknownTableError, InvalidBatchError,
-                ItemCorruptError, BadHelloError, StoreDrainingError)
+                ItemCorruptError, BadHelloError, StoreDrainingError,
+                BadFrameError, BadRequestError, RingServiceError)
 }
 
 

@@ -68,7 +68,7 @@ from ..obs import (
     set_active_trace,
     tracing_enabled,
 )
-from .errors import ReplayError
+from .errors import BadFrameError, BadRequestError, ReplayError
 from .store import ReplayStore
 
 
@@ -226,7 +226,7 @@ class ReplayServer:
                         return  # peer closed (possibly mid-frame)
                     except ValueError as e:
                         self._send_counted(
-                            conn, {"code": "bad_frame", "error": repr(e)},
+                            conn, BadFrameError(repr(e)).to_wire(),
                             compress, codec)
                         return
                     self._c_requests.inc()
@@ -289,7 +289,7 @@ class ReplayServer:
 
     def _dispatch(self, req) -> dict:
         if not isinstance(req, dict) or "op" not in req:
-            return {"code": "bad_request", "error": f"not a request dict: {type(req)}"}
+            return BadRequestError(f"not a request dict: {type(req)}").to_wire()
         op = req["op"]
         timeout_s = float(req.get("timeout_s", self.default_timeout_s))
         # server-side span joining the client's wire trace field (both
@@ -343,7 +343,7 @@ class ReplayServer:
                 return {"code": 0, "tables": self.store.tables()}
             if op == "ping":
                 return {"code": 0, "pong": True}
-            return {"code": "bad_request", "error": f"unknown op {op!r}"}
+            return BadRequestError(f"unknown op {op!r}").to_wire()
         except ReplayError as e:
             wire = e.to_wire()
             if wire.get("code") == "rate_limited":
@@ -419,7 +419,15 @@ class ReplayAdminServer:
                         try:
                             outer.on_drain()
                         except Exception:  # noqa: BLE001 - lease still lapses
-                            pass
+                            # best-effort by contract (the lease expires on
+                            # its own) but never silent: a deregister hook
+                            # that always fails means every drain leaves a
+                            # zombie discovery entry for a full lease
+                            get_registry().counter(
+                                "distar_replay_drain_hook_errors_total",
+                                "drain deregister-hook failures (lease "
+                                "expiry is the fallback)",
+                            ).inc()
                     info = outer.store.begin_drain()
                     data = json.dumps({"code": 0, "info": info}).encode()
                 except Exception as e:  # noqa: BLE001 - probe must not wedge us
@@ -441,6 +449,10 @@ class ReplayAdminServer:
 
     def stop(self) -> None:
         self._server.shutdown()
+        # reap the serve loop before closing its socket under it
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
         self._server.server_close()
 
 

@@ -232,8 +232,9 @@ class _ShardedBase:
         for addr in self.shard_map.addrs:
             try:
                 names.update(self.client_for(addr).tables())
+            # analysis: allow(retryable-swallowed) — fan-in isolation contract (docs/data_plane.md): a dead shard hides its tables, not the fleet's; per-shard failures surface via breaker/fanin-skip counters on the data path
             except (ReplayError, ConnectionError, OSError, CircuitOpenError):
-                continue  # a dead shard hides its tables, not the fleet's
+                continue
         return sorted(names)
 
     def fleet_stats(self) -> Dict[str, dict]:
@@ -479,8 +480,9 @@ class ShardedSampleClient(_ShardedBase):
         for addr, batch in by_shard.items():
             try:
                 applied += self.client_for(addr).update_priorities(table, batch)
+            # analysis: allow(retryable-swallowed) — priority updates are best-effort PER (docs/data_plane.md): a dead shard's items are gone anyway, and the applied count the caller gets back reflects the skip
             except (ReplayError, ConnectionError, OSError, CircuitOpenError):
-                continue  # best-effort: a dead shard's items are gone anyway
+                continue
         return applied
 
 

@@ -334,6 +334,7 @@ class AlertRemediator:
         if self.supervisor.restart(task, reason=f"alert:{event.get('rule')}"):
             _metrics().counter(
                 "distar_resilience_remediations_total",
+                # analysis: allow(metric-label-cardinality) — rule names are bounded by the declarative rulebook (obs/health.py), never by request data
                 "alert-triggered supervised restarts", rule=event.get("rule"),
             ).inc()
             _recorder().record("remediation", rule=event.get("rule"), task=task)

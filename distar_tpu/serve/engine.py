@@ -142,6 +142,7 @@ class MockModelEngine:
             return
         if self.device_lock is not None:
             with self.device_lock:  # one chip: replica forwards serialise
+                # analysis: allow(lock-held-blocking) — the sleep IS the simulated chip: the bench's shared device lock models serial forward execution, so blocking under it is the point
                 time.sleep(d)
         else:
             time.sleep(d)

@@ -51,6 +51,30 @@ class DrainingError(ShedError):
     code = "draining"
 
 
+class BadFrameError(ServeError):
+    """The peer sent an unparseable frame (garbage header/codec): the framed
+    stream can no longer be trusted and the connection closes after the
+    reply."""
+
+    code = "bad_frame"
+
+
+class BadRequestError(ServeError):
+    """The request was not a well-formed op dict, or named an op/surface
+    this server does not have. Not retryable: re-sending the same request
+    cannot fix it."""
+
+    code = "bad_request"
+
+
+class RingServiceError(ServeError):
+    """The shm ring pump answered for a dispatch bug (comm/shm_ring.py
+    ``RingService``): the request reached the server but its handler raised
+    something untyped."""
+
+    code = "shm_error"
+
+
 class UnknownVersionError(ServeError):
     """Registry operation referenced a version that was never loaded."""
 
@@ -72,6 +96,9 @@ _WIRE_CODES = {
         DeadlineExceededError,
         CapacityError,
         DrainingError,
+        BadFrameError,
+        BadRequestError,
+        RingServiceError,
         UnknownVersionError,
         UnknownPlayerError,
     )

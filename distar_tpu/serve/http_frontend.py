@@ -180,4 +180,8 @@ class ServeHTTPServer:
 
     def stop(self) -> None:
         self._server.shutdown()
+        # reap the serve loop before closing its socket under it
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
         self._server.server_close()

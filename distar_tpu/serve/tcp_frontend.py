@@ -66,7 +66,7 @@ from ..obs import (
     wire_ctx,
 )
 from ..resilience import RetryPolicy, retry_call
-from .errors import ServeError, error_from_wire
+from .errors import BadFrameError, BadRequestError, ServeError, error_from_wire
 
 
 class ServeTCPServer:
@@ -197,7 +197,7 @@ class ServeTCPServer:
                     except ValueError as e:
                         # garbage frame header/codec: answer typed, then
                         # close — the stream can no longer be trusted
-                        send_msg(conn, {"code": "bad_frame", "error": repr(e), "shed": False})
+                        send_msg(conn, BadFrameError(repr(e)).to_wire())
                         return
                     self._c_frames.inc()
                     if isinstance(req, dict) and req.get("op") == "hello":
@@ -234,8 +234,7 @@ class ServeTCPServer:
 
     def _dispatch(self, req) -> dict:
         if not isinstance(req, dict) or "op" not in req:
-            return {"code": "bad_request", "error": f"not a request dict: {type(req)}",
-                    "shed": False}
+            return BadRequestError(f"not a request dict: {type(req)}").to_wire()
         op = req["op"]
         gw = self.gateway
         try:
@@ -281,13 +280,11 @@ class ServeTCPServer:
                 # address-level graceful retirement (never per-player)
                 root = self.gateway
                 if not hasattr(root, "begin_drain"):
-                    return {"code": "bad_request",
-                            "error": "target has no drain surface",
-                            "shed": False}
+                    return BadRequestError("target has no drain surface").to_wire()
                 return {"code": 0, **root.begin_drain()}
             if op == "ping":
                 return {"code": 0, "pong": True}
-            return {"code": "bad_request", "error": f"unknown op {op!r}", "shed": False}
+            return BadRequestError(f"unknown op {op!r}").to_wire()
         except ServeError as e:
             return e.to_wire()
         except Exception as e:  # a handler bug must not kill the connection

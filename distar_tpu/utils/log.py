@@ -168,6 +168,23 @@ class ScalarSink:
         for k, v in info.items():
             self.add_scalar(k, v, global_step)
 
+    def close(self) -> None:
+        """Flush and release the sink (idempotent). Without this the jsonl
+        handle lives until interpreter exit — long-lived roles that rotate
+        experiment dirs leak one fd per rotation."""
+        if self._tb is not None:  # pragma: no cover - optional dep
+            try:
+                self._tb.close()
+            except Exception:
+                pass
+            self._tb = None
+        f, self._file = getattr(self, "_file", None), None
+        if f is not None:
+            try:
+                f.close()
+            except OSError:
+                pass
+
 
 def build_logger(path: str, name: str, to_console: bool = True):
     """Return (TextLogger, ScalarSink, VariableRecord) triple for a role."""

@@ -41,6 +41,37 @@ _configure_cache(jax, "/tmp/jax_cache_distar_tpu")
 import numpy as np
 import pytest
 
+# --------------------------------------------------------------- lockwatch
+# DISTAR_LOCKWATCH=1: wrap threading.Lock/RLock creation (distar_tpu code
+# only) + blocking primitives for the whole session, then report the
+# per-thread lock-order graph (ABBA inversions) and held-while-blocking
+# pairs at session end — the dynamic witness for the static lock rules
+# (docs/analysis.md). Must install BEFORE distar_tpu modules construct
+# their locks, i.e. at conftest import.
+_LOCKWATCH = os.environ.get("DISTAR_LOCKWATCH") == "1"
+if _LOCKWATCH:
+    from distar_tpu.analysis import lockwatch as _lockwatch
+
+    _lockwatch.install()
+
+
+def pytest_terminal_summary(terminalreporter, exitstatus, config):
+    if not _LOCKWATCH:
+        return
+    rep = _lockwatch.report()
+    baseline = _lockwatch.load_baseline(
+        os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                     "tools", "lockwatch_baseline.json"))
+    bad = _lockwatch.unbaselined(rep, baseline)
+    out = os.environ.get("DISTAR_LOCKWATCH_OUT")
+    if out:
+        import json as _json
+
+        with open(out, "w") as f:
+            _json.dump({"report": rep, "unbaselined": bad}, f, indent=1)
+    terminalreporter.section("lockwatch")
+    terminalreporter.write_line(_lockwatch.render_report(rep, bad))
+
 
 @pytest.fixture(autouse=True, scope="session")
 def _scoped_experiments_root(tmp_path_factory):

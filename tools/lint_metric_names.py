@@ -1,127 +1,81 @@
-"""Metric-name lint: every registered metric follows the naming convention
-and is documented.
+"""Metric-name lint — thin shim over the analysis framework's metric rules.
 
-Walks ``distar_tpu/**.py`` for ``.counter( / .gauge( / .histogram(`` calls
-and checks every string-literal metric name against the
-``distar_<subsystem>_<name>[_<unit>]`` convention (docs/observability.md)
-AND against the metric table in docs/observability.md — an undocumented
-metric is invisible to operators, which defeats the registry. Dynamically
-named registrations (f-strings) must be declared in ``DYNAMIC_ALLOW`` with
-the names they can produce, so new dynamic families can't dodge the lint.
+Every registered metric must follow ``distar_<subsystem>_<name>[_<unit>]``
+AND appear in the docs/observability.md metric table (an undocumented metric
+is invisible to operators). Dynamically named registrations must be declared
+in ``DYNAMIC_ALLOW`` (now canonical in ``distar_tpu/analysis/hygiene.py``,
+re-exported here). The framework additionally checks counter-vs-gauge misuse
+and label cardinality — run ``python tools/analyze.py`` for the full set;
+this CLI and ``lint``/``registered_names`` keep the original surface.
 
 Invoked from the test suite (tests/test_obs_metrics.py) and runnable
-standalone: ``python tools/lint_metric_names.py``.
+standalone: ``python tools/lint_metric_names.py`` (``--list`` prints every
+statically-known metric name).
 """
 from __future__ import annotations
 
-import ast
 import os
-import re
 import sys
-from typing import Dict, List, Set, Tuple
+from typing import List, Set
 
-NAME_RE = re.compile(r"^distar_[a-z][a-z0-9_]*$")
-REGISTER_METHODS = ("counter", "gauge", "histogram")
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
 
-# files allowed to register dynamically-built names, with every name their
-# dynamic path can produce (which must itself be documented)
-DYNAMIC_ALLOW: Dict[str, List[str]] = {
-    os.path.join("utils", "timing.py"): ["distar_stopwatch_seconds"],
-}
+from distar_tpu.analysis.hygiene import (  # noqa: E402,F401 — legacy surface
+    DYNAMIC_ALLOW,
+    METRIC_NAME_RE as NAME_RE,
+    REGISTER_METHODS,
+)
 
-SKIP_DIRS = {"__pycache__", "_proto_gen"}
-
-
-def _doc_metric_names(docs_path: str) -> Set[str]:
-    """Backticked metric names in docs/observability.md (the metric table +
-    prose both count — operators read the whole page)."""
-    with open(docs_path) as f:
-        text = f.read()
-    names = set()
-    for token in re.findall(r"`([^`\n]+)`", text):
-        m = re.match(r"(distar_[a-z0-9_]+)", token)
-        if m:
-            names.add(m.group(1))
-    return names
-
-
-def find_registrations(pkg_root: str) -> Tuple[List[tuple], List[tuple]]:
-    """Returns (literal, dynamic) registration sites:
-    literal: (relpath, lineno, name); dynamic: (relpath, lineno)."""
-    literal, dynamic = [], []
-    for dirpath, dirnames, filenames in os.walk(pkg_root):
-        dirnames[:] = [d for d in dirnames if d not in SKIP_DIRS]
-        for fn in sorted(filenames):
-            if not fn.endswith(".py"):
-                continue
-            path = os.path.join(dirpath, fn)
-            relpath = os.path.relpath(path, pkg_root)
-            with open(path, "rb") as f:
-                try:
-                    tree = ast.parse(f.read())
-                except SyntaxError:
-                    continue
-            for node in ast.walk(tree):
-                if not isinstance(node, ast.Call):
-                    continue
-                func = node.func
-                if not (isinstance(func, ast.Attribute) and func.attr in REGISTER_METHODS):
-                    continue
-                if not node.args:
-                    continue  # registry-internal plumbing, not a registration
-                first = node.args[0]
-                if isinstance(first, ast.Constant) and isinstance(first.value, str):
-                    literal.append((relpath, node.lineno, first.value))
-                else:
-                    dynamic.append((relpath, node.lineno))
-    return literal, dynamic
+_LEGACY_RULES = ("metric-name", "metric-undocumented", "metric-dynamic-name")
 
 
 def lint(pkg_root: str, docs_path: str) -> List[str]:
+    """Problem strings for the legacy rule set (naming/documentation/dynamic
+    declarations) — the two v2 rules (kind misuse, label cardinality) are
+    analyze.py's, so this shim stays behavior-compatible."""
+    from distar_tpu.analysis import ParsedModule, collect_files
+    from distar_tpu.analysis.hygiene import MetricChecker
+
+    checker = MetricChecker(_REPO, docs_path=docs_path)
     problems: List[str] = []
-    documented = _doc_metric_names(docs_path)
-    literal, dynamic = find_registrations(pkg_root)
-    for relpath, lineno, name in literal:
-        if not NAME_RE.match(name):
-            problems.append(
-                f"{relpath}:{lineno}: metric {name!r} violates the "
-                f"distar_<subsystem>_<name> convention"
-            )
-        elif name not in documented:
-            problems.append(
-                f"{relpath}:{lineno}: metric {name!r} missing from the "
-                f"docs/observability.md metric table"
-            )
-    for relpath, lineno in dynamic:
-        allowed = DYNAMIC_ALLOW.get(relpath)
-        if allowed is None:
-            problems.append(
-                f"{relpath}:{lineno}: dynamically-named metric registration — "
-                f"declare its names in tools/lint_metric_names.py DYNAMIC_ALLOW"
-            )
+    for path in collect_files([pkg_root]):
+        mod = ParsedModule(path, os.path.relpath(path, pkg_root).replace(os.sep, "/"))
+        if mod.syntax_error is not None:
             continue
-        for name in allowed:
-            if name not in documented:
-                problems.append(
-                    f"{relpath}:{lineno}: dynamic metric {name!r} missing from "
-                    f"the docs/observability.md metric table"
-                )
+        for f in checker.check_module(mod):
+            if f.rule not in _LEGACY_RULES or mod.pragma_for(f.line, f.rule) is not None:
+                continue
+            problems.append(f"{mod.relpath}:{f.line}: {f.message}")
     return problems
 
 
 def registered_names(pkg_root: str) -> Set[str]:
     """Every statically-known metric name in the tree (for doc generation)."""
-    literal, _dynamic = find_registrations(pkg_root)
-    names = {name for (_p, _l, name) in literal}
+    import ast
+
+    from distar_tpu.analysis import ParsedModule, collect_files
+
+    names: Set[str] = set()
+    for path in collect_files([pkg_root]):
+        mod = ParsedModule(path, os.path.relpath(path, pkg_root))
+        if mod.syntax_error is not None:
+            continue
+        for node in ast.walk(mod.tree):
+            if (isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in REGISTER_METHODS and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)):
+                names.add(node.args[0].value)
     for extra in DYNAMIC_ALLOW.values():
         names.update(extra)
     return names
 
 
 def main() -> int:
-    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    pkg_root = os.path.join(repo, "distar_tpu")
-    docs_path = os.path.join(repo, "docs", "observability.md")
+    pkg_root = os.path.join(_REPO, "distar_tpu")
+    docs_path = os.path.join(_REPO, "docs", "observability.md")
     problems = lint(pkg_root, docs_path)
     for p in problems:
         sys.stderr.write(p + "\n")

@@ -1,76 +1,54 @@
-"""No-print lint: library code must not write raw stdout.
+"""No-print lint — thin shim over the analysis framework's ``no-print`` rule.
 
-Rejects bare ``print(`` calls in ``distar_tpu/`` outside ``bin/`` (CLI
-entrypoints own their stdout; library code must route output through the
-TextLogger / metrics registry so large-scale runs stay greppable and
-scrapeable). Token-based, so strings, comments and ``pprint``-style names
-never false-positive. A line may opt out with ``# lint: allow-print``
-(none currently do).
+Library code must not write raw stdout: ``distar_tpu/`` outside ``bin/``
+routes output through the TextLogger / metrics registry so large-scale runs
+stay greppable and scrapeable. The actual checker lives in
+``distar_tpu/analysis/hygiene.py`` (one parse pass shared with every other
+rule); this CLI and ``find_bare_prints`` keep the original surface so
+existing test invocations and docs keep working. A line may opt out with
+``# lint: allow-print`` (legacy marker) or an
+``# analysis: allow(no-print) — <why>`` pragma.
 
-Invoked from the test suite (tests/test_no_print_lint.py) and runnable
-standalone: ``python tools/lint_no_print.py``.
+Invoked from the test suite (tests/test_obs_metrics.py) and runnable
+standalone: ``python tools/lint_no_print.py``. The full analyzer is
+``python tools/analyze.py`` (docs/analysis.md).
 """
 from __future__ import annotations
 
-import io
 import os
 import sys
-import tokenize
 from typing import List, Tuple
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
 
 ALLOW_MARKER = "# lint: allow-print"
 
 
 def find_bare_prints(root: str) -> List[Tuple[str, int, str]]:
     """Scan ``root``/**.py (excluding bin/) for bare print( calls; returns
-    (relpath, lineno, line-text) per offence."""
+    (relpath, lineno, line-text) per offence — the pre-framework shape."""
+    from distar_tpu.analysis import ParsedModule, collect_files
+    from distar_tpu.analysis.hygiene import HygieneChecker
+
+    checker = HygieneChecker()
     offences = []
-    for dirpath, dirnames, filenames in os.walk(root):
-        rel_dir = os.path.relpath(dirpath, root)
-        parts = rel_dir.split(os.sep)
-        if "bin" in parts or "_proto_gen" in parts or "__pycache__" in parts:
-            dirnames[:] = []
+    for path in collect_files([root]):
+        mod = ParsedModule(path, os.path.relpath(path, root).replace(os.sep, "/"))
+        if mod.syntax_error is not None:
             continue
-        for fn in sorted(filenames):
-            if not fn.endswith(".py"):
+        for f in checker.check_module(mod):
+            if f.rule != "no-print" or mod.pragma_for(f.line, f.rule) is not None:
                 continue
-            path = os.path.join(dirpath, fn)
-            offences.extend(_scan_file(path, os.path.relpath(path, root)))
+            offences.append(
+                (os.path.relpath(path, root), f.line, mod.line_text(f.line).strip())
+            )
     return offences
 
 
-def _scan_file(path: str, relpath: str) -> List[Tuple[str, int, str]]:
-    with open(path, "rb") as f:
-        source = f.read()
-    lines = source.decode("utf-8", errors="replace").splitlines()
-    out = []
-    try:
-        tokens = list(tokenize.tokenize(io.BytesIO(source).readline))
-    except tokenize.TokenizeError:
-        return out
-    for i, tok in enumerate(tokens):
-        if tok.type != tokenize.NAME or tok.string != "print":
-            continue
-        # attribute access (x.print) or def print(...) is not the builtin
-        prev = tokens[i - 1] if i > 0 else None
-        if prev is not None and prev.type == tokenize.OP and prev.string == ".":
-            continue
-        if prev is not None and prev.type == tokenize.NAME and prev.string in ("def", "class"):
-            continue
-        nxt = tokens[i + 1] if i + 1 < len(tokens) else None
-        if nxt is None or nxt.type != tokenize.OP or nxt.string != "(":
-            continue
-        lineno = tok.start[0]
-        line = lines[lineno - 1] if lineno <= len(lines) else ""
-        if ALLOW_MARKER in line:
-            continue
-        out.append((relpath, lineno, line.strip()))
-    return out
-
-
 def main() -> int:
-    pkg_root = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-                            "distar_tpu")
+    pkg_root = os.path.join(_REPO, "distar_tpu")
     offences = find_bare_prints(pkg_root)
     for relpath, lineno, line in offences:
         sys.stderr.write(f"{relpath}:{lineno}: bare print() in library code: {line}\n")

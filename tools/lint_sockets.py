@@ -1,84 +1,56 @@
-"""Socket-discipline lint: no bare ``except:`` and no unbounded network waits.
+"""Socket-discipline lint — thin shim over the analysis framework's
+``socket-bare-except`` / ``socket-no-timeout`` rules.
 
-Walks ``distar_tpu/**.py`` (AST) and rejects:
+Rejects bare ``except:`` handlers (they swallow ``KeyboardInterrupt``/
+``SystemExit`` and hide the typed error taxonomy the resilience layer
+depends on) and ``urlopen(...)``/``create_connection(...)`` without an
+explicit ``timeout=`` (a hung peer must never park a fleet role forever —
+the week-long-run lesson behind the shuttle deadline fix). The actual
+checker lives in ``distar_tpu/analysis/hygiene.py``; this CLI and
+``find_offences`` keep the original surface. Opt-outs:
+``# lint: allow-bare-except`` / ``# lint: allow-no-timeout`` (legacy) or
+``# analysis: allow(socket-bare-except) — <why>`` pragmas.
 
-* bare ``except:`` handlers — they swallow ``KeyboardInterrupt``/``SystemExit``
-  and hide the typed error taxonomy the resilience layer depends on
-  (``except Exception:`` is the acceptable broad form);
-* ``urlopen(...)`` / ``create_connection(...)`` calls without an explicit
-  ``timeout`` keyword — a hung peer must never park a fleet role forever
-  (the week-long-run lesson behind the shuttle deadline fix).
-
-A line may opt out with ``# lint: allow-bare-except`` or
-``# lint: allow-no-timeout`` (none currently do). Invoked from the test
-suite (tests/test_resilience.py) next to lint_no_print/lint_metric_names,
-and runnable standalone: ``python tools/lint_sockets.py``.
+Invoked from the test suite (tests/test_resilience.py) and runnable
+standalone: ``python tools/lint_sockets.py``. The full analyzer is
+``python tools/analyze.py`` (docs/analysis.md).
 """
 from __future__ import annotations
 
-import ast
 import os
 import sys
 from typing import List, Tuple
 
-TIMEOUT_REQUIRED = ("urlopen", "create_connection")
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
 ALLOW_BARE = "# lint: allow-bare-except"
 ALLOW_NO_TIMEOUT = "# lint: allow-no-timeout"
-SKIP_DIRS = {"__pycache__", "_proto_gen"}
 
-
-def _call_name(node: ast.Call) -> str:
-    func = node.func
-    if isinstance(func, ast.Attribute):
-        return func.attr
-    if isinstance(func, ast.Name):
-        return func.id
-    return ""
-
-
-def _scan_file(path: str, relpath: str) -> List[Tuple[str, int, str]]:
-    with open(path, "rb") as f:
-        source = f.read()
-    lines = source.decode("utf-8", errors="replace").splitlines()
-
-    def line(no: int) -> str:
-        return lines[no - 1] if 0 < no <= len(lines) else ""
-
-    try:
-        tree = ast.parse(source)
-    except SyntaxError:
-        return []
-    out = []
-    for node in ast.walk(tree):
-        if isinstance(node, ast.ExceptHandler) and node.type is None:
-            if ALLOW_BARE not in line(node.lineno):
-                out.append((relpath, node.lineno,
-                            "bare 'except:' — catch a typed error "
-                            "(resilience taxonomy) or 'Exception'"))
-        elif isinstance(node, ast.Call) and _call_name(node) in TIMEOUT_REQUIRED:
-            has_timeout = any(kw.arg == "timeout" for kw in node.keywords)
-            if not has_timeout and ALLOW_NO_TIMEOUT not in line(node.lineno):
-                out.append((relpath, node.lineno,
-                            f"{_call_name(node)}() without an explicit "
-                            "timeout= — unbounded network wait"))
-    return out
+_RULES = ("socket-bare-except", "socket-no-timeout")
 
 
 def find_offences(root: str) -> List[Tuple[str, int, str]]:
+    """(relpath, lineno, message) per offence — the pre-framework shape."""
+    from distar_tpu.analysis import ParsedModule, collect_files
+    from distar_tpu.analysis.hygiene import HygieneChecker
+
+    checker = HygieneChecker()
     offences = []
-    for dirpath, dirnames, filenames in os.walk(root):
-        dirnames[:] = [d for d in dirnames if d not in SKIP_DIRS]
-        for fn in sorted(filenames):
-            if not fn.endswith(".py"):
+    for path in collect_files([root]):
+        mod = ParsedModule(path, os.path.relpath(path, root).replace(os.sep, "/"))
+        if mod.syntax_error is not None:
+            continue
+        for f in checker.check_module(mod):
+            if f.rule not in _RULES or mod.pragma_for(f.line, f.rule) is not None:
                 continue
-            path = os.path.join(dirpath, fn)
-            offences.extend(_scan_file(path, os.path.relpath(path, root)))
+            offences.append((os.path.relpath(path, root), f.line, f.message))
     return offences
 
 
 def main() -> int:
-    pkg_root = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-                            "distar_tpu")
+    pkg_root = os.path.join(_REPO, "distar_tpu")
     offences = find_offences(pkg_root)
     for relpath, lineno, msg in offences:
         sys.stderr.write(f"{relpath}:{lineno}: {msg}\n")
