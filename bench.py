@@ -733,6 +733,165 @@ def bench_rollout() -> dict:
     return out
 
 
+# ------------------------------------------------------------ distill bench
+
+#: ROADMAP item 2's acceptance bar: the student must cost at most half a
+#: teacher step (FLOPs-derived — the committed artifact's ratio is checked
+#: against this in tests/test_distill.py)
+DISTILL_TARGET_RATIO = 0.5
+
+
+def bench_distill() -> dict:
+    """BENCH_MODE=distill: the distillation tier's two numbers.
+
+    * **student/teacher per-step cost ratio** — FLOP counts off the SAME
+      jitted train steps both tiers actually run (teacher: full RL step,
+      fwd+loss+bwd+adam on ``default_model_config``; student: distill step
+      on ``student_model_config``), at the same (batch, unroll). A ratio
+      of flop counts is physics-coherent on ANY host — no chip timing is
+      claimed, which is exactly why this is the number the serve-side
+      capacity multiplier can honestly quote from a CPU CI box (the DD-PPO
+      precedent: keep the scaling story honest while the policy shrinks).
+    * **toy distill run** — a fixed-batch DistillLearner loop whose masked
+      KL vs the teacher must fall MONOTONICALLY over the window (the
+      signal trains; curve committed in-band).
+
+    ``BENCH_DISTILL_SMOKE=1`` shrinks both tiers to smoke dims for the
+    harness test (flagged in-band — a smoke artifact can never be quoted
+    as the real ratio)."""
+    _stage("distill-setup")
+    import itertools
+
+    import jax
+    import jax.numpy as jnp
+
+    from distar_tpu.learner import DistillLearner, RLLearner
+    from distar_tpu.learner.data import fake_rl_batch
+
+    B = int(os.environ.get("BENCH_DISTILL_BATCH", 2))
+    T = int(os.environ.get("BENCH_DISTILL_UNROLL", 8))
+    iters = int(os.environ.get("BENCH_DISTILL_ITERS", 24))
+    smoke = _env_truthy("BENCH_DISTILL_SMOKE")
+    host_cores = len(os.sched_getaffinity(0)) if hasattr(os, "sched_getaffinity") \
+        else (os.cpu_count() or 1)
+
+    smoke_model = {
+        "encoder": {
+            "entity": {"layer_num": 1, "hidden_dim": 32, "output_dim": 16, "head_dim": 8},
+            "spatial": {"down_channels": [4, 4, 8], "project_dim": 4,
+                        "resblock_num": 1, "fc_dim": 16},
+            "scatter": {"output_dim": 4},
+            "core_lstm": {"hidden_size": 32, "num_layers": 1},
+        },
+        "policy": {
+            "action_type_head": {"res_dim": 16, "res_num": 1, "gate_dim": 32},
+            "delay_head": {"decode_dim": 16},
+            "queued_head": {"decode_dim": 16},
+            "selected_units_head": {"func_dim": 16},
+            "target_unit_head": {"func_dim": 16},
+            "location_head": {"res_dim": 8, "res_num": 1,
+                              "upsample_dims": [4, 4, 1], "map_skip_dim": 8},
+        },
+        "value": {"res_dim": 8, "res_num": 1},
+    }
+    model_cfg = smoke_model if smoke else {}
+    common = {"save_freq": 10 ** 9, "log_freq": 10 ** 9}
+
+    # ---- teacher FLOPs: the full RL train step, traced once (no compile,
+    # no timing — the flop count is a property of the lowering)
+    _stage("distill-teacher-trace")
+    teacher = RLLearner({
+        "common": {"experiment_name": "bench_distill_teacher"},
+        "learner": {"batch_size": B, "unroll_len": T,
+                    "value_pretrain_iters": -1, **common},
+        "model": model_cfg,
+    })
+    data = dict(next(teacher._dataloader))
+    data.pop("model_last_iter", None)
+    t_batch = teacher.shard_batch(teacher._cap(data))
+    t_args = (teacher.state["params"], teacher.state["opt_state"], t_batch,
+              jnp.asarray(False))
+    teacher_flops = _flops_of_lowered(teacher._train_step.lower(*t_args))
+    teacher_core = dict(teacher.model_cfg.encoder.core_lstm)
+    teacher_entity = {k: teacher.model_cfg.encoder.entity[k]
+                      for k in ("hidden_dim", "output_dim", "head_num", "layer_num")}
+    del teacher, t_batch, t_args
+
+    # ---- student FLOPs: the distill train step on the shrunk config
+    _stage("distill-student-trace")
+    student = DistillLearner({
+        "common": {"experiment_name": "bench_distill_student"},
+        "learner": {"batch_size": B, "unroll_len": T, **common},
+        "model": model_cfg,
+    })
+    s_data = dict(next(student._dataloader))
+    s_data.pop("model_last_iter", None)
+    s_batch = jax.tree.map(jnp.asarray,
+                           student._strip_batch(student._cap(s_data)))
+    student_flops = _flops_of_lowered(student._train_step.lower(
+        student.state["params"], student.state["opt_state"], s_batch))
+    student_core = dict(student.model_cfg.encoder.core_lstm)
+    student_entity = {k: student.model_cfg.encoder.entity[k]
+                      for k in ("hidden_dim", "output_dim", "head_num", "layer_num")}
+    del s_batch
+
+    ratio = round(student_flops / teacher_flops, 4) \
+        if (teacher_flops and student_flops) else None
+
+    # ---- toy distill loop: fixed batch, KL must fall monotonically
+    _stage("distill-toy-run")
+    toy = DistillLearner({
+        "common": {"experiment_name": "bench_distill_toy"},
+        "learner": {"batch_size": 2, "unroll_len": 3, **common},
+        "model": smoke_model,
+    })
+    toy_batch = fake_rl_batch(2, 3)
+    toy.set_dataloader(itertools.repeat(toy_batch))
+    kl_curve = []
+    for _ in range(iters):
+        kl_curve.append(round(toy._train(dict(next(toy._dataloader)))["divergence"], 5))
+    monotone = all(b < a for a, b in zip(kl_curve, kl_curve[1:]))
+    del toy, student
+
+    out = {
+        "metric": "distill student/teacher per-step cost ratio "
+                  "(FLOPs-derived, same jitted train steps)",
+        "value": ratio,
+        "unit": "x teacher step",
+        "vs_baseline": ratio,
+        "device": "cpu",
+        "cpu_derived": True,
+        "flops_derived": True,
+        "host_cores": host_cores,
+        "scaling_valid": False,
+        "pinning": {"pinned": False,
+                    "refused_reason": "single-process FLOP counting — "
+                                      "nothing to pin",
+                    "host_cores": host_cores},
+        "smoke_model": smoke,
+        "target_ratio": DISTILL_TARGET_RATIO,
+        "meets_target": bool(ratio is not None
+                             and ratio <= DISTILL_TARGET_RATIO) and not smoke,
+        "distill": {
+            "batch": B,
+            "unroll": T,
+            "teacher_flops_per_step": teacher_flops,
+            "student_flops_per_step": student_flops,
+            "teacher_config": {"core_lstm": teacher_core, "entity": teacher_entity},
+            "student_config": {"core_lstm": student_core, "entity": student_entity},
+            "toy_run": {
+                "iters": iters,
+                "kl_curve": kl_curve,
+                "kl_first": kl_curve[0] if kl_curve else None,
+                "kl_last": kl_curve[-1] if kl_curve else None,
+                "monotone_decrease": monotone,
+            },
+        },
+    }
+    print(json.dumps(out), flush=True)
+    return out
+
+
 def _calibrate_matmul(jax):
     """Timing/peak sanity anchor: a dependency-chained bf16 matmul of KNOWN
     FLOPs (8 x 4096^3 = 1.1 TFLOP per call). Every model-step timing rides
@@ -1233,6 +1392,15 @@ def run_child():
         _start_heartbeat()
         try:
             bench_replay()
+        finally:
+            _stop_heartbeat()
+        return
+    if os.environ.get("BENCH_MODE") == "distill":
+        # FLOP-count case: traces on whatever backend jax gives this child
+        # (CPU in CI) but never times it — the ratio is count arithmetic
+        _start_heartbeat()
+        try:
+            bench_distill()
         finally:
             _stop_heartbeat()
         return

@@ -334,6 +334,20 @@ def _maybe_serve_metrics(args, coordinator=None):
     return server
 
 
+def _make_learner(args, model_cfg: dict, load_path: str = ""):
+    """The learner this process hosts: the RL teacher by default, or — with
+    ``--distill`` — the student-tier distillation learner, which consumes
+    the SAME batch stream (teacher logits already ride every flush) and
+    publishes checkpoints under the ``student`` role key so teacher resume
+    can never cross tiers (docs/training_guide.md distillation quickstart)."""
+    if getattr(args, "distill", False):
+        from ..learner import DistillLearner
+
+        return DistillLearner(_learner_cfg(args, model_cfg, load_path=load_path))
+    return plugins.load_component(args.pipeline, "RLLearner")(
+        _learner_cfg(args, model_cfg, load_path=load_path), **_mesh_kwargs(args))
+
+
 def run_all(args) -> None:
     """Single-process league-RL loop on the mock env (the small-scale config
     path; swaps to the real SC2 env behind the same interfaces)."""
@@ -343,7 +357,8 @@ def run_all(args) -> None:
     co = Coordinator()
     # one process hosts every role, so the full rulebook applies locally
     roles = ("learner", "actor", "coordinator", "trace") + (
-        ("replay",) if args.replay else ())
+        ("replay",) if args.replay else ()) + (
+        ("distill",) if args.distill else ())
     fleet = _init_health(args, roles=roles)
     _maybe_serve_metrics(args, coordinator=co)
     actor_adapter = Adapter(coordinator=co)
@@ -416,8 +431,7 @@ def run_all(args) -> None:
             supervisor, {"actor_env_starvation": "actor"}
         ).attach(fleet.evaluator)
 
-    learner = plugins.load_component(args.pipeline, "RLLearner")(
-        _learner_cfg(args, model_cfg), **_mesh_kwargs(args))
+    learner = _make_learner(args, model_cfg)
     if args.replay:
         from ..learner.rl_dataloader import ReplayDataLoader
 
@@ -428,8 +442,11 @@ def run_all(args) -> None:
         ))
     else:
         learner.set_dataloader(RLDataLoader(learner_adapter, player_id, args.batch_size))
-    learner.attach_comm(learner_adapter, player_id, league=league,
-                        send_model_freq=4, send_train_info_freq=4)
+    if not args.distill:
+        # the student tier publishes via checkpoints + fleet rollout, not
+        # the league's weight-push plane (its league player is the teacher)
+        learner.attach_comm(learner_adapter, player_id, league=league,
+                            send_model_freq=4, send_train_info_freq=4)
     _run_learner_supervised(args, learner, args.iters)
     # let the actor finish its in-flight job: a daemon thread killed inside a
     # jitted computation aborts the interpreter teardown
@@ -490,8 +507,10 @@ def run_learner(args) -> None:
     league = RemoteLeague(*_addr(args.league_addr)) if args.league_addr else None
     adapter = Adapter(coordinator_addr=_addr(args.coordinator_addr))
     _init_health(
-        args, roles=("learner", "trace"),
-        source=f"learner:{args.player_id}:{info['rank']}",
+        args,
+        roles=("learner", "trace") + (("distill",) if args.distill else ()),
+        source=(f"distill:{args.player_id}:{info['rank']}" if args.distill
+                else f"learner:{args.player_id}:{info['rank']}"),
         shipper_addr=_addr(args.coordinator_addr),
     )
     _maybe_serve_metrics(args)
@@ -505,8 +524,7 @@ def run_learner(args) -> None:
         ckpt = reply.get("checkpoint_path", "")
         if ckpt and os.path.exists(ckpt):
             load_path = ckpt
-    learner = plugins.load_component(args.pipeline, "RLLearner")(
-        _learner_cfg(args, model_cfg, load_path=load_path), **_mesh_kwargs(args))
+    learner = _make_learner(args, model_cfg, load_path=load_path)
     if not load_path and not getattr(args, "no_supervise", False):
         # a restarted learner process (k8s/systemd) picks up its own durable
         # latest pointer before cold-starting — zero manual intervention
@@ -523,7 +541,8 @@ def run_learner(args) -> None:
         ))
     else:
         learner.set_dataloader(RLDataLoader(adapter, args.player_id, args.batch_size))
-    learner.attach_comm(adapter, args.player_id, league=league)
+    if not args.distill:
+        learner.attach_comm(adapter, args.player_id, league=league)
     _run_learner_supervised(args, learner, args.iters)
     print(f"learner done: {learner.last_iter.val} iters")
 
@@ -734,6 +753,16 @@ def main() -> None:
     p.add_argument("--replay-max-staleness-s", type=float, default=0.0,
                    help="replay role: evict items older than this "
                         "(0 = no staleness eviction)")
+    p.add_argument("--distill", action="store_true",
+                   help="learner-hosting roles: run the student-tier "
+                        "DISTILLATION learner instead of the RL teacher — "
+                        "trains model.student_model_config on the same "
+                        "trajectory batches via masked per-head KL against "
+                        "the teacher logits already riding every flush, "
+                        "publishes checkpoints under the 'student' "
+                        "CheckpointManager role key, and exports the "
+                        "distar_distill_* drift gauges "
+                        "(docs/training_guide.md distillation quickstart)")
     p.add_argument("--player-id", default="MP0")
     p.add_argument("--pipeline", default="default",
                    help="learner implementation to run: 'default' or an "
@@ -794,7 +823,7 @@ def main() -> None:
         # the broker evaluates the FULL rulebook: shipped telemetry gives it
         # per-source learner/actor/serve series for the whole fleet
         _init_health(args, roles=("learner", "actor", "coordinator", "trace",
-                                  "serve", "replay"),
+                                  "serve", "replay", "distill"),
                      source="coordinator")
         server = CoordinatorServer(
             coordinator=Coordinator(default_lease_s=args.lease_s or None),

@@ -1,5 +1,6 @@
 from .base_learner import BaseLearner
 from .data import FakeRLDataloader, FakeSLDataloader, fake_rl_batch, fake_sl_batch
+from .distill_learner import DistillLearner, make_distill_train_step
 from .hooks import Hook, HookRegistry, LambdaHook, default_hooks
 from .rl_dataloader import CollationError, RLDataLoader, ReplayDataLoader, collate_trajectories
 from .rl_learner import RLLearner, make_rl_train_step
@@ -19,6 +20,8 @@ __all__ = [
     "HookRegistry",
     "LambdaHook",
     "default_hooks",
+    "DistillLearner",
+    "make_distill_train_step",
     "RLLearner",
     "make_rl_train_step",
     "SLLearner",

@@ -88,7 +88,9 @@ class BaseLearner:
         self.timer = EasyTimer()
         self.last_iter = CountVar(0)
         self._checkpointer = AsyncCheckpointer()
-        self._ckpt_manager = CheckpointManager(os.path.join(root, "checkpoints"))
+        self._ckpt_manager = CheckpointManager(
+            os.path.join(root, "checkpoints"), role=self.CKPT_ROLE
+        )
         self.log_buffer: Dict[str, Any] = {}
         self.metrics = get_registry()
         prof = self.cfg.learner.get("profile", {})
@@ -122,6 +124,12 @@ class BaseLearner:
     # slicer (data.cap_entities / cap_entities_rl); one choke point for all
     # of setup/prefetch/train host paths
     _CAP_FN = None
+
+    # checkpoint role key (utils.checkpoint.CheckpointManager): "" is the
+    # teacher/default tier; the distillation student sets "student" so the
+    # two tiers' generations can never cross on resume even when they share
+    # an experiment directory
+    CKPT_ROLE = ""
 
     def _cap(self, batch):
         n = self.cfg.learner.get("max_entities")

@@ -1,7 +1,10 @@
+from .distill_loss import DistillLossConfig, compute_distill_loss
 from .rl_loss import ReinforcementLossConfig, compute_rl_loss
 from .sl_loss import SupervisedLossConfig, compute_sl_loss
 
 __all__ = [
+    "DistillLossConfig",
+    "compute_distill_loss",
     "ReinforcementLossConfig",
     "compute_rl_loss",
     "SupervisedLossConfig",

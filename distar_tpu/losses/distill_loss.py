@@ -1,0 +1,94 @@
+"""Actor-learner distillation loss: masked per-head KL against the teacher.
+
+The student tier ("Efficient Transformers in Reinforcement Learning using
+Actor-Learner Distillation", PAPERS.md) trains on the SAME trajectory
+batches the RL learner consumes — the teacher logits already ride every
+rollout flush (the serve plane's ``want_teacher`` leg), so distillation
+costs zero extra teacher forwards on the hot path. The loss is the
+forward KL ``KL(teacher || student)`` per action head, with exactly the
+mask semantics of :mod:`losses.rl_loss`'s ``_kl_terms``:
+
+  * ``selected_units``: per-lane KL over the pointer decode, summed over
+    the S axis under ``selected_units_mask`` (a step with zero active
+    lanes contributes nothing);
+  * heads outside ``ALWAYS_ON`` gate on ``actions_mask[head]`` (a step
+    whose action type takes no target unit must not distill one);
+  * every head multiplies ``step_mask`` so pad steps after a mid-window
+    episode end contribute to no term.
+
+Input layout (time-major, the RL batch's own shapes):
+  student_logit[head]   [T, B, ...]
+  teacher_logit[head]   [T, B, ...]
+  mask:
+    actions_mask[head]  [T, B]
+    selected_units_mask [T, B, S]
+    step_mask           [T, B]   (optional; 1 real / 0 pad)
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .rl_loss import ALWAYS_ON, HEADS, _default_head_weights
+
+
+@dataclasses.dataclass(frozen=True)
+class DistillLossConfig:
+    """Head weights mirror the RL loss's (selected_units down-weighted the
+    same way); ``temperature`` softens BOTH distributions (T > 1 transfers
+    more of the teacher's dark knowledge; the KL is computed at the
+    softened temperature, standard distillation practice)."""
+
+    temperature: float = 1.0
+    selected_units_head_weight: float = 0.01
+
+    def head_weights(self) -> Dict[str, float]:
+        return _default_head_weights(self.selected_units_head_weight)
+
+
+def compute_distill_loss(
+    inputs: Dict,
+    cfg: DistillLossConfig = DistillLossConfig(),
+) -> Tuple[jnp.ndarray, Dict[str, jnp.ndarray]]:
+    """(total, info): weighted masked KL summed over heads. ``info`` carries
+    ``kl/<head>`` per-head means, the weighted ``kl/total``, and
+    ``divergence`` — the UNWEIGHTED sum of head means, the drift gauge the
+    distill learner publishes (weight-independent, so retuning head weights
+    never silently moves the health rule's input)."""
+    student = inputs["student_logit"]
+    teacher = inputs["teacher_logit"]
+    masks = inputs["mask"]
+    su_mask = masks["selected_units_mask"]
+    tau = cfg.temperature
+
+    any_head = student["action_type"]
+    step_mask = masks.get("step_mask")
+    if step_mask is None:
+        step_mask = jnp.ones(any_head.shape[:2], dtype=jnp.float32)
+    else:
+        step_mask = step_mask.astype(jnp.float32)
+
+    info: Dict[str, jnp.ndarray] = {}
+    head_w = cfg.head_weights()
+    total = 0.0
+    divergence = 0.0
+    for head in HEADS:
+        t_logp = jax.nn.log_softmax(teacher[head] / tau, axis=-1)
+        s_logp = jax.nn.log_softmax(student[head] / tau, axis=-1)
+        kl = (jnp.exp(t_logp) * (t_logp - s_logp)).sum(-1)
+        if head == "selected_units":
+            kl = (kl * su_mask).sum(-1)
+        kl = kl * step_mask
+        if head not in ALWAYS_ON:
+            kl = kl * masks["actions_mask"][head]
+        kl_mean = kl.mean()
+        info[f"kl/{head}"] = kl_mean
+        total += kl_mean * head_w[head]
+        divergence += kl_mean
+    info["kl/total"] = total
+    info["divergence"] = divergence
+    info["total_loss"] = total
+    return total, info

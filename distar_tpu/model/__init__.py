@@ -1,4 +1,4 @@
-from .config import default_model_config
+from .config import default_model_config, student_model_config
 from .core import Model
 
-__all__ = ["Model", "default_model_config"]
+__all__ = ["Model", "default_model_config", "student_model_config"]

@@ -13,7 +13,7 @@ from typing import Any, Mapping
 
 from ..lib import actions as A
 from ..lib.features import BEGINNING_ORDER_LENGTH, MAX_DELAY, SPATIAL_SIZE
-from ..utils import Config
+from ..utils import Config, deep_merge_dicts
 
 SPATIAL_Y, SPATIAL_X = SPATIAL_SIZE
 
@@ -250,3 +250,66 @@ def default_model_config() -> Config:
             },
         }
     )
+
+
+#: The distillation student's shrink overlay (cascaded over the teacher's
+#: config by :func:`student_model_config`). Every head keeps its STRUCTURE
+#: — same six heads, same action vocabularies, same logit axes — so the
+#: student's wire outputs (logits, actions, versions) are drop-in
+#: replacements for the teacher's on every serving surface; only widths,
+#: depths and the LSTM carry dims shrink. Dims that derive from the
+#: observation contract (scalar-field vocabularies, context_dim 448, the
+#: spatial grid) are untouched: shrinking them would change semantics, not
+#: just capacity.
+STUDENT_SHRINK = {
+    "encoder": {
+        "entity": {
+            # the entity transformer is the FLOP center: half the width,
+            # quarter the MLP, one less block
+            "head_dim": 64,
+            "hidden_dim": 256,
+            "output_dim": 128,
+            "layer_num": 2,
+        },
+        "spatial": {
+            "project_dim": 16,
+            "down_channels": [32, 64, 64],
+            "resblock_num": 2,
+            "fc_dim": 128,
+        },
+        "scatter": {"output_dim": 16},
+        # half the carry width; SAME layer count, so the (h, c)-tuple
+        # structure the serve plane snapshots/restores is isomorphic
+        # (input = 1024 scalar concat + 128 entity + 128 spatial)
+        "core_lstm": {"input_size": 1280, "hidden_size": 192, "num_layers": 3},
+    },
+    "policy": {
+        "action_type_head": {
+            "input_dim": 192, "res_dim": 128, "res_num": 1,
+            "action_map_dim": 128, "gate_dim": 256,
+        },
+        "delay_head": {"decode_dim": 128, "delay_map_dim": 128},
+        "queued_head": {"decode_dim": 128, "queued_map_dim": 128},
+        "selected_units_head": {"func_dim": 128},
+        "target_unit_head": {"func_dim": 128},
+        "location_head": {
+            "res_dim": 64, "res_num": 2, "map_skip_dim": 64,
+            "upsample_dims": [32, 16, 1],
+        },
+    },
+    "value": {"input_dim": 192, "res_dim": 128, "res_num": 4},
+}
+
+
+def student_model_config(overrides: Mapping = None) -> Config:
+    """The distillation student: :func:`default_model_config` with
+    :data:`STUDENT_SHRINK` cascaded over it, then any user ``overrides``
+    (so a smoke config shrinks the student the same way it shrinks the
+    teacher). Head structure is identical to the teacher's by construction
+    — only capacity differs — which is what lets student checkpoints roll
+    through the same gateways, canary splits and player muxes as teacher
+    ones (docs/serving.md, model tiering)."""
+    cfg = deep_merge_dicts(default_model_config(), STUDENT_SHRINK)
+    if overrides:
+        cfg = deep_merge_dicts(cfg, overrides)
+    return cfg

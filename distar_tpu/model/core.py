@@ -231,15 +231,14 @@ class Model(nn.Module):
         }
 
     # ------------------------------------------------------------- learner
-    def rl_forward(self, spatial_info, entity_info, scalar_info, entity_num,
-                   hidden_state, action_info, selected_units_num, batch_size,
-                   unroll_len, value_feature=None):
-        """Flat [(T+1)*B, ...] inputs -> policy logits [T, B, ...] and six
-        baseline values [T+1, B] (reference rl_learner_forward :95-168).
-
-        ``hidden_state`` is the per-trajectory initial state, tuple of
-        (h, c) pairs each [B, H].
-        """
+    def _learner_logits(self, spatial_info, entity_info, scalar_info,
+                        entity_num, hidden_state, action_info,
+                        selected_units_num, batch_size, unroll_len):
+        """Shared logits half of the learner forwards: encoder -> LSTM over
+        the [T+1, B] window -> teacher-forced policy logits on the first T
+        steps. Returns (logits [T, B, ...] dict with the selected-units S
+        axis padded static, flat LSTM outputs, baseline_feature) — the
+        value-tower consumers take the last two."""
         flat_action = {k: v.reshape((-1,) + v.shape[2:]) for k, v in action_info.items()}
         flat_sun = selected_units_num.reshape(-1)
 
@@ -259,6 +258,48 @@ class Model(nn.Module):
             entity_num[:n_policy],
             flat_action,
             flat_sun,
+        )
+        logits = {
+            k: v.reshape((unroll_len, batch_size) + v.shape[1:]) for k, v in logits.items()
+        }
+        # pad selected-units logits to the fixed S axis so downstream shapes
+        # are static (reference model.py:156-158)
+        su = logits["selected_units"]
+        if su.shape[2] < MAX_SELECTED_UNITS_NUM:
+            su = jnp.pad(
+                su,
+                ((0, 0), (0, 0), (0, MAX_SELECTED_UNITS_NUM - su.shape[2]), (0, 0)),
+                constant_values=NEG_INF,
+            )
+        logits["selected_units"] = su
+        return logits, flat_out, baseline_feature
+
+    def policy_forward(self, spatial_info, entity_info, scalar_info, entity_num,
+                       hidden_state, action_info, selected_units_num, batch_size,
+                       unroll_len):
+        """``rl_forward``'s policy half without the value towers — the
+        distillation student's train-time forward (student models carry no
+        baselines; their training signal is the teacher's logits, not
+        returns). Same flat [(T+1)*B, ...] input layout, returns
+        ``{"target_logit": [T, B, ...]}``."""
+        logits, _, _ = self._learner_logits(
+            spatial_info, entity_info, scalar_info, entity_num, hidden_state,
+            action_info, selected_units_num, batch_size, unroll_len,
+        )
+        return {"target_logit": logits}
+
+    def rl_forward(self, spatial_info, entity_info, scalar_info, entity_num,
+                   hidden_state, action_info, selected_units_num, batch_size,
+                   unroll_len, value_feature=None):
+        """Flat [(T+1)*B, ...] inputs -> policy logits [T, B, ...] and six
+        baseline values [T+1, B] (reference rl_learner_forward :95-168).
+
+        ``hidden_state`` is the per-trajectory initial state, tuple of
+        (h, c) pairs each [B, H].
+        """
+        logits, flat_out, baseline_feature = self._learner_logits(
+            spatial_info, entity_info, scalar_info, entity_num, hidden_state,
+            action_info, selected_units_num, batch_size, unroll_len,
         )
 
         if not static_cfg(self.cfg).use_value_network:
@@ -286,19 +327,6 @@ class Model(nn.Module):
             k: v(critic_input).reshape(unroll_len + 1, batch_size)
             for k, v in self.value_networks.items()
         }
-        logits = {
-            k: v.reshape((unroll_len, batch_size) + v.shape[1:]) for k, v in logits.items()
-        }
-        # pad selected-units logits to the fixed S axis so downstream shapes
-        # are static (reference model.py:156-158)
-        su = logits["selected_units"]
-        if su.shape[2] < MAX_SELECTED_UNITS_NUM:
-            su = jnp.pad(
-                su,
-                ((0, 0), (0, 0), (0, MAX_SELECTED_UNITS_NUM - su.shape[2]), (0, 0)),
-                constant_values=NEG_INF,
-            )
-        logits["selected_units"] = su
         return {"target_logit": logits, "value": values}
 
     # ------------------------------------------------------------------ SL

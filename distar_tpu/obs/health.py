@@ -37,7 +37,7 @@ OK, WARNING, FIRING = "ok", "warning", "firing"
 _STATE_LEVEL = {OK: 0, WARNING: 1, FIRING: 2}
 
 AGGS = ("last", "mean", "min", "max", "rate")
-OPS = (">", ">=", "<", "<=", "nonfinite", "stalled")
+OPS = (">", ">=", "<", "<=", "nonfinite", "stalled", "trending_up")
 
 
 @dataclass
@@ -50,7 +50,11 @@ class HealthRule:
     fires when a series with >=2 in-window points stopped moving (rate==0) —
     the counter-watchdog primitive (no data at all is NOT a breach: a role
     that never started is absence, not a stall; staleness is tracked
-    per-source instead)."""
+    per-source instead). ``op='trending_up'`` is the gauge-drift primitive:
+    it breaches when the window's slope exceeds ``threshold`` (units/s) AND
+    the last value sits at or above the window mean — a persistent rise,
+    not one noisy endpoint; the ``for_count`` debounce then demands the
+    trend survive consecutive evaluations before firing."""
 
     name: str
     metric: str
@@ -82,6 +86,12 @@ class HealthRule:
             if rate is None:  # <2 points: not enough history to call a stall
                 return None
             return rate if rate == 0.0 else None
+        if self.op == "trending_up":
+            rate, last, mean = q["rate"], q["last"], q["mean"]
+            if rate is None or last is None or not math.isfinite(last):
+                return None
+            rising = rate > self.threshold and (mean is None or last >= mean)
+            return rate if rising else None
         v = q["rate"] if self.agg == "rate" else q[self.agg]
         if v is None or not math.isfinite(v):
             return None
@@ -295,7 +305,8 @@ class HealthEvaluator:
 
 # ------------------------------------------------------------ default rules
 def default_rulebook(roles: Iterable[str] = ("learner", "actor", "coordinator",
-                                             "trace", "serve", "replay"),
+                                             "trace", "serve", "replay",
+                                             "distill"),
                      slo_e2e_s: float = 30.0,
                      queue_saturation: float = 384.0,
                      shed_rate_per_s: float = 5.0,
@@ -328,6 +339,21 @@ def default_rulebook(roles: Iterable[str] = ("learner", "actor", "coordinator",
             summary="measured MFU collapsed below 2% of the chip's peak — "
                     "the step is input/host-bound or a kernel regressed "
                     "(capture a trace: opsctl profile)",
+        ))
+    if "distill" in roles:
+        book.append(HealthRule(
+            name="distill_divergence_runaway",
+            # gauge drift, not level: a healthy student's KL falls toward a
+            # floor; a KL RISING over the window means the student has
+            # fallen behind a fast-moving teacher (stale student rollouts
+            # serve increasingly off-policy actions) — warn while the
+            # canary-compare gate still protects promotion
+            metric="distar_distill_kl", op="trending_up", threshold=0.0,
+            window_s=stall_window_s, for_count=3, severity="warning",
+            summary="student-vs-teacher KL divergence is trending up over "
+                    "the window — the student has fallen behind a "
+                    "fast-moving teacher (check distill learner throughput "
+                    "and the teacher's publish cadence)",
         ))
     if "actor" in roles:
         book.append(HealthRule(

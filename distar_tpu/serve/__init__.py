@@ -42,7 +42,7 @@ from .batcher import MicroBatcher, PendingRequest
 from .sessions import SessionTable
 from .registry import ModelRegistry
 from .gateway import InferenceGateway
-from .mux import GatewayMux
+from .mux import STUDENT_TIER, TEACHER_TIER, GatewayMux, tier_player
 from .http_frontend import ServeHTTPServer
 from .tcp_frontend import ServeClient, ServeTCPServer
 
@@ -52,6 +52,9 @@ __all__ = [
     "DeadlineExceededError",
     "DrainingError",
     "GatewayMux",
+    "STUDENT_TIER",
+    "TEACHER_TIER",
+    "tier_player",
     "InferenceGateway",
     "MicroBatcher",
     "MockModelEngine",

@@ -78,7 +78,8 @@ class FleetRollout:
             outcome: get_registry().counter(
                 "distar_fleet_rollouts_total",
                 "fleet-wide rollout attempts by outcome", outcome=outcome)
-            for outcome in ("ok", "load_nack", "rolled_back", "rollback_failed")
+            for outcome in ("ok", "load_nack", "rolled_back",
+                            "rollback_failed", "compare_gated")
         }
 
     # ------------------------------------------------------------------ plumbing
@@ -129,6 +130,11 @@ class FleetRollout:
                 self._c_rollouts["load_nack"].inc()
                 return {"ok": False, "outcome": "load_nack", "phase": "status",
                         "acks": {addr: st["error"]}}
+            # the rollback target must be what THIS player serves: on a
+            # multiplexed gateway the top-level registry is the default
+            # player's (e.g. the teacher's), not the player being rolled
+            if player is not None and (st.get("players") or {}).get(player):
+                st = st["players"][player]
             prev[addr] = (st.get("registry") or {}).get("current")
 
         # phase 1: load + warm everywhere; a loaded version is inert until
@@ -202,18 +208,58 @@ class FleetRollout:
         return {**verdict, "canary": {"addrs": canary_addrs, "pct": pct,
                                       "version": version}}
 
-    def compare(self, canary_addrs: Sequence[str]) -> dict:
+    def _fetch_divergence(self, window_s: float = 600.0) -> Optional[float]:
+        """Freshest ``distar_distill_kl`` value from the coordinator's TSDB
+        (the distill learner ships it with the rest of its telemetry) —
+        the divergence-vs-teacher leg of the canary compare. None when no
+        coordinator is configured or no distill learner ever shipped."""
+        if self.coordinator_addr is None:
+            return None
+        import json
+        import urllib.request
+
+        host, port = self.coordinator_addr
+        url = (f"http://{host}:{port}/timeseries"
+               f"?name=distar_distill_kl&window_s={window_s:g}")
+        try:
+            with urllib.request.urlopen(url, timeout=5.0) as resp:
+                body = json.loads(resp.read())
+        except (OSError, ValueError):
+            return None
+        best = None
+        for st in (body.get("stats") or {}).values():
+            last = (st or {}).get("last")
+            if isinstance(last, (int, float)):
+                ts = (st or {}).get("last_ts", 0.0)
+                if best is None or ts > best[0]:
+                    best = (ts, float(last))
+        return best[1] if best else None
+
+    def compare(self, canary_addrs: Sequence[str],
+                baseline: Optional[dict] = None,
+                divergence: Optional[float] = None,
+                max_divergence: Optional[float] = None,
+                min_fps_ratio: float = 0.9,
+                shed_slack: float = 0.01,
+                latency_ratio: float = 1.5) -> dict:
         """Canary vs stable, from each gateway's own request accounting:
-        cumulative outcome counters, shed rate and latency tails per pool —
-        the promote/abort evidence. (Counters are lifetime; for a clean
-        A/B, snapshot before the canary and diff, or read the
-        ``distar_serve_*`` series over the canary window via the TSDB.)"""
+        cumulative outcome counters, shed rate and latency tails per pool,
+        plus the two distillation-tier axes — **frames/s-per-slot** (ok
+        requests per second per session slot, the serve-side throughput a
+        cheaper student must not lose; measurable when ``baseline`` is a
+        previous ``compare()`` snapshot to diff the lifetime counters
+        against) and **divergence-vs-teacher** (``divergence=`` explicit,
+        else the freshest ``distar_distill_kl`` from the coordinator TSDB).
+
+        The returned ``verdict`` block is the promote/abort evidence the
+        gated :meth:`promote` consumes: ``promote`` is True only when every
+        measurable check passes; each failure lands in ``reasons``."""
         canary_set = set(canary_addrs)
         pools: Dict[str, dict] = {
             "stable": {"gateways": 0, "requests": {}, "shed_rate": 0.0,
-                       "latency_p99_s": 0.0},
+                       "latency_p99_s": 0.0, "slots": 0},
             "canary": {"gateways": 0, "requests": {}, "shed_rate": 0.0,
-                       "latency_p99_s": 0.0},
+                       "latency_p99_s": 0.0, "slots": 0},
         }
         for addr, st in self.fleet_status().items():
             pool = pools["canary" if addr in canary_set else "stable"]
@@ -221,6 +267,7 @@ class FleetRollout:
                 pool.setdefault("unreachable", []).append(addr)
                 continue
             pool["gateways"] += 1
+            pool["slots"] += (st.get("sessions") or {}).get("num_slots", 0)
             for k, v in (st.get("requests") or {}).items():
                 pool["requests"][k] = pool["requests"].get(k, 0.0) + v
             pool["shed_rate"] += st.get("shed_rate", 0.0)
@@ -229,13 +276,64 @@ class FleetRollout:
         for pool in pools.values():
             if pool["gateways"]:
                 pool["shed_rate"] = round(pool["shed_rate"] / pool["gateways"], 6)
-        return pools
+        out: Dict[str, Any] = dict(pools)
+        out["ts"] = time.time()
+        if baseline is not None and baseline.get("ts"):
+            elapsed = max(out["ts"] - baseline["ts"], 1e-9)
+            for name, pool in pools.items():
+                prev = (baseline.get(name) or {}).get("requests") or {}
+                ok_delta = pool["requests"].get("ok", 0.0) - prev.get("ok", 0.0)
+                if pool["slots"]:
+                    pool["fps_per_slot"] = round(
+                        ok_delta / elapsed / pool["slots"], 6)
+        if divergence is None:
+            divergence = self._fetch_divergence()
+        if divergence is not None:
+            out["divergence"] = divergence
+
+        reasons = []
+        canary, stable = pools["canary"], pools["stable"]
+        if canary.get("unreachable"):
+            reasons.append(f"canary gateways unreachable: {canary['unreachable']}")
+        if not canary["gateways"]:
+            reasons.append("no reachable canary gateway")
+        if canary["shed_rate"] > stable["shed_rate"] + shed_slack:
+            reasons.append(
+                f"canary shed_rate {canary['shed_rate']} > stable "
+                f"{stable['shed_rate']} + {shed_slack}")
+        if (canary["latency_p99_s"] and stable["latency_p99_s"]
+                and canary["latency_p99_s"] > latency_ratio * stable["latency_p99_s"]):
+            reasons.append(
+                f"canary p99 {canary['latency_p99_s']:.4f}s > "
+                f"{latency_ratio}x stable {stable['latency_p99_s']:.4f}s")
+        c_fps, s_fps = canary.get("fps_per_slot"), stable.get("fps_per_slot")
+        if c_fps is not None and s_fps and c_fps < min_fps_ratio * s_fps:
+            reasons.append(
+                f"canary fps_per_slot {c_fps} < {min_fps_ratio}x stable {s_fps}")
+        if (max_divergence is not None and divergence is not None
+                and divergence > max_divergence):
+            reasons.append(
+                f"divergence vs teacher {divergence:.4f} > "
+                f"max_divergence {max_divergence}")
+        out["verdict"] = {"promote": not reasons, "reasons": reasons}
+        return out
 
     def promote(self, version: str, source: Optional[str] = None, params=None,
-                router=None, player: Optional[str] = None) -> dict:
+                router=None, player: Optional[str] = None,
+                verdict: Optional[dict] = None) -> dict:
         """The canary graduated: atomic fleet-wide rollout of ``version``,
         then clear the canary split (pins stay — sessions already on canary
-        gateways are now on the fleet generation anyway)."""
+        gateways are now on the fleet generation anyway). Pass a
+        :meth:`compare` result (or its ``verdict`` block) as ``verdict`` to
+        GATE the promotion on the compare evidence: a failing verdict
+        refuses with ``outcome="compare_gated"`` and touches nothing — the
+        canary keeps serving its split until an operator decides."""
+        if verdict is not None:
+            v = verdict.get("verdict", verdict)
+            if not v.get("promote", True):
+                self._c_rollouts["compare_gated"].inc()
+                return {"ok": False, "outcome": "compare_gated",
+                        "reasons": list(v.get("reasons", []))}
         verdict = self.rollout(version, source=source, params=params,
                                player=player)
         if verdict["ok"]:
@@ -265,8 +363,15 @@ def main(argv=None) -> int:
     p.add_argument("--version", default="", help="registry version name")
     p.add_argument("--source", default="", help="checkpoint storage URL")
     p.add_argument("--canary-addrs", default="",
-                   help="canary: comma list of gateway addrs to canary")
+                   help="canary: comma list of gateway addrs to canary; "
+                        "promote: gate on compare() over these addrs "
+                        "(shed/latency/divergence — a failing verdict "
+                        "refuses with outcome=compare_gated)")
     p.add_argument("--canary-pct", type=float, default=10.0)
+    p.add_argument("--max-divergence", type=float, default=None,
+                   help="promote gating: refuse when the freshest "
+                        "distar_distill_kl in the coordinator TSDB exceeds "
+                        "this (the student drifted too far from the teacher)")
     p.add_argument("--player", default="", help="multiplexed gateways: player id")
     p.add_argument("--timeout-s", type=float, default=60.0)
     args = p.parse_args(argv)
@@ -296,7 +401,12 @@ def main(argv=None) -> int:
             verdict = ctl.canary_start(args.version, addrs, args.canary_pct,
                                        source=args.source, player=player)
         else:  # promote
-            verdict = ctl.promote(args.version, source=args.source, player=player)
+            gate = None
+            addrs = [a for a in args.canary_addrs.split(",") if a.strip()]
+            if addrs:
+                gate = ctl.compare(addrs, max_divergence=args.max_divergence)
+            verdict = ctl.promote(args.version, source=args.source,
+                                  player=player, verdict=gate)
         print(json.dumps(verdict, default=str))  # lint: allow-print
         return 0 if verdict.get("ok") else 1
     finally:

@@ -21,6 +21,30 @@ from typing import Dict, List, Optional
 from .errors import UnknownPlayerError
 from .gateway import InferenceGateway
 
+#: Model-tiering player ids (docs/serving.md, model tiering): the wire
+#: ``player`` field IS the QoS class — teacher-tier traffic (eval, ladder,
+#: showmatches: quality-critical, low volume) names the full-size policy,
+#: student-tier traffic (bulk rollouts: volume-critical) names the
+#: distilled student. One mux, one address, zero new wire surface.
+TEACHER_TIER = "teacher"
+STUDENT_TIER = "student"
+
+_TIER_BY_TRAFFIC = {
+    "eval": TEACHER_TIER,
+    "ladder": TEACHER_TIER,
+    "showmatch": TEACHER_TIER,
+    "rollout": STUDENT_TIER,
+    "bulk": STUDENT_TIER,
+}
+
+
+def tier_player(traffic: str, default: str = STUDENT_TIER) -> str:
+    """The serving-tier player id for a traffic class: quality-critical
+    classes (eval/ladder/showmatch) ride the teacher, everything bulk
+    rides the student. Unknown classes get ``default`` — bulk-by-default
+    keeps the expensive tier reserved for traffic that NAMED it."""
+    return _TIER_BY_TRAFFIC.get(str(traffic).lower(), default)
+
 
 class GatewayMux:
     """The gateway surface over a per-player gateway table.

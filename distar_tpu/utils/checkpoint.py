@@ -294,19 +294,30 @@ class CheckpointManager:
     bytes still verify — a truncated or bit-flipped newest checkpoint falls
     back to the previous one (counted in
     ``distar_resilience_ckpt_fallbacks_total`` + a flight-recorder event).
+
+    ``role`` partitions generations within one checkpoint directory: a
+    manager with ``role="student"`` records into ``latest_student.json``
+    and stamps each generation with the role, and ``generations()``
+    additionally filters entries by role — so a teacher's crash-resume can
+    NEVER pick a distillation-student generation (or vice versa) even if
+    both roles share a directory or a pointer file is hand-edited. The
+    empty role is the teacher/default tier (the historical ``latest.json``,
+    unchanged on disk).
     """
 
     POINTER = "latest.json"
 
-    def __init__(self, directory: str, keep: int = 5):
+    def __init__(self, directory: str, keep: int = 5, role: str = ""):
         assert keep >= 1
         self.directory = directory
         self.keep = keep
+        self.role = str(role or "")
         self._lock = threading.Lock()
 
     @property
     def pointer_path(self) -> str:
-        return os.path.join(self.directory, self.POINTER)
+        name = self.POINTER if not self.role else f"latest_{self.role}.json"
+        return os.path.join(self.directory, name)
 
     # -------------------------------------------------------------- recording
     def record(self, path: str, step: int = 0) -> None:
@@ -314,7 +325,10 @@ class CheckpointManager:
         checkpoint bytes are durable (sync save return / async on_complete)."""
         with self._lock:
             gens = [g for g in self.generations() if g.get("path") != path]
-            gens.insert(0, {"path": path, "step": int(step), "ts": time.time()})
+            entry = {"path": path, "step": int(step), "ts": time.time()}
+            if self.role:
+                entry["role"] = self.role
+            gens.insert(0, entry)
             gens = gens[: self.keep]
             storage.write_bytes(
                 self.pointer_path,
@@ -330,7 +344,10 @@ class CheckpointManager:
         except (ValueError, OSError):
             return []  # torn pointer: treated as no-resume, not a crash
         gens = data.get("generations", [])
-        return [g for g in gens if isinstance(g, dict) and g.get("path")]
+        # role filter: even a hand-merged pointer file cannot hand this
+        # role another role's generation (the resume-isolation contract)
+        return [g for g in gens if isinstance(g, dict) and g.get("path")
+                and str(g.get("role", "") or "") == self.role]
 
     # -------------------------------------------------------------- resolving
     def resolve_latest(self) -> Optional[Dict]:

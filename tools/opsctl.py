@@ -36,7 +36,12 @@ aggregate line whose served-version spread says whether a fleet rollout has
 converged. A coordinator hosting an ``Autoscaler`` (GET /autoscaler) adds
 the AUTOSCALER digest: per-fleet target vs actual membership with
 in-progress drains, per-policy value/threshold/hysteresis state, and the
-last scaling decision with its reason. ``profile`` talks to a LEARNER ADMIN surface
+last scaling decision with its reason. When a ``--distill`` learner ships
+telemetry to the probed TSDB, ``status`` adds the DISTILLATION digest:
+student vs teacher generation (and lag), the live divergence gauge (total
++ per head), the FLOPs-derived step-cost ratio, and the current canary
+split state from the ``serve_canary`` record — student drift at a glance
+without reading raw metrics. ``profile`` talks to a LEARNER ADMIN surface
 (``rl_train --admin-port``): captures --steps iterations of jax.profiler
 trace on the live learner and prints the ranked per-bucket attribution
 table (obs/traceview.py).
@@ -337,6 +342,62 @@ _PERF_DIGEST_NAMES = tuple(
 )
 
 
+def _print_distill_digest(addr: str) -> None:
+    """Distillation digest for ``status``: student vs teacher generation,
+    the live divergence gauge (total + per head), the step-cost ratio when
+    a learner published one, and the canary split state — everything an
+    operator needs to see student drift without reading raw metrics. All
+    from the probed TSDB (shipped by any ``--distill`` learner) plus the
+    coordinator's ``serve_canary`` record; silent when no distill learner
+    ever shipped."""
+    def last_of(name, window=600):
+        body = _try_get(addr,
+                        f"/timeseries?name={urllib.parse.quote(name)}"
+                        f"&window_s={window}")
+        best = None
+        for source, st in ((body or {}).get("stats") or {}).items():
+            if st and st.get("last") is not None:
+                ts = st.get("last_ts", 0.0)
+                if best is None or ts > best[0]:
+                    best = (ts, source, st["last"])
+        return best  # (ts, source, value) or None
+
+    kl = last_of("distar_distill_kl")
+    if kl is None:
+        return
+    print("distillation:")
+    student = last_of("distar_distill_student_generation")
+    teacher = last_of("distar_distill_teacher_generation")
+    s_gen = int(student[2]) if student else "-"
+    t_gen = int(teacher[2]) if teacher else "-"
+    lag = (f" (lag {int(teacher[2]) - int(student[2])})"
+           if student and teacher else "")
+    print(f"  [{kl[1]}] student_gen={s_gen} teacher_gen={t_gen}{lag}  "
+          f"divergence={kl[2]:.6g}")
+    heads = []
+    for head in ("action_type", "delay", "queued", "selected_units",
+                 "target_unit", "target_location"):
+        row = last_of(f"distar_distill_head_kl{{head={head}}}")
+        if row:
+            heads.append(f"{head}={row[2]:.4g}")
+    if heads:
+        print(f"  per-head KL: {' '.join(heads)}")
+    ratio = last_of("distar_distill_step_cost_ratio")
+    if ratio:
+        print(f"  step-cost ratio: {ratio[2]:.4g}x teacher (FLOPs-derived)")
+    canary = _try_post(addr, "/coordinator/peers", {"token": "serve_canary"})
+    recs = (canary or {}).get("info") or []
+    if recs:
+        latest = max(recs, key=lambda r: r.get("ts", 0.0))
+        meta = latest.get("meta") or {}
+        if meta.get("pct"):
+            print(f"  canary split: {meta.get('pct')}% -> "
+                  f"{','.join(meta.get('addrs') or [])} "
+                  f"(version {meta.get('version') or '?'})")
+        else:
+            print("  canary split: none (pct=0)")
+
+
 def _print_actor_digest(addr: str) -> None:
     """Actor-throughput digest from the probed TSDB: env-steps/s, the
     rollout-plane backend serving the fleet, plane sample rates per
@@ -444,6 +505,10 @@ def cmd_status(args) -> int:
     # elastic-control-plane digest (present when the probed coordinator
     # hosts an autoscaler): policy state, target vs actual, live drains
     _print_autoscaler(args.addr)
+    # distillation-tier digest (present when a --distill learner ships
+    # telemetry here): student/teacher generation drift, live divergence,
+    # canary split state
+    _print_distill_digest(args.addr)
     _print_perf_digest(args.addr)
     _print_actor_digest(args.addr)
     return {"ok": 0, "warning": 1}.get(status, 2)

@@ -331,12 +331,14 @@ def collect_trajectory(repo: str = _REPO) -> List[dict]:
                        + glob.glob(os.path.join(repo, "FLEET_r*.json"))
                        + glob.glob(os.path.join(repo, "SHM_r*.json"))
                        + glob.glob(os.path.join(repo, "TRACE_r*.json"))
+                       + glob.glob(os.path.join(repo, "DISTILL_r*.json"))
                        + glob.glob(os.path.join(repo, "artifacts", "perf_baseline*.json"))
                        + glob.glob(os.path.join(repo, "artifacts", "rollout_*.json"))
                        + glob.glob(os.path.join(repo, "artifacts", "replay_*.json"))
                        + glob.glob(os.path.join(repo, "artifacts", "fleet_*.json"))
                        + glob.glob(os.path.join(repo, "artifacts", "shm_*.json"))
-                       + glob.glob(os.path.join(repo, "artifacts", "trace_*.json"))):
+                       + glob.glob(os.path.join(repo, "artifacts", "trace_*.json"))
+                       + glob.glob(os.path.join(repo, "artifacts", "distill_*.json"))):
         try:
             doc = load_artifact(path)
         except (OSError, ValueError):
@@ -387,6 +389,18 @@ def collect_trajectory(repo: str = _REPO) -> List[dict]:
                           f"{doc.get('envelope_pct'):g}% envelope",
                 "value": 1.0 if doc.get("within_envelope") else 0.0,
                 "unit": "bool",
+                "status": _status_of(doc),
+            })
+        toy = (doc.get("distill") or {}).get("toy_run") or {}
+        if toy.get("kl_first") is not None:
+            # the distill artifact carries the toy-run KL curve in-band;
+            # surface the convergence verdict as its own trajectory row
+            rows.append({
+                "round": _round_of(path), "artifact": os.path.basename(path),
+                "metric": (f"distill toy-run KL {toy['kl_first']:g} -> "
+                           f"{toy['kl_last']:g} over {toy.get('iters')} iters "
+                           f"(monotone={bool(toy.get('monotone_decrease'))})"),
+                "value": toy["kl_last"], "unit": "KL",
                 "status": _status_of(doc),
             })
         fast = doc.get("replay_fast_path") or {}
