@@ -79,6 +79,18 @@ def _env_fn(args):
     return lambda: MockEnv(episode_game_loops=args.episode_game_loops)
 
 
+def _dynamics_cfg(args) -> dict:
+    """--dynamics-every N -> the learner's training-dynamics block: 0
+    disables the in-jit diagnostics tree entirely (the overhead A/B's off
+    arm), N > 0 sets the gauge-export stride, absent keeps defaults."""
+    every = getattr(args, "dynamics_every", None)
+    if every is None:
+        return {}
+    if every <= 0:
+        return {"dynamics": {"enabled": False}}
+    return {"dynamics": {"every_n": every}}
+
+
 def _learner_cfg(args, model_cfg: dict, load_path: str = "") -> dict:
     return {
         "common": {"experiment_name": args.experiment_name,
@@ -98,6 +110,7 @@ def _learner_cfg(args, model_cfg: dict, load_path: str = "") -> dict:
                 else bool(args.sharded_ckpt)
             ),
             **({"load_path": load_path} if load_path else {}),
+            **_dynamics_cfg(args),
         },
         "model": model_cfg,
     }
@@ -643,6 +656,10 @@ def main() -> None:
     p.add_argument("--no-health", action="store_true",
                    help="disable the fleet-health subsystem (TSDB sampler, "
                         "watchdog rules, telemetry shipping, crash recorder)")
+    p.add_argument("--dynamics-every", type=int, default=None,
+                   help="training-dynamics gauge-export stride (learner "
+                        "dynamics.every_n); 0 disables the in-jit "
+                        "diagnostics tree entirely; default: config/10")
     p.add_argument("--health-sample-s", type=float, default=1.0,
                    help="registry->TSDB sampling cadence")
     p.add_argument("--health-eval-s", type=float, default=2.0,

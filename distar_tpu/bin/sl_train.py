@@ -19,7 +19,8 @@ import argparse
 from .. import plugins
 from ..utils import read_config
 from .rl_train import (
-    _addr, _init_health, _mesh_kwargs, _restart_policy, _run_learner_supervised,
+    _addr, _dynamics_cfg, _init_health, _mesh_kwargs, _restart_policy,
+    _run_learner_supervised,
 )
 
 
@@ -46,6 +47,7 @@ def _learner(args) -> None:
                     bool(args.mesh) if args.sharded_ckpt is None
                     else bool(args.sharded_ckpt)
                 ),
+                **_dynamics_cfg(args),
             },
             "model": model_cfg,
         },
@@ -202,6 +204,10 @@ def main() -> None:
     p.add_argument("--no-health", action="store_true",
                    help="disable the fleet-health subsystem (watchdog rules, "
                         "telemetry shipping, crash recorder)")
+    p.add_argument("--dynamics-every", type=int, default=None,
+                   help="training-dynamics gauge-export stride (learner "
+                        "dynamics.every_n); 0 disables the in-jit "
+                        "diagnostics tree entirely; default: config/10")
     p.add_argument("--no-supervise", action="store_true",
                    help="disable crash-restart supervision and learner "
                         "auto-resume from the latest checkpoint pointer")

@@ -16,7 +16,14 @@ from typing import Any, Dict, Iterator, Optional
 
 import jax
 
-from ..obs import PerfMonitor, get_registry, record_step_phases
+from ..obs import (
+    DYNAMICS_DEFAULTS,
+    DynamicsMonitor,
+    PerfMonitor,
+    get_registry,
+    record_step_phases,
+    tree_spec,
+)
 from ..utils import Config, EasyTimer, build_logger, deep_merge_dicts
 from ..utils.timing import sw as global_stopwatch
 from ..utils.checkpoint import (
@@ -69,6 +76,12 @@ DEFAULT_LEARNER_CONFIG = Config(
             # the live step already compiled)
             "perf": {"aot": "auto", "aot_compile": False,
                      "mem_sample_every": 16},
+            # training-dynamics observatory (obs/dynamics.py): the in-jit
+            # diagnostics tree is computed every step; every_n gates gauge
+            # EXPORT; anomalies (non-finite loss/grads, grad explosion,
+            # entropy collapse) write debounced black-box bundles that
+            # tools/stepreplay.py re-executes deterministically
+            "dynamics": dict(DYNAMICS_DEFAULTS),
         },
     }
 )
@@ -113,6 +126,12 @@ class BaseLearner:
             aot_compile=bool(pcfg.get("aot_compile", False)),
             mem_sample_every=int(pcfg.get("mem_sample_every", 16)),
         )
+        self._dynamics = DynamicsMonitor(
+            dict(self.cfg.learner.get("dynamics", {}) or {}),
+            name=self.name,
+            registry=self.metrics,
+            blackbox_dir=os.path.join(root, "blackbox"),
+        )
         self._profile_lock = threading.Lock()
         self._profile_req: Optional[Dict[str, Any]] = None
         self._state = None  # TrainState pytree (params, opt_state, step)
@@ -124,6 +143,10 @@ class BaseLearner:
     # slicer (data.cap_entities / cap_entities_rl); one choke point for all
     # of setup/prefetch/train host paths
     _CAP_FN = None
+
+    # params-init PRNG seed; recorded in black-box bundles so stepreplay can
+    # rebuild bit-identical init state when a bundle omits the train state
+    init_prng_seed = 0
 
     # checkpoint role key (utils.checkpoint.CheckpointManager): "" is the
     # teacher/default tier; the distillation student sets "student" so the
@@ -298,6 +321,29 @@ class BaseLearner:
             if key in state and sh_key in shardings:
                 state[key] = put(state[key], shardings[sh_key])
         return state
+
+    # ------------------------------------------------------------- optimizer
+    def _build_optimizer(self):
+        """One optimizer-construction choke point for every learner (and the
+        RL admin-rebuild path): learning_rate/betas/eps/weight_decay plus the
+        ``grad_clip`` block routed through parallel/grad_clip.py — the norm
+        path is exercised end-to-end by tests/test_learner.py."""
+        from ..parallel import GradClipConfig, build_optimizer
+
+        lc = self.cfg.learner
+        return build_optimizer(
+            learning_rate=lc.learning_rate,
+            betas=tuple(lc.get("betas", (0.0, 0.99))),
+            eps=lc.get("eps", 1e-5),
+            weight_decay=float(lc.get("weight_decay", 0.0) or 0.0),
+            clip=GradClipConfig(**lc.grad_clip),
+        )
+
+    def _dynamics_spec(self):
+        """Static spec threaded into make_*_train_step; None compiles the
+        step WITHOUT the diagnostics tree (the overhead A/B's off arm)."""
+        lc = self.cfg.learner
+        return tree_spec(lc.get("dynamics"), lc.get("grad_clip"))
 
     # -------------------------------------------------------------- abstract
     def _setup_state(self) -> None:  # pragma: no cover - abstract
@@ -478,6 +524,9 @@ class BaseLearner:
                 t_data = self.timer.value
                 self.log_buffer["data_time"] = t_data
                 self.hooks.call("before_iter", self)
+                # stash aux refs (e.g. the SL pre-step hidden carry) so an
+                # anomaly bundle can reconstruct the step's exact inputs
+                self._dynamics.before_step(self)
                 with self.timer:
                     log_vars = self._train(data)
                 t_train = self.timer.value
@@ -489,6 +538,10 @@ class BaseLearner:
                         loss_gauge.set(float(loss))
                     except (TypeError, ValueError):
                         pass
+                # detection + gauge export from the already-fetched host log
+                # (no extra device sync); the batch is only touched if an
+                # anomaly writes a black-box bundle
+                self._dynamics.on_step(self, log_vars, data)
                 self.last_iter.add(1)
                 # host-callback phase = everything after the device step:
                 # hook pass (log reduction, checkpoint scheduling, weight

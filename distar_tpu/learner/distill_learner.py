@@ -36,7 +36,6 @@ import optax
 
 from ..losses import DistillLossConfig, compute_distill_loss
 from ..model import Model, student_model_config
-from ..parallel import GradClipConfig, build_optimizer
 from ..utils import deep_merge_dicts
 from .base_learner import DEFAULT_LEARNER_CONFIG, BaseLearner
 from .data import FakeRLDataloader, cap_entities_rl
@@ -77,7 +76,8 @@ def _flatten_time(tree):
 
 def make_distill_train_step(model: Model, loss_cfg: DistillLossConfig,
                             optimizer, batch_size: int, unroll_len: int,
-                            hidden_size: int, hidden_layers: int):
+                            hidden_size: int, hidden_layers: int,
+                            dynamics=None):
     """(params, opt_state, batch) -> (params, opt_state, info). The student's
     zero initial carry is built inside the jitted step (its dims are the
     STUDENT's, not the batch's — see the module docstring)."""
@@ -109,6 +109,12 @@ def make_distill_train_step(model: Model, loss_cfg: DistillLossConfig,
         (_, info), grads = jax.value_and_grad(loss_fn, has_aux=True)(params, batch)
         info["grad_norm"] = optax.global_norm(grads)
         updates, opt_state = optimizer.update(grads, opt_state, params)
+        if dynamics is not None:
+            from ..obs import dynamics_tree
+
+            info.update(dynamics_tree(
+                params, grads, updates=updates, batch=batch, spec=dynamics
+            ))
         params = optax.apply_updates(params, updates)
         return params, opt_state, info
 
@@ -160,12 +166,7 @@ class DistillLearner(BaseLearner):
         data = dict(next(self._dataloader))
         data.pop("model_last_iter", None)  # host-side; _train pops it too
         batch = jax.tree.map(jnp.asarray, self._strip_batch(self._cap(data)))
-        self.optimizer = build_optimizer(
-            learning_rate=lc.learning_rate,
-            betas=tuple(lc.betas),
-            eps=lc.eps,
-            clip=GradClipConfig(**lc.grad_clip),
-        )
+        self.optimizer = self._build_optimizer()
 
         def init_fn(rng, spatial, entity, scalar, entity_num, hidden, action, sun):
             return self.model.init(
@@ -180,7 +181,7 @@ class DistillLearner(BaseLearner):
             batch["action_info"],
             batch["selected_units_num"],
         )
-        params = jax.jit(init_fn)(jax.random.PRNGKey(0), *init_args)
+        params = jax.jit(init_fn)(jax.random.PRNGKey(self.init_prng_seed), *init_args)
         self._state = {
             "params": params,
             "opt_state": jax.jit(self.optimizer.init)(params),
@@ -189,6 +190,7 @@ class DistillLearner(BaseLearner):
         step_fn = make_distill_train_step(
             self.model, self.loss_cfg, self.optimizer, B, T,
             hidden_size=core.hidden_size, hidden_layers=core.num_layers,
+            dynamics=self._dynamics_spec(),
         )
         self._train_step = jax.jit(step_fn, donate_argnums=(0, 1))
         reg = self.metrics

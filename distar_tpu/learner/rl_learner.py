@@ -24,7 +24,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..losses import ReinforcementLossConfig, compute_rl_loss
 from ..model import Model, default_model_config
-from ..parallel import GradClipConfig, MeshSpec, build_optimizer, make_mesh
+from ..parallel import MeshSpec, make_mesh
 from ..parallel.grad_clip import leaf_norms
 from ..utils import Config, deep_merge_dicts
 from .base_learner import DEFAULT_LEARNER_CONFIG, BaseLearner
@@ -73,13 +73,18 @@ def _flatten_time(tree):
 
 
 def make_rl_train_step(model: Model, loss_cfg: ReinforcementLossConfig, optimizer,
-                       batch_size: int, unroll_len: int, save_grad: bool = False):
+                       batch_size: int, unroll_len: int, save_grad: bool = False,
+                       dynamics=None):
     """Build the pure train-step fn (params, opt_state, batch) -> updated.
 
     With ``save_grad`` the info dict additionally carries per-parameter
     grad/param L2 norms (reference save_grad TB dumps,
     rl_learner.py:35-47,118-130) — static at trace time, so the toggle
-    never mixes compiled variants."""
+    never mixes compiled variants. ``dynamics`` (an obs.DynamicsSpec, or
+    None) statically folds the training-dynamics diagnostics tree into the
+    info dict — computed against pre-step params and post-clip updates, so
+    the update-to-weight ratios and non-finite censuses describe exactly
+    this step."""
 
     def loss_fn(params, batch, only_update_value):
         obs = {
@@ -131,6 +136,12 @@ def make_rl_train_step(model: Model, loss_cfg: ReinforcementLossConfig, optimize
             info.update(leaf_norms(grads, "grad_norm"))
             info.update(leaf_norms(params, "param_norm"))
         updates, opt_state = optimizer.update(grads, opt_state, params)
+        if dynamics is not None:
+            from ..obs import dynamics_tree
+
+            info.update(dynamics_tree(
+                params, grads, updates=updates, batch=batch, spec=dynamics
+            ))
         params = optax.apply_updates(params, updates)
         return params, opt_state, info
 
@@ -184,12 +195,7 @@ class RLLearner(BaseLearner):
 
         set_context_mesh(self.mesh)  # ring attention resolves sp at trace time
         batch = self._cap(next(self._dataloader))
-        self.optimizer = build_optimizer(
-            learning_rate=lc.learning_rate,
-            betas=tuple(lc.betas),
-            eps=lc.eps,
-            clip=GradClipConfig(**lc.grad_clip),
-        )
+        self.optimizer = self._build_optimizer()
         # jit the init: eager init dispatches thousands of tiny ops, which is
         # painfully slow on a remote/tunneled device
         def init_fn(rng, spatial, entity, scalar, entity_num, hidden, action, sun, vf):
@@ -221,7 +227,7 @@ class RLLearner(BaseLearner):
             return jitted_init(rng, *dummy)
 
         self._init_params = _reinit
-        params = jitted_init(jax.random.PRNGKey(0), *init_args)
+        params = jitted_init(jax.random.PRNGKey(self.init_prng_seed), *init_args)
         del init_args
         from ..parallel.mesh import batch_sharding, fsdp_param_sharding, time_batch_sharding
 
@@ -239,6 +245,7 @@ class RLLearner(BaseLearner):
         step_fn = make_rl_train_step(
             self.model, self.loss_cfg, self.optimizer, B, T,
             save_grad=self.cfg.learner.get("save_grad", False),
+            dynamics=self._dynamics_spec(),
         )
         from ..parallel.mesh import dp_axes
 
@@ -388,12 +395,7 @@ class RLLearner(BaseLearner):
             lc = self.cfg.learner
             # hyperparameter changes rebuild the optax chain; opt state resets
             # (the reference rebuilds the optimizer on update_config too)
-            self.optimizer = build_optimizer(
-                learning_rate=lc.learning_rate,
-                betas=tuple(lc.betas),
-                eps=lc.eps,
-                clip=GradClipConfig(**lc.grad_clip),
-            )
+            self.optimizer = self._build_optimizer()
             from ..parallel.mesh import fsdp_param_sharding
 
             opt_sh = fsdp_param_sharding(
@@ -408,6 +410,7 @@ class RLLearner(BaseLearner):
                     self.model, self.loss_cfg, self.optimizer,
                     lc.batch_size, lc.unroll_len,
                     save_grad=lc.get("save_grad", False),
+                    dynamics=self._dynamics_spec(),
                 ),
                 donate_argnums=(0, 1),
                 out_shardings=(self._shardings["param"], opt_sh, self._shardings["repl"]),
@@ -442,6 +445,12 @@ class RLLearner(BaseLearner):
             self._remaining_value_pretrain -= 1
             return True
         return False
+
+    def _dynamics_aux(self) -> Dict[str, Any]:
+        """Pre-step extras for a black-box bundle: the value-pretrain gate
+        the step is ABOUT to use (read before _train decrements it) — host
+        scalars only, so before_step stays free on the healthy path."""
+        return {"only_update_value": self._remaining_value_pretrain > 0}
 
     def _train(self, data) -> Dict[str, Any]:
         only_value = self.step_value_pretrain()

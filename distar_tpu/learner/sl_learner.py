@@ -19,7 +19,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..losses import SupervisedLossConfig, compute_sl_loss
 from ..model import Model, default_model_config
-from ..parallel import GradClipConfig, MeshSpec, build_optimizer, make_mesh
+from ..parallel import MeshSpec, make_mesh
 from ..parallel.grad_clip import leaf_norms
 from ..utils import deep_merge_dicts
 from .base_learner import DEFAULT_LEARNER_CONFIG, BaseLearner
@@ -52,7 +52,7 @@ SL_LEARNER_DEFAULTS = deep_merge_dicts(
 
 
 def make_sl_train_step(model: Model, loss_cfg: SupervisedLossConfig, optimizer,
-                       batch_size: int, save_grad: bool = False):
+                       batch_size: int, save_grad: bool = False, dynamics=None):
     def loss_fn(params, batch, hidden_state):
         logits, out_state = model.apply(
             params,
@@ -81,6 +81,14 @@ def make_sl_train_step(model: Model, loss_cfg: SupervisedLossConfig, optimizer,
             info.update(leaf_norms(grads, "grad_norm"))
             info.update(leaf_norms(params, "param_norm"))
         updates, opt_state = optimizer.update(grads, opt_state, params)
+        if dynamics is not None:
+            # pre-step params + post-clip updates: ratios/censuses describe
+            # exactly this step (obs/dynamics.py)
+            from ..obs import dynamics_tree
+
+            info.update(dynamics_tree(
+                params, grads, updates=updates, batch=batch, spec=dynamics
+            ))
         params = optax.apply_updates(params, updates)
         return params, opt_state, out_state, info
 
@@ -125,13 +133,7 @@ class SLLearner(BaseLearner):
             (jnp.zeros((B, core.hidden_size)), jnp.zeros((B, core.hidden_size)))
             for _ in range(core.num_layers)
         )
-        self.optimizer = build_optimizer(
-            learning_rate=lc.learning_rate,
-            betas=tuple(lc.betas),
-            eps=lc.eps,
-            weight_decay=lc.get("weight_decay", 0.0),
-            clip=GradClipConfig(**lc.grad_clip),
-        )
+        self.optimizer = self._build_optimizer()
         batch = next(self._dataloader)
         batch.pop("new_episodes", None)
         batch.pop("traj_lens", None)
@@ -145,7 +147,7 @@ class SLLearner(BaseLearner):
             )
 
         params = jax.jit(init_fn)(
-            jax.random.PRNGKey(0),
+            jax.random.PRNGKey(self.init_prng_seed),
             batch["spatial_info"], batch["entity_info"], batch["scalar_info"],
             batch["entity_num"], batch["action_info"], batch["selected_units_num"],
             self._hidden,
@@ -168,6 +170,7 @@ class SLLearner(BaseLearner):
             make_sl_train_step(
                 self.model, self.loss_cfg, self.optimizer, B,
                 save_grad=self.cfg.learner.get("save_grad", False),
+                dynamics=self._dynamics_spec(),
             ),
             donate_argnums=(0, 1),
             # params/opt keep their fsdp shardings; the carried hidden state
@@ -244,6 +247,14 @@ class SLLearner(BaseLearner):
         out.update(host)
         out["_on_device"] = True
         return out
+
+    def _dynamics_aux(self) -> Dict[str, Any]:
+        """Pre-step extras for a black-box bundle: the carried LSTM hidden
+        BEFORE this step's episode-reset (replay restores it and lets
+        _train re-apply the reset from the batch's own new_episodes).
+        Device-array REFS only — hidden is not donated, so they stay valid;
+        the D2H fetch happens only if a bundle is written."""
+        return {"hidden_state": self._hidden}
 
     def _train(self, data) -> Dict[str, Any]:
         data = dict(data)  # callers may reuse the batch dict

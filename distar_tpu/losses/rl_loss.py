@@ -37,6 +37,14 @@ from ..ops import upgo_returns, vtrace_advantages, generalized_lambda_returns
 HEADS = ("action_type", "delay", "queued", "selected_units", "target_unit", "target_location")
 # heads whose losses are always active (the rest gate on actions_mask)
 ALWAYS_ON = ("action_type", "delay")
+# the reward/value fields of the info grid (pg/{field}/{head}, td/{field},
+# reward/{field}, value/{field}) — the obs layer's bounded label vocabulary
+# for the distar_train_loss_* gauges lives HERE, next to the keys it names
+REWARD_FIELDS = ("winloss", "build_order", "built_unit", "effect", "upgrade",
+                 "battle")
+# loss-term prefixes the info dict produces ("{term}/total" and, for the
+# per-head terms, "{term}/{head}")
+LOSS_TERMS = ("pg", "upgo", "td", "entropy", "kl", "dapo")
 FIELD_MASKS = {"build_order": "build_order_mask", "built_unit": "built_unit_mask", "effect": "effect_mask"}
 
 

@@ -21,6 +21,20 @@ from ..ops import sequence_mask
 
 NEG_INF = -1e9
 
+# every non-loss scalar the SL info dict produces — the obs layer's bounded
+# label vocabulary for the distar_train_sl_metric gauge family (a name not
+# listed here is never published as a labelled series)
+SL_METRIC_KEYS = (
+    "action_type_acc",
+    "delay_distance_L1",
+    "queued_acc",
+    "target_unit_acc",
+    "target_location_distance_L2",
+    "selected_units_iou",
+    "selected_units_loss_norm",
+    "selected_units_end_flag_loss",
+)
+
 
 @dataclasses.dataclass(frozen=True)
 class SupervisedLossConfig:

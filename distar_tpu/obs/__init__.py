@@ -78,6 +78,21 @@ from .traceview import analyze_trace, classify, render_markdown
 from .timeseries import RegistrySampler, TimeSeriesStore
 from .shipper import SERIALIZED_CONTENT_TYPE, TelemetryIngest, TelemetryShipper
 from .flightrecorder import FlightRecorder, get_flight_recorder, set_flight_recorder
+from .dynamics import (
+    ANOMALY_CLASSES,
+    BUNDLE_SCHEMA,
+    DYNAMICS_DEFAULTS,
+    DynamicsMonitor,
+    DynamicsSpec,
+    bundle_summary,
+    config_digest,
+    dynamics_tree,
+    first_nonfinite,
+    list_bundles,
+    load_bundle,
+    split_tree,
+    tree_spec,
+)
 from .health import (
     FleetHealth,
     HealthEvaluator,
@@ -152,6 +167,19 @@ __all__ = [
     "FlightRecorder",
     "get_flight_recorder",
     "set_flight_recorder",
+    "ANOMALY_CLASSES",
+    "BUNDLE_SCHEMA",
+    "DYNAMICS_DEFAULTS",
+    "DynamicsMonitor",
+    "DynamicsSpec",
+    "bundle_summary",
+    "config_digest",
+    "dynamics_tree",
+    "first_nonfinite",
+    "list_bundles",
+    "load_bundle",
+    "split_tree",
+    "tree_spec",
     "FleetHealth",
     "HealthEvaluator",
     "HealthRule",

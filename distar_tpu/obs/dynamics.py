@@ -1,0 +1,698 @@
+"""Training-dynamics observatory: in-step diagnostics, anomaly black boxes.
+
+The obs stack could explain latency (tracing), throughput (perf) and process
+health (rules), but was blind to the training math itself: the per-head loss
+info dicts died in the log buffer, gradients were uninstrumented, and the
+only answer to a NaN loss was a blind restart. This module closes that gap
+in three moves:
+
+* ``dynamics_tree`` — a handful of scalar reductions *inside* the jitted
+  (donated) train step: per-module gradient/param global-norms, update-to-
+  weight ratios, grad-clip activation, and non-finite censuses over grads,
+  pre-step params and the batch. The scalars ride the step's existing info
+  dict, so the learner's ONE batched ``device_get`` per step ships them —
+  never a per-leaf sync. Computed every step (a few dozen scalar reductions
+  are noise next to the model matmuls — DYNAMICS_r16.json holds the paired
+  on/off proof); ``every_n`` gates host-side gauge EXPORT, not compute, so
+  anomaly detection never has a blind window.
+
+* ``DynamicsMonitor`` — the host side: publishes the tree plus the routed
+  loss info as bounded-cardinality ``distar_train_*`` gauges, keeps a
+  grad-norm EMA for explosion detection, and on anomaly (non-finite
+  loss/grads, explosion vs EMA, entropy collapse) writes a debounced,
+  capped **black-box bundle**: the offending batch, pre-step aux, PRNG
+  seed, step index, checkpoint pointer, config digest and the diagnostics
+  tree localizing the first non-finite module. ``tools/stepreplay.py``
+  re-executes a bundle deterministically offline.
+
+* ``first_nonfinite`` — provenance: a batch-borne NaN poisons every grad
+  via backprop, so the census is read batch > params > grads; the first
+  family with a hit names the true origin, not the blast radius.
+
+Module top imports stdlib + the obs registry only (the obs package must
+stay importable without jax); everything jit-side imports jax in-function.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import re
+import time
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+from .flightrecorder import _versions, get_flight_recorder
+from .registry import MetricsRegistry, get_registry
+from .tracestore import note_exemplar
+
+BUNDLE_SCHEMA = "distar.blackbox.v1"
+
+DYNAMICS_DEFAULTS = {
+    "enabled": True,
+    # host-side gauge-export period (steps); the in-jit tree is computed
+    # every step so detection has no blind window, and anomaly steps
+    # force-publish regardless of the gate
+    "every_n": 10,
+    "ema_momentum": 0.99,
+    # grad-norm explosion: ||g|| > factor * EMA(||g||), after warmup steps
+    "explosion_factor": 10.0,
+    "explosion_warmup": 20,
+    # per-head |entropy| < floor => collapse (0 disables; RL-specific signal)
+    "entropy_floor": 0.0,
+    "blackbox": True,          # write forensic bundles on anomaly
+    "blackbox_cap": 4,         # max bundles per process (disk guard)
+    "blackbox_dir": "",        # default: <save_dir>/blackbox
+    "blackbox_state": True,    # include post-step train state in the bundle
+    "clear_n": 3,              # clean steps before an anomaly class re-arms
+}
+
+# bundle filenames are self-describing so listings never need to deserialize
+_BUNDLE_RE = re.compile(r"^blackbox_(\d+)_step(\d+)_([a-z0-9_]+)\.bb$")
+
+ANOMALY_CLASSES = (
+    "loss_nonfinite",
+    "grad_nonfinite",
+    "grad_explosion",
+    "entropy_collapse",
+)
+
+
+# --------------------------------------------------------------------- spec
+@dataclass(frozen=True)
+class DynamicsSpec:
+    """Static (hashable) closure args for the in-jit tree — what the step
+    needs to know about the configured grad clip to report its activation."""
+
+    clip_type: str = "none"
+    clip_threshold: float = 1.0
+
+
+def tree_spec(dynamics_cfg: Optional[dict], grad_clip_cfg: Optional[dict]
+              ) -> Optional[DynamicsSpec]:
+    """The spec the learner threads into ``make_*_train_step`` — or None
+    when dynamics is disabled, which statically compiles the step WITHOUT
+    the tree (the 'off' arm of the overhead A/B)."""
+    dcfg = dict(dynamics_cfg or {})
+    if not dcfg.get("enabled", True):
+        return None
+    gc = dict(grad_clip_cfg or {})
+    return DynamicsSpec(
+        clip_type=str(gc.get("type", "none") or "none"),
+        clip_threshold=float(gc.get("threshold", 1.0)),
+    )
+
+
+# ------------------------------------------------------------- in-jit tree
+def _inner(tree):
+    """Top-level module map of a params-like pytree ({'params': {...}} flax
+    convention or a bare dict); non-dict trees become one 'all' module."""
+    if isinstance(tree, dict) and "params" in tree and isinstance(tree["params"], dict):
+        tree = tree["params"]
+    if not isinstance(tree, dict):
+        return {"all": tree}
+    return tree
+
+
+def _float_leaves(tree) -> list:
+    import jax
+    import jax.numpy as jnp
+
+    return [
+        leaf for leaf in jax.tree_util.tree_leaves(tree)
+        if hasattr(leaf, "dtype") and jnp.issubdtype(leaf.dtype, jnp.floating)
+    ]
+
+
+def _l2sq(tree):
+    """Sum of squares over ALL leaves (f32 accumulate) — norms are taken
+    over every numeric leaf, not just floats, to match optax.global_norm."""
+    import jax
+    import jax.numpy as jnp
+
+    acc = jnp.zeros((), jnp.float32)
+    for leaf in jax.tree_util.tree_leaves(tree):
+        leaf = jnp.asarray(leaf).astype(jnp.float32)
+        acc = acc + jnp.sum(leaf * leaf)
+    return acc
+
+
+def _count_nonfinite(tree):
+    """Number of non-finite elements across the tree's FLOAT leaves (ints
+    cannot be non-finite and jnp.isfinite rejects them)."""
+    import jax.numpy as jnp
+
+    acc = jnp.zeros((), jnp.float32)
+    for leaf in _float_leaves(tree):
+        acc = acc + jnp.sum(~jnp.isfinite(leaf)).astype(jnp.float32)
+    return acc
+
+
+def dynamics_tree(params, grads, updates=None, batch=None,
+                  spec: Optional[DynamicsSpec] = None) -> Dict[str, Any]:
+    """The one-pass diagnostics tree, called INSIDE the jitted train step
+    after ``optimizer.update`` (so ``params`` are pre-step and ``updates``
+    are the post-clip deltas) and merged into the step's info dict.
+
+    Emits flat ``dyn/<family>/<module>`` f32 scalars:
+
+    * ``dyn/grad_norm|param_norm|update_ratio/<module>`` + ``/total``
+    * ``dyn/nonfinite_grads|nonfinite_params/<module>`` + ``/total``
+    * ``dyn/nonfinite_batch/<top-level key>`` + ``/total`` (float leaves)
+    * ``dyn/clip_fraction`` / ``dyn/clip_active`` (per ``spec``)
+
+    Cardinality is bounded by the model's top-level module count and the
+    batch's top-level keys — both fixed by config, not by data.
+    """
+    import jax.numpy as jnp
+
+    out: Dict[str, Any] = {}
+    p_in, g_in = _inner(params), _inner(grads)
+    u_in = _inner(updates) if updates is not None else None
+
+    p_tot = g_tot = u_tot = jnp.zeros((), jnp.float32)
+    gbad_tot = pbad_tot = jnp.zeros((), jnp.float32)
+    for mod in sorted(p_in):
+        p2 = _l2sq(p_in[mod])
+        g2 = _l2sq(g_in[mod]) if mod in g_in else jnp.zeros((), jnp.float32)
+        pn, gn = jnp.sqrt(p2), jnp.sqrt(g2)
+        out[f"dyn/param_norm/{mod}"] = pn
+        out[f"dyn/grad_norm/{mod}"] = gn
+        p_tot, g_tot = p_tot + p2, g_tot + g2
+        if u_in is not None and mod in u_in:
+            u2 = _l2sq(u_in[mod])
+            un = jnp.sqrt(u2)
+            out[f"dyn/update_ratio/{mod}"] = un / (pn + 1e-12)
+            u_tot = u_tot + u2
+        gbad = _count_nonfinite(g_in[mod]) if mod in g_in else jnp.zeros((), jnp.float32)
+        pbad = _count_nonfinite(p_in[mod])
+        out[f"dyn/nonfinite_grads/{mod}"] = gbad
+        out[f"dyn/nonfinite_params/{mod}"] = pbad
+        gbad_tot, pbad_tot = gbad_tot + gbad, pbad_tot + pbad
+
+    grad_norm_total = jnp.sqrt(g_tot)
+    out["dyn/param_norm/total"] = jnp.sqrt(p_tot)
+    out["dyn/grad_norm/total"] = grad_norm_total
+    if u_in is not None:
+        out["dyn/update_ratio/total"] = jnp.sqrt(u_tot) / (jnp.sqrt(p_tot) + 1e-12)
+    out["dyn/nonfinite_grads/total"] = gbad_tot
+    out["dyn/nonfinite_params/total"] = pbad_tot
+
+    if batch is not None and isinstance(batch, dict):
+        b_tot = jnp.zeros((), jnp.float32)
+        for key in sorted(batch):
+            if not _float_leaves(batch[key]):
+                continue  # int-only obs can't be non-finite; don't emit a row
+            bad = _count_nonfinite(batch[key])
+            out[f"dyn/nonfinite_batch/{key}"] = bad
+            b_tot = b_tot + bad
+        out["dyn/nonfinite_batch/total"] = b_tot
+
+    if spec is not None:
+        from ..parallel.grad_clip import clip_activation
+
+        frac, active = clip_activation(
+            grads, grad_norm_total, spec.clip_type, spec.clip_threshold
+        )
+        out["dyn/clip_fraction"] = frac
+        out["dyn/clip_active"] = active
+    return out
+
+
+# ---------------------------------------------------------- host-side views
+def _f(val, default: float = 0.0) -> float:
+    try:
+        return float(val)
+    except (TypeError, ValueError):
+        return default
+
+
+def _finite(val) -> bool:
+    import math
+
+    try:
+        return math.isfinite(float(val))
+    except (TypeError, ValueError):
+        return False
+
+
+def split_tree(log: Dict[str, Any]) -> Dict[str, Dict[str, float]]:
+    """Group a host log dict's ``dyn/<family>/<module>`` scalars by family
+    (opsctl's digest view and the tests' hand-check both read this)."""
+    out: Dict[str, Dict[str, float]] = {}
+    for key, val in log.items():
+        if not key.startswith("dyn/"):
+            continue
+        parts = key.split("/", 2)
+        if len(parts) == 3:
+            out.setdefault(parts[1], {})[parts[2]] = _f(val)
+        else:
+            out.setdefault(parts[1], {})[""] = _f(val)
+    return out
+
+
+def first_nonfinite(log: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+    """Localize an anomaly's origin from the censuses. Read order matters:
+    one NaN in the batch makes EVERY module's grads non-finite via backprop,
+    and a poisoned param does the same one hop later — so the narrowest
+    family with a hit (batch, then pre-step params, then grads) names the
+    origin rather than the blast radius."""
+    for origin, prefix in (
+        ("batch", "dyn/nonfinite_batch/"),
+        ("params", "dyn/nonfinite_params/"),
+        ("grads", "dyn/nonfinite_grads/"),
+    ):
+        hits = sorted(
+            key[len(prefix):]
+            for key, val in log.items()
+            if key.startswith(prefix) and key[len(prefix):] != "total"
+            and _f(val) > 0
+        )
+        if hits:
+            return {"origin": origin, "module": hits[0], "all": hits}
+    return None
+
+
+def config_digest(cfg: Any) -> str:
+    """Stable sha256 of a config mapping (canonical JSON, default=str) —
+    the replay tool refuses nothing, but surfaces digest drift loudly."""
+    blob = json.dumps(cfg, sort_keys=True, default=str).encode()
+    return hashlib.sha256(blob).hexdigest()
+
+
+def _plain(obj):
+    """JSON round-trip: Config/EasyDict trees become plain builtins."""
+    return json.loads(json.dumps(obj, default=str))
+
+
+# ------------------------------------------------------------------ bundles
+def load_bundle(path: str) -> Dict[str, Any]:
+    from ..comm import serializer
+
+    with open(path, "rb") as f:
+        bundle = serializer.loads(f.read())
+    if bundle.get("schema") != BUNDLE_SCHEMA:
+        raise ValueError(
+            f"{path}: schema {bundle.get('schema')!r} != {BUNDLE_SCHEMA!r}"
+        )
+    return bundle
+
+
+def bundle_summary(bundle: Dict[str, Any]) -> Dict[str, Any]:
+    prov = bundle.get("provenance") or {}
+    return {
+        "schema": bundle.get("schema"),
+        "step": bundle.get("step"),
+        "reasons": bundle.get("reasons"),
+        "learner": bundle.get("learner"),
+        "origin": prov.get("origin"),
+        "module": prov.get("module"),
+        "config_digest": bundle.get("config_digest"),
+        "ckpt": (bundle.get("checkpoint") or {}).get("path"),
+        "ts": bundle.get("ts"),
+    }
+
+
+def list_bundles(dirpath: str) -> List[Dict[str, Any]]:
+    """Cheap listing from filenames alone (no deserialization)."""
+    out = []
+    try:
+        names = sorted(os.listdir(dirpath))
+    except OSError:
+        return out
+    for name in names:
+        m = _BUNDLE_RE.match(name)
+        if m:
+            out.append({
+                "id": name,
+                "path": os.path.join(dirpath, name),
+                "seq": int(m.group(1)),
+                "step": int(m.group(2)),
+                "reason": m.group(3),
+            })
+    return sorted(out, key=lambda b: b["seq"])
+
+
+# ------------------------------------------------------------------ monitor
+class DynamicsMonitor:
+    """Host-side consumer of the in-jit tree: gauge export, anomaly
+    detection with EMA + debounce, and black-box capture.
+
+    The learner run loop calls ``before_step`` (cheap: stashes device-array
+    REFS for aux state — the fetch happens only if a bundle is written) and
+    ``on_step`` with the already-fetched host log dict; this class never
+    adds a device sync on the healthy path.
+    """
+
+    def __init__(self, cfg: Optional[dict] = None, name: str = "learner",
+                 registry: Optional[MetricsRegistry] = None,
+                 blackbox_dir: str = ""):
+        merged = dict(DYNAMICS_DEFAULTS)
+        merged.update(dict(cfg or {}))
+        self.cfg = merged
+        self.enabled = bool(merged.get("enabled", True))
+        self.every_n = max(1, int(merged.get("every_n", 10)))
+        self.name = name
+        self._reg = registry or get_registry()
+        self.blackbox_dir = merged.get("blackbox_dir") or blackbox_dir
+        self.ema: Optional[float] = None
+        self.steps_seen = 0
+        self.bundles_written = 0
+        self.last_bundle_path: Optional[str] = None
+        self.last_anomaly_step: Optional[int] = None
+        self._active: Set[str] = set()   # debounce: currently-firing classes
+        self._clean = 0                  # consecutive anomaly-free steps
+        self._aux: Optional[dict] = None
+
+    # ------------------------------------------------------------- run hooks
+    def before_step(self, learner) -> None:
+        if not self.enabled:
+            return
+        aux_fn = getattr(learner, "_dynamics_aux", None)
+        self._aux = aux_fn() if aux_fn is not None else None
+
+    def on_step(self, learner, log: Dict[str, Any],
+                batch: Any = None) -> Set[str]:
+        """Detect → (maybe) publish → EMA → (maybe) capture. ``log`` is the
+        host-side float dict the learner already fetched; ``batch`` is the
+        step's input, captured only if a bundle is written. Returns the
+        anomaly classes seen this step (tests read it)."""
+        if not self.enabled:
+            return set()
+        step = int(learner.last_iter.val)
+        anomalies, grad_norm = self.detect(log)
+        sampled = self.steps_seen % self.every_n == 0
+        self.steps_seen += 1
+        if sampled or anomalies:
+            # anomaly steps force-publish: a NaN that only ever existed
+            # between sample points would otherwise never reach the TSDB
+            # rules that alert on it
+            self.publish(log)
+        if _finite(grad_norm):
+            mom = float(self.cfg.get("ema_momentum", 0.99))
+            self.ema = grad_norm if self.ema is None else (
+                mom * self.ema + (1.0 - mom) * grad_norm
+            )
+            self._reg.gauge(
+                "distar_train_grad_norm_ema",
+                "EMA of the global gradient norm (explosion-rule baseline)",
+            ).set(self.ema)
+        if anomalies:
+            self._clean = 0
+            for reason in sorted(anomalies):
+                self._reg.counter(
+                    "distar_train_anomalies_total",
+                    "training anomalies detected, by class",
+                    reason=reason,
+                ).inc()
+            self.last_anomaly_step = step
+            self._reg.gauge(
+                "distar_train_last_anomaly_step",
+                "step index of the most recent training anomaly",
+            ).set(float(step))
+            fresh = anomalies - self._active
+            self._active |= anomalies
+            if (fresh and self.cfg.get("blackbox", True)
+                    and self.bundles_written < int(self.cfg.get("blackbox_cap", 4))):
+                self.capture(learner, log, batch, step, sorted(anomalies))
+        else:
+            self._clean += 1
+            if self._clean >= int(self.cfg.get("clear_n", 3)):
+                self._active.clear()
+        return anomalies
+
+    # ------------------------------------------------------------- detection
+    def detect(self, log: Dict[str, Any]) -> Tuple[Set[str], Optional[float]]:
+        """Pure classification of one step's log dict; returns (classes,
+        global grad norm). Uses only host floats — no device access."""
+        anomalies: Set[str] = set()
+        loss = log.get("total_loss")
+        if loss is not None and not _finite(loss):
+            anomalies.add("loss_nonfinite")
+        grad_norm = log.get("dyn/grad_norm/total", log.get("grad_norm"))
+        if grad_norm is not None and not _finite(grad_norm):
+            anomalies.add("grad_nonfinite")
+        for census in ("dyn/nonfinite_grads/total", "dyn/nonfinite_params/total",
+                       "dyn/nonfinite_batch/total"):
+            if _f(log.get(census)) > 0:
+                anomalies.add("grad_nonfinite")
+        if grad_norm is not None and _finite(grad_norm):
+            warmup = int(self.cfg.get("explosion_warmup", 20))
+            factor = float(self.cfg.get("explosion_factor", 10.0))
+            if (self.ema is not None and self.steps_seen >= warmup
+                    and self.ema > 0
+                    and float(grad_norm) > factor * self.ema):
+                anomalies.add("grad_explosion")
+        floor = float(self.cfg.get("entropy_floor", 0.0))
+        if floor > 0:
+            for key, val in log.items():
+                if not key.startswith("entropy/") or key == "entropy/total":
+                    continue
+                val = _f(val)
+                # masked-out heads report exactly 0.0 — absence of the head,
+                # not collapse of its distribution
+                if val != 0.0 and abs(val) < floor:
+                    anomalies.add("entropy_collapse")
+                    break
+        gn = float(grad_norm) if grad_norm is not None else None
+        return anomalies, gn
+
+    # ----------------------------------------------------------- publication
+    def publish(self, log: Dict[str, Any]) -> None:
+        """Flush the dyn/ tree + routed loss info into bounded gauges. Every
+        label value below is either a loop variable over a static vocabulary
+        or a parsed module/head name bounded by the model architecture."""
+        g = self._reg.gauge
+        for key, raw in log.items():
+            if not key.startswith("dyn/"):
+                continue
+            val = _f(raw, default=float("nan"))
+            parts = key.split("/", 2)
+            family = parts[1]
+            module = parts[2] if len(parts) == 3 else ""
+            if family == "grad_norm":
+                g("distar_train_grad_norm",
+                  "per-module gradient global-norm (module=total is global)",
+                  module=module).set(val)
+            elif family == "param_norm":
+                g("distar_train_param_norm",
+                  "per-module parameter global-norm",
+                  module=module).set(val)
+            elif family == "update_ratio":
+                g("distar_train_update_ratio",
+                  "per-module update-to-weight norm ratio",
+                  module=module).set(val)
+            elif family == "nonfinite_grads":
+                g("distar_train_nonfinite_grads",
+                  "non-finite gradient elements per module",
+                  module=module).set(val)
+            elif family == "nonfinite_params":
+                g("distar_train_nonfinite_params",
+                  "non-finite parameter elements per module (pre-step)",
+                  module=module).set(val)
+            elif family == "nonfinite_batch":
+                g("distar_train_nonfinite_batch",
+                  "non-finite elements per top-level batch leaf",
+                  leaf=module).set(val)
+            elif family == "clip_fraction":
+                g("distar_train_grad_clip_fraction",
+                  "fraction of gradient signal removed by the clip").set(val)
+            elif family == "clip_active":
+                g("distar_train_grad_clip_active",
+                  "1 when the grad clip engaged this step").set(val)
+        if self.ema is not None:
+            gn = log.get("dyn/grad_norm/total", log.get("grad_norm"))
+            if gn is not None and _finite(gn) and self.ema > 0:
+                g("distar_train_grad_norm_explosion",
+                  "grad norm over its EMA (explosion-rule input)",
+                  ).set(float(gn) / self.ema)
+        self.route_losses(log)
+
+    def route_losses(self, log: Dict[str, Any]) -> None:
+        """Satellite: the rl/sl/distill info dicts become ``distar_train_*``
+        loss gauges. The vocabularies live next to the loss code
+        (losses/__init__) so a new head/field extends the routing without
+        touching obs; anything off-vocabulary stays in the log buffer."""
+        from ..losses import HEADS, LOSS_TERMS, REWARD_FIELDS, SL_METRIC_KEYS
+
+        g = self._reg.gauge
+        heads, fields = set(HEADS), set(REWARD_FIELDS)
+        terms = set(LOSS_TERMS)
+        sl_heads = ("action_type", "delay", "queued", "selected_units",
+                    "target_unit", "target_location")
+        pg_by_head: Dict[str, float] = {}
+        for key, raw in log.items():
+            if key.startswith("dyn/"):
+                continue
+            val = _f(raw, default=float("nan"))
+            if key == "total_loss":
+                g("distar_train_loss_term",
+                  "loss terms (term=total is the optimized sum)",
+                  term="total").set(val)
+                continue
+            if key == "divergence":
+                g("distar_train_loss_term",
+                  "loss terms (term=total is the optimized sum)",
+                  term="divergence").set(val)
+                continue
+            if key in SL_METRIC_KEYS:
+                # label key is "metric", not "name": the registry's gauge()
+                # takes the family name positionally as ``name``
+                g("distar_train_sl_metric",
+                  "supervised accuracy/distance metrics by metric name",
+                  metric=key).set(val)
+                continue
+            for head in sl_heads:
+                if key == f"{head}_loss" or (
+                        head == "selected_units" and key == "selected_units_loss"):
+                    g("distar_train_loss_head",
+                      "per-head loss contribution by term",
+                      term="sl", head=head).set(val)
+                    break
+            parts = key.split("/")
+            if len(parts) == 2:
+                term, leaf = parts
+                if term in terms and leaf == "total":
+                    g("distar_train_loss_term",
+                      "loss terms (term=total is the optimized sum)",
+                      term=term).set(val)
+                elif term in ("td", "reward", "value") and leaf in fields:
+                    field = leaf
+                    g("distar_train_loss_field",
+                      "per-reward-field loss/values by term",
+                      term=term, field=field).set(val)
+                elif leaf in heads and term in terms:
+                    head = leaf
+                    g("distar_train_loss_head",
+                      "per-head loss contribution by term",
+                      term=term, head=head).set(val)
+                    if term == "entropy" and val != 0.0:
+                        # masked-out heads report exactly 0.0 — publishing
+                        # it would trip the collapse rule on head absence
+                        g("distar_train_entropy",
+                          "per-head policy entropy (collapse-rule input)",
+                          head=head).set(val)
+                elif key == "kl/extra_at":
+                    g("distar_train_loss_head",
+                      "per-head loss contribution by term",
+                      term="kl", head="extra_at").set(val)
+            elif len(parts) == 3 and parts[0] == "pg":
+                # pg/{field}/{head}: per-head pg is the field-sum (the field
+                # axis is already covered by distar_train_loss_field)
+                if parts[1] in fields and parts[2] in heads:
+                    pg_by_head[parts[2]] = pg_by_head.get(parts[2], 0.0) + val
+        for head, val in sorted(pg_by_head.items()):
+            g("distar_train_loss_head",
+              "per-head loss contribution by term",
+              term="pg", head=head).set(val)
+
+    # --------------------------------------------------------------- capture
+    def capture(self, learner, log: Dict[str, Any], batch: Any,
+                step: int, reasons: List[str]) -> Optional[str]:
+        """Write the forensic black-box bundle. The ONLY place the monitor
+        touches the device — and only because we are already inside an
+        anomaly, where a D2H sync is the least of the step's problems."""
+        import jax
+        import numpy as np
+
+        from ..comm import serializer
+
+        dirpath = self.blackbox_dir or os.path.join(os.getcwd(), "blackbox")
+        try:
+            os.makedirs(dirpath, exist_ok=True)
+            host_batch = None
+            if batch is not None:
+                # pre-device copy when the feeder already placed the batch;
+                # the step does NOT donate batch buffers, so refs are valid
+                host_batch = jax.tree.map(
+                    lambda a: np.asarray(jax.device_get(a))
+                    if hasattr(a, "shape") else a,
+                    batch,
+                )
+            aux = jax.device_get(self._aux) if self._aux is not None else None
+            state = None
+            if self.cfg.get("blackbox_state", True) and learner.state is not None:
+                state = jax.device_get(learner.state)
+            cfg_plain = _plain(learner.cfg)
+            provenance = first_nonfinite(log)
+            ckpt = None
+            try:
+                ckpt = learner.checkpoint_manager.resolve_latest()
+            except Exception:
+                pass
+            bundle = {
+                "schema": BUNDLE_SCHEMA,
+                "ts": time.time(),
+                "step": step,
+                "reasons": list(reasons),
+                "learner": learner.name,
+                "prng_seed": int(getattr(learner, "init_prng_seed", 0)),
+                "batch": host_batch,
+                "aux": aux,
+                # honesty: donated buffers mean the pre-step state is gone —
+                # this state is one optimizer step PAST the anomaly (replay
+                # restores it only to rebuild shapes; param-origin anomalies
+                # replay from the batch + the already-poisoned params)
+                "state": state,
+                "state_note": "one optimizer step PAST the anomaly (donated buffers)",
+                "diagnostics": {k: _f(v, default=float("nan"))
+                                for k, v in log.items()},
+                "provenance": provenance,
+                "checkpoint": ckpt,
+                "config": cfg_plain,
+                "config_digest": config_digest(cfg_plain),
+                "versions": _versions(),
+            }
+            fname = (f"blackbox_{self.bundles_written:03d}_step{step}_"
+                     f"{reasons[0]}.bb")
+            path = os.path.join(dirpath, fname)
+            with open(path, "wb") as f:
+                f.write(serializer.dumps(bundle, compress=True))
+        except Exception as e:  # forensics must never kill the run it studies
+            try:
+                learner.logger.error(f"black-box capture failed: {e!r}")
+            except Exception:
+                pass
+            return None
+        self.bundles_written += 1
+        self.last_bundle_path = path
+        trace_id = f"blackbox:{fname}"
+        gn = log.get("dyn/grad_norm/total", log.get("grad_norm"))
+        # the firing alerts' exemplar slot points at the bundle, so the
+        # on-call path is alert -> bundle id -> stepreplay, no grepping.
+        # Keys are the metric FAMILIES the default rulebook watches: a
+        # rule reference like distar_train_nonfinite_grads{module=total}
+        # finds its exemplar by prefix (ExemplarStore.lookup)
+        note_exemplar("distar_train_grad_norm", trace_id, _f(gn))
+        note_exemplar("distar_train_nonfinite_grads", trace_id,
+                      _f(log.get("dyn/nonfinite_grads/total")))
+        note_exemplar("distar_train_grad_norm_explosion", trace_id, _f(gn))
+        note_exemplar("distar_train_entropy", trace_id,
+                      _f(log.get("entropy/total")))
+        note_exemplar("distar_learner_loss", trace_id, _f(log.get("total_loss")))
+        self._reg.counter(
+            "distar_train_blackbox_bundles_total",
+            "forensic black-box bundles written",
+        ).inc()
+        rec = get_flight_recorder()
+        rec.record(
+            "dynamics_anomaly", step=step, reasons=list(reasons),
+            bundle=fname, learner=learner.name,
+            provenance=bundle.get("provenance"),
+        )
+        try:
+            rec.dump(
+                artifact_dir=dirpath, reason=f"dynamics:{reasons[0]}",
+                config=bundle["config"], registry=self._reg,
+                extra={"blackbox": bundle_summary(bundle)},
+            )
+        except Exception:
+            pass
+        try:
+            learner.logger.warning(
+                f"training anomaly {reasons} at step {step}: "
+                f"black box -> {path}"
+            )
+        except Exception:
+            pass
+        return path

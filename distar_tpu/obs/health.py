@@ -340,6 +340,42 @@ def default_rulebook(roles: Iterable[str] = ("learner", "actor", "coordinator",
                     "the step is input/host-bound or a kernel regressed "
                     "(capture a trace: opsctl profile)",
         ))
+        book.append(HealthRule(
+            name="learner_grad_nonfinite",
+            # fed by the dynamics tree (obs/dynamics.py): the per-module
+            # census totals localize the origin; the firing alert carries a
+            # blackbox:<bundle> exemplar — replay it with tools/stepreplay.py
+            metric="distar_train_nonfinite_grads{module=total}",
+            agg="last", op=">", threshold=0.0,
+            window_s=stall_window_s, for_count=1,
+            summary="non-finite gradient elements detected — the dynamics "
+                    "census names the first bad module and the alert's "
+                    "exemplar points at the black-box bundle "
+                    "(opsctl dynamics; tools/stepreplay.py --bundle <id>)",
+        ))
+        book.append(HealthRule(
+            name="learner_grad_explosion",
+            # ratio gauge published by DynamicsMonitor: ||g|| / EMA(||g||)
+            metric="distar_train_grad_norm_explosion", agg="last", op=">",
+            threshold=10.0, window_s=stall_window_s, for_count=2,
+            severity="warning",
+            summary="gradient norm exploded past 10x its EMA — check "
+                    "distar_train_grad_norm{module=...} for the culprit "
+                    "module and distar_train_grad_clip_fraction for "
+                    "whether the clip is saturating",
+        ))
+        book.append(HealthRule(
+            name="learner_entropy_collapse",
+            # per-head family; masked-out heads publish nothing (the
+            # monitor skips exact-0.0 values), so no-data is not a breach
+            metric="distar_train_entropy{head=action_type}", agg="last",
+            op="<", threshold=1e-4, window_s=stall_window_s, for_count=3,
+            severity="warning",
+            summary="action_type policy entropy collapsed toward zero — "
+                    "the policy went deterministic (premature convergence "
+                    "or a broken entropy bonus); inspect "
+                    "distar_train_entropy per head",
+        ))
     if "distill" in roles:
         book.append(HealthRule(
             name="distill_divergence_runaway",

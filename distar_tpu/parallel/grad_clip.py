@@ -91,6 +91,40 @@ def build_grad_clip(cfg: GradClipConfig) -> optax.GradientTransformation:
     raise NotImplementedError(cfg.type)
 
 
+def clip_activation(grads, global_norm, clip_type: str, threshold: float):
+    """In-jit clip-activation stats for the training-dynamics tree
+    (obs/dynamics.py): how much of the gradient signal the configured clip
+    removed this step.
+
+    Returns ``(fraction, active)`` as f32 scalars:
+
+      * ``norm``  — fraction of the global L2 norm removed,
+        ``max(0, 1 - threshold/||g||)``; active when ``||g|| > threshold``;
+      * ``value`` — fraction of gradient *elements* with ``|g| > threshold``
+        (each is individually clamped); active when any element clipped;
+      * ``none``  — zeros (nothing to clip).
+
+    The EMA modes (``max_norm``/``momentum_norm``) keep their limit inside
+    the optimizer state, which the diagnostics tree cannot see without
+    threading opt_state through — they report zeros rather than a guess.
+    """
+    f32 = jnp.float32
+    if clip_type == "norm":
+        frac = jnp.maximum(
+            0.0, 1.0 - threshold / jnp.maximum(global_norm, 1e-12)
+        ).astype(f32)
+        return frac, (global_norm > threshold).astype(f32)
+    if clip_type == "value":
+        clipped = total = jnp.zeros((), f32)
+        for leaf in jax.tree_util.tree_leaves(grads):
+            leaf = leaf.astype(f32)
+            clipped = clipped + jnp.sum(jnp.abs(leaf) > threshold).astype(f32)
+            total = total + float(leaf.size)
+        frac = clipped / jnp.maximum(total, 1.0)
+        return frac, (clipped > 0).astype(f32)
+    return jnp.zeros((), f32), jnp.zeros((), f32)
+
+
 def leaf_norms(tree, prefix: str):
     """Per-parameter L2 norms keyed by pytree path.
 

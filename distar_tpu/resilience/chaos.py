@@ -182,6 +182,55 @@ class ChaosInjector:
         self._patches.append((learner, "_train", original))
         learner._train = poisoned
 
+    def poison_module(self, learner, module: str, n: int = 1,
+                      value: float = float("nan")) -> None:
+        """Inject ``value`` into one element of the named top-level module's
+        params immediately BEFORE the next ``n`` train steps — a real
+        numeric fault, not a cosmetic log edit: the dynamics tree's
+        pre-step params census (obs/dynamics.py) must name exactly this
+        module, and the black-box bundle + stepreplay must reproduce the
+        resulting non-finite step. Restored by ``restore()``."""
+        original = learner._train
+        state = {"left": n}
+
+        def poisoned(data):
+            if state["left"] > 0:
+                state["left"] -= 1
+                import jax
+                import jax.numpy as jnp
+
+                params = learner._state["params"]
+                inner = params.get("params", params)
+                target = inner[module]
+                leaves, treedef = jax.tree_util.tree_flatten(target)
+
+                # flip element [0...] of the module's first float leaf via a
+                # jitted scatter: the poisoned arrays are fresh XLA buffers,
+                # safe under the step's donation (mutating in place is not)
+                def poison(leaf):
+                    flat = leaf.reshape(-1)
+                    flat = flat.at[0].set(jnp.asarray(value, leaf.dtype))
+                    return flat.reshape(leaf.shape)
+
+                for i, leaf in enumerate(leaves):
+                    if hasattr(leaf, "dtype") and jnp.issubdtype(leaf.dtype, jnp.floating):
+                        leaves[i] = jax.jit(poison)(leaf)
+                        break
+                else:
+                    raise ValueError(f"module {module!r} has no float leaves")
+                new_inner = dict(inner)
+                new_inner[module] = jax.tree_util.tree_unflatten(treedef, leaves)
+                if "params" in params and isinstance(params.get("params"), dict):
+                    learner._state["params"] = {**params, "params": new_inner}
+                else:
+                    learner._state["params"] = new_inner
+                self._log("poison_module", module=module, value=repr(value),
+                          remaining=state["left"])
+            return original(data)
+
+        self._patches.append((learner, "_train", original))
+        learner._train = poisoned
+
     # ----------------------------------------------------------- connections
     def reset_connection(self, host: str, port: int, count: int = 1,
                          timeout_s: float = 5.0) -> int:

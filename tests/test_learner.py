@@ -46,6 +46,34 @@ def test_grad_clip_modes():
             assert n <= 10.0
 
 
+def test_grad_clip_norm_is_exact_and_reports_activation():
+    """The norm clip the flagship config ships: post-clip global norm is
+    exactly min(||g||, threshold), and clip_activation (the dynamics
+    tree's clip gauges) reports the removed fraction to match."""
+    from distar_tpu.parallel.grad_clip import clip_activation
+
+    params = {"w": jnp.zeros((3,)), "b": jnp.zeros((2,))}
+    grads = {"w": jnp.asarray([3.0, 0.0, 0.0]), "b": jnp.asarray([0.0, 4.0])}
+    gnorm = 5.0
+    for threshold, expect in ((2.0, 2.0), (7.0, gnorm)):
+        tx = build_grad_clip(GradClipConfig(type="norm", threshold=threshold))
+        out, _ = tx.update(grads, tx.init(params), params)
+        clipped_norm = float(jnp.sqrt(sum(
+            jnp.sum(g * g) for g in jax.tree.leaves(out))))
+        assert clipped_norm == pytest.approx(expect, rel=1e-6)
+        # direction preserved: clip rescales, never rotates
+        assert float(out["w"][0]) / float(out["b"][1]) == pytest.approx(3.0 / 4.0)
+        frac, active = clip_activation(grads, jnp.asarray(gnorm), "norm", threshold)
+        assert float(frac) == pytest.approx(max(0.0, 1.0 - threshold / gnorm))
+        assert float(active) == (1.0 if gnorm > threshold else 0.0)
+    # value mode: per-element census
+    frac, active = clip_activation(grads, jnp.asarray(gnorm), "value", 3.5)
+    assert float(frac) == pytest.approx(1.0 / 5.0)  # only b[1]=4 exceeds
+    assert float(active) == 1.0
+    frac, active = clip_activation(grads, jnp.asarray(gnorm), "none", 1.0)
+    assert float(frac) == 0.0 and float(active) == 0.0
+
+
 def test_optimizer_adam_zero_beta1():
     opt = build_optimizer(learning_rate=1e-3, betas=(0.0, 0.99), eps=1e-5,
                           clip=GradClipConfig(type="norm", threshold=1.0))

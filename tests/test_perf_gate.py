@@ -139,3 +139,63 @@ def test_perf_md_trajectory_block_is_current():
     committed = text.split(perf_gate.TRAJ_BEGIN, 1)[1].split(perf_gate.TRAJ_END, 1)[0]
     fresh = perf_gate.render_trajectory(perf_gate.collect_trajectory())
     assert committed.strip() == fresh.strip()
+
+
+# ------------------------------------------------------------- curve gate
+def _fam(*entries):
+    return [{"round": r, "artifact": f"curves_r{r}.json", "values": list(v)}
+            for r, v in entries]
+
+
+def test_curve_gate_passes_on_descending_rounds():
+    fams = {"sl_total_loss": _fam(("15", [10.0, 8.0, 6.0]),
+                                  ("16", [10.0, 7.0, 5.9]))}
+    verdicts, failures = perf_gate.curve_verdicts(fams, tolerance=0.10)
+    assert failures == []
+    assert verdicts[0]["regressed"] is False
+    assert verdicts[0]["candidate_last"] == 5.9
+
+
+def test_curve_gate_fails_past_tolerance_and_absorbs_within():
+    fams = {"rl_total_loss": _fam(("15", [10.0, 5.0]), ("16", [10.0, 5.4]))}
+    # 5.4 <= 5.0 * 1.10: inside the band
+    _, failures = perf_gate.curve_verdicts(fams, tolerance=0.10)
+    assert failures == []
+    # 5.4 > 5.0 * 1.05: regression
+    _, failures = perf_gate.curve_verdicts(fams, tolerance=0.05)
+    assert len(failures) == 1 and "regressed past" in failures[0]
+
+
+def test_curve_gate_rejects_nondescent_and_nonfinite():
+    fams = {
+        "flat": _fam(("16", [5.0, 5.0])),
+        "nan": _fam(("16", [5.0, float("nan"), 4.0])),
+    }
+    _, failures = perf_gate.curve_verdicts(fams, tolerance=0.10)
+    assert any("does not descend" in f for f in failures)
+    assert any("non-finite" in f for f in failures)
+
+
+def test_curve_gate_single_round_is_baseline_pass():
+    verdicts, failures = perf_gate.curve_verdicts(
+        {"distill_kl": _fam(("15", [30.0, 26.0]))}, tolerance=0.10)
+    assert failures == [] and verdicts[0]["regressed"] is False
+    assert "single round" in verdicts[0]["note"]
+
+
+def test_curve_gate_sign_safe_for_negative_losses():
+    # RL total_loss can be negative; the band must widen, not flip
+    fams = {"rl": _fam(("15", [1.0, -2.0]), ("16", [1.0, -1.9]))}
+    _, failures = perf_gate.curve_verdicts(fams, tolerance=0.10)
+    assert failures == []  # -1.9 <= -2.0 + 0.10*2.0
+    _, failures = perf_gate.curve_verdicts(fams, tolerance=0.01)
+    assert len(failures) == 1
+
+
+def test_curve_gate_runs_green_on_committed_artifacts():
+    """The repo's own committed toy-run curves must satisfy the gate (the
+    chain perf_gate curve walks in CI)."""
+    fams = perf_gate.collect_curves()
+    assert {"sl_total_loss", "rl_total_loss", "distill_kl"} <= set(fams)
+    _, failures = perf_gate.curve_verdicts(fams, tolerance=0.10)
+    assert failures == []

@@ -16,6 +16,8 @@ faults tests already prove survivable:
         [--mesh dp=4,fsdp=2] [--resume-mesh dp=8] [--kill-after 2] [--iters 5]
   python tools/chaos.py serve-drill --gateways 3 [--sessions 48] [--steps 8]
   python tools/chaos.py shm-drill --dir /tmp/shm_drill [--items 60] [--seed 0]
+  python tools/chaos.py dynamics-drill --dir /tmp/dyn_drill \\
+        [--module spatial_encoder] [--pre-steps 3] [--post-steps 3]
   python tools/chaos.py elastic-drill --dir /tmp/el_drill [--sessions 14] \\
         [--slots 8] [--items 60]
 
@@ -921,6 +923,174 @@ def cmd_elastic_drill(args) -> int:
     return 0 if not failures else 1
 
 
+def cmd_dynamics_drill(args) -> int:
+    """End-to-end drill for the training-dynamics observatory: poison one
+    module's params with a NaN mid-run (``ChaosInjector.poison_module`` — a
+    real numeric fault, pre-step) and prove the whole forensic chain:
+
+      (a) the dynamics census localizes the fault to EXACTLY the poisoned
+          module (provenance origin ``params``, narrowest family wins);
+      (b) exactly ONE learner_grad_nonfinite alert fires, carrying a
+          ``blackbox:<bundle>`` exemplar (debounce: one anomaly, one alert);
+      (c) exactly one black-box bundle lands in the experiment's blackbox/
+          directory;
+      (d) ``tools/stepreplay.py`` re-executes the step from the bundle
+          ALONE (subprocess, fresh interpreter) and reproduces the
+          non-finite step deterministically (exit 0).
+
+    Runs the real SL learner (tiny flagship-shaped model) on CPU in-process;
+    health evaluation is driven deterministically once per step."""
+    import subprocess
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ.setdefault("DISTAR_PERF_AOT", "0")
+    os.environ["DISTAR_EXPERIMENTS_ROOT"] = args.dir
+
+    from distar_tpu.learner import SLLearner
+    from distar_tpu.obs import FleetHealth, default_rulebook, get_registry
+    from distar_tpu.obs.dynamics import list_bundles, load_bundle
+
+    small_model = {
+        "encoder": {
+            "entity": {"layer_num": 1, "hidden_dim": 32, "output_dim": 16,
+                       "head_dim": 8},
+            "spatial": {"down_channels": [4, 4, 8], "project_dim": 4,
+                        "resblock_num": 1, "fc_dim": 16},
+            "scatter": {"output_dim": 4},
+            "core_lstm": {"hidden_size": 32, "num_layers": 1},
+        },
+        "policy": {
+            "action_type_head": {"res_dim": 16, "res_num": 1, "gate_dim": 32},
+            "delay_head": {"decode_dim": 16},
+            "queued_head": {"decode_dim": 16},
+            "selected_units_head": {"func_dim": 16},
+            "target_unit_head": {"func_dim": 16},
+            "location_head": {"res_dim": 8, "res_num": 1,
+                              "upsample_dims": [4, 4, 1], "map_skip_dim": 8},
+        },
+        "value": {"res_dim": 8, "res_num": 1},
+    }
+    exp = os.path.join(args.dir, "exp")
+    learner = SLLearner({
+        "common": {"save_path": exp},
+        "learner": {
+            "batch_size": 2, "unroll_len": 2,
+            "save_freq": 10 ** 6, "log_freq": 1,
+            "dynamics": {"every_n": 1, "blackbox_cap": 4},
+        },
+        "model": small_model,
+    })
+    monitor = learner._dynamics
+    fh = FleetHealth(rules=default_rulebook(roles=("learner",)),
+                     registry=get_registry())  # driven manually, not started
+
+    inner = learner._state["params"]
+    inner = inner.get("params", inner)
+    modules = sorted(inner)
+    module = args.module or modules[0]
+    if module not in modules:
+        print(f"module {module!r} not in model (choose from {modules})")
+        return 2
+
+    inj = ChaosInjector(seed=args.seed)
+    total = args.pre_steps + 1 + args.post_steps
+
+    def step_to(n: int) -> None:
+        learner.run(max_iterations=n)
+        fh.sampler.sample_once()
+        fh.evaluator.evaluate_once()
+
+    for i in range(args.pre_steps):
+        step_to(i + 1)  # clean baseline: EMA + census gauges at healthy 0
+    import jax
+    import jax.numpy as jnp
+
+    # pre-poison snapshot = the "restore from last good checkpoint" the
+    # on-call would do; without it the NaN update poisons every later step
+    snap_state = jax.device_get(learner._state)
+    snap_hidden = jax.device_get(learner._hidden)
+    inj.poison_module(learner, module, n=1)
+    print(f"poisoned module {module!r} params before step {args.pre_steps}")
+    step_to(args.pre_steps + 1)  # the anomalous step
+    inj.restore()
+    learner._state = learner._place_state(snap_state)
+    learner._hidden = jax.tree.map(jnp.asarray, snap_hidden)
+    for i in range(args.pre_steps + 1, total):
+        step_to(i + 1)  # recovery: debounce must hold at one bundle
+
+    failures = []
+    bundles = list_bundles(os.path.join(exp, "blackbox"))
+    if len(bundles) != 1:
+        failures.append(f"expected exactly 1 black-box bundle, found "
+                        f"{[b['id'] for b in bundles]}")
+    provenance = None
+    if bundles:
+        bundle = load_bundle(bundles[0]["path"])
+        provenance = bundle.get("provenance")
+        if not provenance or provenance.get("origin") != "params" \
+                or provenance.get("module") != module:
+            failures.append(f"provenance did not name the poisoned module: "
+                            f"{provenance}")
+    alerts = fh.evaluator.alerts()
+    rule = alerts["rules"].get("learner_grad_nonfinite", {})
+    if rule.get("fired_count") != 1:
+        failures.append(f"learner_grad_nonfinite fired_count="
+                        f"{rule.get('fired_count')} (wanted exactly 1)")
+    other_fired = [n for n in ("learner_loss_nonfinite",
+                               "learner_grad_explosion",
+                               "learner_entropy_collapse")
+                   if alerts["rules"].get(n, {}).get("fired_count", 0) > 0]
+    if other_fired:
+        failures.append(f"other anomaly rules fired: {other_fired}")
+    firing_events = [e for e in alerts["history"]
+                     if e["rule"] == "learner_grad_nonfinite"
+                     and e["state"] == "firing"]
+    exemplar = firing_events[-1].get("exemplar_trace_id") if firing_events else None
+    if not (exemplar or "").startswith("blackbox:"):
+        failures.append(f"firing alert carries no blackbox exemplar: {exemplar!r}")
+    elif bundles and exemplar != f"blackbox:{bundles[0]['id']}":
+        failures.append(f"exemplar {exemplar!r} != bundle {bundles[0]['id']!r}")
+
+    replay_verdict = None
+    if bundles:
+        proc = subprocess.run(
+            [sys.executable, os.path.join(_REPO, "tools", "stepreplay.py"),
+             "--bundle", bundles[0]["path"], "--json",
+             "--workdir", os.path.join(args.dir, "replay")],
+            capture_output=True, text=True, timeout=1200, cwd=_REPO,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        try:
+            replay_verdict = json.loads(proc.stdout.strip().splitlines()[-1])
+        except (ValueError, IndexError):
+            replay_verdict = None
+        if proc.returncode != 0 or replay_verdict is None:
+            failures.append(f"stepreplay exited {proc.returncode}: "
+                            f"{proc.stderr[-800:]}")
+        else:
+            for want in ("nonfinite_reproduced", "deterministic"):
+                if not replay_verdict.get(want):
+                    failures.append(f"stepreplay verdict lacks {want}: "
+                                    f"{replay_verdict}")
+
+    verdict = {
+        "module": module, "steps": total,
+        "poisoned_at_step": args.pre_steps,
+        "bundles": [b["id"] for b in bundles],
+        "provenance": provenance,
+        "anomaly_rule_fired_count": rule.get("fired_count"),
+        "exemplar_trace_id": exemplar,
+        "replay": replay_verdict,
+        "events": [e["kind"] for e in inj.events],
+        "failures": failures,
+    }
+    print(json.dumps(verdict, default=str))
+    print("verdict: NaN localized to the poisoned module, one alert with a "
+          "black-box exemplar, and stepreplay reproduced the step from the "
+          "bundle alone"
+          if not failures else f"verdict: DRILL FAILED {failures}")
+    return 0 if not failures else 1
+
+
 def cmd_latest(args) -> int:
     mgr = CheckpointManager(args.dir)
     gens = mgr.generations()
@@ -1010,6 +1180,21 @@ def main() -> int:
                    help="acked replay inserts across the drain/kill")
     e.add_argument("--seed", type=int, default=0)
 
+    y = sub.add_parser("dynamics-drill",
+                       help="poison one module's params with a NaN mid-run; "
+                            "prove census localization, a single exemplar-"
+                            "carrying alert, a black-box bundle, and a "
+                            "deterministic stepreplay reproduction")
+    y.add_argument("--dir", required=True, help="scratch experiment directory")
+    y.add_argument("--module", default="",
+                   help="top-level param module to poison (default: first "
+                        "module, sorted)")
+    y.add_argument("--pre-steps", type=int, default=3,
+                   help="clean steps before the poison (EMA/census baseline)")
+    y.add_argument("--post-steps", type=int, default=3,
+                   help="clean steps after (debounce must hold at 1 bundle)")
+    y.add_argument("--seed", type=int, default=0)
+
     m = sub.add_parser("multichip-drill",
                        help="kill a multichip learner after a sharded save; "
                             "prove resume on a DIFFERENT mesh shape")
@@ -1034,6 +1219,7 @@ def main() -> int:
             "serve-drill": cmd_serve_drill,
             "shm-drill": cmd_shm_drill,
             "elastic-drill": cmd_elastic_drill,
+            "dynamics-drill": cmd_dynamics_drill,
             "multichip-drill": cmd_multichip_drill}[args.command](args)
 
 

@@ -1,0 +1,200 @@
+#!/usr/bin/env python
+"""curve_run: committed toy-run learning curves for the perf_gate curve gate.
+
+Runs the REAL SL and RL learners (tiny flagship-shaped model, CPU) for a
+few dozen iterations on a FIXED cycle of fake batches — fixing the data
+makes the task memorizable, so total_loss descending is a property of the
+whole train step (loss tree, grads, optimizer, donation plumbing), not of
+the data stream. The per-iteration total_loss curves are committed as
+``artifacts/curves_r<N>.json`` and gated round-over-round by
+``perf_gate curve`` next to the distill KL curve the DISTILL artifacts
+already carry: a PR that silently breaks learning (bad loss merge, wrong
+clip, optimizer state corruption) moves these curves even when every unit
+test still passes.
+
+Usage:
+  python tools/curve_run.py --round 16 [--iters 24] [--cycle 4] [--seed 0]
+  python tools/curve_run.py --out artifacts/curves_r16.json
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import platform
+import sys
+import tempfile
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+SMALL_MODEL = {
+    "encoder": {
+        "entity": {"layer_num": 1, "hidden_dim": 32, "output_dim": 16,
+                   "head_dim": 8},
+        "spatial": {"down_channels": [4, 4, 8], "project_dim": 4,
+                    "resblock_num": 1, "fc_dim": 16},
+        "scatter": {"output_dim": 4},
+        "core_lstm": {"hidden_size": 32, "num_layers": 1},
+    },
+    "policy": {
+        "action_type_head": {"res_dim": 16, "res_num": 1, "gate_dim": 32},
+        "delay_head": {"decode_dim": 16},
+        "queued_head": {"decode_dim": 16},
+        "selected_units_head": {"func_dim": 16},
+        "target_unit_head": {"func_dim": 16},
+        "location_head": {"res_dim": 8, "res_num": 1,
+                          "upsample_dims": [4, 4, 1], "map_skip_dim": 8},
+    },
+    "value": {"res_dim": 8, "res_num": 1},
+}
+
+
+class _Cycle:
+    """Endless cycle over K pre-drawn batches (shallow-copied per yield:
+    the learners pop bookkeeping keys like model_last_iter in place)."""
+
+    def __init__(self, source, k: int):
+        self._batches = [next(source) for _ in range(k)]
+        self._i = 0
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        b = self._batches[self._i % len(self._batches)]
+        self._i += 1
+        return {**b}
+
+
+def _curve(learner, iters: int, cycle: int) -> list:
+    """Drive ``learner.run`` recording per-iteration total_loss host-side
+    (one extra sync per iteration — this is a toy harness, not a bench),
+    reduced to one point per full pass over the batch cycle: per-batch loss
+    LEVELS differ by 3x within a cycle, so consecutive raw iterations are
+    not comparable — the per-cycle mean is."""
+    losses = []
+    orig = learner._train
+
+    def recording(batch):
+        log = orig(batch)
+        losses.append(float(log["total_loss"]))
+        return log
+
+    learner._train = recording
+    try:
+        learner.run(max_iterations=iters)
+    finally:
+        learner._train = orig
+    return [sum(losses[i:i + cycle]) / cycle
+            for i in range(0, len(losses) - cycle + 1, cycle)]
+
+
+def run_curves(iters: int = 24, cycle: int = 4, seed: int = 0,
+               workdir: str = "") -> dict:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ.setdefault("DISTAR_PERF_AOT", "0")
+    os.environ["DISTAR_EXPERIMENTS_ROOT"] = \
+        workdir or tempfile.mkdtemp(prefix="curve_run_")
+
+    from distar_tpu.learner import RLLearner, SLLearner
+    from distar_tpu.learner.data import FakeRLDataloader, FakeSLDataloader
+
+    base_learner_cfg = {
+        "batch_size": 2, "unroll_len": 2,
+        "save_freq": 10 ** 9, "log_freq": 10 ** 9,
+        # curves measure learning, not observability overhead
+        "dynamics": {"enabled": False},
+    }
+    curves = {}
+
+    sl = SLLearner({
+        "common": {"experiment_name": "curve_run_sl"},
+        # the production default (1e-5) barely moves a toy run; the curve
+        # wants visible descent in a few dozen iters
+        "learner": dict(base_learner_cfg, learning_rate=1e-3),
+        "model": SMALL_MODEL,
+    })
+    sl.set_dataloader(_Cycle(iter(FakeSLDataloader(2, 2, seed=seed)), cycle))
+    curves["sl_total_loss"] = _curve(sl, iters, cycle)
+
+    rl = RLLearner({
+        "common": {"experiment_name": "curve_run_rl"},
+        # value-pretrain regime: the policy is frozen, so the vtrace/td
+        # targets are FIXED and total_loss is a true descent objective on
+        # the repeated cycle (the full off-policy surrogate is not — ratio
+        # clipping makes it climb on memorized data). teacher == random
+        # init, so its KL stays off (the skill-run precedent, rl_soak)
+        "learner": dict(base_learner_cfg,
+                        learning_rate=1e-3,
+                        value_pretrain_iters=10 ** 6,
+                        loss={"kl_weight": 0.0,
+                              "action_type_kl_weight": 0.0,
+                              "entropy_weight": 3e-5}),
+        "model": SMALL_MODEL,
+    })
+    rl.set_dataloader(_Cycle(
+        iter(FakeRLDataloader(batch_size=2, unroll_len=2, hidden_size=32,
+                              hidden_layers=1, seed=seed)), cycle))
+    curves["rl_total_loss"] = _curve(rl, iters, cycle)
+
+    doc = {
+        "schema": "distar.curves.v1",
+        "metric": "toy-run learning curves (fixed-cycle fake batches)",
+        "value": float(len(curves)),
+        "unit": "families",
+        "iters": iters, "cycle": cycle, "seed": seed,
+        "points": "per-cycle mean total_loss over the fixed batch cycle",
+        "rl_regime": "value_pretrain (frozen policy: fixed targets)",
+        "device": "cpu", "host": platform.node(),
+        "ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "curves": {},
+    }
+    for family, values in curves.items():
+        doc["curves"][family] = {
+            "iters": len(values),
+            "values": [round(v, 5) for v in values],
+            "first": round(values[0], 5), "last": round(values[-1], 5),
+            "descended": bool(values[-1] < values[0]
+                              and all(math.isfinite(v) for v in values)),
+        }
+    return doc
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    p.add_argument("--round", type=int, default=0,
+                   help="round number; names artifacts/curves_r<N>.json")
+    p.add_argument("--out", default="",
+                   help="explicit output path (overrides --round)")
+    p.add_argument("--iters", type=int, default=24)
+    p.add_argument("--cycle", type=int, default=4,
+                   help="distinct fake batches in the fixed cycle")
+    p.add_argument("--seed", type=int, default=0)
+    args = p.parse_args()
+    out = args.out or (os.path.join(_REPO, "artifacts",
+                                    f"curves_r{args.round:02d}.json")
+                       if args.round else "")
+
+    doc = run_curves(iters=args.iters, cycle=args.cycle, seed=args.seed)
+    for family, curve in doc["curves"].items():
+        print(f"{family}: {curve['first']:g} -> {curve['last']:g} over "
+              f"{curve['iters']} iters (descended={curve['descended']})")
+    if out:
+        os.makedirs(os.path.dirname(out), exist_ok=True)
+        with open(out, "w") as f:
+            json.dump(doc, f, indent=1)
+            f.write("\n")
+        print(f"wrote {out}")
+    else:
+        print(json.dumps(doc, indent=1))
+    return 0 if all(c["descended"] for c in doc["curves"].values()) else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

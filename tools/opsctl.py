@@ -13,6 +13,7 @@ serve HTTP frontend, or a training role started with --metrics-port):
   python tools/opsctl.py trace        --addr 127.0.0.1:8423 \\
         [--name serve_request] [--min-ms 50] [--outcome shed] [--limit 20]
   python tools/opsctl.py trace        --addr 127.0.0.1:8423 --id <trace_id>
+  python tools/opsctl.py dynamics     --dir exp/blackbox [--inspect <id>]
 
 ``status`` exits 0 when healthy, 1 when any rule is warning, 2 when firing —
 scriptable for cron probes; it also prints a per-role step-time/MFU digest
@@ -461,6 +462,113 @@ def _print_perf_digest(addr: str) -> None:
         print(f"  {source:<24} {short:<40} last={last:<12.6g} mean={mean_s}")
 
 
+_DYN_HEADS = ("action_type", "delay", "queued", "selected_units",
+              "target_unit", "target_location")
+
+
+def _print_dynamics_digest(addr: str) -> None:
+    """Training-dynamics digest for ``status``: per-learner total grad
+    norm / EMA, update-to-weight ratio, clip fraction, the top-3 loss
+    heads by magnitude, and the last anomaly (step + bundle count) — the
+    10-second answer to "are the gradients healthy and what dominates the
+    loss". All from the probed TSDB (shipped by any learner running the
+    dynamics monitor); silent when no learner ever shipped the tree."""
+    def stats_of(name, window=600):
+        body = _try_get(addr,
+                        f"/timeseries?name={urllib.parse.quote(name)}"
+                        f"&window_s={window}")
+        out = {}
+        for source, st in ((body or {}).get("stats") or {}).items():
+            if st and st.get("last") is not None:
+                out[source] = st["last"]
+        return out  # {source: last}
+
+    grad = stats_of("distar_train_grad_norm{module=total}")
+    if not grad:
+        return
+    ema = stats_of("distar_train_grad_norm_ema")
+    ratio = stats_of("distar_train_update_ratio{module=total}")
+    clip = stats_of("distar_train_grad_clip_fraction")
+    print("training dynamics:")
+    for source in sorted(grad):
+        parts = [f"grad_norm={grad[source]:.6g}"]
+        if source in ema:
+            parts.append(f"ema={ema[source]:.6g}")
+        if source in ratio:
+            parts.append(f"update_ratio={ratio[source]:.4g}")
+        if source in clip:
+            parts.append(f"clip_fraction={clip[source]:.4g}")
+        print(f"  {source:<24} {'  '.join(parts)}")
+    # top-3 loss heads by |last| across the bounded term x head grid
+    heads = []
+    for term in ("sl", "pg", "upgo", "entropy", "kl", "dapo"):
+        for head in _DYN_HEADS:
+            rows = stats_of(
+                f"distar_train_loss_head{{head={head},term={term}}}")
+            if not rows:
+                rows = stats_of(
+                    f"distar_train_loss_head{{term={term},head={head}}}")
+            for _source, last in rows.items():
+                heads.append((abs(last), f"{term}/{head}", last))
+    if heads:
+        top = sorted(heads, reverse=True)[:3]
+        print("  top loss heads: "
+              + "  ".join(f"{name}={last:.6g}" for _m, name, last in top))
+    anomaly = stats_of("distar_train_last_anomaly_step")
+    bundles = stats_of("distar_train_blackbox_bundles_total")
+    for source in sorted(anomaly):
+        n = bundles.get(source)
+        extra = f" ({int(n)} black-box bundle(s) — opsctl dynamics)" \
+            if n else ""
+        print(f"  {source:<24} last_anomaly_step={int(anomaly[source])}{extra}")
+
+
+def cmd_dynamics(args) -> int:
+    """Black-box bundle browser (local filesystem — bundles are forensic
+    artifacts, not telemetry): list a directory's bundles, or inspect one
+    (summary, provenance, the worst diagnostics) and print the stepreplay
+    invocation that reproduces it."""
+    from distar_tpu.obs.dynamics import (bundle_summary, list_bundles,
+                                         load_bundle)
+
+    dirpath = args.dir
+    if os.path.isdir(os.path.join(dirpath, "blackbox")):
+        dirpath = os.path.join(dirpath, "blackbox")  # experiment root given
+    bundles = list_bundles(dirpath)
+    if args.inspect:
+        match = [b for b in bundles if args.inspect in b["id"]]
+        if not match:
+            print(f"no bundle matching {args.inspect!r} under {dirpath}")
+            return 1
+        bundle = load_bundle(match[0]["path"])
+        if args.json:
+            print(json.dumps(bundle_summary(bundle), indent=1, default=str))
+        else:
+            for k, v in bundle_summary(bundle).items():
+                print(f"  {k}: {v}")
+            diag = bundle.get("diagnostics") or {}
+            worst = sorted(
+                ((v, k) for k, v in diag.items()
+                 if k.startswith("dyn/nonfinite_") and not k.endswith("/total")
+                 and v and v == v),
+                reverse=True)[:5]
+            if worst:
+                print("  non-finite census: "
+                      + "  ".join(f"{k}={int(v)}" for v, k in worst))
+            print(f"  replay: python tools/stepreplay.py --bundle "
+                  f"{match[0]['path']}")
+        return 0
+    if not bundles:
+        print(f"no black-box bundles under {dirpath}")
+        return 1
+    if args.json:
+        print(json.dumps(bundles, indent=1))
+        return 0
+    for b in bundles:
+        print(f"  {b['id']}  step={b['step']}  reason={b['reason']}")
+    return 0
+
+
 def cmd_status(args) -> int:
     body = _get(args.addr, "/healthz")
     status = body.get("status", "unknown")
@@ -509,6 +617,10 @@ def cmd_status(args) -> int:
     # telemetry here): student/teacher generation drift, live divergence,
     # canary split state
     _print_distill_digest(args.addr)
+    # training-dynamics digest (present when a learner ships the dynamics
+    # tree): per-learner grad norm / update ratio / clip fraction, top
+    # loss heads, last anomaly + bundle count
+    _print_dynamics_digest(args.addr)
     _print_perf_digest(args.addr)
     _print_actor_digest(args.addr)
     return {"ok": 0, "warning": 1}.get(status, 2)
@@ -644,7 +756,7 @@ def main() -> int:
     p = argparse.ArgumentParser(description=__doc__,
                                 formatter_class=argparse.RawDescriptionHelpFormatter)
     p.add_argument("command", choices=("status", "tail-alerts", "query",
-                                       "profile", "trace"))
+                                       "profile", "trace", "dynamics"))
     p.add_argument("--addr", default="127.0.0.1:8423", help="host:port of a health surface")
     p.add_argument("--interval", type=float, default=2.0, help="tail-alerts poll cadence")
     p.add_argument("--once", action="store_true",
@@ -674,9 +786,19 @@ def main() -> int:
                    help="trace: filter by outcome (ok/shed/error)")
     p.add_argument("--limit", type=int, default=20,
                    help="trace: max listings")
+    p.add_argument("--dir", default="",
+                   help="dynamics: blackbox directory (or an experiment "
+                        "root containing blackbox/)")
+    p.add_argument("--inspect", default="",
+                   help="dynamics: inspect the bundle whose id contains "
+                        "this substring instead of listing")
     args = p.parse_args()
     if args.command == "status":
         return cmd_status(args)
+    if args.command == "dynamics":
+        if not args.dir:
+            p.error("dynamics requires --dir")
+        return cmd_dynamics(args)
     if args.command == "tail-alerts":
         return cmd_tail_alerts(args)
     if args.command == "profile":

@@ -33,12 +33,14 @@ Usage:
          --candidate fresh.json [--tolerance 0.5]
   python tools/perf_gate.py trajectory [--write PERF.md]
   python tools/perf_gate.py scaling [--artifact one.json]
+  python tools/perf_gate.py curve [--tolerance 0.10] [--json]
 """
 from __future__ import annotations
 
 import argparse
 import glob
 import json
+import math
 import os
 import re
 import sys
@@ -332,7 +334,10 @@ def collect_trajectory(repo: str = _REPO) -> List[dict]:
                        + glob.glob(os.path.join(repo, "SHM_r*.json"))
                        + glob.glob(os.path.join(repo, "TRACE_r*.json"))
                        + glob.glob(os.path.join(repo, "DISTILL_r*.json"))
+                       + glob.glob(os.path.join(repo, "DYNAMICS_r*.json"))
                        + glob.glob(os.path.join(repo, "artifacts", "perf_baseline*.json"))
+                       + glob.glob(os.path.join(repo, "artifacts", "dynamics_*.json"))
+                       + glob.glob(os.path.join(repo, "artifacts", "curves_r*.json"))
                        + glob.glob(os.path.join(repo, "artifacts", "rollout_*.json"))
                        + glob.glob(os.path.join(repo, "artifacts", "replay_*.json"))
                        + glob.glob(os.path.join(repo, "artifacts", "fleet_*.json"))
@@ -381,16 +386,32 @@ def collect_trajectory(repo: str = _REPO) -> List[dict]:
                     "status": _status_of(doc),
                 })
         if doc.get("envelope_pct") is not None:
-            # the tracing-overhead artifact: surface the A/B verdict as its
-            # own row (the untraced arm is the comparison baseline)
+            # a paired on/off overhead artifact (tracing r13, dynamics r16:
+            # ab_label says which subsystem was A/B'd): surface the verdict
+            # as its own row (the off arm is the comparison baseline)
             rows.append({
                 "round": _round_of(path), "artifact": os.path.basename(path),
-                "metric": "tracing on-vs-off within the stated "
+                "metric": f"{doc.get('ab_label', 'tracing')} on-vs-off "
+                          "within the stated "
                           f"{doc.get('envelope_pct'):g}% envelope",
                 "value": 1.0 if doc.get("within_envelope") else 0.0,
                 "unit": "bool",
                 "status": _status_of(doc),
             })
+        for family, curve in sorted((doc.get("curves") or {}).items()):
+            values = (curve or {}).get("values") or []
+            if len(values) >= 2:
+                # a committed learning-curve artifact: surface each family's
+                # first->last descent; `perf_gate curve` gates it across
+                # rounds
+                rows.append({
+                    "round": _round_of(path),
+                    "artifact": os.path.basename(path),
+                    "metric": (f"toy-run {family} {values[0]:g} -> "
+                               f"{values[-1]:g} over {len(values)} points"),
+                    "value": values[-1], "unit": "loss",
+                    "status": _status_of(doc),
+                })
         toy = (doc.get("distill") or {}).get("toy_run") or {}
         if toy.get("kl_first") is not None:
             # the distill artifact carries the toy-run KL curve in-band;
@@ -441,6 +462,115 @@ def render_trajectory(rows: List[dict]) -> str:
     return "\n".join(lines)
 
 
+def collect_curves(repo: str = _REPO) -> Dict[str, List[dict]]:
+    """Committed toy-run learning curves by family, one entry per round:
+    ``sl_total_loss``/``rl_total_loss`` (and anything else a round adds)
+    from ``artifacts/curves_r*.json`` ``curves.<family>.values``, plus
+    ``distill_kl`` from the DISTILL artifacts' in-band ``kl_curve``."""
+    fams: Dict[str, List[dict]] = {}
+
+    def add(family, path, values):
+        values = [float(v) for v in values]
+        if len(values) >= 2:
+            fams.setdefault(family, []).append({
+                "round": _round_of(path),
+                "artifact": os.path.basename(path),
+                "values": values,
+            })
+
+    for path in sorted(glob.glob(os.path.join(repo, "artifacts",
+                                              "curves_r*.json"))):
+        try:
+            doc = load_artifact(path)
+        except (OSError, ValueError):
+            continue
+        for family, curve in (doc.get("curves") or {}).items():
+            add(family, path, (curve or {}).get("values") or [])
+    for path in sorted(glob.glob(os.path.join(repo, "DISTILL_r*.json"))):
+        try:
+            doc = load_artifact(path)
+        except (OSError, ValueError):
+            continue
+        toy = (doc.get("distill") or {}).get("toy_run") or {}
+        add("distill_kl", path, toy.get("kl_curve") or [])
+    for entries in fams.values():
+        entries.sort(key=lambda e: (e["round"].zfill(3), e["artifact"]))
+    return fams
+
+
+def curve_verdicts(fams: Dict[str, List[dict]],
+                   tolerance: float) -> Tuple[List[dict], List[str]]:
+    """Per-family learning-curve gate. Each committed curve must be a real
+    descent (finite, last < first); across rounds the NEWEST round's final
+    value may not regress past the previous round's final value by more
+    than ``tolerance`` (relative, sign-safe for negative RL losses). A
+    family with a single round is its own baseline — PASS."""
+    verdicts, failures = [], []
+    for family, entries in sorted(fams.items()):
+        for e in entries:
+            values = e["values"]
+            if not all(math.isfinite(v) for v in values):
+                failures.append(f"{family}: non-finite values in "
+                                f"{e['artifact']}")
+            elif values[-1] >= values[0]:
+                failures.append(
+                    f"{family}: curve in {e['artifact']} does not descend "
+                    f"({values[0]:g} -> {values[-1]:g})")
+        verdict = {
+            "family": family,
+            "rounds": [e["round"] for e in entries],
+            "first": entries[0]["values"][0],
+            "last": entries[-1]["values"][-1],
+        }
+        if len(entries) >= 2:
+            base, cand = entries[-2], entries[-1]
+            base_last, cand_last = base["values"][-1], cand["values"][-1]
+            allowed = base_last + tolerance * max(abs(base_last), 1e-9)
+            verdict.update({
+                "baseline_round": base["round"], "baseline_last": base_last,
+                "candidate_round": cand["round"], "candidate_last": cand_last,
+                "allowed": allowed,
+                "regressed": cand_last > allowed,
+            })
+            if cand_last > allowed:
+                failures.append(
+                    f"{family}: round {cand['round']} final {cand_last:g} "
+                    f"regressed past round {base['round']}'s {base_last:g} "
+                    f"(allowed {allowed:g} at tolerance {tolerance:g})")
+        else:
+            verdict["regressed"] = False
+            verdict["note"] = "single round: baseline PASS"
+        verdicts.append(verdict)
+    return verdicts, failures
+
+
+def cmd_curve(args) -> int:
+    fams = collect_curves()
+    if not fams:
+        print("no committed learning-curve artifacts "
+              "(artifacts/curves_r*.json, DISTILL_r*.json)")
+        return 1
+    verdicts, failures = curve_verdicts(fams, args.tolerance)
+    if args.json:
+        print(json.dumps({"verdicts": verdicts, "failures": failures},
+                         indent=1))
+    else:
+        for v in verdicts:
+            if "candidate_last" in v:
+                line = (f"{v['family']}: r{v['baseline_round']} "
+                        f"{v['baseline_last']:g} -> r{v['candidate_round']} "
+                        f"{v['candidate_last']:g} (allowed {v['allowed']:g})")
+            else:
+                line = (f"{v['family']}: {v['first']:g} -> {v['last']:g} "
+                        f"({v.get('note', '')})")
+            print(f"  {'REGRESSED' if v.get('regressed') else 'ok':<10} {line}")
+        for f in failures:
+            print(f"  FAIL: {f}")
+    print("curve gate: PASS" if not failures
+          else f"curve gate: FAIL ({len(failures)} offence(s))")
+    return 0 if not failures else 1
+
+
 def cmd_trajectory(args) -> int:
     rows = collect_trajectory()
     table = render_trajectory(rows)
@@ -483,9 +613,17 @@ def main() -> int:
                         help="refuse forged scaling_valid claims (exit 2)")
     ps.add_argument("--artifact", default="",
                     help="check one artifact instead of sweeping the repo")
+    pu = sub.add_parser("curve",
+                        help="learning-curve gate: committed toy-run curves "
+                             "must descend and not regress round-over-round")
+    pu.add_argument("--tolerance", type=float, default=0.10,
+                    help="allowed relative regression of the newest round's "
+                         "final value vs the previous round's (default 10%%)")
+    pu.add_argument("--json", action="store_true",
+                    help="print verdicts as one JSON object")
     args = p.parse_args()
     return {"check": cmd_check, "trajectory": cmd_trajectory,
-            "scaling": cmd_scaling}[args.command](args)
+            "scaling": cmd_scaling, "curve": cmd_curve}[args.command](args)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,241 @@
+#!/usr/bin/env python
+"""stepreplay: deterministically re-execute a black-box anomaly bundle.
+
+The training-dynamics observatory (distar_tpu/obs/dynamics.py) answers
+"what happened" with a forensic bundle: the offending batch, pre-step aux
+(SL hidden carry / RL value-pretrain gate), PRNG seed, step index,
+checkpoint pointer, config digest and the diagnostics tree that localized
+the first non-finite module. This tool answers "can I hold it in my
+hands": it reloads a bundle on any host — no experiment directory, no
+replay fleet, no actor — rebuilds the exact learner from the bundle's own
+config, restores the captured state, and re-executes that one train step
+TWICE:
+
+  python tools/stepreplay.py --bundle exp/blackbox/blackbox_000_step7_grad_nonfinite.bb
+  python tools/stepreplay.py --bundle ... --platform cpu --json
+  python tools/stepreplay.py --bundle ... --params init   # replay from a
+        # fresh PRNG-seeded init instead of the captured state (triage:
+        # batch-borne vs state-borne anomalies)
+
+Verdict (exit 0 only when the bundle is a faithful reproduction):
+
+  * ``nonfinite_reproduced`` — the replayed step is non-finite again
+    (loss, grad norm, or any census total), required whenever the bundle's
+    reasons include a non-finite class;
+  * ``provenance_confirmed`` — the census family/module the ORIGINAL run
+    blamed is non-finite in the replay too;
+  * ``deterministic`` — the two replays are BIT-equal: every logged scalar
+    and every post-step state leaf, NaN payloads included.
+
+Honesty note carried from capture: donated buffers mean the bundled state
+is one optimizer step PAST the anomaly. Batch-origin anomalies reproduce
+regardless (the poison rides the batch); param-origin anomalies reproduce
+because the post-step params are already poisoned by the NaN update.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+
+def _parse_args(argv=None):
+    p = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    p.add_argument("--bundle", required=True, help="path to a .bb bundle")
+    p.add_argument("--platform", default="cpu",
+                   help="JAX_PLATFORMS for the replay (default cpu: any "
+                        "host can replay a fleet bundle)")
+    p.add_argument("--params", choices=("bundle", "init"), default="bundle",
+                   help="'bundle': restore the captured (post-anomaly) "
+                        "state; 'init': fresh init from the recorded PRNG "
+                        "seed — isolates batch-borne anomalies")
+    p.add_argument("--runs", type=int, default=2,
+                   help="replays to compare for bit-equality (>= 2)")
+    p.add_argument("--workdir", default="",
+                   help="scratch experiment dir (default: a tempdir)")
+    p.add_argument("--json", action="store_true",
+                   help="print the verdict as one JSON object")
+    return p.parse_args(argv)
+
+
+def _bits(x) -> bytes:
+    """Bit-exact fingerprint of a host scalar/array (NaN payloads count)."""
+    import numpy as np
+
+    return np.asarray(x).tobytes()
+
+
+def _tree_bits(tree) -> "list[tuple[str, bytes]]":
+    import jax
+
+    leaves = jax.tree_util.tree_leaves_with_path(tree)
+    return [(jax.tree_util.keystr(path), _bits(leaf))
+            for path, leaf in leaves if hasattr(leaf, "shape")]
+
+
+def _nonfinite(log: dict) -> bool:
+    import math
+
+    for key in ("total_loss", "grad_norm", "dyn/grad_norm/total"):
+        v = log.get(key)
+        if v is not None and not math.isfinite(float(v)):
+            return True
+    for key in ("dyn/nonfinite_grads/total", "dyn/nonfinite_params/total",
+                "dyn/nonfinite_batch/total"):
+        if float(log.get(key, 0.0) or 0.0) > 0:
+            return True
+    return False
+
+
+def replay(bundle: dict, params_from: str = "bundle", runs: int = 2) -> dict:
+    """Rebuild the learner from the bundle's config, re-execute the
+    captured step ``runs`` times from identical restored state, and return
+    the verdict dict. Import-time side effects (jax) happen here, after
+    the caller fixed JAX_PLATFORMS."""
+    import jax
+    import jax.numpy as jnp
+
+    from distar_tpu.learner import DistillLearner, RLLearner, SLLearner
+    from distar_tpu.obs.dynamics import config_digest, first_nonfinite
+
+    classes = {"sllearner": SLLearner, "rllearner": RLLearner,
+               "distilllearner": DistillLearner}
+    cls = classes.get(bundle.get("learner", ""))
+    if cls is None:
+        raise SystemExit(f"unknown learner role {bundle.get('learner')!r} "
+                         f"(know {sorted(classes)})")
+
+    cfg = bundle["config"]
+    digest_drift = config_digest(cfg) != bundle.get("config_digest")
+    # redirect every filesystem side effect into the scratch dir and keep
+    # the replay itself out of the anomaly business (no nested bundles)
+    cfg.setdefault("common", {})["save_path"] = os.environ[
+        "DISTAR_EXPERIMENTS_ROOT"]
+    cfg.setdefault("learner", {}).setdefault("dynamics", {})["blackbox"] = False
+
+    learner = cls(cfg)
+    if int(bundle.get("prng_seed", 0)) != learner.init_prng_seed:
+        learner.init_prng_seed = int(bundle["prng_seed"])
+        learner._setup_state()
+    init_state = learner._state
+
+    def place(state):
+        """Fresh XLA buffers per run — the step donates params/opt_state,
+        so each replay needs its own placement (and device_put of host
+        numpy can be zero-copy on CPU, unsafe under donation)."""
+        if getattr(learner, "_shardings", None):
+            return learner._place_state(state)
+        pin = jax.jit(lambda t: jax.tree.map(
+            lambda a: a + 0 if hasattr(a, "shape") else a, t))
+        return pin(state)
+
+    source = bundle.get("state") if params_from == "bundle" else None
+    if params_from == "bundle" and source is None:
+        raise SystemExit("bundle carries no state (blackbox_state was off); "
+                         "rerun with --params init")
+    aux = bundle.get("aux") or {}
+
+    def arm():
+        """Reset the learner to the bundle's captured pre-step conditions."""
+        if source is not None:
+            learner._state = place(source)
+        else:
+            learner._state = place(jax.device_get(init_state))
+        if "hidden_state" in aux and hasattr(learner, "_hidden"):
+            learner._hidden = jax.tree.map(jnp.asarray, aux["hidden_state"])
+        if "only_update_value" in aux and \
+                hasattr(learner, "_remaining_value_pretrain"):
+            learner._remaining_value_pretrain = \
+                1 if aux["only_update_value"] else 0
+
+    batch = dict(bundle["batch"])
+    batch.pop("_on_device", None)  # host copies must re-place on this host
+
+    logs, states = [], []
+    for _ in range(max(2, runs)):
+        arm()
+        log = learner._train(dict(batch))
+        logs.append(log)
+        states.append(_tree_bits(jax.device_get(  # analysis: allow(jax-device-get-in-loop) — loop is over replay arms (2-3 total), each needs its own post-step state snapshot for the bit-equality verdict
+            learner._state)))
+
+    deterministic = all(
+        set(log) == set(logs[0])
+        and all(_bits(log[k]) == _bits(logs[0][k]) for k in logs[0])
+        for log in logs[1:]
+    ) and all(s == states[0] for s in states[1:])
+
+    reproduced = _nonfinite(logs[0])
+    prov = bundle.get("provenance") or None
+    prov_confirmed = None
+    if prov:
+        replay_prov = first_nonfinite(logs[0])
+        key = f"dyn/nonfinite_{prov['origin']}/{prov['module']}"
+        prov_confirmed = bool(
+            float(logs[0].get(key, 0.0) or 0.0) > 0
+            # post-step params are one NaN update past a batch/param poison,
+            # so the replay may localize UPSTREAM of the original blame —
+            # accept a same-or-narrower origin naming the same module
+            or (replay_prov is not None
+                and replay_prov["module"] == prov["module"])
+        )
+
+    expect_nonfinite = any(
+        r in ("loss_nonfinite", "grad_nonfinite")
+        for r in bundle.get("reasons", ())
+    )
+    ok = deterministic and (reproduced or not expect_nonfinite) and \
+        prov_confirmed is not False
+    return {
+        "bundle_step": bundle.get("step"),
+        "reasons": bundle.get("reasons"),
+        "learner": bundle.get("learner"),
+        "params_from": params_from,
+        "runs": max(2, runs),
+        "config_digest_drift": digest_drift,
+        "nonfinite_reproduced": reproduced,
+        "nonfinite_expected": expect_nonfinite,
+        "provenance_recorded": prov,
+        "provenance_confirmed": prov_confirmed,
+        "deterministic": deterministic,
+        "total_loss": float(logs[0].get("total_loss", float("nan"))),
+        "ok": ok,
+    }
+
+
+def main(argv=None) -> int:
+    args = _parse_args(argv)
+    # fix the backend BEFORE jax import: a fleet bundle (TPU) must replay
+    # on a laptop CPU; AOT perf tracing would only add noise to forensics
+    if args.platform:
+        os.environ["JAX_PLATFORMS"] = args.platform
+    os.environ.setdefault("DISTAR_PERF_AOT", "0")
+    workdir = args.workdir or tempfile.mkdtemp(prefix="stepreplay_")
+    os.environ["DISTAR_EXPERIMENTS_ROOT"] = workdir
+
+    from distar_tpu.obs.dynamics import bundle_summary, load_bundle
+
+    bundle = load_bundle(args.bundle)
+    if not args.json:
+        print(f"bundle: {json.dumps(bundle_summary(bundle), default=str)}")
+    verdict = replay(bundle, params_from=args.params, runs=args.runs)
+    if args.json:
+        print(json.dumps(verdict, default=str))
+    else:
+        for k, v in verdict.items():
+            print(f"  {k}: {v}")
+        print("verdict: anomaly reproduced deterministically from the "
+              "bundle alone" if verdict["ok"] else "verdict: REPLAY FAILED")
+    return 0 if verdict["ok"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
