@@ -66,16 +66,34 @@ def _resolve_args(args) -> None:
         args.env_num = int(actor_cfg.get("env_num", 2))
 
 
+def _jaxenv_cfgs(args):
+    """(EnvConfig, ScenarioConfig) from the --jaxenv-* CLI knobs."""
+    from ..envs.jaxenv import EnvConfig, ScenarioConfig
+
+    u = args.jaxenv_units
+    return (EnvConfig(units_per_squad=u),
+            ScenarioConfig(units_per_squad=u, max_units=u,
+                           episode_len=args.jaxenv_episode_len))
+
+
 def _env_fn(args):
-    """Env factory from the user config's env block: ``env.type: sc2``
-    launches real games through the client layer (reference actors always
-    do); the default mock env keeps game-free smoke loops working."""
+    """Env factory: ``--env`` wins, then the user config's env block
+    (``env.type: sc2`` launches real games through the client layer;
+    ``jaxenv`` is the pure-JAX micro-battle world through the host
+    adapter); the default mock env keeps game-free smoke loops working."""
     user_cfg = read_config(args.config) if args.config else {}
     env_cfg = dict(user_cfg.get("env", {}))
-    if env_cfg.pop("type", "mock") == "sc2":
+    env_type = getattr(args, "env", "") or env_cfg.pop("type", "mock")
+    env_cfg.pop("type", None)
+    if env_type == "sc2":
         from ..envs.sc2.launcher import make_sc2_env
 
         return lambda: make_sc2_env({"env": env_cfg})
+    if env_type == "jaxenv":
+        from ..envs.jaxenv import JaxMicroBattleEnv
+
+        jcfg, scfg = _jaxenv_cfgs(args)
+        return lambda: JaxMicroBattleEnv(jcfg, scfg)
     return lambda: MockEnv(episode_game_loops=args.episode_game_loops)
 
 
@@ -607,6 +625,49 @@ def run_actor(args) -> None:
         supervise_call(job_loop, op="actor", policy=_restart_policy(args))
 
 
+def run_anakin(args) -> None:
+    """Fused on-device training: the Anakin loop (envs/jaxenv/anakin.py)
+    replaces the whole actor plane — env step + sample_action + LSTM carry
+    compiled into one scanned XLA program feeding the learner directly.
+    ``--batch-size`` is the number of vmapped env lanes, ``--traj-len`` the
+    window length. Startup asserts the fused loop is device-pure (no
+    host-callback primitives in its jaxpr) and refuses to run otherwise."""
+    from ..envs.jaxenv import AnakinDataLoader, AnakinRunner
+
+    model_cfg = _model_cfg(args)
+    _init_health(args, roles=("learner", "trace"))
+    _maybe_serve_metrics(args)
+    learner = _make_learner(args, model_cfg)
+    # no host-side prefetch on the fused path: batches are produced ON
+    # DEVICE, so the feeder's look-ahead buys nothing — and its producer
+    # thread would be sitting inside the NEXT window's jitted rollout when
+    # run() returns (minutes at large B); a daemon thread dying inside XLA
+    # at interpreter teardown aborts the process (the run_all in-flight-job
+    # hazard, reached through the dataloader instead of the actor)
+    learner.cfg.learner["prefetch_depth"] = 0
+    jcfg, scfg = _jaxenv_cfgs(args)
+    runner = AnakinRunner(
+        learner.model, batch_size=args.batch_size, unroll_len=args.traj_len,
+        env_cfg=jcfg, scenario_cfg=scfg)
+
+    def live_params():
+        state = getattr(learner, "_state", None)
+        return state["params"] if state else None
+
+    loader = AnakinDataLoader(runner, params_provider=live_params)
+    report = runner.purity_report(loader._params(), runner.init_carry())
+    print(f"anakin device purity: {report}", flush=True)
+    if not report["pure"]:
+        raise SystemExit(
+            f"anakin loop is not device-pure: {report['offending']}")
+    print(f"anakin: B={runner.B} lanes x T={runner.T} steps "
+          f"({runner.B * runner.T} env steps/window), "
+          f"units_per_squad={jcfg.units_per_squad}", flush=True)
+    learner.set_dataloader(loader)
+    _run_learner_supervised(args, learner, args.iters)
+    print(f"learner done: {learner.last_iter.val} iters")
+
+
 def main() -> None:
     p = argparse.ArgumentParser()
     p.add_argument("--type", default="all",
@@ -618,6 +679,18 @@ def main() -> None:
     p.add_argument("--traj-len", type=int, default=None)
     p.add_argument("--env-num", type=int, default=None)
     p.add_argument("--episode-game-loops", type=int, default=300)
+    p.add_argument("--env", default="",
+                   choices=("", "mock", "sc2", "jaxenv"),
+                   help="environment backend; overrides the config's "
+                        "env.type (default mock)")
+    p.add_argument("--anakin", action="store_true",
+                   help="fused on-device rollout: train the learner from "
+                        "the jaxenv Anakin loop (implies --env jaxenv; "
+                        "replaces the actor plane entirely)")
+    p.add_argument("--jaxenv-units", type=int, default=4,
+                   help="jaxenv units per squad (padded squad width)")
+    p.add_argument("--jaxenv-episode-len", type=int, default=32,
+                   help="jaxenv env steps until episode timeout")
     p.add_argument("--experiment-name", default="rl_train")
     p.add_argument("--save-path", default="",
                    help="experiment root override (default "
@@ -818,7 +891,11 @@ def main() -> None:
             "--dist-num-processes and --dist-process-id"
         )
 
-    if args.type == "all":
+    if args.anakin:
+        if args.env and args.env != "jaxenv":
+            raise SystemExit("--anakin requires --env jaxenv")
+        run_anakin(args)
+    elif args.type == "all":
         run_all(args)
     elif args.type == "league":
         league = League(read_config(args.config) if args.config else {})

@@ -4,6 +4,10 @@ from .features import ProtoFeatures, compute_battle_score, unpack_feature_layer
 from .mock_env import MockEnv
 from .sc2_env import FakeController, SC2Env
 
+# jaxenv (the pure-JAX micro-battle world) is imported lazily by its users
+# (bin/rl_train, serve/fleet, tests) — an eager import here would pull jax
+# into every envs consumer including game-client-only paths.
+
 __all__ = [
     "BaseEnv",
     "MockEnv",

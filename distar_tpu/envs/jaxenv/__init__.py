@@ -1,0 +1,43 @@
+"""jaxenv: the on-device world (ISSUE 17 tentpole).
+
+A pure-JAX, vmap-able micro-battle environment speaking the real Features
+observation/action contract, plus the Anakin fused rollout loop that trains
+the flagship model against it with zero per-step host transfers. See
+docs/envs.md for the full state/step/reward spec and the Features mapping.
+"""
+from .anakin import AnakinDataLoader, AnakinRunner, device_pure_report
+from .core import EnvConfig, EnvState, micro_legal_mask, reset, step
+from .host import JaxMicroBattleEnv, episode_digest
+from .obs import observe
+from .scenario import Scenario, ScenarioConfig, ScenarioGenerator
+from .winrate import (
+    ModelPolicy,
+    ScriptedPolicy,
+    attack_nearest_policy,
+    head_to_head,
+    idle_policy,
+    model_policy,
+)
+
+__all__ = [
+    "AnakinDataLoader",
+    "AnakinRunner",
+    "device_pure_report",
+    "EnvConfig",
+    "EnvState",
+    "micro_legal_mask",
+    "reset",
+    "step",
+    "observe",
+    "JaxMicroBattleEnv",
+    "episode_digest",
+    "Scenario",
+    "ScenarioConfig",
+    "ScenarioGenerator",
+    "ModelPolicy",
+    "ScriptedPolicy",
+    "attack_nearest_policy",
+    "idle_policy",
+    "model_policy",
+    "head_to_head",
+]

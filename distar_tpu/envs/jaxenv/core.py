@@ -1,0 +1,386 @@
+"""Pure-JAX micro-battle dynamics: reset / step over fixed-shape unit arrays.
+
+Two squads of units (positions, health, cooldowns) fight on the contract's
+spatial rectangle. Commands arrive in the real Features action layout —
+action_type indexes the 327-action vocabulary, selected_units are pointer
+slots into the observation's entity list, target_unit is an entity slot,
+target_location a flat spatial index — and are decoded on device through
+static semantic LUTs built from the action contract. Reward is damage
+differential (``battle``) plus a terminal win bonus (``winloss``).
+
+Every function is a pure jax transform of (config, state, action): single-env
+written, ``jax.vmap``-able over a batch of scenarios, and deterministic given
+the scenario key (goldens in tests/test_jaxenv.py pin this bit-for-bit).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...lib import actions as ACT
+from ...lib import features as F
+from .scenario import (
+    CATALOG_COOLDOWN,
+    CATALOG_DAMAGE,
+    CATALOG_HEALTH,
+    CATALOG_RANGE,
+    CATALOG_SPEED,
+    CELL,
+    MAP_H,
+    MAP_W,
+    Scenario,
+)
+
+# ------------------------------------------------------------ semantic LUTs
+# Order kinds a unit can hold between steps.
+KIND_STOP, KIND_MOVE, KIND_ATTACK_MOVE, KIND_ATTACK_UNIT = 0, 1, 2, 3
+
+# action_type -> command semantics, derived from the action contract's
+# per-head applicability flags (unit-targeted actions command an attack on
+# the target, location actions move — attack-move when the action is an
+# Attack variant — bare selected-units actions stop/hold).
+_SEM_NONE, _SEM_MOVE, _SEM_ATTACK_MOVE, _SEM_ATTACK_UNIT, _SEM_STOP = 0, 1, 2, 3, 4
+
+
+def _build_action_semantics() -> np.ndarray:
+    sem = np.zeros(ACT.NUM_ACTIONS, np.int32)
+    for i, a in enumerate(ACT.ACTIONS):
+        if a["target_unit"]:
+            sem[i] = _SEM_ATTACK_UNIT
+        elif a["target_location"]:
+            sem[i] = _SEM_ATTACK_MOVE if "Attack" in a["name"] else _SEM_MOVE
+        elif a["selected_units"]:
+            sem[i] = _SEM_STOP
+    return sem
+
+
+ACTION_SEMANTIC = _build_action_semantics()
+_SEM_TO_KIND = np.array(
+    [KIND_STOP, KIND_MOVE, KIND_ATTACK_MOVE, KIND_ATTACK_UNIT, KIND_STOP], np.int32)
+
+# The micro-battle-meaningful action subset (optional policy legal_mask):
+# no_op, Attack_pt, Attack_unit, HoldPosition, Move_pt, Move_unit, Smart_pt,
+# Smart_unit, Stop — every other action decodes to one of these semantics
+# anyway, but constraining sampling concentrates exploration.
+MICRO_ACTION_TYPES = (0, 2, 3, 156, 197, 198, 265, 266, 267)
+
+
+def micro_legal_mask() -> np.ndarray:
+    mask = np.zeros(ACT.NUM_ACTIONS, bool)
+    mask[list(MICRO_ACTION_TYPES)] = True
+    return mask
+
+
+# Winner codes (EnvState.winner)
+WINNER_NONE, WINNER_HOME, WINNER_AWAY, WINNER_DRAW = -1, 0, 1, 2
+
+
+@dataclass(frozen=True)
+class EnvConfig:
+    """Static (hashable, jit-closure-safe) dynamics knobs."""
+
+    units_per_squad: int = 8
+    loops_per_step: int = 22      # game loops one env step represents
+    damage_norm: float = 200.0    # battle reward = damage diff / this
+    timeout_margin: float = 0.05  # health-fraction lead needed to win a timeout
+    hit_slack: float = 1.0        # px of target-radius slack on weapon range
+
+    @property
+    def num_units(self) -> int:
+        return 2 * self.units_per_squad
+
+
+class EnvState(NamedTuple):
+    """Complete battle state, all leaves fixed-shape (N = 2 * U units; the
+    first U slots are home, the rest away)."""
+
+    scenario: Scenario
+    pos: jax.Array           # f32 [N, 2] (x, y)
+    health: jax.Array        # f32 [N]
+    max_health: jax.Array    # f32 [N]
+    cooldown: jax.Array      # f32 [N] steps until the weapon is ready
+    alive: jax.Array         # bool [N]
+    order_kind: jax.Array    # i32 [N] KIND_*
+    order_pos: jax.Array     # f32 [N, 2]
+    order_target: jax.Array  # i32 [N] unit index, -1 = none
+    t: jax.Array             # i32 [] env steps taken
+    done: jax.Array          # bool []
+    winner: jax.Array        # i32 [] WINNER_*
+    last_action: jax.Array   # i32 [2, 3] per team (action_type, delay, queued)
+    last_selected: jax.Array  # bool [2, N] units in each team's last selection
+    last_targeted: jax.Array  # bool [2, N] unit each team last targeted
+    dmg_dealt: jax.Array     # f32 [2] cumulative damage by team
+    kills: jax.Array         # f32 [2] cumulative kills by team
+
+
+def team_vector(cfg: EnvConfig) -> jnp.ndarray:
+    """i32 [N]: 0 for home slots, 1 for away slots."""
+    U = cfg.units_per_squad
+    return jnp.concatenate([jnp.zeros(U, jnp.int32), jnp.ones(U, jnp.int32)])
+
+
+def reset(cfg: EnvConfig, scenario: Scenario) -> EnvState:
+    U = cfg.units_per_squad
+    types = jnp.concatenate([scenario.type_home, scenario.type_away])
+    slot = jnp.arange(U)
+    alive = jnp.concatenate([slot < scenario.n_home, slot < scenario.n_away])
+    pos = jnp.concatenate([scenario.pos_home, scenario.pos_away]).astype(jnp.float32)
+    health = jnp.asarray(CATALOG_HEALTH)[types] * alive
+    N = cfg.num_units
+    return EnvState(
+        scenario=scenario,
+        pos=pos,
+        health=health,
+        # masked like health so never-spawned pad slots contribute nothing to
+        # the timeout health-fraction denominator
+        max_health=jnp.asarray(CATALOG_HEALTH)[types] * alive,
+        cooldown=jnp.zeros(N, jnp.float32),
+        alive=alive,
+        order_kind=jnp.zeros(N, jnp.int32),
+        order_pos=pos,
+        order_target=jnp.full(N, -1, jnp.int32),
+        t=jnp.asarray(0, jnp.int32),
+        done=jnp.asarray(False),
+        winner=jnp.asarray(WINNER_NONE, jnp.int32),
+        last_action=jnp.zeros((2, 3), jnp.int32),
+        last_selected=jnp.zeros((2, N), bool),
+        last_targeted=jnp.zeros((2, N), bool),
+        dmg_dealt=jnp.zeros(2, jnp.float32),
+        kills=jnp.zeros(2, jnp.float32),
+    )
+
+
+def unit_types(cfg: EnvConfig, state: EnvState) -> jnp.ndarray:
+    """i32 [N] catalog row per unit slot."""
+    return jnp.concatenate([state.scenario.type_home, state.scenario.type_away])
+
+
+def pack_perm(cfg: EnvConfig, state: EnvState, team) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Entity packing for ``team``'s observation: a permutation placing the
+    team's own alive units first, then alive enemies, then dead slots (the
+    contract wants valid entities in the first entity_num rows), plus the
+    alive count. ``step`` decodes pointer actions through the SAME
+    permutation, so entity slots the model emits land on the right units."""
+    own = team_vector(cfg) == team
+    rank = jnp.where(state.alive & own, 0, jnp.where(state.alive & ~own, 1, 2))
+    perm = jnp.argsort(rank, stable=True)
+    entity_num = state.alive.sum().astype(jnp.int32)
+    return perm, entity_num
+
+
+def _decode_team_action(cfg: EnvConfig, state: EnvState, team,
+                        action: dict, selected_units_num) -> EnvState:
+    """Apply one team's contract-layout action to its units' orders."""
+    N = cfg.num_units
+    at = jnp.asarray(action["action_type"]).reshape(()).astype(jnp.int32)
+    at = jnp.clip(at, 0, ACT.NUM_ACTIONS - 1)
+    sem = jnp.asarray(ACTION_SEMANTIC)[at]
+    has_sel = jnp.asarray(ACT.SELECTED_UNITS_MASK)[at]
+    perm, entity_num = pack_perm(cfg, state, team)
+
+    # selected-units pointer: entity slots -> unit ids, end-token lane (and
+    # any post-end junk) excluded via selected_units_num
+    S = F.MAX_SELECTED_UNITS_NUM
+    su = jnp.asarray(action["selected_units"]).reshape(S).astype(jnp.int32)
+    sun = jnp.asarray(selected_units_num).reshape(()).astype(jnp.int32)
+    lane_ok = (jnp.arange(S) < (sun - 1)) & (su >= 0) & (su < entity_num) & (su < N)
+    sel_unit_ids = perm[jnp.clip(su, 0, N - 1)]
+    sel = jnp.zeros(N, bool).at[sel_unit_ids].max(lane_ok)
+    own = team_vector(cfg) == team
+    sel = sel & own & state.alive
+
+    # target unit: an entity slot in the same packed view
+    tslot = jnp.asarray(action["target_unit"]).reshape(()).astype(jnp.int32)
+    t_ok = (tslot >= 0) & (tslot < entity_num) & (tslot < N)
+    target_id = perm[jnp.clip(tslot, 0, N - 1)]
+
+    # target location: flat index over the (y, x) spatial rectangle
+    loc = jnp.asarray(action["target_location"]).reshape(()).astype(jnp.int32)
+    loc = jnp.clip(loc, 0, MAP_H * MAP_W - 1)
+    tpos = jnp.stack([(loc % MAP_W).astype(jnp.float32),
+                      (loc // MAP_W).astype(jnp.float32)])
+
+    valid = has_sel & (sem != _SEM_NONE) & jnp.where(sem == _SEM_ATTACK_UNIT, t_ok, True)
+    upd = sel & valid
+    new_kind = jnp.asarray(_SEM_TO_KIND)[sem]
+    order_kind = jnp.where(upd, new_kind, state.order_kind)
+    order_pos = jnp.where(upd[:, None], tpos[None, :], state.order_pos)
+    order_target = jnp.where(
+        upd,
+        jnp.where(sem == _SEM_ATTACK_UNIT, target_id, -1),
+        state.order_target,
+    )
+
+    last_action = state.last_action.at[team].set(jnp.stack([
+        at,
+        jnp.asarray(action.get("delay", 0)).reshape(()).astype(jnp.int32),
+        jnp.asarray(action.get("queued", 0)).reshape(()).astype(jnp.int32),
+    ]))
+    targeted = jnp.zeros(N, bool).at[target_id].set(
+        valid & (sem == _SEM_ATTACK_UNIT) & upd.any())
+    return state._replace(
+        order_kind=order_kind,
+        order_pos=order_pos,
+        order_target=order_target,
+        last_action=last_action,
+        last_selected=state.last_selected.at[team].set(sel),
+        last_targeted=state.last_targeted.at[team].set(targeted),
+    )
+
+
+def _scripted_orders(cfg: EnvConfig, state: EnvState, team) -> EnvState:
+    """Built-in opponent: every unit of ``team`` attack-moves at the nearest
+    living enemy (a chase-and-shoot baseline; pure, no PRNG)."""
+    team_of = team_vector(cfg)
+    own = team_of == team
+    enemy_alive = state.alive & ~own
+    d = jnp.linalg.norm(state.pos[:, None, :] - state.pos[None, :, :], axis=-1)
+    d = jnp.where(enemy_alive[None, :], d, jnp.inf)
+    nearest = jnp.argmin(d, axis=1)
+    has_enemy = enemy_alive.any()
+    upd = own & state.alive & has_enemy
+    return state._replace(
+        order_kind=jnp.where(upd, KIND_ATTACK_MOVE, state.order_kind),
+        order_pos=jnp.where(upd[:, None], state.pos[nearest], state.order_pos),
+        order_target=jnp.where(upd, -1, state.order_target),
+    )
+
+
+def step(cfg: EnvConfig, state: EnvState,
+         action_home: dict, selected_units_num_home,
+         action_away: Optional[dict] = None, selected_units_num_away=None):
+    """One simultaneous tick. ``action_away=None`` plays the scripted
+    opponent. Returns ``(state, reward, done, winner)`` where ``reward`` is
+    ``{"battle": f32[2], "winloss": f32[2]}`` (home, away). Once done, the
+    state freezes and further steps are zero-reward no-ops (window padding
+    semantics — the Anakin loop masks them out)."""
+    prev = state
+    prev_done = state.done
+    team_of = team_vector(cfg)
+    U = cfg.units_per_squad
+    N = cfg.num_units
+    types = unit_types(cfg, state)
+
+    state = _decode_team_action(cfg, state, 0, action_home, selected_units_num_home)
+    if action_away is None:
+        state = _scripted_orders(cfg, state, 1)
+    else:
+        state = _decode_team_action(cfg, state, 1, action_away, selected_units_num_away)
+
+    rng_ = jnp.asarray(CATALOG_RANGE)[types] + cfg.hit_slack
+    dmg_ = jnp.asarray(CATALOG_DAMAGE)[types]
+    spd_ = jnp.asarray(CATALOG_SPEED)[types]
+    cd_ = jnp.asarray(CATALOG_COOLDOWN)[types]
+
+    # --- target resolution
+    d = jnp.linalg.norm(state.pos[:, None, :] - state.pos[None, :, :], axis=-1)
+    enemy = team_of[:, None] != team_of[None, :]
+    cand = enemy & state.alive[None, :]
+    d_cand = jnp.where(cand, d, jnp.inf)
+    nearest = jnp.argmin(d_cand, axis=1)
+    nearest_d = jnp.min(d_cand, axis=1)
+    explicit = (state.order_kind == KIND_ATTACK_UNIT)
+    explicit_ok = explicit & (state.order_target >= 0) \
+        & state.alive[jnp.clip(state.order_target, 0, N - 1)]
+    # stop/hold and attack-move auto-acquire in range; plain move does not
+    # shoot. An explicit attack whose designated target is still out of
+    # range ALSO auto-acquires — chasers return fire on the way in instead
+    # of marching mutely through the defending squad.
+    explicit_dist = d[jnp.arange(N), jnp.clip(state.order_target, 0, N - 1)]
+    explicit_near = explicit_ok & (explicit_dist <= rng_)
+    auto = (state.order_kind == KIND_STOP) | (state.order_kind == KIND_ATTACK_MOVE) \
+        | (explicit_ok & ~explicit_near)
+    auto_ok = auto & jnp.isfinite(nearest_d)
+    target = jnp.where(explicit_near, jnp.clip(state.order_target, 0, N - 1),
+                       jnp.where(auto_ok, nearest, -1))
+    t_idx = jnp.clip(target, 0, N - 1)
+    t_dist = d[jnp.arange(N), t_idx]
+    engaged = (target >= 0) & (t_dist <= rng_)
+    shoot = state.alive & engaged & (state.cooldown <= 0.0)
+
+    dmg_in = jnp.zeros(N, jnp.float32).at[t_idx].add(jnp.where(shoot, dmg_, 0.0))
+    cooldown = jnp.where(shoot, cd_, jnp.maximum(state.cooldown - 1.0, 0.0))
+
+    # --- movement (attackers in range hold; everyone else follows orders)
+    chase = explicit_ok & ~engaged
+    dest = jnp.where(
+        chase[:, None], state.pos[t_idx],
+        jnp.where(((state.order_kind == KIND_MOVE)
+                   | (state.order_kind == KIND_ATTACK_MOVE))[:, None],
+                  state.order_pos, state.pos))
+    dvec = dest - state.pos
+    dist = jnp.linalg.norm(dvec, axis=-1)
+    stepv = dvec / jnp.maximum(dist, 1e-6)[:, None] \
+        * jnp.minimum(spd_, dist)[:, None]
+    moving = state.alive & ~engaged & (dist > 1e-3)
+    newpos = state.pos + jnp.where(moving[:, None], stepv, 0.0)
+    newpos = jnp.clip(newpos, 0.5,
+                      jnp.array([MAP_W - 0.5, MAP_H - 0.5], jnp.float32))
+
+    def _passable(p):
+        cx = (p[:, 0] // CELL).astype(jnp.int32)
+        cy = (p[:, 1] // CELL).astype(jnp.int32)
+        return state.scenario.terrain[cy, cx]
+
+    # wall slide: when the full step lands in a blocked cell, fall back to
+    # the x-only then y-only component so units skirt walls instead of
+    # pinning against them (no pathfinding, but unsticks straight-liners)
+    slide_x = jnp.stack([newpos[:, 0], state.pos[:, 1]], axis=-1)
+    slide_y = jnp.stack([state.pos[:, 0], newpos[:, 1]], axis=-1)
+    cand = jnp.where(_passable(newpos)[:, None], newpos,
+                     jnp.where(_passable(slide_x)[:, None], slide_x,
+                               jnp.where(_passable(slide_y)[:, None], slide_y,
+                                         state.pos)))
+    pos = jnp.where(moving[:, None], cand, state.pos)
+
+    # --- health / outcome
+    health = jnp.maximum(state.health - dmg_in, 0.0)
+    alive = state.alive & (health > 0.0)
+    died = state.alive & ~alive
+    dealt = jnp.where(shoot, jnp.minimum(dmg_, state.health[t_idx]), 0.0)
+    dealt_home = (dealt * (team_of == 0)).sum()
+    dealt_away = (dealt * (team_of == 1)).sum()
+    kills_home = (died & (team_of == 1)).sum().astype(jnp.float32)
+    kills_away = (died & (team_of == 0)).sum().astype(jnp.float32)
+
+    t2 = state.t + 1
+    home_alive = alive[:U].any()
+    away_alive = alive[U:].any()
+    timeout = t2 >= state.scenario.episode_len
+    end = (~home_alive) | (~away_alive) | timeout
+    hfrac = health[:U].sum() / jnp.maximum(state.max_health[:U].sum(), 1e-6)
+    afrac = health[U:].sum() / jnp.maximum(state.max_health[U:].sum(), 1e-6)
+    timeout_winner = jnp.where(
+        hfrac > afrac + cfg.timeout_margin, WINNER_HOME,
+        jnp.where(afrac > hfrac + cfg.timeout_margin, WINNER_AWAY, WINNER_DRAW))
+    winner = jnp.where(
+        ~end, WINNER_NONE,
+        jnp.where(home_alive & ~away_alive, WINNER_HOME,
+                  jnp.where(away_alive & ~home_alive, WINNER_AWAY,
+                            jnp.where(~home_alive & ~away_alive, WINNER_DRAW,
+                                      timeout_winner)))).astype(jnp.int32)
+
+    battle_home = (dealt_home - dealt_away) / cfg.damage_norm
+    winloss_home = jnp.where(
+        end & (winner == WINNER_HOME), 1.0,
+        jnp.where(end & (winner == WINNER_AWAY), -1.0, 0.0))
+
+    new_state = state._replace(
+        pos=pos, health=health, cooldown=cooldown, alive=alive,
+        t=t2, done=state.done | end, winner=winner,
+        dmg_dealt=state.dmg_dealt + jnp.stack([dealt_home, dealt_away]),
+        kills=state.kills + jnp.stack([kills_home, kills_away]),
+    )
+    # freeze after done: padded steps replay the terminal state, zero reward
+    new_state = jax.tree.map(
+        lambda old, new: jnp.where(prev_done, old, new), prev, new_state)
+    live = 1.0 - prev_done.astype(jnp.float32)
+    reward = {
+        "battle": jnp.stack([battle_home, -battle_home]) * live,
+        "winloss": jnp.stack([winloss_home, -winloss_home]) * live,
+    }
+    return new_state, reward, new_state.done, new_state.winner

@@ -1,0 +1,182 @@
+"""Host-side BaseEnv adapter over the pure-JAX micro-battle world.
+
+``JaxMicroBattleEnv`` makes jaxenv a drop-in for the existing actor stack
+(``rl_train --env jaxenv`` without ``--anakin``): the reset/step surface,
+per-agent obs dicts, and the auxiliary keys the agent's reward machinery
+reads (``game_loop``, ``action_result``, ``battle_score``) all match
+MockEnv. Internally it jits single-scenario reset/step/observe once and
+converts at the boundary — this is the SLOW path the Anakin loop exists to
+replace, kept for contract parity tests and the bench A/B.
+
+Host-side the int64 contract leaves (``entity_num``) are restored from the
+device int32 (jax runs without x64), so leaf-by-leaf parity with
+``features.fake_step_data`` holds exactly (tests/test_jaxenv.py).
+
+``episode_digest`` is the determinism witness: a sha256 over every
+observation byte, reward, and done flag of a fully scripted episode —
+goldens in tests/data/ catch any drift in scenario generation, dynamics,
+or observation packing.
+"""
+from __future__ import annotations
+
+import hashlib
+from functools import partial
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..env import BaseEnv
+from .core import EnvConfig, WINNER_DRAW, reset, step
+from .obs import observe
+from .scenario import Scenario, ScenarioConfig, ScenarioGenerator
+
+
+def _host_obs(dev_obs: dict) -> dict:
+    """Device obs pytree -> host numpy with the exact contract dtypes."""
+    out = jax.tree.map(np.asarray, dev_obs)
+    out["entity_num"] = np.asarray(int(out["entity_num"]), np.int64)
+    return out
+
+
+class JaxMicroBattleEnv(BaseEnv):
+    """Two-agent BaseEnv over one jaxenv scenario per episode.
+
+    Each ``reset`` draws the next scenario from the generator chain (or
+    replays a fixed ``scenario`` when one is pinned — the determinism and
+    win-rate paths). Team 0 is agent 0 (home).
+    """
+
+    num_agents = 2
+
+    def __init__(self, env_cfg: EnvConfig = EnvConfig(),
+                 scenario_cfg: Optional[ScenarioConfig] = None,
+                 seed: int = 0, scenario: Optional[Scenario] = None):
+        self.cfg = env_cfg
+        self.gen = ScenarioGenerator(
+            scenario_cfg
+            if scenario_cfg is not None
+            else ScenarioConfig(units_per_squad=env_cfg.units_per_squad))
+        self._key = jax.random.PRNGKey(seed)
+        self._pinned = scenario
+        self._state = None
+        self._entity_num = {0: 1, 1: 1}
+        self._jit_reset = jax.jit(partial(reset, env_cfg))
+        self._jit_step = jax.jit(partial(step, env_cfg))
+        self._jit_obs = jax.jit(partial(observe, env_cfg), static_argnums=(1,))
+
+    # --------------------------------------------------------------- BaseEnv
+    def _obs_pair(self) -> Dict[int, dict]:
+        out = {}
+        for team in (0, 1):
+            o = _host_obs(self._jit_obs(self._state, team))
+            o["game_loop"] = int(self._state.t) * self.cfg.loops_per_step
+            o["action_result"] = [1]
+            o["battle_score"] = float(self._state.dmg_dealt[team])
+            o["opponent_battle_score"] = float(self._state.dmg_dealt[1 - team])
+            # the end token of the NEXT action's pointer rows equals this
+            # obs's entity_num; remembered so step() can recover sun
+            self._entity_num[team] = int(o["entity_num"])
+            out[team] = o
+        return out
+
+    def reset(self) -> Dict[int, dict]:
+        if self._pinned is not None:
+            scn = self._pinned
+        else:
+            self._key, k = jax.random.split(self._key)
+            scn = self.gen.generate(k)
+        self._state = self._jit_reset(scn)
+        return self._obs_pair()
+
+    def step(self, actions: Dict[int, dict]) -> Tuple[Dict[int, dict], Dict[int, float], bool, dict]:
+        if self._state is None:
+            raise RuntimeError("step() before reset()")
+
+        def dev_action(a: dict) -> dict:
+            return {k: jnp.asarray(np.asarray(a[k]))
+                    for k in ("action_type", "delay", "queued", "selected_units",
+                              "target_unit", "target_location")}
+
+        def sun_of(a: dict, obs_entity_num: int) -> jnp.ndarray:
+            # host actors don't ship selected_units_num; recover it as the
+            # position of the end token (== entity_num) in the pointer rows
+            if "selected_units_num" in a:
+                return jnp.asarray(int(np.asarray(a["selected_units_num"])))
+            su = np.asarray(a["selected_units"]).reshape(-1)
+            hits = np.flatnonzero(su == obs_entity_num)
+            n = int(hits[0]) + 1 if hits.size else su.shape[0]
+            return jnp.asarray(n, jnp.int32)
+
+        if 0 not in actions:
+            raise ValueError("agent 0 action required (home team)")
+        a0 = dev_action(actions[0])
+        s0 = sun_of(actions[0], self._entity_num[0])
+        kw = {}
+        if 1 in actions:
+            kw["action_away"] = dev_action(actions[1])
+            kw["selected_units_num_away"] = sun_of(actions[1], self._entity_num[1])
+        self._state, rew, done, winner = self._jit_step(self._state, a0, s0, **kw)
+        obs = self._obs_pair()
+        done = bool(done)
+        rewards = {0: float(rew["winloss"][0]), 1: float(rew["winloss"][1])}
+        info: dict = {"game_loop": obs[0]["game_loop"],
+                      "battle_reward": {0: float(rew["battle"][0]),
+                                        1: float(rew["battle"][1])}}
+        if done:
+            w = int(winner)
+            info["winner"] = -1 if w == WINNER_DRAW else w
+        return obs, rewards, done, info
+
+
+def episode_digest(seed: int = 0,
+                   scenario_cfg: Optional[ScenarioConfig] = None,
+                   env_cfg: Optional[EnvConfig] = None,
+                   max_steps: int = 64) -> dict:
+    """Deterministic fingerprint of one fully scripted episode.
+
+    Both teams play the built-in scripted controller (``action_away=None``
+    drives away; home passes no_op so the home scripted path stays
+    exercised via auto-acquire). Returns the per-step digest chain and the
+    final sha256 — bit-identical across fresh processes for the same seed
+    and configs (tests/test_jaxenv.py goldens).
+    """
+    from ...lib import features as F
+
+    env_cfg = env_cfg or EnvConfig(units_per_squad=4)
+    scenario_cfg = scenario_cfg or ScenarioConfig(
+        units_per_squad=env_cfg.units_per_squad,
+        max_units=env_cfg.units_per_squad, episode_len=max_steps)
+    env = JaxMicroBattleEnv(env_cfg, scenario_cfg, seed=seed)
+    obs = env.reset()
+    no_op = {
+        "action_type": np.asarray(0, np.int64),
+        "delay": np.asarray(1, np.int64),
+        "queued": np.asarray(0, np.int64),
+        "selected_units": np.zeros(F.MAX_SELECTED_UNITS_NUM, np.int64),
+        "target_unit": np.asarray(0, np.int64),
+        "target_location": np.asarray(0, np.int64),
+        "selected_units_num": np.asarray(1, np.int64),
+    }
+    h = hashlib.sha256()
+
+    def eat(tree):
+        for leaf in jax.tree.leaves(tree):
+            h.update(np.ascontiguousarray(np.asarray(leaf)).tobytes())
+
+    eat({k: obs[0][k] for k in ("spatial_info", "scalar_info", "entity_info",
+                                "entity_num")})
+    steps = 0
+    winner = None
+    for _ in range(max_steps):
+        obs, rewards, done, info = env.step({0: no_op})
+        steps += 1
+        eat({k: obs[0][k] for k in ("spatial_info", "scalar_info",
+                                    "entity_info", "entity_num")})
+        eat(np.asarray([rewards[0], rewards[1]], np.float64))
+        h.update(b"\x01" if done else b"\x00")
+        if done:
+            winner = info.get("winner")
+            break
+    return {"sha256": h.hexdigest(), "steps": steps, "winner": winner}

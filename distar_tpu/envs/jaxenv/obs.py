@@ -1,0 +1,146 @@
+"""Features-contract observation builder: EnvState -> the flagship obs pytree.
+
+``observe(cfg, state, team)`` emits exactly the schema in
+``lib.features`` — SPATIAL_INFO planes (effect_* as coordinate lists),
+SCALAR_INFO fields, ENTITY_INFO vectors padded to MAX_ENTITY_NUM — with the
+contract dtypes, built entirely from jnp ops so it lives inside the Anakin
+``lax.scan``. One documented divergence: on device, int64 contract leaves
+(``entity_num``) are int32 because jax runs without x64; the host adapter
+(``host.JaxMicroBattleEnv``) casts them back so host-side parity is
+leaf-by-leaf exact (tests/test_jaxenv.py).
+
+Entity packing (own alive units first, then enemies) comes from
+``core.pack_perm`` — the same permutation ``core.step`` uses to decode
+pointer actions, so the model's entity slots always refer to these rows.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...lib import actions as ACT
+from ...lib import features as F
+from .core import EnvConfig, EnvState, pack_perm, team_vector, unit_types
+from .scenario import (
+    CATALOG_COOLDOWN,
+    CATALOG_DENSE_TYPES,
+    CELL,
+    MAP_H,
+    MAP_W,
+)
+
+# SC2 player_relative plane codes
+_PR_SELF, _PR_ENEMY = 1, 4
+_RACE_ZERG = 2
+
+
+def _pad_entities(vals, slot_mask, dtype):
+    """[N] per-packed-slot values -> [MAX_ENTITY_NUM] contract vector."""
+    vals = jnp.where(slot_mask, vals, 0)
+    out = jnp.zeros(F.MAX_ENTITY_NUM, dtype)
+    return out.at[: vals.shape[0]].set(vals.astype(dtype))
+
+
+def observe(cfg: EnvConfig, state: EnvState, team: int = 0) -> dict:
+    """One team's schema-complete observation (no batch dim, device arrays)."""
+    N = cfg.num_units
+    team_of = team_vector(cfg)
+    own = team_of == team
+    perm, entity_num = pack_perm(cfg, state, team)
+    slot_mask = jnp.arange(N) < entity_num
+
+    types = unit_types(cfg, state)
+    dense = jnp.asarray(CATALOG_DENSE_TYPES)[types]
+    px = jnp.clip(jnp.round(state.pos[:, 0]), 0, MAP_W - 1)
+    py = jnp.clip(jnp.round(state.pos[:, 1]), 0, MAP_H - 1)
+
+    def packed(unit_vals):
+        return jnp.asarray(unit_vals)[perm]
+
+    entity_info = {k: jnp.zeros(F.MAX_ENTITY_NUM, dt) for k, dt in F.ENTITY_INFO.items()}
+    entity_info.update(
+        unit_type=_pad_entities(packed(dense), slot_mask, np.int16),
+        alliance=_pad_entities(
+            packed(jnp.where(own, _PR_SELF, _PR_ENEMY)), slot_mask, np.uint8),
+        x=_pad_entities(packed(px), slot_mask, np.uint8),
+        y=_pad_entities(packed(py), slot_mask, np.uint8),
+        health_ratio=_pad_entities(
+            packed(state.health / jnp.maximum(state.max_health, 1e-6)),
+            slot_mask, np.float16),
+        build_progress=_pad_entities(
+            packed(jnp.ones(N, jnp.float32)), slot_mask, np.float16),
+        display_type=_pad_entities(packed(jnp.ones(N, jnp.int32)), slot_mask, np.uint8),
+        weapon_cooldown=_pad_entities(
+            packed(jnp.clip(jnp.ceil(state.cooldown), 0, 255)), slot_mask, np.uint8),
+        is_active=_pad_entities(
+            packed((state.order_kind != 0).astype(jnp.int32)), slot_mask, np.uint8),
+        order_length=_pad_entities(
+            packed((state.order_kind != 0).astype(jnp.int32)), slot_mask, np.uint8),
+        last_selected_units=_pad_entities(
+            packed(state.last_selected[team].astype(jnp.int32)), slot_mask, np.int8),
+        last_targeted_unit=_pad_entities(
+            packed(state.last_targeted[team].astype(jnp.int32)), slot_mask, np.int8),
+    )
+
+    # --- spatial planes
+    terrain8 = jnp.repeat(jnp.repeat(state.scenario.terrain, CELL, axis=0),
+                          CELL, axis=1).astype(np.uint8)
+    iy = py.astype(jnp.int32)
+    ix = px.astype(jnp.int32)
+    pr_val = jnp.where(state.alive, jnp.where(own, _PR_SELF, _PR_ENEMY), 0)
+    player_relative = jnp.zeros(F.SPATIAL_SIZE, np.uint8).at[iy, ix].max(
+        pr_val.astype(np.uint8))
+    spatial_info = {
+        "height_map": terrain8 * np.uint8(64),
+        "visibility_map": jnp.full(F.SPATIAL_SIZE, 2, np.uint8),
+        "creep": jnp.zeros(F.SPATIAL_SIZE, np.uint8),
+        "player_relative": player_relative,
+        "alerts": jnp.zeros(F.SPATIAL_SIZE, np.uint8),
+        "pathable": terrain8,
+        "buildable": terrain8,
+    }
+    for k, dt in F.SPATIAL_INFO.items():
+        if k.startswith("effect_"):
+            spatial_info[k] = jnp.zeros((F.EFFECT_LENGTH,), dt)
+
+    # --- scalar stats
+    own_alive = (state.alive & own).sum()
+    enemy_alive = (state.alive & ~own).sum()
+    own_counts = jnp.zeros(ACT.NUM_UNIT_TYPES, jnp.int32).at[dense].add(
+        (state.alive & own).astype(jnp.int32))
+    enemy_counts = jnp.zeros(ACT.NUM_UNIT_TYPES, jnp.int32).at[dense].add(
+        (state.alive & ~own).astype(jnp.int32))
+    stats = jnp.stack([
+        own_alive.astype(jnp.float32),
+        (state.health * own).sum(),
+        enemy_alive.astype(jnp.float32),
+        state.dmg_dealt[team],
+        state.dmg_dealt[1 - team],
+        state.kills[team],
+        state.kills[1 - team],
+        state.t.astype(jnp.float32),
+        (state.max_health * own).sum(),
+        (state.max_health * ~own).sum(),
+    ])
+    scalar_info = {k: jnp.zeros(shape, dt) for k, (dt, shape) in F.SCALAR_INFO.items()}
+    scalar_info.update(
+        home_race=jnp.asarray(_RACE_ZERG, np.uint8),
+        away_race=jnp.asarray(_RACE_ZERG, np.uint8),
+        time=(state.t * cfg.loops_per_step).astype(np.float32),
+        unit_counts_bow=jnp.clip(own_counts, 0, 255).astype(np.uint8),
+        agent_statistics=jnp.log1p(jnp.maximum(stats, 0.0)).astype(np.float32),
+        last_action_type=state.last_action[team, 0].astype(np.int16),
+        last_delay=state.last_action[team, 1].astype(np.int16),
+        last_queued=state.last_action[team, 2].astype(np.int16),
+        unit_type_bool=(own_counts > 0).astype(np.uint8),
+        enemy_unit_type_bool=(enemy_counts > 0).astype(np.uint8),
+    )
+
+    return {
+        "spatial_info": spatial_info,
+        "scalar_info": scalar_info,
+        "entity_info": entity_info,
+        # int32 on device (jax runs without x64); the host adapter casts to
+        # the contract's int64
+        "entity_num": jnp.maximum(entity_num, 1).astype(jnp.int32),
+    }

@@ -1,0 +1,154 @@
+"""PRNG-keyed scenario parameter structs + the procedural generator.
+
+A Scenario is everything that varies between micro-battle episodes — unit
+composition, squad sizes, terrain mask, spawn geometry — as a pytree of
+fixed-shape device arrays, so a batch of scenarios is just
+``jax.vmap(generator.generate)(keys)`` and procedural curriculum is a pure
+function of (key, config). The league's payoff matrix gets its scenario
+lever through the key alone: same key + same config => the same battle,
+bit for bit (tests/test_jaxenv.py goldens).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...lib import actions as ACT
+from ...lib import features as F
+
+# Spatial geometry: the world IS the contract's spatial rectangle. Positions
+# are float (x, y) with x in [0, 160), y in [0, 152); the terrain occupancy
+# grid is one cell per CELL*CELL pixel block, sized so it upsamples exactly
+# to SPATIAL_SIZE for the pathable/buildable planes.
+MAP_H, MAP_W = F.SPATIAL_SIZE  # (y=152, x=160)
+CELL = 8
+GRID_H, GRID_W = MAP_H // CELL, MAP_W // CELL  # (19, 20)
+
+# Unit catalog: a handful of real SC2 unit types (raw game ids that exist in
+# the action contract's 260-type vocabulary) with micro-battle combat stats.
+# Columns are parallel arrays so a catalog row gathers in-jit.
+CATALOG_RAW_TYPES = np.array([48, 105, 110, 74], dtype=np.int64)  # marine, zergling, roach, stalker
+CATALOG_DENSE_TYPES = ACT.UNIT_TYPES_REORDER_ARRAY[CATALOG_RAW_TYPES]
+assert (CATALOG_DENSE_TYPES >= 0).all(), "catalog unit ids must be in the contract vocabulary"
+CATALOG_HEALTH = np.array([45.0, 35.0, 145.0, 160.0], dtype=np.float32)
+CATALOG_DAMAGE = np.array([6.0, 5.0, 16.0, 13.0], dtype=np.float32)
+CATALOG_RANGE = np.array([12.0, 3.0, 10.0, 14.0], dtype=np.float32)  # px
+CATALOG_SPEED = np.array([2.0, 3.0, 1.5, 2.0], dtype=np.float32)     # px/step
+CATALOG_COOLDOWN = np.array([2.0, 1.0, 3.0, 3.0], dtype=np.float32)  # steps between shots
+NUM_CATALOG_TYPES = len(CATALOG_RAW_TYPES)
+
+
+class Scenario(NamedTuple):
+    """One episode's parameters (a vmap-able pytree of device arrays)."""
+
+    key: jax.Array          # the generating PRNG key (provenance + folds)
+    n_home: jax.Array       # int32 [] live home units (<= units_per_squad)
+    n_away: jax.Array       # int32 []
+    type_home: jax.Array    # int32 [U] catalog row per slot
+    type_away: jax.Array    # int32 [U]
+    pos_home: jax.Array     # float32 [U, 2] spawn (x, y)
+    pos_away: jax.Array     # float32 [U, 2]
+    terrain: jax.Array      # bool [GRID_H, GRID_W], True = passable
+    episode_len: jax.Array  # int32 [] env steps until timeout
+
+
+@dataclass(frozen=True)
+class ScenarioConfig:
+    """Static knobs of the procedural distribution (hashable: jit-static)."""
+
+    units_per_squad: int = 8      # U: the padded squad width
+    min_units: int = 2
+    max_units: int = 8            # inclusive; clamped to units_per_squad
+    episode_len: int = 64
+    blocked_frac: float = 0.12    # fraction of terrain cells impassable
+    spawn_spread: float = 12.0    # px of per-unit jitter around the spawn center
+    spawn_margin: float = 20.0    # px the spawn centers keep from map edges
+    mirror_spawns: bool = True    # away spawn = point mirror of home spawn
+    mirror_types: bool = False    # away squad = home's composition + size
+    #   (composition-fair episodes: win-rate A/Bs measure the POLICY, not
+    #   the catalog matchup lottery)
+
+    def __post_init__(self):
+        if not (1 <= self.min_units <= self.max_units <= self.units_per_squad):
+            raise ValueError(
+                f"need 1 <= min_units <= max_units <= units_per_squad, got "
+                f"{self.min_units}/{self.max_units}/{self.units_per_squad}")
+
+
+class ScenarioGenerator:
+    """key -> Scenario, pure and jit/vmap-compatible.
+
+    ``generate`` draws squad sizes, catalog compositions, a blob terrain
+    mask, and mirrored spawn clusters; ``batch`` is the vmapped convenience
+    used by the Anakin loop and the win-rate evaluator.
+    """
+
+    def __init__(self, cfg: ScenarioConfig = ScenarioConfig()):
+        self.cfg = cfg
+
+    def generate(self, key: jax.Array) -> Scenario:
+        cfg = self.cfg
+        U = cfg.units_per_squad
+        k_nh, k_na, k_th, k_ta, k_terrain, k_center, k_jh, k_ja = jax.random.split(key, 8)
+        n_home = jax.random.randint(k_nh, (), cfg.min_units, cfg.max_units + 1, jnp.int32)
+        n_away = jax.random.randint(k_na, (), cfg.min_units, cfg.max_units + 1, jnp.int32)
+        type_home = jax.random.randint(k_th, (U,), 0, NUM_CATALOG_TYPES, jnp.int32)
+        if cfg.mirror_types:
+            n_away = n_home
+            type_away = type_home
+        else:
+            type_away = jax.random.randint(k_ta, (U,), 0, NUM_CATALOG_TYPES, jnp.int32)
+
+        # spawn geometry: home center in the left band, away mirrored (or
+        # independently drawn in the right band)
+        m = cfg.spawn_margin
+        cx = jax.random.uniform(k_center, (), minval=m, maxval=MAP_W / 3.0)
+        cy = jax.random.uniform(
+            jax.random.fold_in(k_center, 1), (), minval=m, maxval=MAP_H - m)
+        home_center = jnp.stack([cx, cy])
+        if cfg.mirror_spawns:
+            away_center = jnp.stack([MAP_W - cx, MAP_H - cy])
+        else:
+            ax = jax.random.uniform(
+                jax.random.fold_in(k_center, 2), (),
+                minval=2.0 * MAP_W / 3.0, maxval=MAP_W - m)
+            ay = jax.random.uniform(
+                jax.random.fold_in(k_center, 3), (), minval=m, maxval=MAP_H - m)
+            away_center = jnp.stack([ax, ay])
+        s = cfg.spawn_spread
+        pos_home = home_center[None] + jax.random.uniform(k_jh, (U, 2), minval=-s, maxval=s)
+        pos_away = away_center[None] + jax.random.uniform(k_ja, (U, 2), minval=-s, maxval=s)
+        bound = jnp.array([MAP_W - 1.0, MAP_H - 1.0])
+        pos_home = jnp.clip(pos_home, 1.0, bound)
+        pos_away = jnp.clip(pos_away, 1.0, bound)
+
+        # blob terrain: iid blocked cells, then guaranteed-passable discs
+        # around both spawn clusters so no unit starts inside a wall
+        passable = jax.random.uniform(k_terrain, (GRID_H, GRID_W)) >= cfg.blocked_frac
+        gy, gx = jnp.mgrid[0:GRID_H, 0:GRID_W]
+        cell_center = jnp.stack(  # (x, y) of each cell center, in px
+            [gx * CELL + CELL / 2.0, gy * CELL + CELL / 2.0], axis=-1)
+        carve_r = cfg.spawn_spread + 1.5 * CELL
+        for center in (home_center, away_center):
+            d = jnp.linalg.norm(cell_center - center[None, None], axis=-1)
+            passable = passable | (d <= carve_r)
+
+        return Scenario(
+            key=key,
+            n_home=n_home.astype(jnp.int32),
+            n_away=n_away.astype(jnp.int32),
+            type_home=type_home,
+            type_away=type_away,
+            pos_home=pos_home.astype(jnp.float32),
+            pos_away=pos_away.astype(jnp.float32),
+            terrain=passable,
+            episode_len=jnp.asarray(cfg.episode_len, jnp.int32),
+        )
+
+    def batch(self, key: jax.Array, n: int) -> Scenario:
+        """[n] stacked scenarios from n folds of ``key``."""
+        return jax.vmap(self.generate)(jax.random.split(key, n))

@@ -1,0 +1,192 @@
+"""Head-to-head win-rate evaluation over fixed PRNG-keyed scenario sets.
+
+The SC2-blocked remainder of PR 15: ``FleetRollout.compare()``'s win-rate
+leg gets real episodes here. ``head_to_head`` runs policy A (home) vs
+policy B (away) across a batch of scenarios — one jitted ``lax.scan`` to
+the timeout, lanes freeze at their terminal step — and reduces final
+winner codes to a win-rate summary. The scenario set is a pure function of
+the key set, so a student/teacher A/B is reproducible bit-for-bit and both
+orderings can be averaged to cancel the home/away asymmetry.
+
+Policies are ``(obs_batch, carry, key) -> (action_info, selected_units_num,
+carry)`` with an ``init_carry(batch)`` hook; ``model_policy`` wraps the
+flagship ``sample_action`` (LSTM carry threaded), and the scripted
+``attack_nearest_policy``/``idle_policy`` are the mock engines the tier-1
+compare() test uses.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ...lib import features as F
+from ...obs import get_registry
+from .core import (
+    EnvConfig,
+    WINNER_AWAY,
+    WINNER_HOME,
+    micro_legal_mask,
+    reset,
+    step,
+)
+from .obs import observe
+from .scenario import ScenarioConfig, ScenarioGenerator
+
+ATTACK_UNIT = 3  # contract action_type: Attack_unit
+
+
+class ScriptedPolicy:
+    """Stateless policy from a pure fn(obs_batch, key) -> (action, sun)."""
+
+    def __init__(self, fn: Callable):
+        self._fn = fn
+
+    def init_carry(self, batch: int):
+        return None
+
+    def __call__(self, obs, carry, key):
+        action, sun = self._fn(obs, key)
+        return action, sun, carry
+
+
+def _attack_nearest(obs, key):
+    """Select every own unit, focus-fire the enemy slot nearest the squad
+    centroid (packed obs puts own alive units first, enemies after —
+    core.pack_perm; entity x/y are the rounded px positions)."""
+    alliance = obs["entity_info"]["alliance"]          # [B, 512]
+    B = alliance.shape[0]
+    S = F.MAX_SELECTED_UNITS_NUM
+    entity_num = obs["entity_num"].astype(jnp.int32)   # [B]
+    slot_ok = jnp.arange(F.MAX_ENTITY_NUM)[None] < entity_num[:, None]
+    own = (alliance == 1) & slot_ok
+    enemy = (alliance == 4) & slot_ok
+    n_own = own.sum(axis=1).astype(jnp.int32)          # [B]
+    lane = jnp.arange(S)[None]                          # [1, S]
+    su = jnp.where(lane < n_own[:, None], lane,
+                   jnp.where(lane == n_own[:, None], entity_num[:, None], 0))
+    sun = jnp.minimum(n_own + 1, S)
+    ex = obs["entity_info"]["x"].astype(jnp.float32)   # [B, 512]
+    ey = obs["entity_info"]["y"].astype(jnp.float32)
+    cx = jnp.sum(jnp.where(own, ex, 0.0), axis=1) / jnp.maximum(n_own, 1)
+    cy = jnp.sum(jnp.where(own, ey, 0.0), axis=1) / jnp.maximum(n_own, 1)
+    d2 = (ex - cx[:, None]) ** 2 + (ey - cy[:, None]) ** 2
+    target = jnp.argmin(jnp.where(enemy, d2, jnp.inf), axis=1).astype(jnp.int32)
+    # argmin over an all-inf row returns 0; fall back to the first enemy slot
+    target = jnp.where(enemy.any(axis=1), target,
+                       jnp.minimum(n_own, F.MAX_ENTITY_NUM - 1))
+    action = {
+        "action_type": jnp.full((B,), ATTACK_UNIT, jnp.int32),
+        "delay": jnp.ones((B,), jnp.int32),
+        "queued": jnp.zeros((B,), jnp.int32),
+        "selected_units": su.astype(jnp.int32),
+        "target_unit": target,
+        "target_location": jnp.zeros((B,), jnp.int32),
+    }
+    return action, sun
+
+
+def _idle(obs, key):
+    B = obs["entity_num"].shape[0]
+    action = {
+        "action_type": jnp.zeros((B,), jnp.int32),
+        "delay": jnp.ones((B,), jnp.int32),
+        "queued": jnp.zeros((B,), jnp.int32),
+        "selected_units": jnp.zeros((B, F.MAX_SELECTED_UNITS_NUM), jnp.int32),
+        "target_unit": jnp.zeros((B,), jnp.int32),
+        "target_location": jnp.zeros((B,), jnp.int32),
+    }
+    return action, jnp.ones((B,), jnp.int32)
+
+
+def attack_nearest_policy() -> ScriptedPolicy:
+    return ScriptedPolicy(_attack_nearest)
+
+
+def idle_policy() -> ScriptedPolicy:
+    return ScriptedPolicy(_idle)
+
+
+class ModelPolicy:
+    """sample_action-driven policy with its own LSTM carry."""
+
+    def __init__(self, model, params, restrict_micro: bool = True):
+        self.model = model
+        self.params = params
+        lstm = model.cfg["encoder"]["core_lstm"]
+        self._hidden_size = int(lstm["hidden_size"])
+        self._hidden_layers = int(lstm["num_layers"])
+        self._legal = jnp.asarray(micro_legal_mask()) if restrict_micro else None
+
+    def init_carry(self, batch: int):
+        z = jnp.zeros((batch, self._hidden_size), jnp.float32)
+        return tuple((z, z) for _ in range(self._hidden_layers))
+
+    def __call__(self, obs, carry, key):
+        out = self.model.apply(
+            self.params, obs["spatial_info"], obs["entity_info"],
+            obs["scalar_info"], obs["entity_num"], carry, key, self._legal,
+            method=self.model.sample_action)
+        return out["action_info"], out["selected_units_num"], out["hidden_state"]
+
+
+def model_policy(model, params, restrict_micro: bool = True) -> ModelPolicy:
+    return ModelPolicy(model, params, restrict_micro=restrict_micro)
+
+
+def head_to_head(policy_a, policy_b,
+                 episodes: int = 16, seed: int = 0,
+                 keys: Optional[jax.Array] = None,
+                 env_cfg: EnvConfig = EnvConfig(),
+                 scenario_cfg: Optional[ScenarioConfig] = None) -> dict:
+    """Policy A (home) vs policy B (away) over a fixed scenario set.
+
+    Returns ``{win_rate, wins, losses, draws, episodes}`` where ``win_rate``
+    counts a draw as half a win for A. ``keys`` pins the exact scenario set
+    (e.g. the league's fixed eval suite); otherwise ``episodes`` scenarios
+    are derived from ``seed``.
+    """
+    scenario_cfg = (scenario_cfg if scenario_cfg is not None
+                    else ScenarioConfig(units_per_squad=env_cfg.units_per_squad))
+    if scenario_cfg.units_per_squad != env_cfg.units_per_squad:
+        raise ValueError("scenario_cfg.units_per_squad must match env_cfg")
+    gen = ScenarioGenerator(scenario_cfg)
+    if keys is None:
+        keys = jax.random.split(jax.random.PRNGKey(seed), episodes)
+    B = keys.shape[0]
+    T = int(scenario_cfg.episode_len)
+
+    observe_b = jax.vmap(partial(observe, env_cfg), in_axes=(0, None))
+    step_b = jax.vmap(partial(step, env_cfg))
+
+    def run(keys):
+        states = jax.vmap(partial(reset, env_cfg))(jax.vmap(gen.generate)(keys))
+        ca = policy_a.init_carry(B)
+        cb = policy_b.init_carry(B)
+
+        def body(c, k):
+            states, ca, cb = c
+            ka, kb = jax.random.split(k)
+            act_a, sun_a, ca = policy_a(observe_b(states, 0), ca, ka)
+            act_b, sun_b, cb = policy_b(observe_b(states, 1), cb, kb)
+            states, _, _, _ = step_b(states, act_a, sun_a, act_b, sun_b)
+            return (states, ca, cb), None
+
+        (states, _, _), _ = jax.lax.scan(
+            body, (states, ca, cb),
+            jax.random.split(jax.random.fold_in(keys[0], 0x5eed), T))
+        return states.winner
+
+    winner = jax.jit(run)(keys)
+    wins = int((winner == WINNER_HOME).sum())
+    losses = int((winner == WINNER_AWAY).sum())
+    draws = B - wins - losses
+    win_rate = (wins + 0.5 * draws) / max(B, 1)
+    get_registry().gauge(
+        "distar_env_head2head_win_rate",
+        "home-side win rate of the last jaxenv head-to-head evaluation",
+    ).set(win_rate)
+    return {"win_rate": win_rate, "wins": wins, "losses": losses,
+            "draws": draws, "episodes": B}

@@ -241,7 +241,10 @@ class FleetRollout:
                 max_divergence: Optional[float] = None,
                 min_fps_ratio: float = 0.9,
                 shed_slack: float = 0.01,
-                latency_ratio: float = 1.5) -> dict:
+                latency_ratio: float = 1.5,
+                win_rate: Optional[dict] = None,
+                win_rate_fn: Optional[Callable[[], dict]] = None,
+                min_win_rate: Optional[float] = None) -> dict:
         """Canary vs stable, from each gateway's own request accounting:
         cumulative outcome counters, shed rate and latency tails per pool,
         plus the two distillation-tier axes — **frames/s-per-slot** (ok
@@ -250,6 +253,15 @@ class FleetRollout:
         previous ``compare()`` snapshot to diff the lifetime counters
         against) and **divergence-vs-teacher** (``divergence=`` explicit,
         else the freshest ``distar_distill_kl`` from the coordinator TSDB).
+
+        The third distillation axis is **win_rate**: head-to-head episodes
+        of the canary (home) vs the stable policy over a fixed PRNG-keyed
+        jaxenv scenario set (``envs.jaxenv.head_to_head``). Pass a
+        ready-made summary via ``win_rate=`` or a zero-arg callable via
+        ``win_rate_fn=`` (evaluated here, so the episode cost lands inside
+        the compare step that reports it); ``min_win_rate`` turns the
+        column into a gate — a canary that loses the head-to-head cannot
+        promote.
 
         The returned ``verdict`` block is the promote/abort evidence the
         gated :meth:`promote` consumes: ``promote`` is True only when every
@@ -290,6 +302,10 @@ class FleetRollout:
             divergence = self._fetch_divergence()
         if divergence is not None:
             out["divergence"] = divergence
+        if win_rate is None and win_rate_fn is not None:
+            win_rate = win_rate_fn()
+        if win_rate is not None:
+            out["win_rate"] = dict(win_rate)
 
         reasons = []
         canary, stable = pools["canary"], pools["stable"]
@@ -315,6 +331,18 @@ class FleetRollout:
             reasons.append(
                 f"divergence vs teacher {divergence:.4f} > "
                 f"max_divergence {max_divergence}")
+        if min_win_rate is not None:
+            wr = (win_rate or {}).get("win_rate")
+            if wr is None:
+                reasons.append(
+                    f"win_rate gate requested (min {min_win_rate}) but no "
+                    "head-to-head result supplied")
+            elif wr < min_win_rate:
+                reasons.append(
+                    f"canary win_rate {wr:.3f} < min_win_rate {min_win_rate} "
+                    f"({win_rate.get('wins')}W/{win_rate.get('losses')}L/"
+                    f"{win_rate.get('draws')}D over "
+                    f"{win_rate.get('episodes')} episodes)")
         out["verdict"] = {"promote": not reasons, "reasons": reasons}
         return out
 

@@ -1,0 +1,126 @@
+"""Anakin fused rollout loop (envs/jaxenv/anakin.py): batch contract parity
+with the learner's collate layout, device purity of the fused program, the
+window metrics, and a tier-1 SMALL_MODEL training smoke on a vmap'd
+scenario batch.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from conftest import SMALL_MODEL  # shared tiny model config
+
+from distar_tpu.envs.jaxenv import (
+    AnakinDataLoader,
+    AnakinRunner,
+    EnvConfig,
+    ScenarioConfig,
+)
+from distar_tpu.learner.data import fake_rl_batch
+from distar_tpu.obs import get_registry
+
+TINY_B, TINY_T = 2, 3
+TINY_ENV = EnvConfig(units_per_squad=2)
+TINY_SCN = ScenarioConfig(units_per_squad=2, min_units=1, max_units=2,
+                          episode_len=8, spawn_margin=30.0, spawn_spread=6.0)
+
+
+@pytest.fixture(scope="module")
+def learner(tmp_path_factory):
+    from distar_tpu.learner import RLLearner
+
+    tmp = tmp_path_factory.mktemp("anakin_rl")
+    cfg = {
+        "common": {"experiment_name": "anakin_t", "save_path": str(tmp)},
+        "learner": {
+            "batch_size": TINY_B,
+            "unroll_len": TINY_T,
+            "save_freq": 100000,
+            "log_freq": 1,
+        },
+        "model": SMALL_MODEL,
+    }
+    return RLLearner(cfg)
+
+
+@pytest.fixture(scope="module")
+def runner(learner):
+    return AnakinRunner(learner.model, batch_size=TINY_B, unroll_len=TINY_T,
+                        env_cfg=TINY_ENV, scenario_cfg=TINY_SCN, seed=0)
+
+
+@pytest.fixture(scope="module")
+def loader(learner, runner):
+    return AnakinDataLoader(
+        runner, params_provider=lambda: learner._state["params"])
+
+
+@pytest.fixture(scope="module")
+def batch(loader):
+    return next(loader)
+
+
+def _shapes(tree):
+    return jax.tree.map(lambda x: tuple(np.shape(x)), tree)
+
+
+def test_batch_layout_matches_collate_contract(batch):
+    """Leaf-by-leaf structural parity with fake_rl_batch — the same layout
+    collate_trajectories hands the learner, so RLLearner trains on fused
+    batches with zero adapter code."""
+    lstm = SMALL_MODEL["encoder"]["core_lstm"]
+    fake = fake_rl_batch(TINY_B, TINY_T, hidden_size=lstm["hidden_size"],
+                         hidden_layers=lstm["num_layers"])
+    fake_shapes = _shapes(fake)
+    got_shapes = _shapes(batch)
+    assert jax.tree.structure(got_shapes) == jax.tree.structure(fake_shapes)
+    flat_got = jax.tree_util.tree_flatten_with_path(got_shapes)[0]
+    flat_fake = jax.tree.leaves(fake_shapes)
+    bad = [(jax.tree_util.keystr(p), g, f)
+           for (p, g), f in zip(flat_got, flat_fake) if g != f]
+    assert not bad, f"shape mismatches vs collate contract: {bad[:8]}"
+    # every leaf already lives on device — the learner's shard_batch
+    # (jnp.asarray) must not trigger a host round-trip
+    assert all(isinstance(x, jax.Array) for x in jax.tree.leaves(batch))
+    # time-major windows: done/step are [T, B], obs leaves [T+1, B, ...]
+    assert batch["done"].shape == (TINY_T, TINY_B)
+    assert batch["entity_num"].shape == (TINY_T + 1, TINY_B)
+
+
+def test_fused_rollout_is_device_pure(runner, loader):
+    """Acceptance witness: the jitted scan contains no callback / infeed /
+    outfeed / host primitives anywhere in its jaxpr (recursively), and a
+    transfer guard sees no host transfer during a whole fused window."""
+    report = runner.purity_report(loader._params(), runner.init_carry())
+    assert report["pure"] is True, report
+    assert report["offending"] == []
+    # steady state: carry built and first window compiled outside the guard
+    # (compile-time constant uploads are one-off), then a whole fused window
+    # must execute with the guard up — no per-step host traffic
+    params = loader._params()
+    carry, _ = runner.rollout(params, runner.init_carry())
+    with jax.transfer_guard("disallow"):
+        carry, out = runner.rollout(params, carry)
+    assert out["done"].shape == (TINY_T, TINY_B)
+
+
+def test_window_metrics_and_progression(loader, batch):
+    snap = get_registry().snapshot()
+    assert snap["distar_rollout_plane_backend{backend=anakin}"] == 1.0
+    assert snap["distar_anakin_batches_total"] >= 1.0
+    assert snap["distar_anakin_env_steps_per_s"] > 0.0
+    assert snap["distar_anakin_window_seconds_count"] >= 1.0
+    # the next window continues the same lanes: env step counters advance
+    batch2 = next(loader)
+    assert float(batch2["step"].min()) > float(batch["step"].min()) or (
+        float(batch2["done"].sum()) > 0.0)
+
+
+def test_small_model_trains_on_fused_batches(learner, loader):
+    """Satellite 3 tier-1 smoke: SMALL_MODEL runs a real optimizer step on a
+    vmap'd-scenario Anakin batch (self-teacher => KL leg is exactly 0)."""
+    learner.set_dataloader(iter(loader))
+    learner.run(max_iterations=1)
+    assert learner.last_iter.val >= 1
+    total = learner.variable_record.get("total_loss").avg
+    assert np.isfinite(total)

@@ -1,0 +1,327 @@
+"""jaxenv: the pure-JAX micro-battle world (ISSUE 17 tentpole).
+
+Covers the Features contract parity (leaf-by-leaf against the mock-env /
+fake_step_data schema), the determinism golden (committed fingerprint from
+a fresh process — any drift in scenario generation, dynamics, or
+observation packing flips the sha), env dynamics (combat resolves, states
+freeze after done), the scripted-policy win-rate evaluator, and the
+``FleetRollout.compare()`` win-rate verdict fed by real jaxenv episodes.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distar_tpu.envs.jaxenv import (
+    EnvConfig,
+    JaxMicroBattleEnv,
+    ScenarioConfig,
+    ScenarioGenerator,
+    attack_nearest_policy,
+    episode_digest,
+    head_to_head,
+    idle_policy,
+    micro_legal_mask,
+    observe,
+    reset,
+    step,
+)
+from distar_tpu.lib import actions as ACT
+from distar_tpu.lib import features as F
+
+DATA = os.path.join(os.path.dirname(__file__), "data")
+
+TINY_ENV = EnvConfig(units_per_squad=2)
+TINY_SCN = ScenarioConfig(units_per_squad=2, min_units=1, max_units=2,
+                          episode_len=24, spawn_margin=30.0, spawn_spread=6.0)
+
+
+def _no_op(batch=None):
+    shape = () if batch is None else (batch,)
+    return {
+        "action_type": jnp.zeros(shape, jnp.int32),
+        "delay": jnp.ones(shape, jnp.int32),
+        "queued": jnp.zeros(shape, jnp.int32),
+        "selected_units": jnp.zeros(shape + (F.MAX_SELECTED_UNITS_NUM,), jnp.int32),
+        "target_unit": jnp.zeros(shape, jnp.int32),
+        "target_location": jnp.zeros(shape, jnp.int32),
+    }
+
+
+# ------------------------------------------------------------------ contract
+def test_host_observation_contract_parity_leaf_by_leaf():
+    """The host adapter's obs match the mock-env/fake_step_data contract
+    exactly: same keys, shapes, AND dtypes (including int64 entity_num)."""
+    env = JaxMicroBattleEnv(TINY_ENV, TINY_SCN, seed=1)
+    obs = env.reset()
+    ref = F.fake_step_data(train=False, rng=np.random.default_rng(0))
+    for agent in (0, 1):
+        o = obs[agent]
+        for section in ("spatial_info", "scalar_info", "entity_info"):
+            assert sorted(o[section]) == sorted(ref[section])
+            for k, rv in ref[section].items():
+                v = o[section][k]
+                assert v.shape == rv.shape, (section, k, v.shape, rv.shape)
+                assert v.dtype == rv.dtype, (section, k, v.dtype, rv.dtype)
+        assert o["entity_num"].dtype == np.int64
+        assert int(o["entity_num"]) >= 1
+        # the aux keys the actor's reward machinery reads (MockEnv parity)
+        for k in ("game_loop", "action_result", "battle_score",
+                  "opponent_battle_score"):
+            assert k in o, k
+
+
+def test_device_observation_schema():
+    """On-device observe() emits the schema dtypes directly (entity_num is
+    the one documented divergence: int32 without x64)."""
+    gen = ScenarioGenerator(TINY_SCN)
+    state = reset(TINY_ENV, gen.generate(jax.random.PRNGKey(0)))
+    obs = observe(TINY_ENV, state, 0)
+    for k, dt in F.SPATIAL_INFO.items():
+        assert obs["spatial_info"][k].dtype == dt, k
+        expected = (F.EFFECT_LENGTH,) if k.startswith("effect_") else F.SPATIAL_SIZE
+        assert obs["spatial_info"][k].shape == expected, k
+    for k, (dt, shape) in F.SCALAR_INFO.items():
+        assert obs["scalar_info"][k].dtype == dt, k
+        assert obs["scalar_info"][k].shape == tuple(shape), k
+    for k, dt in F.ENTITY_INFO.items():
+        assert obs["entity_info"][k].dtype == dt, k
+        assert obs["entity_info"][k].shape == (F.MAX_ENTITY_NUM,), k
+    assert obs["entity_num"].dtype == jnp.int32
+
+
+def test_entity_packing_alliance_blocks():
+    """Packed entities: own alive first (alliance 1), then enemies (4),
+    zero padding after entity_num — the pointer-action slot contract."""
+    gen = ScenarioGenerator(TINY_SCN)
+    state = reset(TINY_ENV, gen.generate(jax.random.PRNGKey(2)))
+    for team in (0, 1):
+        obs = observe(TINY_ENV, state, team)
+        n = int(obs["entity_num"])
+        alliance = np.asarray(obs["entity_info"]["alliance"])
+        valid = alliance[:n]
+        assert set(np.unique(valid)) <= {1, 4}
+        # own block strictly before enemy block
+        if (valid == 1).any() and (valid == 4).any():
+            assert valid.argmax() == 0 or valid[0] == 1
+            first_enemy = int(np.argmax(valid == 4))
+            assert (valid[first_enemy:] == 4).all()
+        assert (alliance[n:] == 0).all()
+
+
+def test_micro_legal_mask_covers_micro_vocabulary():
+    mask = micro_legal_mask()
+    assert mask.shape == (ACT.NUM_ACTIONS,)
+    assert mask[0]          # no_op
+    assert mask[3]          # Attack_unit
+    assert mask[197]        # Move_pt
+    assert mask.sum() < 16  # micro vocabulary only
+
+
+# --------------------------------------------------------------- determinism
+def test_determinism_golden_tiny():
+    """Tier-1 drift witness: the committed golden was generated in a fresh
+    process; any change to scenario generation, dynamics, or observation
+    bytes flips the sha256."""
+    with open(os.path.join(DATA, "jaxenv_golden_tiny.json")) as f:
+        golden = json.load(f)
+    c = golden["config"]
+    got = episode_digest(
+        seed=c["seed"],
+        env_cfg=EnvConfig(units_per_squad=c["units_per_squad"]),
+        scenario_cfg=ScenarioConfig(
+            units_per_squad=c["units_per_squad"], min_units=c["min_units"],
+            max_units=c["max_units"], episode_len=c["episode_len"],
+            spawn_margin=c["spawn_margin"], spawn_spread=c["spawn_spread"]),
+        max_steps=c["max_steps"])
+    assert got == golden["digest"], (
+        "jaxenv episode drifted from the committed golden — if the change "
+        "is intentional, regenerate tests/data/jaxenv_golden_tiny.json")
+
+
+@pytest.mark.slow
+def test_determinism_across_two_fresh_processes():
+    """Same scenario key + params => bit-identical episode in two separate
+    interpreter processes (fresh jit caches, fresh PRNG plumbing)."""
+    prog = (
+        "import json; from distar_tpu.envs.jaxenv import episode_digest, "
+        "EnvConfig, ScenarioConfig; "
+        "print(json.dumps(episode_digest(seed=17, "
+        "env_cfg=EnvConfig(units_per_squad=2), "
+        "scenario_cfg=ScenarioConfig(units_per_squad=2, min_units=1, "
+        "max_units=2, episode_len=24, spawn_margin=30.0, spawn_spread=6.0), "
+        "max_steps=24)))"
+    )
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=os.pathsep.join(
+                   [os.path.dirname(os.path.dirname(__file__))]
+                   + sys.path))
+    runs = [subprocess.run([sys.executable, "-c", prog], env=env,
+                           capture_output=True, text=True, timeout=300)
+            for _ in range(2)]
+    for r in runs:
+        assert r.returncode == 0, r.stderr
+    d1, d2 = (json.loads(r.stdout.strip().splitlines()[-1]) for r in runs)
+    assert d1 == d2
+
+
+def test_scenario_generator_key_determinism_and_batch():
+    gen = ScenarioGenerator(TINY_SCN)
+    a = gen.generate(jax.random.PRNGKey(5))
+    b = gen.generate(jax.random.PRNGKey(5))
+    c = gen.generate(jax.random.PRNGKey(6))
+    for la, lb in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        assert (np.asarray(la) == np.asarray(lb)).all()
+    assert any((np.asarray(la) != np.asarray(lc)).any()
+               for la, lc in zip(jax.tree.leaves(a), jax.tree.leaves(c)))
+    batch = gen.batch(jax.random.PRNGKey(7), 5)
+    assert batch.pos_home.shape == (5, TINY_SCN.units_per_squad, 2)
+    assert batch.terrain.shape[0] == 5
+
+
+# ------------------------------------------------------------------ dynamics
+def test_episode_resolves_and_freezes_after_done():
+    """Scripted-vs-scripted combat terminates; after done the state freezes
+    and further steps yield zero reward (window-padding semantics)."""
+    cfg = EnvConfig(units_per_squad=2)
+    gen = ScenarioGenerator(ScenarioConfig(
+        units_per_squad=2, min_units=2, max_units=2, episode_len=64,
+        spawn_margin=50.0, spawn_spread=4.0))
+    state = reset(cfg, gen.generate(jax.random.PRNGKey(1)))
+    no_op = _no_op()
+    stepf = jax.jit(lambda s: step(cfg, s, no_op, jnp.asarray(1)))
+    done = False
+    for _ in range(64):
+        state, rew, done, winner = stepf(state)
+        if bool(done):
+            break
+    assert bool(done)
+    assert int(winner) in (0, 1, 2)
+    frozen = jax.tree.map(np.asarray, state)
+    state2, rew2, done2, _ = stepf(state)
+    for a, b in zip(jax.tree.leaves(frozen), jax.tree.leaves(
+            jax.tree.map(np.asarray, state2))):
+        assert (a == b).all()
+    assert float(np.abs(np.asarray(rew2["battle"])).sum()) == 0.0
+    assert float(np.abs(np.asarray(rew2["winloss"])).sum()) == 0.0
+
+
+def test_winloss_fires_exactly_once():
+    cfg = EnvConfig(units_per_squad=2)
+    gen = ScenarioGenerator(ScenarioConfig(
+        units_per_squad=2, min_units=2, max_units=2, episode_len=48,
+        spawn_margin=50.0, spawn_spread=4.0))
+    state = reset(cfg, gen.generate(jax.random.PRNGKey(4)))
+    no_op = _no_op()
+    stepf = jax.jit(lambda s: step(cfg, s, no_op, jnp.asarray(1)))
+    total = np.zeros(2)
+    for _ in range(60):
+        state, rew, done, winner = stepf(state)
+        total += np.abs(np.asarray(rew["winloss"]))
+    assert bool(state.done)
+    # one +-1 pair at the terminal step (or 0 on a health-fraction draw)
+    assert float(total.sum()) in (0.0, 2.0)
+
+
+# -------------------------------------------------------------- host adapter
+def test_host_env_round_trip_with_actions():
+    env = JaxMicroBattleEnv(TINY_ENV, TINY_SCN, seed=3)
+    obs = env.reset()
+    n0 = int(obs[0]["entity_num"])
+    su = np.zeros(F.MAX_SELECTED_UNITS_NUM, np.int64)
+    su[0] = 0
+    su[1] = n0  # end token
+    attack = {
+        "action_type": np.asarray(3, np.int64),  # Attack_unit
+        "delay": np.asarray(1, np.int64),
+        "queued": np.asarray(0, np.int64),
+        "selected_units": su,
+        "target_unit": np.asarray(max(n0 - 1, 0), np.int64),
+        "target_location": np.asarray(0, np.int64),
+    }
+    for t in range(TINY_SCN.episode_len):
+        obs, rewards, done, info = env.step({0: attack})
+        assert set(rewards) == {0, 1}
+        if done:
+            assert "winner" in info
+            break
+    assert done
+    # rewards are zero-sum at termination (or a draw)
+    assert rewards[0] == -rewards[1]
+
+
+# ------------------------------------------------------------------ win rate
+def test_head_to_head_separates_scripted_policies():
+    """The win-rate leg's mock engines: attack-nearest must beat idle on the
+    SAME fixed scenario keys from both the home and the away side.
+
+    Composition-fair (mirror_types), open terrain, and a timeout long enough
+    to let engagements resolve — the evaluation is bit-deterministic per
+    seed, so the margins asserted here are pinned, not statistical."""
+    ec = EnvConfig(units_per_squad=2)
+    sc = ScenarioConfig(units_per_squad=2, min_units=2, max_units=2,
+                        episode_len=160, spawn_margin=50.0, spawn_spread=4.0,
+                        mirror_types=True, blocked_frac=0.0)
+    atk_home = head_to_head(attack_nearest_policy(), idle_policy(),
+                            episodes=8, seed=5, env_cfg=ec, scenario_cfg=sc)
+    atk_away = head_to_head(idle_policy(), attack_nearest_policy(),
+                            episodes=8, seed=5, env_cfg=ec, scenario_cfg=sc)
+    assert atk_home["episodes"] == 8
+    assert atk_home["wins"] + atk_home["losses"] + atk_home["draws"] == 8
+    # attacker advantage from both sides of the same scenario set
+    assert atk_home["win_rate"] > 0.5
+    assert atk_away["win_rate"] < 0.5
+    # determinism: the evaluation is a pure function of the key set
+    again = head_to_head(attack_nearest_policy(), idle_policy(),
+                         episodes=8, seed=5, env_cfg=ec, scenario_cfg=sc)
+    assert again == atk_home
+
+
+def test_fleet_compare_win_rate_verdict_from_real_episodes():
+    """Satellite 1 acceptance: ``FleetRollout.compare()`` carries a win_rate
+    column computed from REAL jaxenv episodes (mock engines = the scripted
+    policies; mock gateways = a patched fleet_status), and ``min_win_rate``
+    gates the promote verdict."""
+    from distar_tpu.serve.fleet import FleetRollout, GatewayMap
+
+    ctl = FleetRollout(GatewayMap(["127.0.0.1:9001", "127.0.0.1:9002"]),
+                       timeout_s=1.0)
+    healthy = {"requests": {"ok": 10.0}, "shed_rate": 0.0,
+               "latency_s": {"p99": 0.01}, "sessions": {"num_slots": 4}}
+    ctl.fleet_status = lambda: {"127.0.0.1:9001": dict(healthy),
+                                "127.0.0.1:9002": dict(healthy)}
+    ec = EnvConfig(units_per_squad=2)
+    sc = ScenarioConfig(units_per_squad=2, min_units=2, max_units=2,
+                        episode_len=160, spawn_margin=50.0, spawn_spread=4.0,
+                        mirror_types=True, blocked_frac=0.0)
+
+    def strong_canary():
+        return head_to_head(attack_nearest_policy(), idle_policy(),
+                            episodes=8, seed=5, env_cfg=ec, scenario_cfg=sc)
+
+    def weak_canary():
+        return head_to_head(idle_policy(), attack_nearest_policy(),
+                            episodes=8, seed=5, env_cfg=ec, scenario_cfg=sc)
+
+    good = ctl.compare(["127.0.0.1:9001"], win_rate_fn=strong_canary,
+                       min_win_rate=0.5)
+    assert good["win_rate"]["episodes"] == 8
+    assert good["win_rate"]["win_rate"] > 0.5
+    assert good["verdict"]["promote"] is True, good["verdict"]
+
+    bad = ctl.compare(["127.0.0.1:9001"], win_rate_fn=weak_canary,
+                      min_win_rate=0.5)
+    assert bad["verdict"]["promote"] is False
+    assert any("win_rate" in r for r in bad["verdict"]["reasons"])
+    # a failing win-rate verdict gates promote without touching the fleet
+    gated = ctl.promote("v2", verdict=bad)
+    assert gated["ok"] is False and gated["outcome"] == "compare_gated"
+
+    # no head-to-head supplied but the gate requested -> explicit reason
+    missing = ctl.compare(["127.0.0.1:9001"], min_win_rate=0.5)
+    assert any("no head-to-head" in r for r in missing["verdict"]["reasons"])

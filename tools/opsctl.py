@@ -410,7 +410,7 @@ def _print_actor_digest(addr: str) -> None:
         if st and st.get("last") is not None:
             rows.append((source, "env_steps_per_s", f"{st['last']:.6g}"))
     backends = []
-    for backend in ("inline", "local", "remote"):
+    for backend in ("inline", "local", "remote", "anakin"):
         name = urllib.parse.quote(
             f"distar_rollout_plane_backend{{backend={backend}}}")
         body = _try_get(addr, f"/timeseries?name={name}&window_s=600")
@@ -424,6 +424,13 @@ def _print_actor_digest(addr: str) -> None:
             if st and st.get("rate"):
                 rows.append((source, f"plane_samples_per_s[{backend}]",
                              f"{st['rate']:.6g}"))
+    # the fused-rollout tier has no plane samples: its feed-rate signal is
+    # the per-window env-steps/s gauge
+    body = _try_get(addr, "/timeseries?name=distar_anakin_env_steps_per_s&window_s=600")
+    for source, st in ((body or {}).get("stats") or {}).items():
+        if st and st.get("last") is not None:
+            rows.append((source, "anakin_env_steps_per_s",
+                         f"{st['last']:.6g}"))
     shed = 0.0
     for reason in ("shed_queue_full", "shed_deadline", "shed_capacity", "draining"):
         name = urllib.parse.quote(f"distar_serve_shed_total{{reason={reason}}}")

@@ -335,6 +335,7 @@ def collect_trajectory(repo: str = _REPO) -> List[dict]:
                        + glob.glob(os.path.join(repo, "TRACE_r*.json"))
                        + glob.glob(os.path.join(repo, "DISTILL_r*.json"))
                        + glob.glob(os.path.join(repo, "DYNAMICS_r*.json"))
+                       + glob.glob(os.path.join(repo, "ANAKIN_r*.json"))
                        + glob.glob(os.path.join(repo, "artifacts", "perf_baseline*.json"))
                        + glob.glob(os.path.join(repo, "artifacts", "dynamics_*.json"))
                        + glob.glob(os.path.join(repo, "artifacts", "curves_r*.json"))
@@ -343,7 +344,8 @@ def collect_trajectory(repo: str = _REPO) -> List[dict]:
                        + glob.glob(os.path.join(repo, "artifacts", "fleet_*.json"))
                        + glob.glob(os.path.join(repo, "artifacts", "shm_*.json"))
                        + glob.glob(os.path.join(repo, "artifacts", "trace_*.json"))
-                       + glob.glob(os.path.join(repo, "artifacts", "distill_*.json"))):
+                       + glob.glob(os.path.join(repo, "artifacts", "distill_*.json"))
+                       + glob.glob(os.path.join(repo, "artifacts", "anakin_*.json"))):
         try:
             doc = load_artifact(path)
         except (OSError, ValueError):
@@ -422,6 +424,23 @@ def collect_trajectory(repo: str = _REPO) -> List[dict]:
                            f"{toy['kl_last']:g} over {toy.get('iters')} iters "
                            f"(monotone={bool(toy.get('monotone_decrease'))})"),
                 "value": toy["kl_last"], "unit": "KL",
+                "status": _status_of(doc),
+            })
+        anakin = doc.get("anakin") or {}
+        if anakin.get("fused_vs_actor") or anakin.get("fused_vs_host"):
+            # the anakin artifact carries both A/Bs in-band; headline the
+            # real mock-env actor path (the ROADMAP baseline) and keep the
+            # charitable tight-loop floor in the label
+            baseline = ("mock-env actor path" if anakin.get("fused_vs_actor")
+                        else "one-lane host loop")
+            rows.append({
+                "round": _round_of(path), "artifact": os.path.basename(path),
+                "metric": (f"anakin fused scan vs {baseline}, same policy "
+                           f"({anakin.get('batch_lanes')} lanes; "
+                           f"tight-loop floor {anakin.get('fused_vs_host')}x; "
+                           f"device_pure={bool(anakin.get('device_pure'))})"),
+                "value": anakin.get("fused_vs_actor")
+                or anakin["fused_vs_host"], "unit": "x",
                 "status": _status_of(doc),
             })
         fast = doc.get("replay_fast_path") or {}
