@@ -1,0 +1,26 @@
+"""The skill observatory: continuous evaluation arena over checkpoint
+history, a durable payoff matrix with Wilson confidence intervals, and
+ELO/TrueSkill rating trajectories — the measured substrate the PFSP
+league matchmakes from."""
+from .evaluator import ArenaEvaluator, anchor_policy
+from .store import (
+    ANCHORS,
+    ArenaStore,
+    get_arena_store,
+    match_key,
+    match_seed,
+    set_arena_store,
+    wilson_interval,
+)
+
+__all__ = [
+    "ANCHORS",
+    "ArenaEvaluator",
+    "ArenaStore",
+    "anchor_policy",
+    "get_arena_store",
+    "match_key",
+    "match_seed",
+    "set_arena_store",
+    "wilson_interval",
+]

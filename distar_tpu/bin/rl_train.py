@@ -14,6 +14,7 @@ Usage:
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import time
 from typing import Optional
@@ -352,6 +353,80 @@ def run_replay(args) -> None:
     supervisor.join()
 
 
+def run_arena(args) -> None:
+    """Standalone arena-evaluator role: pulls checkpoint generations via
+    CheckpointManager role keys, plays deterministic head-to-head batches on
+    jaxenv against the coordinator-scheduled opponent, and reports results
+    under idempotent match keys — crash-restart under the supervisor is
+    exactly-once by construction (the store re-issues the same assignment
+    until its results are applied)."""
+    from ..arena import ArenaEvaluator
+
+    _init_health(
+        args, roles=("arena",), source="arena",
+        shipper_addr=_addr(args.coordinator_addr) if args.coordinator_addr else None,
+    )
+    roles = tuple(r.strip() for r in args.arena_roles.split(",")) \
+        if args.arena_roles else ("",)
+    env_cfg, scenario_cfg = _jaxenv_cfgs(args)
+
+    def serve_loop(ctx):
+        evaluator = ArenaEvaluator(
+            ckpt_dir=args.arena_ckpt_dir,
+            model_cfg=_model_cfg(args),
+            coordinator_addr=_addr(args.coordinator_addr),
+            roles=roles,
+            episodes=args.arena_episodes,
+            env_cfg=env_cfg,
+            scenario_cfg=scenario_cfg,
+        )
+        print(f"arena evaluator on {args.arena_ckpt_dir} "
+              f"(roles={','.join(r or 'main' for r in roles)})", flush=True)
+        try:
+            while not ctx.should_exit:
+                out = evaluator.evaluate_once()
+                if out is None:
+                    ctx.sleep(args.arena_interval_s)
+                    continue
+                a = out["assignment"]
+                print(f"arena: {a['home']} vs {a['away']} r{a['round']} "
+                      f"win_rate={out['result']['win_rate']:.3f} "
+                      f"applied={out['ack'].get('applied')}", flush=True)
+                if args.arena_batches and \
+                        evaluator.batches_done >= args.arena_batches:
+                    break
+        finally:
+            if args.arena_artifact:
+                ratings = _fetch_arena_ratings(args)
+                evaluator.write_artifact(args.arena_artifact, ratings=ratings)
+                print(f"arena artifact written to {args.arena_artifact}",
+                      flush=True)
+
+    if getattr(args, "no_supervise", False):
+        from ..resilience import TaskContext
+
+        serve_loop(TaskContext())
+        return
+    supervisor = Supervisor(policy=_restart_policy(args))
+    supervisor.add("arena", serve_loop)
+    supervisor.start()
+    supervisor.join()
+
+
+def _fetch_arena_ratings(args) -> Optional[dict]:
+    """GET /arena/ratings from the coordinator for the artifact ledger;
+    None when the store isn't hosted there (artifact stays throughput-only)."""
+    import urllib.request
+
+    host, port = _addr(args.coordinator_addr)
+    try:
+        with urllib.request.urlopen(
+                f"http://{host}:{port}/arena/ratings", timeout=10.0) as resp:
+            return json.loads(resp.read())
+    except Exception:
+        return None
+
+
 def _maybe_serve_metrics(args, coordinator=None):
     """Start an HTTP server exposing GET /metrics for this process's registry
     when --metrics-port is given (CoordinatorServer doubles as the exporter;
@@ -672,7 +747,7 @@ def main() -> None:
     p = argparse.ArgumentParser()
     p.add_argument("--type", default="all",
                    choices=["all", "league", "coordinator", "learner", "actor",
-                            "replay"])
+                            "replay", "arena"])
     p.add_argument("--config", default="")
     p.add_argument("--iters", type=int, default=4)
     p.add_argument("--batch-size", type=int, default=None)
@@ -853,6 +928,28 @@ def main() -> None:
                         "CheckpointManager role key, and exports the "
                         "distar_distill_* drift gauges "
                         "(docs/training_guide.md distillation quickstart)")
+    p.add_argument("--arena-ckpt-dir", default="",
+                   help="--type arena: checkpoint directory whose "
+                        "CheckpointManager generations form the model roster")
+    p.add_argument("--arena-roles", default="",
+                   help="--type arena: comma-separated CheckpointManager "
+                        "role keys to rate ('' = the default/teacher "
+                        "lineage, shown as main)")
+    p.add_argument("--arena-episodes", type=int, default=8,
+                   help="--type arena: episodes per scheduled scenario batch")
+    p.add_argument("--arena-batches", type=int, default=0,
+                   help="--type arena: stop after N batches (0 = run forever)")
+    p.add_argument("--arena-interval-s", type=float, default=5.0,
+                   help="--type arena: idle sleep when no assignment is "
+                        "available")
+    p.add_argument("--arena-artifact", default="",
+                   help="--type arena: write the ARENA_r*.json ledger "
+                        "(matches/s + ratings, honesty flags in-band) here "
+                        "on exit")
+    p.add_argument("--arena-store", default="",
+                   help="--type coordinator: host the durable ArenaStore, "
+                        "journaled at this path (league-autosave idiom); "
+                        "enables the /arena/* routes")
     p.add_argument("--player-id", default="MP0")
     p.add_argument("--pipeline", default="default",
                    help="learner implementation to run: 'default' or an "
@@ -917,16 +1014,46 @@ def main() -> None:
         # the broker evaluates the FULL rulebook: shipped telemetry gives it
         # per-source learner/actor/serve series for the whole fleet
         _init_health(args, roles=("learner", "actor", "coordinator", "trace",
-                                  "serve", "replay", "distill"),
+                                  "serve", "replay", "distill", "arena"),
                      source="coordinator")
+        if args.arena_store:
+            # host the skill ledger: reload the journal (ratings, payoff AND
+            # the idempotency key set survive a broker restart), then keep
+            # journaling on the autosave thread
+            from ..arena import ArenaStore, set_arena_store
+
+            store = ArenaStore(path=args.arena_store)
+            if store.maybe_load():
+                print(f"arena store resumed from {args.arena_store}",
+                      flush=True)
+            store.start_autosave(interval_s=args.league_autosave_s or 30.0)
+            set_arena_store(store)
         server = CoordinatorServer(
             coordinator=Coordinator(default_lease_s=args.lease_s or None),
             port=args.port,
         )
         server.start()
         print(f"coordinator serving on {server.host}:{server.port}", flush=True)
-        while True:
-            time.sleep(3600)
+        if args.arena_store:
+            # a drained broker must not lose the tail of the match ledger:
+            # turn SIGTERM into SystemExit so the final journal below runs
+            # (SIGKILL still loses at most one autosave interval)
+            import signal as _signal
+            import sys as _sys
+
+            _signal.signal(_signal.SIGTERM, lambda *_: _sys.exit(0))
+        try:
+            while True:
+                time.sleep(3600)
+        finally:
+            if args.arena_store:
+                store.save()
+                print("arena store journaled on shutdown", flush=True)
+    elif args.type == "arena":
+        if not (args.coordinator_addr and args.arena_ckpt_dir):
+            raise SystemExit(
+                "--type arena requires --coordinator-addr and --arena-ckpt-dir")
+        run_arena(args)
     elif args.type == "learner":
         if not args.coordinator_addr:
             raise SystemExit("--type learner requires --coordinator-addr (and usually --league-addr)")

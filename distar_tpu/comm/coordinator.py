@@ -271,7 +271,23 @@ class CoordinatorServer:
                 else co.depth(b["token"])
             ),
             "telemetry": _ingest_telemetry,
+            # arena wire plane (served when this coordinator hosts the
+            # ArenaStore; the store's idempotent keys make arena_report
+            # exactly-once even when the retry fabric replays a POST)
+            "arena_next": lambda b: _arena_call(
+                "next_match", b.get("players", []),
+                episodes=int(b.get("episodes", 8))),
+            "arena_report": lambda b: _arena_call(
+                "report_batch", b.get("matches", [])),
         }
+
+        def _arena_call(method: str, *args, **kwargs):
+            from ..arena import get_arena_store
+
+            store = get_arena_store()
+            if store is None:
+                raise RuntimeError("no arena store hosted on this coordinator")
+            return getattr(store, method)(*args, **kwargs)
 
         class Handler(BaseHTTPRequestHandler):
             def log_message(self, *args):
@@ -300,6 +316,24 @@ class CoordinatorServer:
                         self.end_headers()
                         return
                     write_json_response(self, scaler.status())
+                    return
+                if self.path.rstrip("/") in ("/arena/ratings", "/arena/payoff"):
+                    # skill-ledger snapshots (opsctl arena / perf_gate skill
+                    # read these): answered from the process-global ArenaStore
+                    # when this coordinator hosts one, 404 otherwise
+                    from ..arena import get_arena_store
+                    from ..obs import write_json_response
+
+                    store = get_arena_store()
+                    if store is None:
+                        self.send_response(404)
+                        self.send_header("Content-Length", "0")
+                        self.end_headers()
+                        return
+                    snap = (store.ratings_snapshot()
+                            if self.path.rstrip("/").endswith("ratings")
+                            else store.payoff_snapshot())
+                    write_json_response(self, snap)
                     return
                 if handle_health_get(self, self.path):
                     return

@@ -16,6 +16,7 @@ compare() test uses.
 """
 from __future__ import annotations
 
+import time
 from functools import partial
 from typing import Callable, Optional
 
@@ -144,9 +145,12 @@ def head_to_head(policy_a, policy_b,
     """Policy A (home) vs policy B (away) over a fixed scenario set.
 
     Returns ``{win_rate, wins, losses, draws, episodes}`` where ``win_rate``
-    counts a draw as half a win for A. ``keys`` pins the exact scenario set
-    (e.g. the league's fixed eval suite); otherwise ``episodes`` scenarios
-    are derived from ``seed``.
+    counts a draw as half a win for A, plus the per-match stats a payoff
+    ledger ingests: ``matches`` (one ``{winner, draw, game_steps}`` record
+    per episode, in key order), ``mean_game_steps``, and ``duration_s``
+    (wall-clock for the whole batch, compile included on first call).
+    ``keys`` pins the exact scenario set (e.g. the league's fixed eval
+    suite); otherwise ``episodes`` scenarios are derived from ``seed``.
     """
     scenario_cfg = (scenario_cfg if scenario_cfg is not None
                     else ScenarioConfig(units_per_squad=env_cfg.units_per_squad))
@@ -177,16 +181,29 @@ def head_to_head(policy_a, policy_b,
         (states, _, _), _ = jax.lax.scan(
             body, (states, ca, cb),
             jax.random.split(jax.random.fold_in(keys[0], 0x5eed), T))
-        return states.winner
+        # lanes freeze after done, so states.t is each lane's terminal step
+        return states.winner, states.t
 
-    winner = jax.jit(run)(keys)
+    t0 = time.monotonic()
+    winner, game_steps = jax.jit(run)(keys)
+    winner = jax.device_get(winner)
+    game_steps = jax.device_get(game_steps)
+    duration_s = time.monotonic() - t0
     wins = int((winner == WINNER_HOME).sum())
     losses = int((winner == WINNER_AWAY).sum())
     draws = B - wins - losses
     win_rate = (wins + 0.5 * draws) / max(B, 1)
+    matches = []
+    for i in range(B):
+        w = int(winner[i])
+        name = {WINNER_HOME: "home", WINNER_AWAY: "away"}.get(w, "draw")
+        matches.append({"winner": name, "draw": name == "draw",
+                        "game_steps": int(game_steps[i])})
     get_registry().gauge(
         "distar_env_head2head_win_rate",
         "home-side win rate of the last jaxenv head-to-head evaluation",
     ).set(win_rate)
     return {"win_rate": win_rate, "wins": wins, "losses": losses,
-            "draws": draws, "episodes": B}
+            "draws": draws, "episodes": B, "matches": matches,
+            "mean_game_steps": float(game_steps.mean()) if B else 0.0,
+            "duration_s": duration_s}

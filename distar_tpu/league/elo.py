@@ -23,6 +23,7 @@ class ELORating:
         self.minimum_games = minimum_games
         self.elos: Dict[str, float] = defaultdict(float)  # stored as offsets from init
         self.wins = defaultdict(partial(defaultdict, int))
+        self.draws = defaultdict(partial(defaultdict, int))
         self.games = defaultdict(partial(defaultdict, int))
         self.game_count = 0
 
@@ -38,6 +39,8 @@ class ELORating:
             self.wins[p2][p1] += 1
             score = 0.0
         else:
+            self.draws[p1][p2] += 1
+            self.draws[p2][p1] += 1
             score = 0.5
         self.games[p1][p2] += 1
         self.games[p2][p1] += 1
@@ -57,11 +60,16 @@ class ELORating:
         the observed (clipped) pairwise winrates over pairs with enough games."""
         players = list(self.elos.keys())
         r = {p: self.elos[p] for p in players}
+        # `draws` may be absent on ladders unpickled from pre-draws journals
+        draws = getattr(self, "draws", None) or defaultdict(partial(defaultdict, int))
         pairs = []
         for p1 in players:
             for p2 in players:
                 if p1 != p2 and self.games[p1][p2] > self.minimum_games:
-                    wr = self.wins[p1][p2] / max(self.games[p1][p2], 1)
+                    # draws score half — wins alone would undercount a player
+                    # who converts losses into draws (50w/50d reads 0.5, not 0.75)
+                    score = self.wins[p1][p2] + 0.5 * draws[p1][p2]
+                    wr = score / max(self.games[p1][p2], 1)
                     pairs.append((p1, p2, min(max(wr, 0.1), 0.9)))
         if not pairs:
             return self.ratings()

@@ -15,6 +15,10 @@ def _stat_entry(warm_up_size: int) -> Dict[str, WindowedMeter]:
     return {k: WindowedMeter(warm_up_size) for k in DATA_KEYS}
 
 
+def _decay_entry() -> Dict[str, float]:
+    return {"wins": 0.0, "draws": 0.0, "losses": 0.0, "games": 0.0}
+
+
 class Payoff:
     def __init__(self, decay: float = 0.999, warm_up_size: int = 1000, min_win_rate_games: int = 1000):
         self._decay = decay
@@ -25,11 +29,38 @@ class Payoff:
         self._record: Dict[str, Dict[str, WindowedMeter]] = defaultdict(
             partial(_stat_entry, warm_up_size)
         )
+        # reference payoff semantics: exponentially decayed per-opponent
+        # result counters (multiply all by decay, then increment the bucket
+        # for this game) — recency-weighted without a fixed window
+        self._decayed: Dict[str, Dict[str, float]] = defaultdict(_decay_entry)
 
     def update(self, opponent_id: str, stat_info: Dict[str, float]) -> None:
         for k in DATA_KEYS:
             if k in stat_info:
                 self._record[opponent_id][k].update(stat_info[k])
+        if "winrate" in stat_info:
+            rec = getattr(self, "_decayed", None)
+            if rec is None:  # backfill payoffs unpickled from pre-decay journals
+                rec = self._decayed = defaultdict(_decay_entry)
+            entry = rec[opponent_id]
+            for k in entry:
+                entry[k] *= self._decay
+            entry["games"] += 1.0
+            score = float(stat_info["winrate"])
+            if score >= 1.0:
+                entry["wins"] += 1.0
+            elif score <= 0.0:
+                entry["losses"] += 1.0
+            else:
+                entry["draws"] += 1.0
+
+    def decayed_win_rate(self, opponent_id: str) -> float:
+        """Recency-weighted win rate (draws score half); 0.5 with no games."""
+        rec = getattr(self, "_decayed", None) or {}
+        entry = rec.get(opponent_id) if hasattr(rec, "get") else None
+        if not entry or entry["games"] <= 0.0:
+            return 0.5
+        return (entry["wins"] + 0.5 * entry["draws"]) / entry["games"]
 
     def win_rate_opponent(self, opponent_id: str, use_prior: bool = True) -> float:
         meter = self._record[opponent_id]["winrate"]

@@ -306,7 +306,7 @@ class HealthEvaluator:
 # ------------------------------------------------------------ default rules
 def default_rulebook(roles: Iterable[str] = ("learner", "actor", "coordinator",
                                              "trace", "serve", "replay",
-                                             "distill"),
+                                             "distill", "arena"),
                      slo_e2e_s: float = 30.0,
                      queue_saturation: float = 384.0,
                      shed_rate_per_s: float = 5.0,
@@ -446,6 +446,24 @@ def default_rulebook(roles: Iterable[str] = ("learner", "actor", "coordinator",
             window_s=stall_window_s, for_count=3,
             summary="replay store stopped serving samples (learner gone or "
                     "rate limiter starved of inserts)",
+        ))
+    if "arena" in roles:
+        book.append(HealthRule(
+            name="arena_rating_regression",
+            # the store publishes the NEGATED main-lineage ELO, so a rising
+            # trend here means the newest generation is shedding rating
+            metric="distar_arena_main_rating_inverted", op="trending_up",
+            threshold=0.0, window_s=300.0, for_count=3, severity="warning",
+            summary="main-lineage arena rating is trending DOWN — the newest "
+                    "generation is losing skill vs the ladder (check "
+                    "opsctl arena for the payoff matrix)",
+        ))
+        book.append(HealthRule(
+            name="arena_match_stall",
+            metric="distar_arena_matches_applied", op="stalled",
+            window_s=stall_window_s, for_count=3, severity="warning",
+            summary="arena stopped applying matches — evaluator dead or "
+                    "wedged (matches gauge flat with evaluators registered)",
         ))
     return book
 

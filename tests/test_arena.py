@@ -1,0 +1,512 @@
+"""Arena skill-observatory tier-1 tests (PR 18).
+
+Covers the full tentpole surface:
+
+  * closed-form verification of the wired-up dormant ladder modules
+    (ELO incremental update + draw-aware refit, Payoff 0.5-winrate prior
+    and exponential-decay counters, Wilson confidence intervals);
+  * the ArenaStore's deterministic uncertainty-directed scheduler (pure
+    function of *reported* state), idempotent-key dedup, anchor floor,
+    PFSP variance-weight preview, durability (journal save/load);
+  * the chaos arena-drill's in-process twin: an evaluator abandoned
+    mid-batch re-receives the identical assignment on restart — zero
+    lost, zero double-counted by key construction;
+  * the e2e acceptance: three toy checkpoint generations + two scripted
+    anchors play a scheduled arena on jaxenv; ``attack_nearest`` ends
+    rated above ``idle`` with confidence; the payoff matrix is
+    non-trivial; ratings survive a coordinator restart via the durable
+    store; ``GET /arena/ratings`` + ``/arena/payoff`` serve over a real
+    CoordinatorServer; ``opsctl arena`` renders the scoreboard from
+    shipped TSDB series.
+"""
+import json
+import math
+import os
+import sys
+import urllib.request
+from argparse import Namespace
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distar_tpu.arena import (
+    ANCHORS,
+    ArenaEvaluator,
+    ArenaStore,
+    match_key,
+    match_seed,
+    set_arena_store,
+    wilson_interval,
+)
+from distar_tpu.envs.jaxenv import EnvConfig, ScenarioConfig
+from distar_tpu.league.elo import DRAW, ELORating, WIN
+from distar_tpu.league.payoff import Payoff
+from distar_tpu.obs import (
+    FleetHealth,
+    MetricsRegistry,
+    default_rulebook,
+    set_fleet_health,
+    set_registry,
+)
+
+from conftest import SMALL_MODEL
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+TINY_ENV = EnvConfig(units_per_squad=2)
+# accounting-only scenario: outcome content doesn't matter, speed does
+TINY_SCN = ScenarioConfig(units_per_squad=2, min_units=2, max_units=2,
+                          episode_len=12)
+# separating scenario: open terrain + long-enough timeout so attack_nearest
+# actually converts engagements (mirrors test_jaxenv's pinned config)
+FIGHT_SCN = ScenarioConfig(units_per_squad=2, min_units=2, max_units=2,
+                           episode_len=96, spawn_margin=50.0,
+                           spawn_spread=4.0, mirror_types=True,
+                           blocked_frac=0.0)
+
+
+@pytest.fixture
+def registry():
+    reg = MetricsRegistry()
+    prev = set_registry(reg)
+    yield reg
+    set_registry(prev)
+
+
+@pytest.fixture
+def arena_global():
+    """Process-global arena-store slot, restored on teardown."""
+    yield
+    set_arena_store(None)
+
+
+# ------------------------------------------------------------ ladder closed forms
+def test_wilson_interval_closed_form():
+    # no data -> the uninformative full interval
+    assert wilson_interval(0, 0, 0) == (0.0, 1.0)
+    # 8W/2L, z=1.96: hand-expanded Wilson score interval
+    z, n, p = 1.96, 10.0, 0.8
+    denom = 1 + z * z / n
+    center = (p + z * z / (2 * n)) / denom
+    half = z * math.sqrt(p * (1 - p) / n + z * z / (4 * n * n)) / denom
+    lo, hi = wilson_interval(8, 0, 2)
+    assert lo == pytest.approx(center - half)
+    assert hi == pytest.approx(center + half)
+    # draws count half a win: 4W/4D/2L has the same p-hat as 6W/4L
+    assert wilson_interval(4, 4, 2) == wilson_interval(6, 0, 4)
+    # interval is clamped into [0, 1]
+    lo, hi = wilson_interval(3, 0, 0)
+    assert 0.0 <= lo < 1.0 and hi == 1.0
+
+
+def test_elo_first_game_closed_form():
+    """K=44 incremental update from equal ratings: the winner takes exactly
+    K * (1 - 0.5) = 22 points, symmetrically."""
+    elo = ELORating()
+    elo.update("a", "b", WIN)
+    r = elo.ratings(start_from_zero=False)
+    assert r["a"] == pytest.approx(1022.0)
+    assert r["b"] == pytest.approx(978.0)
+
+
+def test_elo_refit_counts_draws_as_half():
+    """The payoff-consistency refit must read 50W/50D as a 0.75 score rate,
+    not 0.5 — the divergence the wire-and-verify satellite existed to catch.
+    The refit fixed point then satisfies expected(a,b) ~= 0.75, i.e. a gap
+    of 400*log10(3) ~= 190.85 elo."""
+    elo = ELORating()
+    for _ in range(50):
+        elo.update("a", "b", WIN)
+    for _ in range(50):
+        elo.update("a", "b", DRAW)
+    refit = elo.refit()
+    gap = refit["a"] - refit["b"]
+    expected = 1.0 / (1.0 + 10 ** (-gap / 400.0))
+    assert expected == pytest.approx(0.75, abs=1e-3)
+    assert gap == pytest.approx(400.0 * math.log10(3.0), abs=1.0)
+
+
+def test_payoff_prior_below_min_games():
+    p = Payoff(min_win_rate_games=5)
+    for _ in range(4):
+        p.update("opp", {"winrate": 1.0})
+    # 4 < 5 games: the 0.5 prior masks the perfect record
+    assert p.win_rate_opponent("opp") == 0.5
+    assert p.win_rate_opponent("opp", use_prior=False) == 1.0
+    p.update("opp", {"winrate": 1.0})
+    assert p.win_rate_opponent("opp") == 1.0
+
+
+def test_payoff_decay_closed_form():
+    """n results under decay d leave games = (1-d^n)/(1-d) — the geometric
+    series the reference's recency weighting reduces to."""
+    d, n = 0.9, 10
+    p = Payoff(decay=d)
+    assert p.decayed_win_rate("opp") == 0.5  # no games -> prior
+    for _ in range(n):
+        p.update("opp", {"winrate": 1.0})
+    expected_games = (1 - d ** n) / (1 - d)
+    assert p._decayed["opp"]["games"] == pytest.approx(expected_games)
+    assert p.decayed_win_rate("opp") == pytest.approx(1.0)
+    # one fresh loss outweighs a decayed win of the same age
+    p.update("opp", {"winrate": 0.0})
+    games = expected_games * d + 1.0
+    wins = expected_games * d
+    assert p.decayed_win_rate("opp") == pytest.approx(wins / games)
+    assert p.decayed_win_rate("opp") < 1.0
+
+
+# ----------------------------------------------------------------- match identity
+def test_match_key_and_seed_determinism():
+    assert match_key("a", "b", 3, 1) == "a|b|r3e1"
+    # the seed is symmetric in the pair (home seat alternates by round) and
+    # distinct across rounds, so every scenario set is fresh but replayable
+    assert match_seed("a", "b", 0) == match_seed("b", "a", 0)
+    assert match_seed("a", "b", 0) != match_seed("a", "b", 1)
+
+
+# --------------------------------------------------------------------- scheduling
+def test_scheduler_is_pure_in_reported_state(registry):
+    store = ArenaStore()
+    players = ["main:2", "main:1"]
+    first = store.next_match(players, episodes=4)
+    # re-asking without reporting returns the identical assignment — the
+    # property that makes kill/restart exactly-once
+    assert store.next_match(players, episodes=4) == first
+    assert store.next_match(players, episodes=4) == first
+    # cold start goes through the anchor floor: newest generation vs anchor
+    assert {first["home"], first["away"]} == {"main:2", ANCHORS[0]}
+    assert first["round"] == 0
+    assert first["seed"] == match_seed(first["home"], first["away"], 0)
+
+
+def test_scheduler_widest_ci_and_anchor_floor(registry):
+    store = ArenaStore(anchor_period=4)
+    players = ["main:1"]
+
+    def play(assignment, winner="home", episodes=4):
+        recs = [{"key": match_key(assignment["home"], assignment["away"],
+                                  assignment["round"], i),
+                 "home": assignment["home"], "away": assignment["away"],
+                 "round": assignment["round"], "winner": winner,
+                 "game_steps": 10, "duration_s": 0.1}
+                for i in range(episodes)]
+        return store.report_batch(recs)
+
+    a0 = store.next_match(players)   # completed=0 -> anchor floor
+    assert {a0["home"], a0["away"]} == {"main:1", "attack_nearest"}
+    assert play(a0) == {"applied": 4, "duplicates": 0}
+    # completed=1: widest-CI pick among unplayed pairs (width 1.0), ties
+    # break lexicographically -> (attack_nearest, idle)
+    a1 = store.next_match(players)
+    assert {a1["home"], a1["away"]} == {"attack_nearest", "idle"}
+    play(a1)
+    a2 = store.next_match(players)   # next unplayed pair
+    assert {a2["home"], a2["away"]} == {"idle", "main:1"}
+    play(a2)
+    # all pairs played 4 games each; a lopsided pair (p-hat at 0) has a
+    # NARROWER Wilson interval than a balanced one, so the drawn pair wins
+    store.report_batch([
+        {"key": match_key("idle", "main:1", 9, i), "home": "idle",
+         "away": "main:1", "round": 9, "winner": "draw",
+         "game_steps": 10, "duration_s": 0.1} for i in range(4)])
+    a3 = store.next_match(players)
+    assert {a3["home"], a3["away"]} == {"idle", "main:1"}
+    # round advanced past every applied round for the pair
+    assert a3["round"] == 10
+
+
+def test_report_batch_dedups_by_key(registry):
+    store = ArenaStore()
+    recs = [{"key": match_key("a", "b", 0, i), "home": "a", "away": "b",
+             "round": 0, "winner": "home", "game_steps": 5,
+             "duration_s": 0.1} for i in range(3)]
+    assert store.report_batch(recs) == {"applied": 3, "duplicates": 0}
+    # byte-identical replay (the crashed-after-ack evaluator): all deduped
+    assert store.report_batch(recs) == {"applied": 0, "duplicates": 3}
+    assert store.matches_total == 3
+    assert store.duplicates_total == 3
+    snap = store.ratings_snapshot()
+    assert snap["players"]["a"]["games"] == 3
+    # ELO moved for exactly 3 games, not 6
+    assert store.elo.game_count == 3
+
+
+def test_store_durability_roundtrip(registry, tmp_path):
+    path = str(tmp_path / "arena.journal")
+    store = ArenaStore(path=path)
+    recs = [{"key": match_key("a", "b", 0, i), "home": "a", "away": "b",
+             "round": 0, "winner": "home" if i else "draw", "game_steps": 7,
+             "duration_s": 0.2} for i in range(4)]
+    store.report_batch(recs)
+    store.save()
+
+    fresh = ArenaStore(path=path)
+    assert fresh.maybe_load()
+    assert fresh.ratings_snapshot() == store.ratings_snapshot()
+    assert fresh.payoff_snapshot() == store.payoff_snapshot()
+    # idempotency survives the restart: the seen-key set is journaled
+    assert fresh.report_batch(recs) == {"applied": 0, "duplicates": 4}
+    # and the scheduler resumes from the same round counters
+    assert fresh.next_match(["a", "b"]) == store.next_match(["a", "b"])
+
+
+def test_pfsp_preview_matches_hand_computed_variance_weights(registry):
+    """GET /arena/payoff's read-only PFSP preview must equal the paper's
+    variance weighting w*(1-w) over merged winrates, normalized, with 0.5
+    for unplayed pairs."""
+    store = ArenaStore(anchors=())  # no anchors: exact 3-player matrix
+    for i in range(4):  # A beats B 3-1
+        store.report_batch([{
+            "key": match_key("A", "B", i, 0), "home": "A", "away": "B",
+            "round": i, "winner": "home" if i else "away",
+            "game_steps": 5, "duration_s": 0.1}])
+    for i in range(2):  # A draws C twice
+        store.report_batch([{
+            "key": match_key("A", "C", i, 0), "home": "A", "away": "C",
+            "round": i, "winner": "draw", "game_steps": 5,
+            "duration_s": 0.1}])
+    snap = store.payoff_snapshot()
+    pv = snap["pfsp_preview"]
+    # A's winrates: vs B = 0.75, vs C = 0.5 -> weights 0.1875, 0.25
+    wb, wc = 0.75 * 0.25, 0.5 * 0.5
+    assert pv["A"]["B"] == pytest.approx(wb / (wb + wc))
+    assert pv["A"]["C"] == pytest.approx(wc / (wb + wc))
+    # B: vs A = 0.25, vs C unplayed -> 0.5 prior
+    wa, wc = 0.25 * 0.75, 0.5 * 0.5
+    assert pv["B"]["A"] == pytest.approx(wa / (wa + wc))
+    assert pv["B"]["C"] == pytest.approx(wc / (wa + wc))
+    assert snap["pfsp_weighting"] == "variance"
+    for row in pv.values():
+        assert sum(row.values()) == pytest.approx(1.0)
+
+
+def test_default_rulebook_carries_arena_rules():
+    rules = {r.name: r for r in default_rulebook()}
+    reg = rules["arena_rating_regression"]
+    assert reg.metric == "distar_arena_main_rating_inverted"
+    assert reg.op == "trending_up"
+    stall = rules["arena_match_stall"]
+    assert stall.metric == "distar_arena_matches_applied"
+    assert stall.op == "stalled"
+
+
+# ------------------------------------------------------- head_to_head match stats
+def test_head_to_head_reports_per_match_stats(registry):
+    from distar_tpu.envs.jaxenv.winrate import (attack_nearest_policy,
+                                                idle_policy, head_to_head)
+
+    res = head_to_head(attack_nearest_policy(), idle_policy(), episodes=4,
+                       seed=3, env_cfg=TINY_ENV, scenario_cfg=TINY_SCN)
+    assert len(res["matches"]) == 4
+    counts = {"home": 0, "away": 0, "draw": 0}
+    for m in res["matches"]:
+        counts[m["winner"]] += 1
+        assert m["draw"] == (m["winner"] == "draw")
+        assert 0 < m["game_steps"] <= TINY_SCN.episode_len
+    assert counts["home"] == res["wins"]
+    assert counts["away"] == res["losses"]
+    assert counts["draw"] == res["draws"]
+    assert res["mean_game_steps"] == pytest.approx(
+        np.mean([m["game_steps"] for m in res["matches"]]))
+    assert res["duration_s"] > 0.0
+
+
+# ------------------------------------------------------- chaos drill in-process twin
+def test_evaluator_kill_restart_twin(registry, tmp_path):
+    """In-process twin of ``tools/chaos.py arena-drill``: an evaluator that
+    dies mid-batch (assignment taken + scenario run, nothing reported)
+    loses nothing — the restarted evaluator re-receives the identical
+    assignment, and a replayed ack dedups 100%."""
+    store = ArenaStore(path=str(tmp_path / "journal"))
+    ckpt = str(tmp_path / "ckpt")  # empty -> anchors-only roster
+    os.makedirs(ckpt)
+
+    def make_eval():
+        return ArenaEvaluator(ckpt, model_cfg={}, store=store, episodes=3,
+                              env_cfg=TINY_ENV, scenario_cfg=TINY_SCN)
+
+    ev1 = make_eval()
+    first = ev1.evaluate_once()
+    assert first["ack"] == {"applied": 3, "duplicates": 0}
+
+    # mid-batch death: take the assignment, never report (whole-batch
+    # atomicity means the store is untouched)
+    doomed = store.next_match(ev1.refresh_roster(), episodes=3)
+    assert store.matches_total == 3
+
+    ev2 = make_eval()  # the supervisor's restart
+    second = ev2.evaluate_once()
+    # the identical assignment is re-issued — the hole is filled exactly
+    assert second["assignment"] == doomed
+    assert second["ack"] == {"applied": 3, "duplicates": 0}
+    assert store.matches_total == 6
+    assert store.duplicates_total == 0
+
+    # crashed-after-ack replay: same keys, fully deduped, totals unchanged
+    home, away = doomed["home"], doomed["away"]
+    replay = [{"key": match_key(home, away, doomed["round"], i),
+               "home": home, "away": away, "round": doomed["round"],
+               "winner": "draw", "game_steps": 1, "duration_s": 0.0}
+              for i in range(3)]
+    assert store.report_batch(replay) == {"applied": 0, "duplicates": 3}
+    assert store.matches_total == 6
+
+
+# ------------------------------------------------------------------ e2e acceptance
+def _save_generations(ckpt_dir, params, steps):
+    from distar_tpu.utils.checkpoint import CheckpointManager, save_checkpoint
+
+    mgr = CheckpointManager(ckpt_dir)
+    for g, step in enumerate(steps):
+        gen = jax.tree.map(
+            lambda x, g=g: x + 0.01 * g
+            if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating)
+            else x, params)
+        path = os.path.join(ckpt_dir, f"gen_{step}.ckpt")
+        save_checkpoint(path, gen)
+        mgr.record(path, step=step)
+
+
+def _init_toy_params(model, env_cfg, scenario_cfg):
+    from functools import partial
+
+    from distar_tpu.envs.jaxenv.core import reset
+    from distar_tpu.envs.jaxenv.obs import observe
+    from distar_tpu.envs.jaxenv.scenario import ScenarioGenerator
+    from distar_tpu.envs.jaxenv.winrate import model_policy
+
+    gen = ScenarioGenerator(scenario_cfg)
+    keys = jax.random.split(jax.random.PRNGKey(0), 2)
+    states = jax.vmap(partial(reset, env_cfg))(jax.vmap(gen.generate)(keys))
+    obs = jax.vmap(partial(observe, env_cfg), in_axes=(0, None))(states, 0)
+    carry = model_policy(model, None).init_carry(2)
+    return model.init(jax.random.PRNGKey(1), obs["spatial_info"],
+                      obs["entity_info"], obs["scalar_info"],
+                      obs["entity_num"], carry, jax.random.PRNGKey(2), None,
+                      method=model.sample_action)
+
+
+def test_arena_e2e_generations_vs_anchors(registry, tmp_path, capsys):
+    """The PR's acceptance run: 3 toy checkpoint generations + 2 scripted
+    anchors play a scheduled arena on jaxenv; attack_nearest out-rates idle
+    with confidence; the matrix is non-trivial; ratings survive a
+    coordinator restart; HTTP + opsctl consumption surfaces render."""
+    from distar_tpu.comm.coordinator import CoordinatorServer
+    from distar_tpu.model import Model, default_model_config
+    from distar_tpu.utils import deep_merge_dicts
+
+    fh = FleetHealth(rules=default_rulebook(), registry=registry)
+    prev_fh = set_fleet_health(fh)
+    journal = str(tmp_path / "arena.journal")
+    ckpt_dir = str(tmp_path / "ckpt")
+    os.makedirs(ckpt_dir)
+    store = ArenaStore(path=journal)
+    set_arena_store(store)
+    srv = CoordinatorServer()
+    srv.start()
+    try:
+        # phase A: anchors-only ladder — every batch is the scripted pair;
+        # scripted episodes are cheap, so this phase banks the statistical
+        # power (48 games) that grounds the confidence assertion below
+        ev_a = ArenaEvaluator(ckpt_dir, model_cfg=SMALL_MODEL, store=store,
+                              episodes=16, env_cfg=TINY_ENV,
+                              scenario_cfg=FIGHT_SCN)
+        for _ in range(3):
+            out = ev_a.evaluate_once()
+            assert {out["assignment"]["home"], out["assignment"]["away"]} \
+                == set(ANCHORS)
+            assert out["ack"]["duplicates"] == 0
+        # phase B: three toy generations join mid-flight (roster refresh);
+        # model batches are compile-dominated, so they run lean (4 episodes)
+        model = Model(deep_merge_dicts(default_model_config(), SMALL_MODEL))
+        params = _init_toy_params(model, TINY_ENV, FIGHT_SCN)
+        _save_generations(ckpt_dir, params, steps=(100, 200, 300))
+        ev_b = ArenaEvaluator(ckpt_dir, model_cfg=SMALL_MODEL, store=store,
+                              episodes=4, env_cfg=TINY_ENV,
+                              scenario_cfg=FIGHT_SCN)
+        played = []
+        for _ in range(4):
+            out = ev_b.evaluate_once()
+            played.append((out["assignment"]["home"],
+                           out["assignment"]["away"]))
+            assert out["ack"]["duplicates"] == 0
+        # every generation met at least one anchor (rating scale grounded)
+        met = {p for pair in played for p in pair}
+        assert {"main:100", "main:200", "main:300"} <= met
+
+        assert store.matches_total == 3 * 16 + 4 * 4
+        assert store.duplicates_total == 0
+        ratings = store.ratings_snapshot()
+        atk, idl = (ratings["players"]["attack_nearest"],
+                    ratings["players"]["idle"])
+        assert atk["elo"] > idl["elo"]
+        assert atk["trueskill_exposed"] > idl["trueskill_exposed"]
+        # ... with confidence: the anchor pair's Wilson interval excludes 0.5
+        payoff = store.payoff_snapshot()
+        cell = next(c for c in payoff["cells"]
+                    if {c["a"], c["b"]} == set(ANCHORS))
+        assert cell["games"] == 48
+        atk_low = (cell["wilson_low"] if cell["a"] == "attack_nearest"
+                   else 1.0 - cell["wilson_high"])
+        assert atk_low > 0.5
+        # non-trivial matrix: several distinct pairs actually played
+        assert sum(1 for c in payoff["cells"] if c["games"]) >= 4
+
+        # durable restart: a fresh store reloads the journal bit-for-bit
+        store.save()
+        fresh = ArenaStore(path=journal)
+        assert fresh.maybe_load()
+        assert fresh.ratings_snapshot() == ratings
+        assert fresh.payoff_snapshot() == payoff
+
+        # HTTP consumption surfaces over the real coordinator
+        def get(route):
+            with urllib.request.urlopen(
+                    f"http://{srv.host}:{srv.port}{route}", timeout=10) as r:
+                return json.loads(r.read().decode())
+
+        assert get("/arena/ratings") == ratings
+        served = get("/arena/payoff")
+        assert served == payoff
+        # PFSP preview re-derived from the served matrix itself
+        wrs, opps = [], []
+        for c in served["cells"]:
+            if "attack_nearest" in (c["a"], c["b"]):
+                wr = (c["win_rate"] if c["a"] == "attack_nearest"
+                      else 1.0 - c["win_rate"])
+                opps.append(c["b"] if c["a"] == "attack_nearest" else c["a"])
+                wrs.append(wr)
+        raw = [w * (1.0 - w) for w in wrs]
+        for opp, r in zip(opps, raw):
+            assert served["pfsp_preview"]["attack_nearest"][opp] == \
+                pytest.approx(r / sum(raw))
+
+        # scoreboard from shipped TSDB series: sample the registry into the
+        # fleet TSDB (what the coordinator's sampler thread does), then
+        # render via the real opsctl CLI surface against the live server
+        fh.sampler.sample_once()
+        fh.sampler.sample_once()
+        sys.path.insert(0, os.path.join(_REPO, "tools"))
+        try:
+            import opsctl
+        finally:
+            sys.path.pop(0)
+        rc = opsctl.cmd_arena(Namespace(addr=f"{srv.host}:{srv.port}",
+                                        window=600.0, json=False))
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "attack_nearest" in out and "idle" in out
+        assert "rating trajectories (TSDB):" in out
+        assert "pfsp preview" in out
+        # the status digest line rides the same route
+        opsctl._print_arena_digest(f"{srv.host}:{srv.port}")
+        dig = capsys.readouterr().out
+        assert "arena: 64 matches" in dig
+    finally:
+        srv.stop()
+        set_arena_store(None)
+        set_fleet_health(prev_fh)
+        fh.stop()

@@ -199,3 +199,77 @@ def test_curve_gate_runs_green_on_committed_artifacts():
     assert {"sl_total_loss", "rl_total_loss", "distill_kl"} <= set(fams)
     _, failures = perf_gate.curve_verdicts(fams, tolerance=0.10)
     assert failures == []
+
+
+ARENA_ARTIFACT = os.path.join(REPO, "ARENA_r18.json")
+
+
+def _arena_doc(anchor_relative, player="main:300", matches=12):
+    return {"bench": "arena", "metric": "arena match throughput",
+            "value": 0.5, "unit": "matches/s", "host_cores": 1,
+            "scaling_valid": False,
+            "arena": {"player": player, "matches": matches,
+                      "anchor": "mean(attack_nearest,idle)",
+                      "anchor_relative": anchor_relative}}
+
+
+@pytest.mark.skipif(not os.path.exists(ARENA_ARTIFACT),
+                    reason="no committed arena skill artifact")
+def test_skill_gate_passes_on_committed_artifact():
+    entries = perf_gate.collect_skill()
+    assert any(e["artifact"] == "ARENA_r18.json" for e in entries)
+    assert entries[-1]["player"].startswith("main:")
+    verdicts, failures = perf_gate.skill_verdicts(entries, tolerance=50.0)
+    assert failures == []
+    assert verdicts and verdicts[0]["regressed"] is False
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "perf_gate.py"),
+         "skill"], capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "skill gate: PASS" in proc.stdout
+
+
+def test_skill_gate_fails_on_injected_regression(tmp_path):
+    (tmp_path / "ARENA_r18.json").write_text(json.dumps(_arena_doc(-100.0)))
+    (tmp_path / "ARENA_r19.json").write_text(
+        json.dumps(_arena_doc(-200.0, player="main:400")))
+    entries = perf_gate.collect_skill(repo=str(tmp_path))
+    assert [e["round"] for e in entries] == ["18", "19"]
+    verdicts, failures = perf_gate.skill_verdicts(entries, tolerance=50.0)
+    assert len(failures) == 1 and "regressed past" in failures[0]
+    assert verdicts[0]["regressed"] is True
+    # a 100-point drop inside a 150-point tolerance is absorbed
+    _, failures = perf_gate.skill_verdicts(entries, tolerance=150.0)
+    assert failures == []
+    # and through the CLI, end to end (exit code contract: 1 = regression)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "perf_gate.py"),
+         "skill", "--repo", str(tmp_path)],
+        capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "REGRESSED" in proc.stdout
+
+
+def test_skill_gate_single_round_is_baseline_pass(tmp_path):
+    (tmp_path / "ARENA_r18.json").write_text(json.dumps(_arena_doc(-250.0)))
+    verdicts, failures = perf_gate.skill_verdicts(
+        perf_gate.collect_skill(repo=str(tmp_path)), tolerance=50.0)
+    assert failures == [] and verdicts[0]["note"] == "single round: baseline PASS"
+
+
+def test_skill_gate_rejects_nonfinite(tmp_path):
+    (tmp_path / "ARENA_r18.json").write_text(
+        json.dumps(_arena_doc(float("nan"))))
+    _, failures = perf_gate.skill_verdicts(
+        perf_gate.collect_skill(repo=str(tmp_path)), tolerance=50.0)
+    assert any("non-finite" in f for f in failures)
+
+
+@pytest.mark.skipif(not os.path.exists(ARENA_ARTIFACT),
+                    reason="no committed arena skill artifact")
+def test_skill_trajectory_rows_present():
+    rows = perf_gate.collect_trajectory()
+    arena_rows = [r for r in rows if r["artifact"] == "ARENA_r18.json"]
+    units = {r["unit"] for r in arena_rows}
+    assert "matches/s" in units, "headline throughput row missing"
+    assert "elo" in units, "in-band anchor-relative skill row missing"

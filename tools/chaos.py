@@ -20,6 +20,8 @@ faults tests already prove survivable:
         [--module spatial_encoder] [--pre-steps 3] [--post-steps 3]
   python tools/chaos.py elastic-drill --dir /tmp/el_drill [--sessions 14] \\
         [--slots 8] [--items 60]
+  python tools/chaos.py arena-drill --dir /tmp/arena_drill [--batches 4] \\
+        [--episodes 6] [--kill-after 1]
 
 ``corrupt`` damages a checkpoint in place (the resume path must fall back);
 ``kill`` sends a signal to a role process (the supervisor/orchestrator must
@@ -1091,6 +1093,187 @@ def cmd_dynamics_drill(args) -> int:
     return 0 if not failures else 1
 
 
+# evaluator child for the arena drill: a REAL subprocess speaking the real
+# arena_next/arena_report wire plane, killable with SIGKILL mid-batch.
+# Anchors-only roster (the checkpoint dir is empty) so no model compiles.
+_ARENA_CHILD = r"""
+import os, sys, time
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+repo = sys.argv[1]
+if repo not in sys.path:
+    sys.path.insert(0, repo)
+host, port = sys.argv[2], int(sys.argv[3])
+ckpt, batches, episodes = sys.argv[4], int(sys.argv[5]), int(sys.argv[6])
+units, ep_len = int(sys.argv[7]), int(sys.argv[8])
+from distar_tpu.arena import ArenaEvaluator
+from distar_tpu.envs.jaxenv import EnvConfig, ScenarioConfig
+ev = ArenaEvaluator(
+    ckpt, model_cfg={}, coordinator_addr=(host, port), episodes=episodes,
+    env_cfg=EnvConfig(units_per_squad=units),
+    scenario_cfg=ScenarioConfig(units_per_squad=units, max_units=units,
+                                episode_len=ep_len))
+done = 0
+while done < batches:
+    print("BATCH_START %d" % done, flush=True)
+    out = ev.evaluate_once()
+    if out is None:
+        time.sleep(0.2)
+        continue
+    ack = out["ack"]
+    print("BATCH_DONE %d applied=%d duplicates=%d"
+          % (done, ack["applied"], ack["duplicates"]), flush=True)
+    done += 1
+print("EVAL_EXIT", flush=True)
+"""
+
+
+def cmd_arena_drill(args) -> int:
+    """Kill an arena evaluator mid-batch and restart it: zero lost and zero
+    double-counted matches by idempotent-key construction.
+
+    Stands up a real coordinator hosting a durable ArenaStore, runs a real
+    evaluator subprocess (anchors-only roster: scripted policies, no model
+    loads) over the real ``arena_next``/``arena_report`` wire plane, and
+    SIGKILLs it shortly after a batch starts — the assignment is taken and
+    the scenario is running, but nothing is reported. The restarted
+    evaluator must re-receive the identical assignment (scheduling is a
+    pure function of *reported* state) and finish the run with EXACT
+    accounting:
+
+      (a) applied matches == scheduled matches (zero lost);
+      (b) zero idempotent-key duplicates during normal operation, and a
+          deliberately replayed ack — the whole last batch re-sent over the
+          wire, as a crashed-after-report evaluator would — dedups 100%
+          with the match total unchanged (zero double-counted);
+      (c) the round counter advanced exactly once per completed batch;
+      (d) the journal reloads into a fresh store that STILL dedups the
+          replayed batch (idempotency survives a coordinator restart)."""
+    import subprocess
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.makedirs(args.dir, exist_ok=True)
+
+    from distar_tpu.arena import (ArenaStore, match_key, set_arena_store)
+    from distar_tpu.comm.coordinator import (CoordinatorServer,
+                                             coordinator_request)
+
+    journal = os.path.join(args.dir, "arena.journal")
+    ckpt_dir = os.path.join(args.dir, "ckpt")  # empty -> anchors-only roster
+    os.makedirs(ckpt_dir, exist_ok=True)
+    store = ArenaStore(path=journal)
+    set_arena_store(store)
+    srv = CoordinatorServer()
+    srv.start()
+    inj = ChaosInjector(seed=args.seed)
+    episodes = int(args.episodes)
+
+    def spawn(batches: int):
+        return subprocess.Popen(
+            [sys.executable, "-c", _ARENA_CHILD, _REPO, srv.host,
+             str(srv.port), ckpt_dir, str(batches), str(episodes), "2", "12"],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+            bufsize=1, cwd=_REPO, env={**os.environ, "JAX_PLATFORMS": "cpu"})
+
+    failures = []
+    proc = proc2 = None
+    try:
+        kill_after = max(1, int(args.kill_after))
+        total = max(kill_after + 1, int(args.batches))
+        proc = spawn(total)
+        assert proc.stdout is not None
+        for line in proc.stdout:
+            if line.startswith(f"BATCH_START {kill_after}"):
+                break
+        time.sleep(args.kill_delay_s)  # land inside the running scenario
+        inj.kill_role(proc.pid, sig=signal.SIGKILL, name="arena-evaluator")
+        proc.wait(timeout=60)
+        matches_at_kill = store.matches_total
+        killed_mid_batch = matches_at_kill < (kill_after + 1) * episodes
+        # the hole the restarted evaluator must fill: pure re-ask, no state
+        hole = store.next_match([], episodes=episodes)
+        if hole is None:
+            failures.append("store refused to re-issue the lost assignment")
+
+        proc2 = spawn(total - matches_at_kill // episodes)
+        out2, _ = proc2.communicate(timeout=args.timeout_s)
+        if proc2.returncode != 0:
+            failures.append(f"restarted evaluator exited {proc2.returncode}")
+        if "duplicates=0" not in out2 or "EVAL_EXIT" not in out2:
+            failures.append(f"restarted evaluator log unexpected: {out2!r}")
+
+        expected = total * episodes
+        if store.matches_total != expected:
+            failures.append(f"lost matches: applied {store.matches_total}, "
+                            f"scheduled {expected}")
+        if store.duplicates_total != 0:
+            failures.append(f"{store.duplicates_total} duplicates during "
+                            "normal operation (keys must be unique)")
+        if len(store._seen) != expected:
+            failures.append(f"seen-key set has {len(store._seen)} entries, "
+                            f"wanted {expected} distinct keys")
+        pair = tuple(sorted(store.anchors))
+        rounds = store._next_round.get(pair)
+        if rounds != total:
+            failures.append(f"round counter at {rounds}, wanted {total} "
+                            "(one advance per completed batch)")
+        if hole is not None:
+            refilled = [match_key(hole["home"], hole["away"], hole["round"], i)
+                        in store._seen for i in range(episodes)]
+            if not all(refilled):
+                failures.append(f"re-issued assignment {hole} not fully "
+                                f"applied after restart: {refilled}")
+
+        # the double-count arm: replay the final batch's ack over the wire,
+        # exactly as an evaluator killed AFTER reporting would on restart
+        last = total - 1
+        home, away = pair if last % 2 == 0 else (pair[1], pair[0])
+        replay = [{"key": match_key(home, away, last, i), "home": home,
+                   "away": away, "round": last, "winner": "draw",
+                   "game_steps": 1, "duration_s": 0.0}
+                  for i in range(episodes)]
+        resp = coordinator_request(srv.host, srv.port, "arena_report",
+                                   {"matches": replay})
+        ack = resp.get("info") if resp.get("code") == 0 else None
+        if not ack or ack.get("applied") != 0 \
+                or ack.get("duplicates") != episodes:
+            failures.append(f"replayed ack was not fully deduped: {resp}")
+        if store.matches_total != expected:
+            failures.append("replayed ack double-counted matches")
+
+        # idempotency must survive a coordinator restart via the journal
+        store.save()
+        fresh = ArenaStore(path=journal)
+        fresh.maybe_load()
+        ack2 = fresh.report_batch(replay)
+        if fresh.matches_total != expected or ack2["applied"] != 0:
+            failures.append(f"journal reload lost idempotency: "
+                            f"matches={fresh.matches_total}, ack={ack2}")
+
+        verdict = {
+            "batches": total, "episodes": episodes,
+            "killed_after_batch": kill_after,
+            "killed_mid_batch": killed_mid_batch,
+            "matches_at_kill": matches_at_kill,
+            "matches_applied": store.matches_total,
+            "duplicates": store.duplicates_total,
+            "replayed_ack_deduped": bool(ack and ack.get("duplicates") == episodes),
+            "events": [e["kind"] for e in inj.events],
+            "failures": failures,
+        }
+        print(json.dumps(verdict, default=str))
+        print("verdict: evaluator killed mid-batch and restarted; zero lost, "
+              "zero double-counted, replayed ack deduped before and after a "
+              "journal reload" if not failures
+              else f"verdict: DRILL FAILED {failures}")
+        return 0 if not failures else 1
+    finally:
+        for p_ in (proc, proc2):
+            if p_ is not None and p_.poll() is None:
+                p_.kill()
+        srv.stop()
+        set_arena_store(None)
+
+
 def cmd_latest(args) -> int:
     mgr = CheckpointManager(args.dir)
     gens = mgr.generations()
@@ -1195,6 +1378,25 @@ def main() -> int:
                    help="clean steps after (debounce must hold at 1 bundle)")
     y.add_argument("--seed", type=int, default=0)
 
+    a = sub.add_parser(
+        "arena-drill",
+        help="kill an arena evaluator mid-batch, restart it, prove zero "
+             "lost / zero double-counted matches by idempotent keys")
+    a.add_argument("--dir", required=True, help="scratch directory (journal "
+                   "+ empty checkpoint dir live here)")
+    a.add_argument("--batches", type=int, default=4,
+                   help="total scenario batches the run must complete")
+    a.add_argument("--episodes", type=int, default=6,
+                   help="episodes per batch (matches per assignment)")
+    a.add_argument("--kill-after", type=int, default=1,
+                   help="SIGKILL the evaluator when this batch STARTS "
+                        "(this many batches already reported)")
+    a.add_argument("--kill-delay-s", type=float, default=0.2,
+                   help="wait this long after BATCH_START before the kill")
+    a.add_argument("--seed", type=int, default=0)
+    a.add_argument("--timeout-s", type=float, default=900.0,
+                   help="restarted evaluator wall budget")
+
     m = sub.add_parser("multichip-drill",
                        help="kill a multichip learner after a sharded save; "
                             "prove resume on a DIFFERENT mesh shape")
@@ -1220,6 +1422,7 @@ def main() -> int:
             "shm-drill": cmd_shm_drill,
             "elastic-drill": cmd_elastic_drill,
             "dynamics-drill": cmd_dynamics_drill,
+            "arena-drill": cmd_arena_drill,
             "multichip-drill": cmd_multichip_drill}[args.command](args)
 
 

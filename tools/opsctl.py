@@ -399,6 +399,79 @@ def _print_distill_digest(addr: str) -> None:
             print("  canary split: none (pct=0)")
 
 
+def _print_arena_digest(addr: str) -> None:
+    """Arena digest for ``status``: match accounting + the current top of
+    the ladder — read off the coordinator's GET /arena/ratings route
+    (absent when no arena store is hosted there)."""
+    body = _try_get(addr, "/arena/ratings")
+    if not body:
+        return
+    players = body.get("players") or {}
+    top = max(players.items(), key=lambda kv: kv[1].get("elo", 0.0))[0] \
+        if players else "-"
+    print(f"arena: {body.get('matches_total', 0)} matches "
+          f"({body.get('duplicates_total', 0)} deduped) "
+          f"players={len(players)} top={top}")
+
+
+def cmd_arena(args) -> int:
+    """The arena scoreboard: rating ladder, payoff matrix with Wilson
+    intervals, PFSP preview weights, and rating-over-time trajectories from
+    the shipped ``distar_arena_*`` TSDB series."""
+    ratings = _get(args.addr, "/arena/ratings")
+    payoff = _get(args.addr, "/arena/payoff")
+    if args.json:
+        print(json.dumps({"ratings": ratings, "payoff": payoff}, indent=1))
+        return 0
+    players = ratings.get("players") or {}
+    print(f"arena scoreboard  ({ratings.get('matches_total', 0)} matches, "
+          f"{ratings.get('duplicates_total', 0)} duplicates deduped)")
+    print(f"  {'player':<24} {'elo':>8} {'trueskill':>10} {'games':>6}")
+    ordered = sorted(players.items(), key=lambda kv: -kv[1].get("elo", 0.0))
+    for pid, row in ordered:
+        tag = "  (anchor)" if row.get("anchor") else ""
+        print(f"  {pid:<24} {row.get('elo', 0.0):>8.1f} "
+              f"{row.get('trueskill_exposed', 0.0):>10.2f} "
+              f"{row.get('games', 0):>6}{tag}")
+    cells = payoff.get("cells") or []
+    if cells:
+        print("payoff matrix (a-perspective, draws count half):")
+        for c in cells:
+            if not c.get("games"):
+                continue
+            print(f"  {c['a']:<20} vs {c['b']:<20} "
+                  f"wr={c['win_rate']:.3f} "
+                  f"ci=[{c['wilson_low']:.3f},{c['wilson_high']:.3f}] "
+                  f"n={c['games']}")
+    preview = payoff.get("pfsp_preview") or {}
+    if preview:
+        print(f"pfsp preview ({payoff.get('pfsp_weighting', 'variance')} "
+              f"weighting):")
+        for pid in sorted(preview):
+            row = " ".join(f"{o}={w:.3f}"
+                           for o, w in sorted(preview[pid].items()))
+            print(f"  {pid:<24} {row}")
+    # rating-over-time from the shipped TSDB series: the coordinator's
+    # registry sampler turns every distar_arena_rating_elo gauge into a
+    # series per player — the learning-curve view of the ladder
+    shown = False
+    for pid, _ in ordered:
+        name = urllib.parse.quote(f"distar_arena_rating_elo{{player={pid}}}")
+        body = _try_get(args.addr,
+                        f"/timeseries?name={name}&window_s={args.window}")
+        for source, pts in ((body or {}).get("points") or {}).items():
+            if not pts:
+                continue
+            if not shown:
+                print("rating trajectories (TSDB):")
+                shown = True
+            first, last = pts[0][1], pts[-1][1]
+            print(f"  {pid:<24} [{source}] {len(pts)} pts  "
+                  f"{first:.1f} -> {last:.1f}  "
+                  f"({'+' if last >= first else ''}{last - first:.1f})")
+    return 0
+
+
 def _print_actor_digest(addr: str) -> None:
     """Actor-throughput digest from the probed TSDB: env-steps/s, the
     rollout-plane backend serving the fleet, plane sample rates per
@@ -628,6 +701,9 @@ def cmd_status(args) -> int:
     # tree): per-learner grad norm / update ratio / clip fraction, top
     # loss heads, last anomaly + bundle count
     _print_dynamics_digest(args.addr)
+    # skill-ledger digest (present when the probed coordinator hosts the
+    # arena store): match accounting + the ladder's current top
+    _print_arena_digest(args.addr)
     _print_perf_digest(args.addr)
     _print_actor_digest(args.addr)
     return {"ok": 0, "warning": 1}.get(status, 2)
@@ -763,7 +839,8 @@ def main() -> int:
     p = argparse.ArgumentParser(description=__doc__,
                                 formatter_class=argparse.RawDescriptionHelpFormatter)
     p.add_argument("command", choices=("status", "tail-alerts", "query",
-                                       "profile", "trace", "dynamics"))
+                                       "profile", "trace", "dynamics",
+                                       "arena"))
     p.add_argument("--addr", default="127.0.0.1:8423", help="host:port of a health surface")
     p.add_argument("--interval", type=float, default=2.0, help="tail-alerts poll cadence")
     p.add_argument("--once", action="store_true",
@@ -812,6 +889,8 @@ def main() -> int:
         return cmd_profile(args)
     if args.command == "trace":
         return cmd_trace(args)
+    if args.command == "arena":
+        return cmd_arena(args)
     if not args.name:
         p.error("query requires --name")
     return cmd_query(args)

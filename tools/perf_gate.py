@@ -336,6 +336,7 @@ def collect_trajectory(repo: str = _REPO) -> List[dict]:
                        + glob.glob(os.path.join(repo, "DISTILL_r*.json"))
                        + glob.glob(os.path.join(repo, "DYNAMICS_r*.json"))
                        + glob.glob(os.path.join(repo, "ANAKIN_r*.json"))
+                       + glob.glob(os.path.join(repo, "ARENA_r*.json"))
                        + glob.glob(os.path.join(repo, "artifacts", "perf_baseline*.json"))
                        + glob.glob(os.path.join(repo, "artifacts", "dynamics_*.json"))
                        + glob.glob(os.path.join(repo, "artifacts", "curves_r*.json"))
@@ -345,7 +346,8 @@ def collect_trajectory(repo: str = _REPO) -> List[dict]:
                        + glob.glob(os.path.join(repo, "artifacts", "shm_*.json"))
                        + glob.glob(os.path.join(repo, "artifacts", "trace_*.json"))
                        + glob.glob(os.path.join(repo, "artifacts", "distill_*.json"))
-                       + glob.glob(os.path.join(repo, "artifacts", "anakin_*.json"))):
+                       + glob.glob(os.path.join(repo, "artifacts", "anakin_*.json"))
+                       + glob.glob(os.path.join(repo, "artifacts", "arena_*.json"))):
         try:
             doc = load_artifact(path)
         except (OSError, ValueError):
@@ -441,6 +443,20 @@ def collect_trajectory(repo: str = _REPO) -> List[dict]:
                            f"device_pure={bool(anakin.get('device_pure'))})"),
                 "value": anakin.get("fused_vs_actor")
                 or anakin["fused_vs_host"], "unit": "x",
+                "status": _status_of(doc),
+            })
+        arena = doc.get("arena") or {}
+        if arena.get("anchor_relative") is not None:
+            # the arena artifact carries the skill ledger in-band; surface
+            # the newest generation's anchor-relative rating as its own
+            # trajectory row (`perf_gate skill` gates it across rounds)
+            rows.append({
+                "round": _round_of(path), "artifact": os.path.basename(path),
+                "metric": (f"arena anchor-relative rating of newest "
+                           f"generation ({arena.get('player', '?')}; "
+                           f"{arena.get('matches', '?')} matches vs "
+                           f"{arena.get('anchor', 'anchors')})"),
+                "value": arena["anchor_relative"], "unit": "elo",
                 "status": _status_of(doc),
             })
         fast = doc.get("replay_fast_path") or {}
@@ -590,6 +606,105 @@ def cmd_curve(args) -> int:
     return 0 if not failures else 1
 
 
+def collect_skill(repo: str = _REPO) -> List[dict]:
+    """Committed arena skill ledgers, one entry per round: the newest
+    generation's anchor-relative ELO from ``ARENA_r*.json`` /
+    ``artifacts/arena_*.json`` in-band ``arena`` blocks."""
+    entries: List[dict] = []
+    for path in sorted(glob.glob(os.path.join(repo, "ARENA_r*.json"))
+                       + glob.glob(os.path.join(repo, "artifacts",
+                                                "arena_*.json"))):
+        try:
+            doc = load_artifact(path)
+        except (OSError, ValueError):
+            continue
+        arena = doc.get("arena") or {}
+        value = arena.get("anchor_relative")
+        if value is None:
+            continue
+        entries.append({
+            "round": _round_of(path), "artifact": os.path.basename(path),
+            "player": arena.get("player", "?"),
+            "matches": arena.get("matches"),
+            "value": float(value),
+        })
+    entries.sort(key=lambda e: (e["round"].zfill(3), e["artifact"]))
+    return entries
+
+
+def skill_verdicts(entries: List[dict],
+                   tolerance: float) -> Tuple[List[dict], List[str]]:
+    """The skill gate, round-over-round like ``curve``: the NEWEST round's
+    anchor-relative rating may not fall more than ``tolerance`` ELO points
+    below the previous round's. A single round is its own baseline — PASS.
+    Non-finite ratings always fail."""
+    failures: List[str] = []
+    for e in entries:
+        if not math.isfinite(e["value"]):
+            failures.append(f"non-finite anchor-relative rating in "
+                            f"{e['artifact']}")
+    verdicts: List[dict] = []
+    if entries:
+        verdict = {
+            "rounds": [e["round"] for e in entries],
+            "first": entries[0]["value"],
+            "last": entries[-1]["value"],
+            "player": entries[-1]["player"],
+        }
+        if len(entries) >= 2:
+            base, cand = entries[-2], entries[-1]
+            allowed = base["value"] - tolerance
+            verdict.update({
+                "baseline_round": base["round"],
+                "baseline_value": base["value"],
+                "candidate_round": cand["round"],
+                "candidate_value": cand["value"],
+                "allowed": allowed,
+                "regressed": cand["value"] < allowed,
+            })
+            if cand["value"] < allowed:
+                failures.append(
+                    f"skill: round {cand['round']} anchor-relative rating "
+                    f"{cand['value']:g} regressed past round "
+                    f"{base['round']}'s {base['value']:g} "
+                    f"(allowed {allowed:g} at tolerance {tolerance:g} elo)")
+        else:
+            verdict["regressed"] = False
+            verdict["note"] = "single round: baseline PASS"
+        verdicts.append(verdict)
+    return verdicts, failures
+
+
+def cmd_skill(args) -> int:
+    repo = getattr(args, "repo", "") or _REPO
+    entries = collect_skill(repo)
+    if not entries:
+        print("no committed arena skill ledgers "
+              "(ARENA_r*.json, artifacts/arena_*.json)")
+        return 1
+    verdicts, failures = skill_verdicts(entries, args.tolerance)
+    if args.json:
+        print(json.dumps({"entries": entries, "verdicts": verdicts,
+                          "failures": failures}, indent=1))
+    else:
+        for e in entries:
+            print(f"  r{e['round']:<4} {e['artifact']:<24} "
+                  f"{e['player']:<16} anchor-relative={e['value']:g}")
+        for v in verdicts:
+            if "candidate_value" in v:
+                print(f"  gate: r{v['baseline_round']} "
+                      f"{v['baseline_value']:g} -> r{v['candidate_round']} "
+                      f"{v['candidate_value']:g} (allowed {v['allowed']:g})"
+                      f"{'  REGRESSED' if v['regressed'] else ''}")
+            else:
+                print(f"  gate: {v.get('note', '')}")
+        for f in failures:
+            print(f"  FAIL: {f}")
+    print("skill gate: PASS" if not failures
+          else f"skill gate: FAIL ({len(failures)} offence(s))")
+    return 0 if not failures else 1
+
+
 def cmd_trajectory(args) -> int:
     rows = collect_trajectory()
     table = render_trajectory(rows)
@@ -640,9 +755,22 @@ def main() -> int:
                          "final value vs the previous round's (default 10%%)")
     pu.add_argument("--json", action="store_true",
                     help="print verdicts as one JSON object")
+    pk = sub.add_parser("skill",
+                        help="arena skill gate: the newest generation's "
+                             "anchor-relative rating must not regress "
+                             "round-over-round")
+    pk.add_argument("--tolerance", type=float, default=50.0,
+                    help="allowed anchor-relative ELO drop vs the previous "
+                         "round (default 50 points — jaxenv scenario noise)")
+    pk.add_argument("--repo", default="",
+                    help="sweep this tree instead of the repo root "
+                         "(hermetic tests)")
+    pk.add_argument("--json", action="store_true",
+                    help="print entries/verdicts as one JSON object")
     args = p.parse_args()
     return {"check": cmd_check, "trajectory": cmd_trajectory,
-            "scaling": cmd_scaling, "curve": cmd_curve}[args.command](args)
+            "scaling": cmd_scaling, "curve": cmd_curve,
+            "skill": cmd_skill}[args.command](args)
 
 
 if __name__ == "__main__":
