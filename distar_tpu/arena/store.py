@@ -346,33 +346,30 @@ class ArenaStore:
         return best[1] or None
 
     # -------------------------------------------------------------- durability
-    def save(self, path: Optional[str] = None) -> str:
-        """Atomic journal (tmp+fsync+rename via the storage layer): a
-        coordinator killed mid-save leaves the previous journal intact."""
-        from ..utils import storage
+    def _state_locked(self) -> dict:
+        return {
+            "pairs": dict(self._pairs),
+            "next_round": dict(self._next_round),
+            "seen": list(self._seen.keys()),
+            "elo": self.elo,
+            "trueskill": self.trueskill,
+            "payoffs": self.payoffs,
+            "matches_total": self.matches_total,
+            "duplicates_total": self.duplicates_total,
+        }
 
-        path = path or self.path
-        assert path, "ArenaStore.save needs a path"
+    def state_blob(self) -> dict:
+        """Detached full-ledger state — the HA snapshot payload (journal
+        snapshots and the warm-standby follower feed both carry it). The
+        pickle round-trip detaches the live ladder objects so later matches
+        can't mutate a snapshot already handed out."""
         with self._lock:
-            blob = pickle.dumps({
-                "pairs": dict(self._pairs),
-                "next_round": dict(self._next_round),
-                "seen": list(self._seen.keys()),
-                "elo": self.elo,
-                "trueskill": self.trueskill,
-                "payoffs": self.payoffs,
-                "matches_total": self.matches_total,
-                "duplicates_total": self.duplicates_total,
-            })
-        storage.write_bytes(path, blob)
-        return path
+            return pickle.loads(pickle.dumps(self._state_locked()))
 
-    def load(self, path: Optional[str] = None) -> None:
-        from ..utils import storage
-
-        path = path or self.path
-        assert path, "ArenaStore.load needs a path"
-        data = pickle.loads(storage.read_bytes(path))
+    def load_state(self, data: dict) -> None:
+        """Adopt a ``state_blob()``/journal payload wholesale — ratings,
+        payoff matrix, round counters AND the seen-key set, so idempotent
+        dedup keeps holding across restarts and failovers."""
         with self._lock:
             self._pairs = dict(data["pairs"])
             self._next_round = dict(data["next_round"])
@@ -383,6 +380,25 @@ class ArenaStore:
             self.matches_total = int(data["matches_total"])
             self.duplicates_total = int(data["duplicates_total"])
         self._publish_metrics()
+
+    def save(self, path: Optional[str] = None) -> str:
+        """Atomic journal (tmp+fsync+rename via the storage layer): a
+        coordinator killed mid-save leaves the previous journal intact."""
+        from ..utils import storage
+
+        path = path or self.path
+        assert path, "ArenaStore.save needs a path"
+        with self._lock:
+            blob = pickle.dumps(self._state_locked())
+        storage.write_bytes(path, blob)
+        return path
+
+    def load(self, path: Optional[str] = None) -> None:
+        from ..utils import storage
+
+        path = path or self.path
+        assert path, "ArenaStore.load needs a path"
+        self.load_state(pickle.loads(storage.read_bytes(path)))
 
     def maybe_load(self) -> bool:
         """Load the journal at ``self.path`` if present; False otherwise."""

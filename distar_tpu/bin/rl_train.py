@@ -571,6 +571,11 @@ def run_all(args) -> None:
 
 
 def _addr(s: str):
+    """``"host:port"`` -> ``(host, port)``; an HA comma list
+    (``"h1:p1,h2:p2"``) passes through as ``(spec, None)``, which
+    ``coordinator_request`` resolves with leadership failover."""
+    if "," in s:
+        return s, None
     host, _, port = s.rpartition(":")
     return host or "127.0.0.1", int(port)
 
@@ -833,8 +838,32 @@ def main() -> None:
                    help="coordinator role: lease TTL for registrations; "
                         "endpoints that stop heartbeating are evicted "
                         "(0 = leases disabled)")
+    p.add_argument("--journal-dir", default="",
+                   help="coordinator role: write-ahead-journal directory "
+                        "(comm/ha.py) — every mutating route is journaled, "
+                        "a restart replays it, and standbys can tail it "
+                        "('' = in-memory broker, the pre-HA behavior)")
+    p.add_argument("--ha-role", default="auto",
+                   choices=("auto", "primary", "standby"),
+                   help="coordinator HA role: auto probes --ha-peers and "
+                        "joins a live primary as standby, else leads")
+    p.add_argument("--ha-peers", default="",
+                   help="comma list of peer coordinator host:port addrs "
+                        "(the other members of the HA pair/set)")
+    p.add_argument("--ha-port", type=int, default=0,
+                   help="journal follower-feed TCP port (0 = ephemeral; "
+                        "peers discover it via GET /coordinator/ha)")
+    p.add_argument("--ha-advertise", default="",
+                   help="host:port this coordinator advertises to peers "
+                        "and clients (default 127.0.0.1:--port)")
+    p.add_argument("--ha-takeover-grace-s", type=float, default=3.0,
+                   help="standby promotes after this long without contact "
+                        "from the primary's follower feed")
     p.add_argument("--league-addr", default="", help="host:port of the league server")
-    p.add_argument("--coordinator-addr", default="", help="host:port of the coordinator")
+    p.add_argument("--coordinator-addr", default="",
+                   help="host:port of the coordinator (HA fleets: a comma "
+                        "list 'h1:p1,h2:p2' — clients follow leadership "
+                        "across failovers)")
     p.add_argument("--plane", default="inline",
                    choices=("inline", "local", "remote"),
                    help="rollout inference plane backend (docs/serving.md): "
@@ -1028,16 +1057,35 @@ def main() -> None:
                       flush=True)
             store.start_autosave(interval_s=args.league_autosave_s or 30.0)
             set_arena_store(store)
-        server = CoordinatorServer(
-            coordinator=Coordinator(default_lease_s=args.lease_s or None),
-            port=args.port,
-        )
+        co = Coordinator(default_lease_s=args.lease_s or None)
+        server = CoordinatorServer(coordinator=co, port=args.port)
+        ha_state = None
+        if args.journal_dir:
+            # HA broker: journal every mutating route, replay on restart,
+            # serve the follower feed; with --ha-peers, lease-based
+            # leadership + epoch fencing (docs/resilience.md)
+            from ..comm.ha import HAState
+
+            ha_state = HAState(
+                co, args.journal_dir,
+                advertise=args.ha_advertise or f"127.0.0.1:{server.port}",
+                feed_port=args.ha_port,
+                peers=[p for p in (args.ha_peers or "").split(",") if p],
+                role=args.ha_role,
+                takeover_grace_s=args.ha_takeover_grace_s,
+            )
+            ha_state.boot()
+            server.attach_ha(ha_state)
+            print(f"coordinator HA: role={ha_state.role} "
+                  f"epoch={ha_state.epoch} journal={args.journal_dir} "
+                  f"feed=:{ha_state.feed_port}", flush=True)
         server.start()
         print(f"coordinator serving on {server.host}:{server.port}", flush=True)
-        if args.arena_store:
-            # a drained broker must not lose the tail of the match ledger:
-            # turn SIGTERM into SystemExit so the final journal below runs
-            # (SIGKILL still loses at most one autosave interval)
+        if args.arena_store or ha_state is not None:
+            # a drained broker must not lose the tail of the match ledger
+            # or the journal: turn SIGTERM into SystemExit so the final
+            # journaling below runs (SIGKILL is exactly what the WAL and
+            # the arena autosave bound the damage of)
             import signal as _signal
             import sys as _sys
 
@@ -1049,6 +1097,10 @@ def main() -> None:
             if args.arena_store:
                 store.save()
                 print("arena store journaled on shutdown", flush=True)
+            if ha_state is not None:
+                ha_state.final_snapshot()
+                ha_state.stop()
+                print("coordinator journal snapshotted on shutdown", flush=True)
     elif args.type == "arena":
         if not (args.coordinator_addr and args.arena_ckpt_dir):
             raise SystemExit(

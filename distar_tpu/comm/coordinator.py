@@ -34,8 +34,12 @@ class Coordinator:
         self._default_lease_s = default_lease_s
         self._records: Dict[str, deque] = defaultdict(lambda: deque(maxlen=self._maxlen))
         self._strikes: Dict[str, int] = defaultdict(int)
-        self._leases: Dict[str, float] = {}  # "ip:port" -> expiry ts
-        self._last_sweep = 0.0
+        # "ip:port" -> monotonic expiry: lease bookkeeping rides
+        # time.monotonic() so an NTP step can neither mass-evict a healthy
+        # fleet nor immortalize dead endpoints (record *ages* in depth()/
+        # stats() stay wall-clock — they describe data, not liveness)
+        self._leases: Dict[str, float] = {}
+        self._last_sweep = 0.0  # monotonic
         self._evict_callbacks: list = []
         self._lock = threading.RLock()
 
@@ -59,25 +63,43 @@ class Coordinator:
 
     def register(self, token: str, ip: str, port: int, meta: Optional[dict] = None,
                  lease_s: Optional[float] = None) -> bool:
+        return self.apply_register(token, ip, port, meta, lease_s=lease_s)
+
+    def apply_register(self, token: str, ip: str, port: int,
+                       meta: Optional[dict] = None, lease_s: Optional[float] = None,
+                       record_ts: Optional[float] = None) -> bool:
+        """``register`` plus the journal-replay re-aging hook: ``record_ts``
+        (the original wall time from the WAL record) anchors both the record
+        timestamp and the lease, so a replayed registration whose lease
+        already lapsed during the outage expires on the first sweep instead
+        of getting a fresh TTL."""
         lease_s = self._default_lease_s if lease_s is None else lease_s
+        now = time.time()
+        ts = now if record_ts is None else record_ts
         with self._lock:
             self._records[token].append(
-                {"ip": ip, "port": port, "meta": meta or {}, "ts": time.time()}
+                {"ip": ip, "port": port, "meta": meta or {}, "ts": ts}
             )
             if lease_s is not None:
-                self._leases[f"{ip}:{port}"] = time.time() + lease_s
+                self._leases[f"{ip}:{port}"] = \
+                    time.monotonic() + lease_s - (now - ts)
             return True
 
     def heartbeat(self, ip: str, port: int, lease_s: Optional[float] = None) -> bool:
         """Refresh an endpoint's lease. Returns True when the broker still
         holds records for that endpoint — False tells a producer its state
         is gone (broker restarted or evicted) and it must re-register."""
+        return self.apply_heartbeat(ip, port, lease_s=lease_s)
+
+    def apply_heartbeat(self, ip: str, port: int, lease_s: Optional[float] = None,
+                        record_ts: Optional[float] = None) -> bool:
         lease_s = self._default_lease_s if lease_s is None else lease_s
+        age = 0.0 if record_ts is None else max(0.0, time.time() - record_ts)
         key = f"{ip}:{port}"
         with self._lock:
             self._sweep_leases()
             if lease_s is not None:
-                self._leases[key] = time.time() + lease_s
+                self._leases[key] = time.monotonic() + lease_s - age
             from ..obs import get_registry
 
             get_registry().counter(
@@ -104,7 +126,7 @@ class Coordinator:
         """Evict endpoints whose lease expired (at most once per
         ``min_interval_s`` — called from the hot read paths). Caller holds
         lock."""
-        now = time.time()
+        now = time.monotonic()
         if now - self._last_sweep < min_interval_s:
             return
         self._last_sweep = now
@@ -206,6 +228,36 @@ class Coordinator:
                 for token, q in self._records.items()
             }
 
+    def state_snapshot(self) -> dict:
+        """Full broker state in wire/journal-safe form (HA snapshots and the
+        follower feed). Lease expiries cross the process boundary as
+        *remaining seconds* — monotonic readings are meaningless in another
+        process, wall timestamps would re-import the NTP hazard."""
+        with self._lock:
+            mono = time.monotonic()
+            return {
+                "records": {t: [dict(r) for r in q]
+                            for t, q in self._records.items() if q},
+                "strikes": dict(self._strikes),
+                "lease_remaining": {k: exp - mono
+                                    for k, exp in self._leases.items()},
+            }
+
+    def restore_state(self, state: dict) -> None:
+        """Adopt a ``state_snapshot()`` wholesale (journal recovery or a
+        standby receiving the leader's snapshot)."""
+        with self._lock:
+            self._records = defaultdict(lambda: deque(maxlen=self._maxlen))
+            for token, recs in (state.get("records") or {}).items():
+                self._records[token].extend(dict(r) for r in recs)
+            self._strikes = defaultdict(int)
+            self._strikes.update(state.get("strikes") or {})
+            mono = time.monotonic()
+            self._leases = {
+                k: mono + float(rem)
+                for k, rem in (state.get("lease_remaining") or {}).items()
+            }
+
     def publish_metrics(self, registry=None) -> None:
         """Refresh ``distar_coordinator_queue_depth{token=...}`` gauges (and
         the strike gauge) — called by the /metrics route at scrape time."""
@@ -235,6 +287,11 @@ class CoordinatorServer:
 
         self.coordinator = coordinator or Coordinator()
         co = self.coordinator
+        # HA is attached after construction (attach_ha) because HAState
+        # needs this server's bound port for its advertise addr; the box
+        # lets the request handlers see the attachment without a rebuild
+        ha_box: dict = {"ha": None}
+        self._ha_box = ha_box
 
         def _ingest_telemetry(msg: dict) -> int:
             # fold shipped snapshots into the process fleet store: the broker
@@ -254,11 +311,16 @@ class CoordinatorServer:
         co.add_evict_callback(_evict_telemetry)
 
         routes = {
-            "register": lambda b: co.register(**b),
+            # explicit-arg extraction (not **b): a wire body must not be
+            # able to reach internal kwargs like apply_register's record_ts
+            "register": lambda b: co.register(
+                b["token"], b["ip"], b["port"],
+                meta=b.get("meta"), lease_s=b.get("lease_s")),
             "ask": lambda b: co.ask(b["token"]),
             "peers": lambda b: co.peers(b["token"]),
             "strike": lambda b: co.strike(b["ip"], b["port"]),
-            "heartbeat": lambda b: co.heartbeat(**b),
+            "heartbeat": lambda b: co.heartbeat(
+                b["ip"], b["port"], lease_s=b.get("lease_s")),
             "unregister": lambda b: co.unregister(b["ip"], b["port"]),
             # absent max_age_s -> the coordinator's own default filter, so
             # HTTP callers and in-process callers see identical accounting
@@ -301,6 +363,20 @@ class CoordinatorServer:
 
                 if self.path.rstrip("/") == "/metrics":
                     write_scrape_response(self, refresh=co.publish_metrics)
+                    return
+                if self.path.rstrip("/") == "/coordinator/ha":
+                    # leadership digest (standby probes, client boot-strapping,
+                    # opsctl status): role/epoch/journal seq/feed addr/lag —
+                    # 404 when this coordinator runs without HA
+                    from ..obs import write_json_response
+
+                    ha_state = ha_box["ha"]
+                    if ha_state is None:
+                        self.send_response(404)
+                        self.send_header("Content-Length", "0")
+                        self.end_headers()
+                        return
+                    write_json_response(self, ha_state.status())
                     return
                 if self.path.rstrip("/") == "/autoscaler":
                     # elastic-control-plane digest (opsctl status reads it):
@@ -367,14 +443,32 @@ class CoordinatorServer:
                     else:
                         body = json.loads(raw or b"{}")
                     fn = routes.get(name)
-                    payload = (
-                        {"code": 404, "info": f"no route {name}"}
-                        if fn is None
-                        else {"code": 0, "info": fn(body)}
-                    )
+                    ha_state = ha_box["ha"]
+                    if fn is None:
+                        payload = {"code": 404, "info": f"no route {name}"}
+                    elif ha_state is not None:
+                        from .ha import NotLeader
+
+                        try:
+                            payload = {"code": 0,
+                                       "info": ha_state.dispatch(name, body, fn)}
+                        except NotLeader as e:
+                            # typed redirect: clients follow the hint under
+                            # the retry fabric instead of seeing a 500
+                            payload = {"code": 2, "info": "not_leader",
+                                       "leader": e.leader}
+                            outcome = "not_leader"
+                    else:
+                        payload = {"code": 0, "info": fn(body)}
                 except Exception as e:
                     payload = {"code": 1, "info": repr(e)}
                     outcome = "error"
+                ha_state = ha_box["ha"]
+                if ha_state is not None:
+                    # the fencing stamp: every reply carries the epoch so a
+                    # deposed primary's answers are detectably stale
+                    payload.setdefault("epoch", ha_state.epoch)
+                    payload.setdefault("role", ha_state.role)
                 data = json.dumps(payload, default=str).encode()
                 self.send_response(200)
                 self.send_header("Content-Type", "application/json")
@@ -388,6 +482,16 @@ class CoordinatorServer:
         self._server = ThreadingHTTPServer((host, port), Handler)
         self.host, self.port = self._server.server_address
         self._thread: Optional[threading.Thread] = None
+
+    def attach_ha(self, ha_state) -> None:
+        """Wire a booted :class:`distar_tpu.comm.ha.HAState` into request
+        dispatch: POSTs route through its journal/leadership contract and
+        every reply is epoch-stamped. Attach before ``start()``."""
+        self._ha_box["ha"] = ha_state
+
+    @property
+    def ha(self):
+        return self._ha_box["ha"]
 
     def start(self):
         self._thread = threading.Thread(target=self._server.serve_forever, daemon=True)
@@ -428,18 +532,81 @@ def _coordinator_request_once(host: str, port: int, route: str,
         raise CommError(f"{op} @ {host}:{port} failed: {e!r}", op=op, cause=e) from e
 
 
-def coordinator_request(host: str, port: int, route: str, body: Optional[dict] = None,
-                        timeout=10.0, policy=None):
+def _failover_request_once(targets, route: str, body: Optional[dict],
+                           timeout: float) -> dict:
+    """One HA-aware attempt against the believed-primary of an addr set:
+    transport failures rotate the target (ambiguous acks on non-idempotent
+    routes surface typed instead), ``not_leader`` replies follow the
+    leadership hint, and replies whose epoch is below the highest ever seen
+    are discarded — all raised as typed retryables so the PR 4 fabric
+    drives the redirect loop."""
+    from ..resilience import CommError
+    from . import ha as _ha
+
+    host, port = targets.active()
+    addr = f"{host}:{port}"
+    try:
+        reply = _coordinator_request_once(host, port, route, body, timeout)
+    except CommError as e:
+        targets.rotate((host, port))
+        if route not in _ha.IDEMPOTENT_ROUTES and _ha.is_ambiguous(e):
+            # the primary died between send and reply: an `ask` may have
+            # popped a record whose reply we never saw — retrying on the
+            # standby would consume a SECOND record, so refuse typed
+            raise _ha.AmbiguousAckError(route, addr, cause=e) from e
+        raise
+    epoch = reply.get("epoch")
+    if epoch is not None:
+        epoch = int(epoch)
+        if targets.is_stale(epoch):
+            # a deposed primary still answering: fence it out
+            from ..obs import get_registry
+
+            get_registry().counter(
+                "distar_coordinator_ha_stale_replies_total",
+                "replies discarded for carrying a deposed primary's epoch",
+            ).inc()
+            targets.rotate((host, port))
+            raise _ha.StaleEpochError(addr, epoch, targets.max_epoch)
+        targets.note_epoch(epoch)
+    if reply.get("code") == 2 and reply.get("info") == "not_leader":
+        targets.follow(str(reply.get("leader") or ""), (host, port))
+        raise _ha.NotLeaderError(addr, str(reply.get("leader") or ""),
+                                 int(epoch if epoch is not None else -1))
+    return reply
+
+
+def coordinator_request(host: str, port: Optional[int], route: str,
+                        body: Optional[dict] = None, timeout=10.0, policy=None):
     """Broker RPC under the resilience retry fabric.
 
     Default policy rides through a several-second broker restart
     (``resilience.DEFAULT_COMM_POLICY``); pass ``resilience.NO_RETRY`` for a
     single attempt. Raises ``resilience.CommError`` (a ``ConnectionError``
     subclass, so legacy ``except OSError`` sites still catch it) once the
-    policy is exhausted."""
+    policy is exhausted.
+
+    HA fleets pass a comma list of coordinators — ``("h1:p1,h2:p2", None)``
+    or ``"h1:p1,h2:p2"`` as ``host`` with ``port=None`` — and the call
+    follows leadership across failovers (``not_leader`` redirects, epoch
+    fencing, ambiguous-ack typing for non-idempotent routes). A single
+    ``(host, port)`` keeps the exact pre-HA behavior."""
     from ..resilience import DEFAULT_COMM_POLICY, retry_call
 
+    op = f"coordinator:{route}"
+    if port is None or (isinstance(host, str) and "," in host):
+        from . import ha as _ha
+
+        spec = host if port is None else f"{host}:{port}"
+        addrs = _ha.parse_addrs(spec)
+        if len(addrs) > 1:
+            targets = _ha.targets_for(addrs)
+            return retry_call(
+                _failover_request_once, targets, route, body, timeout,
+                op=op, policy=policy or DEFAULT_COMM_POLICY,
+            )
+        host, port = addrs[0]
     return retry_call(
         _coordinator_request_once, host, port, route, body, timeout,
-        op=f"coordinator:{route}", policy=policy or DEFAULT_COMM_POLICY,
+        op=op, policy=policy or DEFAULT_COMM_POLICY,
     )

@@ -20,6 +20,22 @@ import threading
 from typing import List, Optional, Tuple
 
 
+def _norm_addr(coordinator_addr) -> Tuple[str, Optional[int]]:
+    """Accept the classic ``(host, port)`` tuple, a ``"host:port"`` string,
+    or an HA comma list (``"h1:p1,h2:p2"`` or ``("h1:p1,h2:p2", None)``) and
+    return the pair ``coordinator_request`` expects — ``port=None`` marks an
+    HA spec the request layer resolves with leadership failover."""
+    if isinstance(coordinator_addr, str):
+        if "," in coordinator_addr:
+            return coordinator_addr, None
+        host, _, port = coordinator_addr.rpartition(":")
+        return host or "127.0.0.1", int(port)
+    host, port = coordinator_addr
+    if port is None or (isinstance(host, str) and "," in host):
+        return str(host), None
+    return host, int(port)
+
+
 def register_endpoint(coordinator_addr: Tuple[str, int], token: str, host: str,
                       port: int, meta: Optional[dict] = None,
                       lease_s: Optional[float] = None,
@@ -33,7 +49,7 @@ def register_endpoint(coordinator_addr: Tuple[str, int], token: str, host: str,
     pass your own) to end the keep-alive."""
     from .coordinator import coordinator_request
 
-    chost, cport = coordinator_addr
+    chost, cport = _norm_addr(coordinator_addr)
     body = {"token": token, "ip": host, "port": port, "meta": meta or {}}
     if lease_s:
         body["lease_s"] = lease_s
@@ -49,7 +65,15 @@ def register_endpoint(coordinator_addr: Tuple[str, int], token: str, host: str,
                     hb["lease_s"] = lease_s
                 alive = coordinator_request(chost, cport, "heartbeat", hb)
                 if not (alive or {}).get("info", False):
+                    # broker lost our records (restart / failover to a
+                    # standby that missed us): re-register, and nudge any
+                    # telemetry shippers in this process to re-ship their
+                    # full snapshot — the restarted broker would otherwise
+                    # show this source stale until the next natural ship
                     coordinator_request(chost, cport, "register", body)
+                    from ..obs.shipper import request_resync_all
+
+                    request_resync_all("heartbeat")
             except Exception:  # noqa: BLE001 - keep-alive must never crash a role
                 continue
 
@@ -69,7 +93,7 @@ def unregister_endpoint(coordinator_addr: Tuple[str, int], host: str,
     paths treat that as best-effort (the lease still lapses)."""
     from .coordinator import coordinator_request
 
-    chost, cport = coordinator_addr
+    chost, cport = _norm_addr(coordinator_addr)
     reply = coordinator_request(chost, cport, "unregister",
                                 {"ip": host, "port": port})
     return int(reply.get("info") or 0)
@@ -107,6 +131,6 @@ def discover_endpoints(coordinator_addr: Tuple[str, int], token: str) -> List[di
     whether an empty fleet is an error."""
     from .coordinator import coordinator_request
 
-    host, port = coordinator_addr
+    host, port = _norm_addr(coordinator_addr)
     reply = coordinator_request(host, port, "peers", {"token": token})
     return list(reply.get("info") or [])

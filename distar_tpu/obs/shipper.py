@@ -20,12 +20,51 @@ from __future__ import annotations
 
 import threading
 import time
+import weakref
 from typing import Optional, Tuple
 
 from .registry import MetricsRegistry, get_registry
 from .timeseries import TimeSeriesStore
 
 SERIALIZED_CONTENT_TYPE = "application/x-distar-serialized"
+
+# every running shipper in this process, so a broker restart/failover can
+# nudge them all to re-ship immediately (weak: a dropped shipper unregisters
+# itself by dying)
+_ACTIVE_LOCK = threading.Lock()
+_ACTIVE_SHIPPERS: "weakref.WeakSet" = weakref.WeakSet()
+_FAILOVER_HOOK_INSTALLED = False
+
+
+def request_resync_all(reason: str) -> int:
+    """Ask every active shipper in this process to re-ship its full registry
+    snapshot NOW (out of cycle) — called when discovery's heartbeat learns
+    the broker lost our records (``reason="heartbeat"``) and when the HA
+    client fails over to a new primary (``reason="failover"``). A restarted
+    or newly-promoted broker would otherwise show every source stale until
+    the next natural ship interval. Returns the number of shippers nudged."""
+    with _ACTIVE_LOCK:
+        shippers = list(_ACTIVE_SHIPPERS)
+    for s in shippers:
+        s.request_resync(reason)
+    return len(shippers)
+
+
+def _install_failover_hook() -> None:
+    """One-time: subscribe to client-side coordinator failovers so shippers
+    resync the moment a new primary is adopted. Lazy + best-effort (obs must
+    stay importable without comm)."""
+    global _FAILOVER_HOOK_INSTALLED
+    with _ACTIVE_LOCK:
+        if _FAILOVER_HOOK_INSTALLED:
+            return
+        _FAILOVER_HOOK_INSTALLED = True
+    try:
+        from ..comm import ha
+
+        ha.add_failover_listener(lambda _targets: request_resync_all("failover"))
+    except Exception:  # noqa: BLE001 - telemetry must not break on comm shape
+        pass
 
 
 class TelemetryIngest:
@@ -133,7 +172,20 @@ class TelemetryShipper:
         #: broker can reclaim this source's series when the lease goes
         self.endpoint = endpoint
         self._stop = threading.Event()
+        self._wake = threading.Event()  # out-of-cycle ship trigger (resync)
+        self._pending_lock = threading.Lock()
+        self._resync_reasons: list = []
         self._thread: Optional[threading.Thread] = None
+
+    def request_resync(self, reason: str) -> None:
+        """Schedule an immediate full-snapshot ship (every ship already IS a
+        full registry snapshot — a resync is simply an out-of-cycle one) and
+        count it under ``distar_obs_shipper_resyncs_total{reason}`` once it
+        lands."""
+        with self._pending_lock:
+            if reason not in self._resync_reasons:
+                self._resync_reasons.append(reason)
+        self._wake.set()
 
     # ------------------------------------------------------------------- wire
     def _message(self) -> dict:
@@ -176,6 +228,19 @@ class TelemetryShipper:
             from ..resilience import CommError
 
             host, port = self._addr
+            targets = None
+            if port is None or (isinstance(host, str) and "," in host):
+                # HA fleet: ship to the believed-primary of the addr set and
+                # share the process-wide leadership view with every other
+                # coordinator client (comm.ha failover state)
+                from ..comm import ha as _ha
+
+                addrs = _ha.parse_addrs(host if port is None else f"{host}:{port}")
+                if len(addrs) > 1:
+                    targets = _ha.targets_for(addrs)
+                    host, port = targets.active()
+                else:
+                    host, port = addrs[0]
             req = urllib.request.Request(
                 f"http://{host}:{port}/coordinator/telemetry",
                 data=serializer.dumps(msg),
@@ -190,10 +255,20 @@ class TelemetryShipper:
                 decoded = json.loads(reply)
             except (urllib.error.URLError, ConnectionError, TimeoutError,
                     OSError, ValueError) as e:
+                if targets is not None:
+                    targets.rotate((host, port))
                 raise CommError(
                     f"telemetry ship @ {host}:{port} failed: {e!r}",
                     op="telemetry_ship", cause=e,
                 ) from e
+            if decoded.get("code") == 2 and targets is not None:
+                # a standby answered: adopt its leadership hint and let the
+                # retry policy re-ship to the new primary (telemetry is
+                # ephemeral by contract, so a lost tick costs nothing)
+                targets.follow(str(decoded.get("leader") or ""), (host, port))
+                raise CommError(
+                    f"telemetry ship @ {host}:{port}: not_leader",
+                    op="telemetry_ship")
             if decoded.get("code") != 0:
                 raise RuntimeError(f"telemetry ingest rejected: {decoded!r}")
             n = int(decoded.get("info") or 0)
@@ -224,24 +299,58 @@ class TelemetryShipper:
                                  deadline_s=self._timeout_s)
             breaker = CircuitBreaker(op="telemetry_ship",
                                      reset_after_s=4 * self.interval_s)
-            while not self._stop.wait(self.interval_s):
+            prev_failed = False
+            while True:
+                self._wake.wait(self.interval_s)
+                self._wake.clear()
+                if self._stop.is_set():
+                    break
+                with self._pending_lock:
+                    reasons, self._resync_reasons = self._resync_reasons, []
                 try:
                     retry_call(self.ship_once, op="telemetry_ship",
                                policy=policy, breaker=breaker)
+                    if prev_failed and "recovered" not in reasons:
+                        # first successful ship after an outage is itself a
+                        # resync: the broker just regained this source
+                        reasons.append("recovered")
+                    prev_failed = False
+                    for reason in reasons:
+                        reg.counter(
+                            "distar_obs_shipper_resyncs_total",
+                            "full-snapshot re-ships after broker restart "
+                            "or failover", reason=reason,
+                        ).inc()
                 except (CommError, CircuitOpenError):
                     errors.inc()
+                    prev_failed = True
                 except Exception:
                     # anything else (rejected ingest, codec bug): counted,
                     # never propagated — telemetry must not take the fleet
                     # down with it
                     errors.inc()
+                    prev_failed = True
+                if prev_failed and reasons:
+                    # a requested resync is still owed: re-queue it so the
+                    # next successful ship counts it
+                    with self._pending_lock:
+                        for reason in reasons:
+                            if reason not in self._resync_reasons:
+                                self._resync_reasons.append(reason)
 
         self._thread = threading.Thread(target=run, daemon=True, name="obs-shipper")
         self._thread.start()
+        with _ACTIVE_LOCK:
+            _ACTIVE_SHIPPERS.add(self)
+        if self._addr is not None:
+            _install_failover_hook()
         return self
 
     def stop(self) -> None:
         self._stop.set()
+        self._wake.set()
+        with _ACTIVE_LOCK:
+            _ACTIVE_SHIPPERS.discard(self)
         if self._thread is not None:
             self._thread.join(timeout=5.0)
             self._thread = None

@@ -1,0 +1,402 @@
+"""Coordinator HA: write-ahead journal recovery, warm-standby failover,
+epoch fencing, ambiguous-ack typing, monotonic lease bookkeeping, and the
+route-classification lint (distar_tpu/comm/ha.py; docs/resilience.md)."""
+import os
+import sys
+import time
+
+import pytest
+
+from distar_tpu.comm import Coordinator, CoordinatorServer, coordinator_request
+from distar_tpu.comm import coordinator as coordinator_mod
+from distar_tpu.comm import discovery, ha
+from distar_tpu.resilience import CommError
+
+TOOLS = os.path.join(os.path.dirname(__file__), "..", "tools")
+
+
+@pytest.fixture(autouse=True)
+def _fresh_targets():
+    ha.reset_targets()
+    yield
+    ha.reset_targets()
+
+
+# ------------------------------------------------------------- address parsing
+def test_parse_addrs_forms():
+    assert ha.parse_addrs("h1:1,h2:2") == (("h1", 1), ("h2", 2))
+    assert ha.parse_addrs("h1:1") == (("h1", 1),)
+    assert ha.parse_addrs(("h1", 9)) == (("h1", 9),)
+    assert ha.parse_addrs([("a", 1), "b:2"]) == (("a", 1), ("b", 2))
+    assert ha.parse_addrs(":7") == (("127.0.0.1", 7),)  # default host
+    with pytest.raises(ValueError):
+        ha.parse_addrs("")
+    assert ha.format_addrs((("a", 1), ("b", 2))) == "a:1,b:2"
+
+
+def test_discovery_norm_addr():
+    assert discovery._norm_addr(("h", 5)) == ("h", 5)
+    assert discovery._norm_addr("h:5") == ("h", 5)
+    # HA comma specs come back with port=None — the request layer's marker
+    assert discovery._norm_addr("a:1,b:2") == ("a:1,b:2", None)
+    assert discovery._norm_addr(("a:1,b:2", None)) == ("a:1,b:2", None)
+
+
+# ------------------------------------------------------------------ journaling
+def test_journal_roundtrip_snapshot_and_compaction(tmp_path):
+    root = str(tmp_path / "j")
+    j = ha.Journal(root, snapshot_every=4)
+    for i in range(3):
+        j.append("register", {"token": "t", "ip": f"10.0.0.{i}", "port": i})
+    j.snapshot({"state": {"marker": 3}})
+    for i in range(3, 6):
+        j.append("register", {"token": "t", "ip": f"10.0.0.{i}", "port": i})
+    j.close()
+
+    j2 = ha.Journal(root)
+    base, records = j2.recover()
+    assert base is not None and base["state"]["marker"] == 3
+    # only the post-snapshot tail replays; seq continues where we left off
+    assert [r["body"]["port"] for r in records] == [3, 4, 5]
+    assert j2.seq == 6
+    # compaction keeps at most the two newest snapshots
+    j2.snapshot({"state": 1})
+    j2.snapshot({"state": 2})
+    j2.snapshot({"state": 3})
+    snaps = [f for f in os.listdir(root) if f.startswith("snap.")]
+    assert len(snaps) <= 2
+    j2.close()
+
+
+def test_journal_torn_tail_discarded(tmp_path):
+    root = str(tmp_path / "j")
+    j = ha.Journal(root)
+    j.append("register", {"token": "t", "ip": "a", "port": 1})
+    j.append("register", {"token": "t", "ip": "b", "port": 2})
+    j.close()
+    seg = sorted(p for p in os.listdir(root) if p.startswith("wal."))[0]
+    path = os.path.join(root, seg)
+    # tear the last record mid-payload: the crash-before-ack shape
+    with open(path, "r+b") as f:
+        f.truncate(os.path.getsize(path) - 3)
+    base, records = ha.Journal(root).recover()
+    assert base is None
+    assert [r["body"]["ip"] for r in records] == ["a"]
+
+
+def test_journal_corrupt_record_stops_scan(tmp_path):
+    root = str(tmp_path / "j")
+    j = ha.Journal(root)
+    j.append("register", {"token": "t", "ip": "a", "port": 1})
+    size_after_first = os.path.getsize(
+        os.path.join(root, sorted(os.listdir(root))[0]))
+    j.append("register", {"token": "t", "ip": "b", "port": 2})
+    j.close()
+    path = os.path.join(root, sorted(os.listdir(root))[0])
+    # flip a payload bit inside the SECOND record: CRC mismatch stops the
+    # scan there without touching the first record
+    with open(path, "r+b") as f:
+        f.seek(size_after_first + ha._FRAME.size + 2)
+        b = f.read(1)
+        f.seek(-1, os.SEEK_CUR)
+        f.write(bytes([b[0] ^ 0xFF]))
+    _, records = ha.Journal(root).recover()
+    assert [r["body"]["ip"] for r in records] == ["a"]
+
+
+def test_journal_corrupt_snapshot_raises(tmp_path):
+    root = str(tmp_path / "j")
+    j = ha.Journal(root)
+    j.append("register", {"token": "t", "ip": "a", "port": 1})
+    j.snapshot({"state": 1})
+    j.close()
+    snap = [p for p in os.listdir(root) if p.startswith("snap.")][0]
+    with open(os.path.join(root, snap), "r+b") as f:
+        f.seek(8)
+        f.write(b"\xff\xff")
+    with pytest.raises(ha.JournalCorruptError):
+        ha.Journal(root).recover()
+
+
+# -------------------------------------------------- monotonic lease regression
+class _WallJump:
+    """time-module shim: wall clock jumped ``offset_s`` into the future,
+    monotonic untouched — the NTP-step scenario lease sweeping must ignore."""
+
+    def __init__(self, offset_s: float):
+        self._offset = offset_s
+
+    def time(self):
+        return time.time() + self._offset
+
+    def __getattr__(self, name):
+        return getattr(time, name)
+
+
+def test_lease_sweep_survives_wall_clock_jump(monkeypatch):
+    co = Coordinator(default_lease_s=1000.0)
+    co.register("svc", "10.0.0.1", 1)
+    # a 2-hour NTP step forward: wall-clock-based leases would mass-evict
+    monkeypatch.setattr(coordinator_mod, "time", _WallJump(7200.0))
+    co._last_sweep = float("-inf")  # defeat the sweep rate limit
+    assert [r["ip"] for r in co.peers("svc")] == ["10.0.0.1"]
+    # eviction still works on MONOTONIC passage
+    co._leases["10.0.0.1:1"] = time.monotonic() - 1.0
+    co._last_sweep = float("-inf")
+    assert co.peers("svc") == []
+
+
+def test_replayed_lease_is_reaged_not_refreshed():
+    co = Coordinator(default_lease_s=30.0)
+    # replaying a record journaled 29s ago leaves ~1s of lease, not 30
+    co.apply_register("svc", "10.0.0.1", 1, record_ts=time.time() - 29.0)
+    remaining = co._leases["10.0.0.1:1"] - time.monotonic()
+    assert 0.0 < remaining < 2.0
+    # and one whose lease already lapsed during the outage is born expired
+    co.apply_register("svc", "10.0.0.2", 2, record_ts=time.time() - 60.0)
+    assert co._leases["10.0.0.2:2"] < time.monotonic()
+    co._last_sweep = float("-inf")
+    assert {r["ip"] for r in co.peers("svc")} == {"10.0.0.1"}
+
+
+# ------------------------------------------------------- ambiguous-ack typing
+def test_is_ambiguous_classification():
+    # a refused/unresolvable connection never carried the request
+    assert not ha.is_ambiguous(ConnectionRefusedError())
+    assert not ha.is_ambiguous(
+        CommError("x", cause=ConnectionRefusedError()))
+    err = CommError("x")
+    err.__cause__ = ConnectionRefusedError()
+    assert not ha.is_ambiguous(err)
+    # timeouts / resets / truncated replies may have been applied
+    assert ha.is_ambiguous(TimeoutError())
+    assert ha.is_ambiguous(CommError("x", cause=TimeoutError()))
+    assert ha.is_ambiguous(CommError("x"))
+
+
+def test_failover_idempotent_retried_once_nonidempotent_typed(monkeypatch):
+    calls = []
+
+    def flaky_once(host, port, route, body, timeout):
+        calls.append((host, port))
+        if len(calls) == 1:
+            # mid-flight death: ambiguous (not a refused connection)
+            raise CommError("reset", cause=TimeoutError())
+        return {"code": 0, "info": True, "epoch": 1}
+
+    monkeypatch.setattr(coordinator_mod, "_coordinator_request_once",
+                        flaky_once)
+    # idempotent route: the ambiguous failure rotates and is retried —
+    # exactly one extra attempt lands on the standby
+    r = coordinator_request("a:1,b:2", None, "register",
+                            {"token": "t", "ip": "x", "port": 1})
+    assert r["code"] == 0
+    assert calls == [("a", 1), ("b", 2)]
+
+    # non-idempotent `ask`: the same failure surfaces typed instead of
+    # retrying into a possible double-pop; no second attempt is made
+    calls.clear()
+    ha.reset_targets()
+    with pytest.raises(ha.AmbiguousAckError) as ei:
+        coordinator_request("a:1,b:2", None, "ask", {"token": "t"})
+    assert len(calls) == 1
+    assert ei.value.route == "ask"
+
+
+def test_failover_refused_connection_is_not_ambiguous(monkeypatch):
+    calls = []
+
+    def down_then_up(host, port, route, body, timeout):
+        calls.append((host, port))
+        if host == "a":
+            raise CommError("refused", cause=ConnectionRefusedError())
+        return {"code": 0, "info": None, "epoch": 1}
+
+    monkeypatch.setattr(coordinator_mod, "_coordinator_request_once",
+                        down_then_up)
+    # `ask` against a DEAD primary is safe to retry: the request never
+    # left this process, so the pop cannot have been applied
+    r = coordinator_request("a:1,b:2", None, "ask", {"token": "t"})
+    assert r["code"] == 0 and calls == [("a", 1), ("b", 2)]
+
+
+# --------------------------------------------------------------- epoch fencing
+def test_stale_epoch_reply_is_fenced(monkeypatch):
+    targets = ha.targets_for(ha.parse_addrs("a:1,b:2"))
+    targets.note_epoch(5)
+
+    def deposed(host, port, route, body, timeout):
+        return {"code": 0, "info": [], "epoch": 3}
+
+    monkeypatch.setattr(coordinator_mod, "_coordinator_request_once", deposed)
+    with pytest.raises(ha.StaleEpochError):
+        coordinator_mod._failover_request_once(targets, "peers", {}, 5.0)
+    # the deposed answerer was rotated away from
+    assert targets.active() == ("b", 2)
+
+
+def test_not_leader_redirect_follows_hint(monkeypatch):
+    targets = ha.targets_for(ha.parse_addrs("a:1,b:2"))
+
+    def standby(host, port, route, body, timeout):
+        return {"code": 2, "info": "not_leader", "leader": "b:2", "epoch": 4}
+
+    monkeypatch.setattr(coordinator_mod, "_coordinator_request_once", standby)
+    with pytest.raises(ha.NotLeaderError):
+        coordinator_mod._failover_request_once(targets, "peers", {}, 5.0)
+    assert targets.active() == ("b", 2)
+    assert targets.max_epoch == 4
+
+
+def test_failover_notifies_listeners():
+    targets = ha.targets_for(ha.parse_addrs("a:1,b:2"))
+    hits = []
+    listener = hits.append
+    ha.add_failover_listener(listener)
+    try:
+        targets.rotate(("a", 1))
+    finally:
+        ha.remove_failover_listener(listener)
+    assert hits and hits[0] is targets
+
+
+# ----------------------------------------------------------- route-set lint
+def test_lint_ha_routes_clean():
+    sys.path.insert(0, TOOLS)
+    try:
+        import lint_ha_routes
+
+        assert lint_ha_routes.lint() == []
+    finally:
+        sys.path.remove(TOOLS)
+
+
+def test_route_sets_invariants():
+    assert not (ha.JOURNALED_ROUTES & ha.EPHEMERAL_ROUTES)
+    assert ha.DURABLE_ROUTES <= ha.JOURNALED_ROUTES
+    assert "ask" not in ha.IDEMPOTENT_ROUTES
+
+
+# ------------------------------------------------------------ shipper resync
+def test_shipper_resync_counted():
+    from distar_tpu.obs import (
+        MetricsRegistry, TelemetryIngest, TelemetryShipper, TimeSeriesStore,
+    )
+    from distar_tpu.obs import shipper as shipper_mod
+
+    reg = MetricsRegistry()
+    reg.counter("x_total", "seed one counter so snapshots are non-empty").inc()
+    ingest = TelemetryIngest(TimeSeriesStore())
+    s = TelemetryShipper("t-ha", ingest=ingest, interval_s=60.0, registry=reg)
+    s.start()
+    try:
+        assert shipper_mod.request_resync_all("heartbeat") >= 1
+        c = reg.counter("distar_obs_shipper_resyncs_total",
+                        "full-snapshot re-ships after broker restart "
+                        "or failover", reason="heartbeat")
+        deadline = time.time() + 5.0
+        while c.value < 1 and time.time() < deadline:
+            time.sleep(0.05)
+        assert c.value >= 1, "resync never shipped/counted"
+    finally:
+        s.stop()
+
+
+# ------------------------------------------------- end-to-end warm standby
+def _spawn(role, port, journal_dir, peers=(), grace=0.8):
+    co = Coordinator(default_lease_s=5.0)
+    srv = CoordinatorServer(coordinator=co, port=port)
+    state = ha.HAState(co, journal_dir,
+                       advertise=f"127.0.0.1:{srv.port}",
+                       role=role, peers=list(peers),
+                       takeover_grace_s=grace,
+                       arena_store_fn=lambda: None)
+    state.boot()
+    srv.attach_ha(state)
+    srv.start()
+    return co, srv, state
+
+
+def test_ha_pair_failover_end_to_end(tmp_path):
+    co1, srv1, ha1 = _spawn("primary", 0, str(tmp_path / "j1"))
+    addr1 = f"127.0.0.1:{srv1.port}"
+    co2, srv2, ha2 = _spawn("standby", 0, str(tmp_path / "j2"),
+                            peers=[addr1])
+    addr2 = f"127.0.0.1:{srv2.port}"
+    spec = f"{addr1},{addr2}"
+    try:
+        time.sleep(0.3)
+        # replies are epoch/role-stamped; the standby replicates before ack
+        r = coordinator_request(spec, None, "register",
+                                {"token": "t", "ip": "10.0.0.1", "port": 1})
+        assert r["code"] == 0 and r["role"] == "primary"
+        assert int(r["epoch"]) >= 1
+        deadline = time.time() + 2.0
+        while not co2.peers("t") and time.time() < deadline:
+            time.sleep(0.05)
+        assert co2.peers("t"), "standby did not replicate the register"
+
+        # a standby addressed directly answers the typed not_leader envelope
+        host, port = addr2.split(":")
+        reply = coordinator_mod._coordinator_request_once(
+            host, int(port), "register",
+            {"token": "x", "ip": "z", "port": 9}, 5.0)
+        assert reply["code"] == 2 and reply["info"] == "not_leader"
+        assert reply["leader"] == addr1
+
+        # pop on the primary; the pop itself replicates (no resurrection)
+        got = coordinator_request(spec, None, "ask", {"token": "t"})
+        assert got["info"]["ip"] == "10.0.0.1"
+        coordinator_request(spec, None, "register",
+                            {"token": "t", "ip": "10.0.0.2", "port": 2})
+
+        # SIGKILL-equivalent: stop the primary without a parting snapshot
+        epoch_before = ha2.epoch
+        srv1.stop()
+        ha1._stop.set()
+        deadline = time.time() + 10.0
+        while ha2.role != "primary" and time.time() < deadline:
+            time.sleep(0.05)
+        assert ha2.role == "primary", "standby never promoted"
+        assert ha2.epoch > epoch_before
+
+        # the comma-spec client follows leadership without code changes
+        r = coordinator_request(spec, None, "peers", {"token": "t"})
+        assert r["role"] == "primary"
+        assert [p["ip"] for p in r["info"]] == ["10.0.0.2"]
+    finally:
+        for srv, st in ((srv1, ha1), (srv2, ha2)):
+            try:
+                srv.stop()
+            except Exception:
+                pass
+            st.stop()
+
+
+def test_cold_restart_replays_journal_exactly(tmp_path):
+    root = str(tmp_path / "j")
+    co1, srv1, ha1 = _spawn("primary", 0, root)
+    spec = f"127.0.0.1:{srv1.port}"
+    try:
+        host, port = spec.split(":")
+        for i in range(4):
+            coordinator_request(host, int(port), "register",
+                                {"token": "q", "ip": f"10.1.0.{i}", "port": i})
+        got = coordinator_request(host, int(port), "ask", {"token": "q"})
+        assert got["info"]["ip"] == "10.1.0.0"
+    finally:
+        srv1.stop()
+        ha1._stop.set()  # crash-stop: no final snapshot
+
+    co2 = Coordinator(default_lease_s=5.0)
+    ha2 = ha.HAState(co2, root, advertise="127.0.0.1:1", role="primary",
+                     arena_store_fn=lambda: None)
+    ha2.boot()
+    try:
+        ips = [r["ip"] for r in co2.peers("q")]
+        assert ips == ["10.1.0.1", "10.1.0.2", "10.1.0.3"], \
+            "replay must reconstruct the queue minus the acked pop"
+        # the restarted primary leads a NEW epoch (fencing the old one out)
+        assert ha2.epoch > ha1.epoch - 1
+    finally:
+        ha2.stop()

@@ -22,6 +22,9 @@ faults tests already prove survivable:
         [--slots 8] [--items 60]
   python tools/chaos.py arena-drill --dir /tmp/arena_drill [--batches 4] \\
         [--episodes 6] [--kill-after 1]
+  python tools/chaos.py coordinator-drill --dir /tmp/coord_drill \\
+        [--items 30] [--post-items 15] [--lease-s 8] [--grace-s 1.5] \\
+        [--no-ha]
 
 ``corrupt`` damages a checkpoint in place (the resume path must fall back);
 ``kill`` sends a signal to a role process (the supervisor/orchestrator must
@@ -1274,6 +1277,394 @@ def cmd_arena_drill(args) -> int:
         set_arena_store(None)
 
 
+_COORDINATOR_CHILD = r"""
+import os, sys, time
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+repo = sys.argv[1]
+if repo not in sys.path:
+    sys.path.insert(0, repo)
+port, journal_dir, role = int(sys.argv[2]), sys.argv[3], sys.argv[4]
+peers = [p for p in sys.argv[5].split(",") if p and p != "-"]
+grace, lease_s = float(sys.argv[6]), float(sys.argv[7])
+from distar_tpu.arena import ArenaStore, set_arena_store
+from distar_tpu.comm.coordinator import Coordinator, CoordinatorServer
+set_arena_store(ArenaStore())
+co = Coordinator(default_lease_s=lease_s)
+srv = CoordinatorServer(coordinator=co, port=port)
+if role != "none":
+    from distar_tpu.comm.ha import HAState
+    ha = HAState(co, journal_dir, advertise="127.0.0.1:%d" % srv.port,
+                 peers=peers, role=role, takeover_grace_s=grace,
+                 snapshot_every=64)
+    ha.boot()
+    srv.attach_ha(ha)
+srv.start()
+print("READY %d" % srv.port, flush=True)
+while True:
+    time.sleep(1)
+"""
+
+
+def cmd_coordinator_drill(args) -> int:
+    """SIGKILL the primary coordinator under live fleet load and prove the
+    HA contract end to end (the broker was the fleet's last SPOF):
+
+      LEG 1 — failover: primary + warm standby, live load (producers
+      registering payload records, an arena reporter, discovery heartbeats,
+      a telemetry shipper) → SIGKILL the primary mid-run. The standby must
+      be serving within one lease window; draining the queue afterwards
+      must surface EVERY acked register exactly once (semi-synchronous
+      replication: an ack means the standby has it); re-reporting every
+      acked arena batch must dedup 100% (zero double-counted matches);
+      heartbeated leases survive, an abandoned lease is cleanly evicted;
+      the revived old primary must rejoin as a STANDBY (epoch fencing) and
+      the shipper must have counted a resync.
+
+      LEG 2 — cold restart: kill every coordinator, restart one over its
+      journal alone — acked items, arena accounting and dedup keys must be
+      reconstructed exactly by snapshot + WAL replay.
+
+      LEG 3 (--no-ha or always-on counter-demo) — a journal-less
+      coordinator demonstrably LOSES acked items across the same kill: the
+      baseline the durability contract is measured against."""
+    import itertools
+    import socket
+    import subprocess
+    import threading
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.makedirs(args.dir, exist_ok=True)
+
+    from distar_tpu.comm import ha as ha_mod
+    from distar_tpu.comm.coordinator import coordinator_request
+    from distar_tpu.comm.discovery import register_endpoint
+    from distar_tpu.obs import get_registry
+    from distar_tpu.obs.shipper import TelemetryShipper
+
+    def free_port() -> int:
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        return port
+
+    def spawn(port: int, jdir: str, role: str, peers: str):
+        proc = subprocess.Popen(
+            [sys.executable, "-c", _COORDINATOR_CHILD, _REPO, str(port),
+             jdir, role, peers or "-", str(args.grace_s), str(args.lease_s)],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+            bufsize=1, cwd=_REPO, env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        assert proc.stdout is not None
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            line = proc.stdout.readline()
+            if line.startswith("READY"):
+                return proc
+            if proc.poll() is not None:
+                break
+        raise RuntimeError(f"coordinator child on :{port} never came up")
+
+    inj = ChaosInjector(seed=args.seed)
+    failures = []
+    children = []
+    lease_s = float(args.lease_s)
+
+    if args.no_ha:
+        # counter-demo only: journal-less coordinator loses acked items
+        port = free_port()
+        proc = spawn(port, os.path.join(args.dir, "none"), "none", "-")
+        children.append(proc)
+        for i in range(10):
+            coordinator_request("127.0.0.1", port, "register",
+                                {"token": "demo", "ip": f"10.3.0.{i}", "port": 1})
+        inj.kill_role(proc.pid, sig=signal.SIGKILL, name="coordinator")
+        proc.wait(timeout=30)
+        proc = spawn(port, os.path.join(args.dir, "none"), "none", "-")
+        children.append(proc)
+        depth = coordinator_request("127.0.0.1", port, "depth",
+                                    {"token": "demo"}).get("info")
+        lost = 10 - int(depth or 0)
+        verdict = {"mode": "no-ha counter-demo", "acked": 10, "lost": lost,
+                   "failures": [] if lost > 0 else
+                   ["journal-less restart did NOT lose state?"]}
+        print(json.dumps(verdict))
+        print("verdict: journal-less coordinator lost "
+              f"{lost}/10 acked items across a SIGKILL — the loss HA exists "
+              "to prevent" if lost > 0 else "verdict: DRILL FAILED")
+        for p_ in children:
+            if p_.poll() is None:
+                p_.kill()
+        return 0 if lost > 0 else 1
+
+    p1, p2 = free_port(), free_port()
+    addr1, addr2 = f"127.0.0.1:{p1}", f"127.0.0.1:{p2}"
+    spec = f"{addr1},{addr2}"
+    j1, j2 = os.path.join(args.dir, "j1"), os.path.join(args.dir, "j2")
+    ha_mod.reset_targets()
+
+    primary = spawn(p1, j1, "primary", "-")
+    standby = spawn(p2, j2, "standby", addr1)
+    children += [primary, standby]
+    shipper = None
+    hb_thread = None
+    try:
+        # ------------------------------------------------------ live load
+        stop_load = threading.Event()
+        acked_lock = threading.Lock()
+        acked_items: list = []     # "ip:port" acked under token "payload"
+        ack_times: list = []
+        acked_batches: list = []   # arena batches acked (list of records)
+        counter = itertools.count()
+
+        def pusher():
+            while not stop_load.is_set():
+                i = next(counter)
+                ip = f"10.1.{i // 250}.{i % 250}"
+                try:
+                    r = coordinator_request(spec, None, "register",
+                                            {"token": "payload", "ip": ip,
+                                             "port": 7}, timeout=5.0)
+                    if r.get("code") == 0:
+                        with acked_lock:
+                            acked_items.append(f"{ip}:7")
+                            ack_times.append(time.time())
+                except Exception:
+                    pass
+                time.sleep(0.02)
+
+        def reporter():
+            b = 0
+            while not stop_load.is_set():
+                batch = [{"key": f"a|b|r{b}e{i}", "home": "a", "away": "b",
+                          "round": b, "winner": "draw", "game_steps": 1,
+                          "duration_s": 0.0} for i in range(4)]
+                try:
+                    r = coordinator_request(spec, None, "arena_report",
+                                            {"matches": batch}, timeout=5.0)
+                    if r.get("code") == 0:
+                        with acked_lock:
+                            acked_batches.append(batch)
+                        b += 1
+                except Exception:
+                    pass
+                time.sleep(0.05)
+
+        threads = [threading.Thread(target=pusher, daemon=True),
+                   threading.Thread(target=reporter, daemon=True)]
+        for t in threads:
+            t.start()
+        # a heartbeated service lease (must survive the failover) and an
+        # abandoned one (must be cleanly evicted when its lease lapses)
+        hb_stop = threading.Event()
+        hb_thread = register_endpoint((spec, None), "svc", "10.9.0.1", 1,
+                                      lease_s=lease_s,
+                                      heartbeat_interval_s=max(0.5, lease_s / 8),
+                                      stop_event=hb_stop)
+        coordinator_request(spec, None, "register",
+                            {"token": "svc", "ip": "10.9.0.2", "port": 1,
+                             "lease_s": lease_s})
+        shipper = TelemetryShipper("coordinator-drill",
+                                   coordinator_addr=(spec, None),
+                                   interval_s=0.5).start()
+
+        deadline = time.time() + args.timeout_s
+        while time.time() < deadline:
+            with acked_lock:
+                if len(acked_items) >= args.items and len(acked_batches) >= 3:
+                    break
+            time.sleep(0.1)
+        st1 = ha_mod.probe_ha_status(addr1)
+        epoch_before = int(st1["epoch"]) if st1 else -1
+
+        # ------------------------------------------------- the SIGKILL
+        t_kill = time.time()
+        inj.kill_role(primary.pid, sig=signal.SIGKILL, name="coordinator-primary")
+        primary.wait(timeout=30)
+
+        with acked_lock:
+            acked_at_kill = len(acked_items)
+        while time.time() < deadline:
+            with acked_lock:
+                if len(acked_items) >= acked_at_kill + args.post_items:
+                    break
+            time.sleep(0.1)
+        with acked_lock:
+            recovery_s = next((t - t_kill for t in ack_times if t > t_kill),
+                              None)
+        if recovery_s is None:
+            failures.append("no register was acked after the primary kill")
+        elif recovery_s > lease_s:
+            failures.append(f"standby took {recovery_s:.1f}s to serve "
+                            f"(> one lease window {lease_s:.0f}s)")
+        st2 = ha_mod.probe_ha_status(addr2)
+        if not st2 or st2.get("role") != "primary":
+            failures.append(f"standby did not take over: {st2}")
+        elif int(st2.get("epoch", -1)) <= epoch_before:
+            failures.append(f"promotion did not bump the epoch: {st2} "
+                            f"vs {epoch_before}")
+
+        # ------------------------------- epoch fencing: revive the victim
+        revived = spawn(p1, j1, "auto", addr2)
+        children.append(revived)
+        revived_role = None
+        for _ in range(40):
+            st = ha_mod.probe_ha_status(addr1)
+            revived_role = st.get("role") if st else None
+            if revived_role == "standby":
+                break
+            time.sleep(0.25)
+        if revived_role != "standby":
+            failures.append("revived old primary did not rejoin as standby: "
+                            f"{revived_role}")
+        stop_load.set()
+        for t in threads:
+            t.join(timeout=10)
+
+        # ------------------------------------- zero lost acked queue items
+        popped = []
+        empties = 0
+        while empties < 5:
+            r = coordinator_request(spec, None, "ask", {"token": "payload"},
+                                    timeout=5.0)
+            info = r.get("info")
+            if r.get("code") == 0 and info:
+                popped.append(f"{info['ip']}:{info['port']}")
+                empties = 0
+            else:
+                empties += 1
+        with acked_lock:
+            acked_set = set(acked_items)
+        lost = acked_set - set(popped)
+        if lost:
+            failures.append(f"{len(lost)} acked queue items lost across "
+                            f"failover: {sorted(lost)[:5]}...")
+        if len(popped) != len(set(popped)):
+            failures.append("a queue item was popped twice")
+        extras = len(set(popped) - acked_set)  # applied-but-unacked: benign
+
+        # --------------------------------- zero double-counted arena matches
+        st2 = ha_mod.probe_ha_status(addr2) or {}
+        with acked_lock:
+            replay_all = [rec for batch in acked_batches for rec in batch]
+        import urllib.request
+
+        with urllib.request.urlopen(f"http://{addr2}/arena/ratings",
+                                    timeout=5.0) as resp:
+            matches_before = int(json.loads(resp.read())["matches_total"])
+        rr = coordinator_request(spec, None, "arena_report",
+                                 {"matches": replay_all})
+        ack = rr.get("info") or {}
+        if rr.get("code") != 0 or ack.get("applied") != 0 \
+                or ack.get("duplicates") != len(replay_all):
+            failures.append(f"replayed acked arena batches not fully "
+                            f"deduped: {rr}")
+        with urllib.request.urlopen(f"http://{addr2}/arena/ratings",
+                                    timeout=5.0) as resp:
+            matches_after = int(json.loads(resp.read())["matches_total"])
+        if matches_after != matches_before:
+            failures.append(f"arena matches double-counted across failover: "
+                            f"{matches_before} -> {matches_after}")
+
+        # ------------------- every lease re-established or cleanly evicted
+        svc = {f"{r['ip']}:{r['port']}" for r in
+               coordinator_request(spec, None, "peers",
+                                   {"token": "svc"}).get("info") or ()}
+        if "10.9.0.1:1" not in svc:
+            failures.append("heartbeated lease did not survive the failover")
+        evict_deadline = time.time() + lease_s + 5
+        while "10.9.0.2:1" in svc and time.time() < evict_deadline:
+            time.sleep(0.5)
+            svc = {f"{r['ip']}:{r['port']}" for r in
+                   coordinator_request(spec, None, "peers",
+                                       {"token": "svc"}).get("info") or ()}
+        if "10.9.0.2:1" in svc:
+            failures.append("abandoned lease was never evicted on the "
+                            "new primary")
+
+        resyncs = sum(v for k, v in get_registry().snapshot().items()
+                      if k.startswith("distar_obs_shipper_resyncs_total"))
+        if resyncs < 1:
+            failures.append("telemetry shipper never counted a resync "
+                            "across the failover")
+
+        # ------------------------------------------ LEG 2: cold restart
+        cold_acked = []
+        for i in range(5):
+            r = coordinator_request(spec, None, "register",
+                                    {"token": "cold", "ip": f"10.2.0.{i}",
+                                     "port": 9})
+            if r.get("code") == 0:
+                cold_acked.append(f"10.2.0.{i}:9")
+        hb_stop.set()
+        shipper.stop()
+        for proc in (standby, revived):
+            inj.kill_role(proc.pid, sig=signal.SIGKILL, name="coordinator")
+            proc.wait(timeout=30)
+        cold = spawn(p2, j2, "auto", addr1)
+        children.append(cold)
+        st_cold = ha_mod.probe_ha_status(addr2)
+        if not st_cold or st_cold.get("role") != "primary":
+            failures.append(f"cold restart did not take leadership: {st_cold}")
+        cold_popped = []
+        empties = 0
+        while empties < 5:
+            r = coordinator_request(spec, None, "ask", {"token": "cold"},
+                                    timeout=5.0)
+            info = r.get("info")
+            if r.get("code") == 0 and info:
+                cold_popped.append(f"{info['ip']}:{info['port']}")
+                empties = 0
+            else:
+                empties += 1
+        if set(cold_popped) != set(cold_acked):
+            failures.append(f"journal replay lost acked items: wanted "
+                            f"{cold_acked}, got {cold_popped}")
+        rr = coordinator_request(spec, None, "arena_report",
+                                 {"matches": replay_all})
+        ack = rr.get("info") or {}
+        if rr.get("code") != 0 or ack.get("applied") != 0:
+            failures.append(f"arena dedup keys did not survive the cold "
+                            f"journal replay: {rr}")
+        with urllib.request.urlopen(f"http://{addr2}/arena/ratings",
+                                    timeout=5.0) as resp:
+            matches_cold = int(json.loads(resp.read())["matches_total"])
+        if matches_cold != matches_after:
+            failures.append(f"cold replay changed arena accounting: "
+                            f"{matches_after} -> {matches_cold}")
+
+        verdict = {
+            "acked_items": len(acked_set), "popped": len(popped),
+            "applied_unacked_extras": extras,
+            "acked_arena_matches": len(replay_all),
+            "matches_total": matches_after,
+            "recovery_s": recovery_s,
+            "lease_window_s": lease_s,
+            "epoch_before": epoch_before,
+            "epoch_after": st2.get("epoch"),
+            "revived_old_primary_role": revived_role,
+            "shipper_resyncs": resyncs,
+            "cold_restart_items": len(cold_popped),
+            "events": [e["kind"] for e in inj.events],
+            "failures": failures,
+        }
+        print(json.dumps(verdict, default=str))
+        print("verdict: primary SIGKILL'd under live load; standby served "
+              f"in {recovery_s:.1f}s, zero acked items lost, zero arena "
+              "matches double-counted, fencing demoted the revived primary, "
+              "cold journal replay exact" if not failures
+              else f"verdict: DRILL FAILED {failures}")
+        return 0 if not failures else 1
+    finally:
+        if shipper is not None:
+            shipper.stop()
+        if hb_thread is not None:
+            hb_thread.stop_event.set()
+        for p_ in children:
+            if p_.poll() is None:
+                p_.kill()
+        ha_mod.reset_targets()
+
+
 def cmd_latest(args) -> int:
     mgr = CheckpointManager(args.dir)
     gens = mgr.generations()
@@ -1397,6 +1788,31 @@ def main() -> int:
     a.add_argument("--timeout-s", type=float, default=900.0,
                    help="restarted evaluator wall budget")
 
+    o = sub.add_parser(
+        "coordinator-drill",
+        help="SIGKILL the primary coordinator under live fleet load; prove "
+             "warm-standby failover with zero acked-item loss, exact arena "
+             "dedup, lease survival/eviction, epoch fencing of the revived "
+             "primary, and an exact cold journal-replay restart")
+    o.add_argument("--dir", required=True,
+                   help="scratch directory (per-coordinator journals)")
+    o.add_argument("--items", type=int, default=30,
+                   help="acked payload registers before the kill")
+    o.add_argument("--post-items", type=int, default=15,
+                   help="further acked registers the fleet must land on the "
+                        "standby after the kill")
+    o.add_argument("--lease-s", type=float, default=8.0,
+                   help="endpoint lease TTL; the failover must complete "
+                        "within ONE lease window")
+    o.add_argument("--grace-s", type=float, default=1.5,
+                   help="standby takeover grace (quiet feed -> promotion)")
+    o.add_argument("--no-ha", action="store_true",
+                   help="counter-demo: journal-less coordinator provably "
+                        "loses acked items across the same SIGKILL")
+    o.add_argument("--seed", type=int, default=0)
+    o.add_argument("--timeout-s", type=float, default=120.0,
+                   help="load-phase wall budget")
+
     m = sub.add_parser("multichip-drill",
                        help="kill a multichip learner after a sharded save; "
                             "prove resume on a DIFFERENT mesh shape")
@@ -1423,6 +1839,7 @@ def main() -> int:
             "elastic-drill": cmd_elastic_drill,
             "dynamics-drill": cmd_dynamics_drill,
             "arena-drill": cmd_arena_drill,
+            "coordinator-drill": cmd_coordinator_drill,
             "multichip-drill": cmd_multichip_drill}[args.command](args)
 
 

@@ -1,0 +1,132 @@
+"""HA-route lint: no coordinator route may silently become volatile.
+
+The durability contract of the HA broker (distar_tpu/comm/ha.py) is a
+classification: every route in ``CoordinatorServer.routes`` is either
+**journaled** (``JOURNALED_ROUTES`` — written to the WAL before its reply,
+replayed on restart, streamed to standbys) or **explicitly ephemeral**
+(``EPHEMERAL_ROUTES`` — read-only or lossy-by-design, each with a reason).
+This lint reads both sides with ``ast`` (no imports, same shim pattern as
+lint_sockets/lint_metric_names) and fails when:
+
+* a route exists in ``CoordinatorServer.routes`` but in neither set — the
+  failure a future route (the league's matchmaker) would hit, forcing its
+  author to decide durability instead of inheriting volatility;
+* a route appears in both sets (contradictory classification);
+* the ephemeral allowlist names a route that no longer exists — the list is
+  SHRINK-ONLY: stale entries must be deleted, never accumulated;
+* ``DURABLE_ROUTES`` isn't a subset of ``JOURNALED_ROUTES``;
+* ``ask`` (a queue pop, the one non-idempotent route) ever appears in
+  ``IDEMPOTENT_ROUTES`` — retrying a possibly-applied pop double-consumes.
+
+Invoked from the test suite (tests/test_coordinator_ha.py) and runnable
+standalone: ``python tools/lint_ha_routes.py``.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import sys
+from typing import Dict, List, Set
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+COORDINATOR_PY = os.path.join(_REPO, "distar_tpu", "comm", "coordinator.py")
+HA_PY = os.path.join(_REPO, "distar_tpu", "comm", "ha.py")
+
+_SET_NAMES = ("JOURNALED_ROUTES", "EPHEMERAL_ROUTES", "DURABLE_ROUTES",
+              "IDEMPOTENT_ROUTES")
+
+
+def server_routes(path: str = COORDINATOR_PY) -> Set[str]:
+    """String keys of the ``routes = {...}`` dict in CoordinatorServer."""
+    tree = ast.parse(open(path, encoding="utf-8").read(), filename=path)
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id == "routes"
+                and isinstance(node.value, ast.Dict)):
+            continue
+        keys = set()
+        for k in node.value.keys:
+            if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                keys.add(k.value)
+        return keys
+    raise AssertionError(f"no `routes = {{...}}` dict literal found in {path}")
+
+
+def route_sets(path: str = HA_PY) -> Dict[str, Set[str]]:
+    """The classification frozensets from ha.py, read as literals."""
+    tree = ast.parse(open(path, encoding="utf-8").read(), filename=path)
+    out: Dict[str, Set[str]] = {}
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id in _SET_NAMES):
+            continue
+        value = node.value
+        if (isinstance(value, ast.Call) and isinstance(value.func, ast.Name)
+                and value.func.id == "frozenset" and value.args):
+            value = value.args[0]
+        elts = getattr(value, "elts", None)
+        if elts is None:
+            raise AssertionError(
+                f"{node.targets[0].id} in {path} is not a literal set — "
+                "the lint (and reviewers) must be able to read it statically")
+        out[node.targets[0].id] = {
+            e.value for e in elts
+            if isinstance(e, ast.Constant) and isinstance(e.value, str)
+        }
+    missing = [n for n in _SET_NAMES if n not in out]
+    assert not missing, f"route sets missing from {path}: {missing}"
+    return out
+
+
+def lint() -> List[str]:
+    problems: List[str] = []
+    routes = server_routes()
+    sets = route_sets()
+    journaled, ephemeral = sets["JOURNALED_ROUTES"], sets["EPHEMERAL_ROUTES"]
+    for route in sorted(routes - journaled - ephemeral):
+        problems.append(
+            f"route '{route}' in CoordinatorServer.routes is neither "
+            "journaled (ha.JOURNALED_ROUTES) nor explicitly tagged ephemeral "
+            "(ha.EPHEMERAL_ROUTES) — unclassified routes are volatile by "
+            "accident; decide its durability")
+    for route in sorted(journaled & ephemeral):
+        problems.append(
+            f"route '{route}' is in BOTH JOURNALED_ROUTES and "
+            "EPHEMERAL_ROUTES — pick one")
+    for route in sorted(ephemeral - routes):
+        problems.append(
+            f"EPHEMERAL_ROUTES names '{route}' which is not a server route — "
+            "the allowlist is shrink-only; delete the stale entry")
+    for route in sorted(journaled - routes):
+        problems.append(
+            f"JOURNALED_ROUTES names '{route}' which is not a server route")
+    for route in sorted(sets["DURABLE_ROUTES"] - journaled):
+        problems.append(
+            f"DURABLE_ROUTES names '{route}' outside JOURNALED_ROUTES — "
+            "only journaled records can be fsync'd/replicated")
+    if "ask" in sets["IDEMPOTENT_ROUTES"]:
+        problems.append(
+            "'ask' is a queue POP and must never be in IDEMPOTENT_ROUTES — "
+            "retrying a possibly-applied pop consumes a second record")
+    return problems
+
+
+def main() -> int:
+    problems = lint()
+    for p in problems:
+        sys.stderr.write(p + "\n")
+    if problems:
+        sys.stderr.write(
+            f"{len(problems)} offence(s); every coordinator route must be "
+            "journaled or explicitly ephemeral (distar_tpu/comm/ha.py)\n")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -414,6 +414,58 @@ def _print_arena_digest(addr: str) -> None:
           f"players={len(players)} top={top}")
 
 
+def _print_coordinator_ha_digest(addr: str) -> None:
+    """Coordinator HA digest for ``status``: leadership role, fencing epoch
+    and journal position per coordinator, plus the standby's replication lag
+    (records + seconds behind the primary) with a freshness warning when the
+    standby has drifted far enough that a failover would replay stale state.
+    ``addr`` may be a comma list (the same spec clients pass as
+    ``--coordinator-addr``); a single probed coordinator also reveals its
+    peers, which are folded in. Silent when nothing at ``addr`` speaks HA
+    (GET /coordinator/ha is 404 on a journal-less coordinator)."""
+    probed = {}
+    pending = [a.strip() for a in addr.split(",") if a.strip()]
+    while pending:
+        a = pending.pop(0)
+        if a in probed:
+            continue
+        body = _try_get(a, "/coordinator/ha", timeout=3.0)
+        probed[a] = body
+        for peer in (body or {}).get("peers") or []:
+            if peer not in probed:
+                pending.append(peer)
+    rows = {a: b for a, b in probed.items() if b}
+    if not rows:
+        return
+    print("coordinator HA:")
+    for a in sorted(rows):
+        b = rows[a]
+        role = b.get("role", "?")
+        line = (f"  {a:<24} role={role:<8} epoch={b.get('epoch', 0)} "
+                f"seq={b.get('seq', 0)}")
+        if role == "standby":
+            lag_r = int(b.get("journal_lag_records", 0))
+            lag_s = float(b.get("journal_lag_seconds", 0.0))
+            line += f" lag={lag_r} records / {lag_s:.1f}s behind"
+            if lag_r > 256 or lag_s > 30.0:
+                line += "  STALE STANDBY (failover would lose recent state)"
+        print(line)
+    roles = [b.get("role") for b in rows.values()]
+    if "primary" not in roles:
+        print("  WARNING: no primary answering (fleet is between leaders)")
+    elif roles.count("primary") > 1:
+        print("  WARNING: multiple primaries answering (epoch fencing will "
+              "demote the loser; check again shortly)")
+    elif "standby" not in roles and len(rows) == 1:
+        n = int(next(iter(rows.values())).get("followers", 0))
+        if n:
+            print(f"  note: {n} follower(s) tailing the journal feed "
+                  "(probe the comma list for their lag)")
+        else:
+            print("  note: single HA coordinator probed, no standby attached "
+                  "(a failover here would wait on a cold journal replay)")
+
+
 def cmd_arena(args) -> int:
     """The arena scoreboard: rating ladder, payoff matrix with Wilson
     intervals, PFSP preview weights, and rating-over-time trajectories from
@@ -701,6 +753,9 @@ def cmd_status(args) -> int:
     # tree): per-learner grad norm / update ratio / clip fraction, top
     # loss heads, last anomaly + bundle count
     _print_dynamics_digest(args.addr)
+    # coordinator-HA digest (present when the probed coordinator journals):
+    # role/epoch/journal position per coordinator, standby replication lag
+    _print_coordinator_ha_digest(args.addr)
     # skill-ledger digest (present when the probed coordinator hosts the
     # arena store): match accounting + the ladder's current top
     _print_arena_digest(args.addr)
