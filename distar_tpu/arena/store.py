@@ -276,6 +276,13 @@ class ArenaStore:
                     "pfsp_preview": preview,
                     "pfsp_weighting": "variance"}
 
+    def pfsp_preview(self, roster: Sequence[str]) -> Dict[str, Dict[str, float]]:
+        """Public PFSP-weight rows over an explicit roster — the league
+        matchmaker's read path (it must weight exactly what the payoff
+        snapshot previews, so both call one implementation)."""
+        with self._lock:
+            return self._pfsp_preview_locked(list(roster))
+
     def _pfsp_preview_locked(self, roster: List[str]) -> Dict[str, Dict[str, float]]:
         """Read-only PFSP opponent weights per player: the paper's variance
         weighting ``w(1-w)`` over observed winrates (0.5 for unplayed pairs),

@@ -748,11 +748,138 @@ def run_anakin(args) -> None:
     print(f"learner done: {learner.last_iter.val} iters")
 
 
+def run_league_learner(args) -> None:
+    """One league learner process (league/runtime/runner.py): register with
+    the coordinator-hosted matchmaker, then loop matchmade rounds — fused
+    Anakin rollout with the opponent on the away seat, report matches under
+    idempotent keys, record checkpoint generations into this player's
+    CheckpointManager role-key lineage, and stream train-info (snapshot
+    minting happens server-side)."""
+    import zlib
+
+    from ..envs.jaxenv import AnakinDataLoader, AnakinRunner
+    from ..league.remote import RemoteLeagueService
+    from ..league.runtime.runner import LeagueLearnerLoop
+    from ..learner.base_learner import experiments_root
+
+    player_id = args.player_id
+    _init_health(
+        args, roles=("learner", "trace"), source=f"league:{player_id}",
+        shipper_addr=_addr(args.coordinator_addr),
+    )
+    _maybe_serve_metrics(args)
+    remote = RemoteLeagueService(args.coordinator_addr)
+    cfg = _learner_cfg(args, _model_cfg(args))
+    # isolated checkpoint lineage per league player: a per-player save
+    # subtree keeps file names (and logs) collision-free across concurrent
+    # learners, and the role-keyed pointer file means generations can never
+    # cross on resume even if lineages are later merged into one directory
+    cfg["common"]["save_path"] = os.path.join(
+        cfg["common"].get("save_path")
+        or os.path.join(experiments_root(), args.experiment_name),
+        player_id)
+    cfg["learner"]["ckpt_role"] = player_id
+    learner = plugins.load_component(args.pipeline, "RLLearner")(
+        cfg, **_mesh_kwargs(args))
+    learner.cfg.learner["prefetch_depth"] = 0  # run_anakin teardown hazard
+    jcfg, scfg = _jaxenv_cfgs(args)
+    runner = AnakinRunner(
+        learner.model, batch_size=args.batch_size, unroll_len=args.traj_len,
+        env_cfg=jcfg, scenario_cfg=scfg,
+        seed=zlib.crc32(player_id.encode()) & 0x7FFFFFFF,
+        opponent_seat=True)
+    loop = LeagueLearnerLoop(
+        player_id, remote, learner, loader=None,
+        rounds=args.league_rounds,
+        iters_per_round=args.league_iters_per_round)
+    loader = AnakinDataLoader(
+        runner,
+        params_provider=lambda: (learner._state or {}).get("params"),
+        opponent_provider=loop.opponent_params)
+    loop.loader = loader
+    learner.set_dataloader(loader)
+    report = runner.purity_report(loader._params(), runner.init_carry(),
+                                  loader._opponent_params())
+    if not report["pure"]:
+        raise SystemExit(
+            f"league-learner fused loop is not device-pure: "
+            f"{report['offending']}")
+    if not getattr(args, "no_supervise", False):
+        learner.resume_latest()  # supervised restart resumes the lineage
+
+    def run_loop():
+        out = loop.run()
+        print(f"league-learner {player_id} done: {json.dumps(out)}",
+              flush=True)
+
+    if getattr(args, "no_supervise", False):
+        run_loop()
+        return
+    supervise_call(
+        run_loop, op=f"league-learner:{player_id}",
+        policy=_restart_policy(args),
+        on_restart=lambda e: learner.resume_latest(),
+    )
+
+
+def run_league_run(args) -> None:
+    """The self-play economy launcher: coordinator (LeagueService +
+    ArenaStore + HA journal) in this process, one league-learner subprocess
+    per player (docs/league.md quickstart). Exits 0 only when every learner
+    exits 0, at least one historical snapshot was minted from a checkpoint
+    generation, and the payoff matrix has real off-diagonal entries."""
+    from ..league.runtime.runner import LeagueRunner
+    from ..learner.base_learner import experiments_root
+
+    player_ids = [s.strip() for s in args.league_players.split(",") if s.strip()]
+    save_path = args.save_path or os.path.join(
+        experiments_root(), args.experiment_name)
+    journal = args.journal_dir
+    if not journal:
+        journal = os.path.join(save_path, "league_journal")
+    elif journal.lower() == "none":
+        journal = ""  # chaos counter-demo: run the economy un-journaled
+    extra = [
+        "--batch-size", str(args.batch_size),
+        "--traj-len", str(args.traj_len),
+        "--jaxenv-units", str(args.jaxenv_units),
+        "--jaxenv-episode-len", str(args.jaxenv_episode_len),
+        "--experiment-name", args.experiment_name,
+    ]
+    if args.host_devices:
+        extra += ["--host-devices", str(args.host_devices)]
+    elif args.platform != "auto":
+        extra += ["--platform", args.platform]
+    if args.mesh:
+        extra += ["--mesh", args.mesh]
+    if args.no_health:
+        extra += ["--no-health"]
+    if args.no_supervise:
+        extra += ["--no-supervise"]
+    runner = LeagueRunner(
+        player_ids=player_ids,
+        save_path=save_path,
+        journal_dir=journal,
+        arena_store_path=os.path.join(save_path, "arena_store.pkl"),
+        lease_s=args.lease_s or 30.0,
+        # first-round asks sit behind each learner's XLA compile on small
+        # hosts; a short TTL would count those as orphans
+        job_ttl_s=600.0,
+        learner_argv_extra=extra,
+        rounds=args.league_rounds,
+        iters_per_round=args.league_iters_per_round,
+        actors_per_player=args.league_actors_per_player,
+        reassign=args.league_actors_per_player > 0,
+    )
+    digest = runner.run(port=args.port)
+    raise SystemExit(0 if digest.get("ok") else 1)
+
+
 def main() -> None:
     p = argparse.ArgumentParser()
     p.add_argument("--type", default="all",
                    choices=["all", "league", "coordinator", "learner", "actor",
-                            "replay", "arena"])
+                            "replay", "arena", "league-run", "league-learner"])
     p.add_argument("--config", default="")
     p.add_argument("--iters", type=int, default=4)
     p.add_argument("--batch-size", type=int, default=None)
@@ -980,6 +1107,23 @@ def main() -> None:
                         "journaled at this path (league-autosave idiom); "
                         "enables the /arena/* routes")
     p.add_argument("--player-id", default="MP0")
+    p.add_argument("--league-players", default="MP0,EP0,ME0",
+                   help="--type league-run: comma list of active league "
+                        "player ids (prefix picks the class: MP main, EP "
+                        "exploiter, ME main-exploiter, ...); one learner "
+                        "subprocess is spawned per player")
+    p.add_argument("--league-rounds", type=int, default=2,
+                   help="league-run/league-learner: matchmade rounds per "
+                        "learner (each: ask -> train -> report -> "
+                        "checkpoint generation -> train-info)")
+    p.add_argument("--league-iters-per-round", type=int, default=1,
+                   help="optimizer steps per matchmade round")
+    p.add_argument("--league-actors-per-player", type=int, default=0,
+                   help="--type league-run: seed each player's elastic "
+                        "actor-slot fleet with this many members and run "
+                        "the payoff-driven reassigner over them (0 = no "
+                        "actor fleets; the fused learners roll out "
+                        "on-device)")
     p.add_argument("--pipeline", default="default",
                    help="learner implementation to run: 'default' or an "
                         "importable custom-pipeline module (plugins.py)")
@@ -1106,6 +1250,13 @@ def main() -> None:
             raise SystemExit(
                 "--type arena requires --coordinator-addr and --arena-ckpt-dir")
         run_arena(args)
+    elif args.type == "league-run":
+        run_league_run(args)
+    elif args.type == "league-learner":
+        if not args.coordinator_addr:
+            raise SystemExit(
+                "--type league-learner requires --coordinator-addr")
+        run_league_learner(args)
     elif args.type == "learner":
         if not args.coordinator_addr:
             raise SystemExit("--type learner requires --coordinator-addr (and usually --league-addr)")

@@ -341,6 +341,16 @@ class CoordinatorServer:
                 episodes=int(b.get("episodes", 8))),
             "arena_report": lambda b: _arena_call(
                 "report_batch", b.get("matches", [])),
+            # league wire plane (served when this coordinator hosts the
+            # LeagueService): the matchmaker's mutating routes are journaled
+            # like the arena ledger's, so broker failover loses no roster,
+            # assignment or snapshot-lineage state (the body is passed
+            # whole — the service does its own explicit field extraction)
+            "league_register": lambda b: _league_call("register_learner", b),
+            "league_ask": lambda b: _league_call("ask_job", b),
+            "league_report": lambda b: _league_call("report", b),
+            "league_train_info": lambda b: _league_call("train_info", b),
+            "league_status": lambda b: _league_call("status", b),
         }
 
         def _arena_call(method: str, *args, **kwargs):
@@ -350,6 +360,14 @@ class CoordinatorServer:
             if store is None:
                 raise RuntimeError("no arena store hosted on this coordinator")
             return getattr(store, method)(*args, **kwargs)
+
+        def _league_call(method: str, body: dict):
+            from ..league.runtime import get_league_service
+
+            service = get_league_service()
+            if service is None:
+                raise RuntimeError("no league service hosted on this coordinator")
+            return getattr(service, method)(body)
 
         class Handler(BaseHTTPRequestHandler):
             def log_message(self, *args):
@@ -392,6 +410,21 @@ class CoordinatorServer:
                         self.end_headers()
                         return
                     write_json_response(self, scaler.status())
+                    return
+                if self.path.rstrip("/") == "/league/status":
+                    # matchmaking digest (opsctl league reads it): answered
+                    # from the process-global LeagueService when this
+                    # coordinator hosts one, 404 otherwise
+                    from ..league.runtime import get_league_service
+                    from ..obs import write_json_response
+
+                    service = get_league_service()
+                    if service is None:
+                        self.send_response(404)
+                        self.send_header("Content-Length", "0")
+                        self.end_headers()
+                        return
+                    write_json_response(self, service.status())
                     return
                 if self.path.rstrip("/") in ("/arena/ratings", "/arena/payoff"):
                     # skill-ledger snapshots (opsctl arena / perf_gate skill

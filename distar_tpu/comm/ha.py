@@ -72,6 +72,13 @@ JOURNALED_ROUTES = frozenset({
     "heartbeat",     # lease refreshes (flush-only: loss => one re-register)
     "ask",           # queue POP — consuming a record must survive a restart
     "arena_report",  # arena ledger mutations (idempotent keys dedup replays)
+    # league matchmaker (league/runtime/service.py): every mutating route
+    # is a pure function of (state, seeded RNG, body, record ts), so the
+    # replica replays to the exact roster/assignment/lineage/RNG cursor
+    "league_register",    # learner roster + lease refresh (idempotent)
+    "league_ask",         # matchmaking draw — advances RNG + assignment map
+    "league_report",      # job completion + arena forward (key-dedup'd)
+    "league_train_info",  # step accounting + snapshot minting (seq watermark)
 })
 
 #: explicitly-ephemeral allowlist (SHRINK-ONLY — lint_ha_routes.py): routes
@@ -84,12 +91,17 @@ EPHEMERAL_ROUTES = frozenset({
     "telemetry",   # TSDB ingest is best-effort by contract: shippers re-ship
                    # full snapshots every interval (and resync on failover)
     "arena_next",  # pure function of *reported* arena state — no state here
+    "league_status",  # read-only matchmaking digest (explicitly non-mutating:
+                      # even assignment expiry is deferred to journaled routes)
 })
 
 #: journaled routes whose ack additionally requires fsync + standby
 #: replication (when a follower is attached) before the reply goes out
 DURABLE_ROUTES = frozenset({
     "register", "unregister", "strike", "ask", "arena_report",
+    # league mutations are all accounting: losing an acked one would orphan
+    # an assignment, double-mint a snapshot or fork the RNG cursor
+    "league_register", "league_ask", "league_report", "league_train_info",
 })
 
 #: routes safe to retry across a failover after an AMBIGUOUS ack (the reply
@@ -100,6 +112,11 @@ DURABLE_ROUTES = frozenset({
 IDEMPOTENT_ROUTES = frozenset({
     "register", "unregister", "strike", "heartbeat", "arena_report",
     "peers", "stats", "depth", "telemetry", "arena_next",
+    # league_register dedups on learner_id, league_report on match keys +
+    # assignment pop, league_train_info on its per-player seq watermark.
+    # ``league_ask`` is deliberately absent: like ``ask`` it is a draw —
+    # retrying a possibly-applied ask would mint a second assignment.
+    "league_register", "league_report", "league_train_info", "league_status",
 })
 
 LEAD_ROUTE = "__lead__"  # journal-internal leadership records
@@ -425,14 +442,32 @@ def probe_ha_status(addr: str, timeout: float = 2.0) -> Optional[dict]:
         return None
 
 
-def apply_record(coordinator, rec: dict, arena_store=None) -> None:
+def apply_record(coordinator, rec: dict, arena_store=None,
+                 league_service=None) -> None:
     """Apply one journaled record to a coordinator replica (restart replay
     and the standby tail share this one code path). Leases are re-aged from
     the record's wall timestamp, so an endpoint that stopped heartbeating
     long before the crash is evicted on the first sweep instead of getting
-    a fresh TTL."""
+    a fresh TTL. League records replay through the hosted LeagueService
+    with the record's clock, so lease/expiry decisions match the primary's."""
     route, body, ts = rec["route"], rec.get("body") or {}, float(rec.get("ts", 0.0))
     if route == LEAD_ROUTE:
+        return
+    if route.startswith("league_"):
+        if league_service is None:
+            from ..league.runtime import get_league_service
+
+            league_service = get_league_service()
+        method = {"league_register": "register_learner", "league_ask": "ask_job",
+                  "league_report": "report",
+                  "league_train_info": "train_info"}.get(route)
+        if league_service is not None and method is not None:
+            getattr(league_service, method)(body, now=ts)
+        else:
+            _metrics().counter(
+                "distar_coordinator_ha_apply_skips_total",
+                "journal records skipped on apply (no hosting store / "
+                "unknown route)", route=route).inc()
         return
     if route == "register":
         coordinator.apply_register(
@@ -486,7 +521,8 @@ class HAState:
                  takeover_grace_s: float = 3.0,
                  sync_timeout_s: float = 2.0,
                  snapshot_every: int = 512,
-                 arena_store_fn: Optional[Callable] = None):
+                 arena_store_fn: Optional[Callable] = None,
+                 league_service_fn: Optional[Callable] = None):
         assert role in ("auto", "primary", "standby"), role
         self.coordinator = coordinator
         self.journal = Journal(journal_dir, snapshot_every=snapshot_every)
@@ -495,6 +531,7 @@ class HAState:
         self.takeover_grace_s = float(takeover_grace_s)
         self.sync_timeout_s = float(sync_timeout_s)
         self._arena_store_fn = arena_store_fn
+        self._league_service_fn = league_service_fn
         self.role = "booting"
         self.leader_hint = ""
         self._mutate_lock = threading.Lock()
@@ -520,15 +557,24 @@ class HAState:
 
         return get_arena_store()
 
+    def _league_service(self):
+        if self._league_service_fn is not None:
+            return self._league_service_fn()
+        from ..league.runtime import get_league_service
+
+        return get_league_service()
+
     @property
     def epoch(self) -> int:
         return self.journal.epoch
 
     def _state_blob(self) -> dict:
         store = self._arena_store()
+        service = self._league_service()
         return {
             "coordinator": self.coordinator.state_snapshot(),
             "arena": store.state_blob() if store is not None else None,
+            "league": service.state_blob() if service is not None else None,
         }
 
     def _restore_blob(self, blob: dict) -> None:
@@ -537,6 +583,10 @@ class HAState:
         store = self._arena_store()
         if arena is not None and store is not None:
             store.load_state(arena)
+        league = blob.get("league")
+        service = self._league_service()
+        if league is not None and service is not None:
+            service.load_state(league)
 
     # ------------------------------------------------------------------- boot
     def boot(self) -> "HAState":
@@ -545,7 +595,8 @@ class HAState:
         if base is not None:
             self._restore_blob(base)
         for rec in records:
-            apply_record(self.coordinator, rec, self._arena_store())
+            apply_record(self.coordinator, rec, self._arena_store(),
+                         self._league_service())
         self._start_feed_server()
         role = self._requested_role
         leader = ""
@@ -815,7 +866,8 @@ class HAState:
                                 ts=rec.get("ts"),
                                 durable=rec.get("route") in DURABLE_ROUTES)
                             apply_record(self.coordinator, rec,
-                                         self._arena_store())
+                                         self._arena_store(),
+                                         self._league_service())
                             self._applied_seq = int(rec.get("seq", 0))
                             self._applied_ts = float(rec.get("ts", 0.0))
                             self._leader_seq = max(self._leader_seq,

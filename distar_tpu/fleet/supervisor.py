@@ -40,7 +40,7 @@ from ..obs import get_registry
 from ..resilience.supervisor import RestartPolicy
 
 #: fleet kinds this module knows how to parse/drain
-KINDS = ("gateway", "replay")
+KINDS = ("gateway", "replay", "actor")
 
 
 @dataclass
@@ -78,8 +78,10 @@ class SubprocessFleet:
     ready line on stdout; stdin is held open (closing it reaps the member,
     the established fleet-process idiom)."""
 
-    DRAIN_PATH = {"gateway": "/serve/drain", "replay": "/drain"}
-    READY_TOKEN = {"gateway": "SERVE-GATEWAY", "replay": "REPLAY-SHARD"}
+    DRAIN_PATH = {"gateway": "/serve/drain", "replay": "/drain",
+                  "actor": "/actor/drain"}
+    READY_TOKEN = {"gateway": "SERVE-GATEWAY", "replay": "REPLAY-SHARD",
+                   "actor": "LEAGUE-ACTOR"}
 
     def __init__(self, name: str, kind: str,
                  build_cmd: Callable[[int], List[str]],

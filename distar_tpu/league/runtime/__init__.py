@@ -1,0 +1,26 @@
+"""League runtime: the subsystem that RUNS a multi-learner league.
+
+``service``   — coordinator-hosted, WAL-replayable matchmaking control
+                plane (roster, PFSP jobs from the arena ledger, snapshot
+                minting from checkpoint generations, assignment map).
+``reassign``  — payoff-driven elastic actor rebalancing over the PR 12
+                fleet supervisor.
+``runner``    — the ``rl_train --type league-run`` launcher: one
+                coordinator (league + arena + HA journal) plus N learner
+                subprocesses, each an independent mesh.
+"""
+from .reassign import PayoffReassigner
+from .service import (
+    BRANCHES,
+    LeagueService,
+    get_league_service,
+    set_league_service,
+)
+
+__all__ = [
+    "BRANCHES",
+    "LeagueService",
+    "PayoffReassigner",
+    "get_league_service",
+    "set_league_service",
+]

@@ -102,7 +102,8 @@ class BaseLearner:
         self.last_iter = CountVar(0)
         self._checkpointer = AsyncCheckpointer()
         self._ckpt_manager = CheckpointManager(
-            os.path.join(root, "checkpoints"), role=self.CKPT_ROLE
+            os.path.join(root, "checkpoints"),
+            role=self.cfg.learner.get("ckpt_role", "") or self.CKPT_ROLE,
         )
         self.log_buffer: Dict[str, Any] = {}
         self.metrics = get_registry()

@@ -124,3 +124,102 @@ def test_small_model_trains_on_fused_batches(learner, loader):
     assert learner.last_iter.val >= 1
     total = learner.variable_record.get("total_loss").avg
     assert np.isfinite(total)
+
+
+# ---------------------------------------------------------------- away seat
+
+
+@pytest.fixture(scope="module")
+def opp_runner(learner):
+    return AnakinRunner(learner.model, batch_size=TINY_B, unroll_len=TINY_T,
+                        env_cfg=TINY_ENV, scenario_cfg=TINY_SCN, seed=0,
+                        opponent_seat=True)
+
+
+@pytest.fixture(scope="module")
+def opp_loader(learner, opp_runner):
+    return AnakinDataLoader(
+        opp_runner, params_provider=lambda: learner._state["params"])
+
+
+def test_away_seat_batch_layout_matches_single_policy(batch, opp_loader):
+    """A league exploiter trains against a frozen opponent with zero learner
+    changes: the opponent-seat batch is structurally identical to the
+    single-policy batch (the match_result leaf is stripped host-side)."""
+    opp_batch = next(opp_loader)
+    assert "match_result" not in opp_batch
+    got = _shapes(opp_batch)
+    ref = _shapes(batch)
+    assert jax.tree.structure(got) == jax.tree.structure(ref)
+    assert jax.tree.leaves(got) == jax.tree.leaves(ref)
+    assert all(isinstance(x, jax.Array) for x in jax.tree.leaves(opp_batch))
+
+
+def test_away_seat_match_results_drain(opp_loader):
+    """Finished episodes surface exactly once through drain_results() with a
+    home/away/draw verdict — the feed LeagueService.report consumes."""
+    for _ in range(6):  # 6 windows x 3 steps > episode_len=8: episodes finish
+        next(opp_loader)
+    results = opp_loader.drain_results()
+    assert results, "no episodes finished across 6 windows"
+    assert {r["winner"] for r in results} <= {"home", "away", "draw"}
+    assert all(r["steps"] >= 1 for r in results)
+    # drained means drained — the buffer does not replay old outcomes
+    assert opp_loader.drain_results() == []
+
+
+def test_away_seat_rollout_is_device_pure(opp_runner, opp_loader):
+    """The two-policy fused program stays callback/infeed/outfeed-free: the
+    frozen opponent runs in-scan, not via host ping-pong."""
+    report = opp_runner.purity_report(
+        opp_loader._params(), opp_runner.init_carry(),
+        opp_loader._opponent_params())
+    assert report["pure"] is True, report
+    assert report["offending"] == []
+
+
+def test_away_seat_requires_opponent_params(opp_runner, runner, opp_loader):
+    """The seat is explicit: an opponent-seat runner demands opponent params
+    and a single-policy runner rejects them — no silent self-play fallback."""
+    params = opp_loader._params()
+    with pytest.raises(AssertionError):
+        opp_runner.rollout(params, opp_runner.init_carry())
+    with pytest.raises(AssertionError):
+        runner.rollout(params, runner.init_carry(),
+                       opponent_params=opp_loader._opponent_params())
+
+
+def test_away_seat_trains_exploiter(learner, opp_loader):
+    """End-to-end: the learner takes a real optimizer step on an away-seat
+    batch — the exploiter training loop a league learner runs."""
+    learner.set_dataloader(iter(opp_loader))
+    learner.run(max_iterations=1)
+    total = learner.variable_record.get("total_loss").avg
+    assert np.isfinite(total)
+
+
+def test_failed_window_drops_poisoned_carry():
+    """The fused call donates the carry; if a window raises, the loader must
+    drop its carry reference so a supervised retry re-initialises instead of
+    re-passing deleted buffers (the league learner's restart path)."""
+    from types import SimpleNamespace
+
+    calls = {"init": 0}
+
+    def init_carry(key=None):
+        calls["init"] += 1
+        return ("carry", calls["init"])
+
+    def rollout(params, carry, opponent_params=None):
+        raise RuntimeError("window failed mid-donation")
+
+    stub = SimpleNamespace(opponent_seat=False, init_carry=init_carry,
+                           rollout=rollout, B=1, T=1, _seed=0)
+    dl = AnakinDataLoader(stub, params_provider=lambda: {"w": 1})
+    with pytest.raises(RuntimeError):
+        next(dl)
+    assert dl._carry is None
+    assert calls["init"] == 1
+    with pytest.raises(RuntimeError):
+        next(dl)
+    assert calls["init"] == 2

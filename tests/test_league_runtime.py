@@ -1,0 +1,510 @@
+"""League runtime tier-1 tests (PR 20).
+
+The matchmaking control plane must be deterministic enough to journal:
+every assertion here pins a replay invariant the HA coordinator depends
+on —
+
+  * seeded branch distribution: ``ask_job`` draws branches from the
+    configured per-class probabilities with the service RNG (statistical
+    check + bit-exact sequence equality between same-seed services);
+  * PFSP weights agree with the arena store's variance preview
+    (``LeagueService.pfsp_weights`` == ``ArenaStore.pfsp_preview`` row) —
+    matchmaking and the observatory must never disagree about who is
+    worth playing;
+  * snapshot minting is idempotent on (player, generation): a retried
+    train-info can never mint the same checkpoint twice;
+  * state_blob/load_state and route-by-route journal replay (the
+    ``comm.ha.apply_record`` path) reconstruct an identical
+    ``state_digest`` — roster, lineage, assignments, RNG cursor;
+  * ``League.save_resume`` carries the runtime leg (satellite 6);
+  * the elastic half: largest-remainder quotas, the payoff-driven
+    reassigner's drain-before-grow ordering, publisher no-op on unknown
+    players, and real actor-slot fleets spawning/draining under the PR 12
+    supervisor;
+  * the wire half: ``RemoteLeagueService`` round-trips every route
+    through a real ``CoordinatorServer``.
+"""
+import os
+import time
+
+import pytest
+
+from distar_tpu.arena import ArenaStore, set_arena_store
+from distar_tpu.league.remote import RemoteLeagueService
+from distar_tpu.league.runtime import (
+    BRANCHES,
+    LeagueService,
+    PayoffReassigner,
+    set_league_service,
+)
+from distar_tpu.league.runtime.reassign import _largest_remainder
+from distar_tpu.league.runtime.runner import (
+    LeaguePublisher,
+    build_actor_fleets,
+    league_cfg,
+)
+from distar_tpu.obs import MetricsRegistry, set_registry
+
+ROSTER = ("MP0", "EP0", "ME0")
+
+
+@pytest.fixture
+def registry():
+    reg = MetricsRegistry()
+    prev = set_registry(reg)
+    yield reg
+    set_registry(prev)
+
+
+@pytest.fixture
+def arena_global():
+    """Process-global arena-store slot, restored on teardown."""
+    prev = set_arena_store(None)
+    yield
+    set_arena_store(prev)
+
+
+@pytest.fixture
+def service_global():
+    prev = None
+    try:
+        yield
+    finally:
+        set_league_service(prev)
+
+
+def _service(seed: int = 7, lease_s: float = 5.0,
+             job_ttl_s: float = 60.0) -> LeagueService:
+    return LeagueService(league_cfg(ROSTER), seed=seed,
+                         lease_s=lease_s, job_ttl_s=job_ttl_s)
+
+
+# --------------------------------------------------------------- matchmaking
+def test_branch_distribution_matches_configured_probs(registry, arena_global):
+    """400 seeded asks per player land within 4 sigma of the configured
+    branch probabilities (deterministic given the seed, so no flake), and
+    a same-seed service reproduces the branch sequence bit-exactly."""
+    n = 400
+    expected = {
+        "MP0": {"sp": 0.5, "pfsp": 0.5},
+        "EP0": {"pfsp": 1.0},
+        "ME0": {"vs_main": 0.3, "pfsp": 0.5, "eval": 0.2},
+    }
+    sequences = {}
+    for pid, probs in expected.items():
+        svc = _service(seed=11)
+        counts = {b: 0 for b in BRANCHES}
+        seq = []
+        for i in range(n):
+            job = svc.ask_job({"player_id": pid}, now=1000.0 + i)
+            counts[job["branch"]] += 1
+            seq.append((job["branch"], tuple(job["player_ids"])))
+        sequences[pid] = seq
+        for branch, p in probs.items():
+            assert abs(counts[branch] / n - p) < 0.09, (pid, branch, counts)
+        for branch in set(BRANCHES) - set(probs):
+            assert counts[branch] == 0, (pid, branch, counts)
+
+    # bit-exact determinism: same seed, same request stream, same draws
+    for pid in expected:
+        svc2 = _service(seed=11)
+        replay = [
+            (j["branch"], tuple(j["player_ids"]))
+            for j in (svc2.ask_job({"player_id": pid}, now=1000.0 + i)
+                      for i in range(n))
+        ]
+        assert replay == sequences[pid]
+
+
+def test_ask_job_shapes_and_unknown_player(registry, arena_global):
+    svc = _service()
+    job = svc.ask_job({"player_id": "ME0", "learner_id": "L1"}, now=1.0)
+    assert job["job_id"] == "J1"
+    assert job["player_ids"][0] == "ME0"
+    assert job["branch"] in BRANCHES
+    if job["branch"] == "eval":
+        assert job["send_data_players"] == []
+    assert svc.ask_job({"player_id": "nope"}, now=2.0) is None
+    status = svc.status(now=3.0)
+    assert status["assignments_pending"] == 1
+    assert status["assignments"]["J1"]["learner_id"] == "L1"
+
+
+def test_pfsp_weights_agree_with_arena_preview(registry, arena_global):
+    """The service's matchmaking weights ARE the arena's variance-PFSP
+    row — same roster, same floats — and fall back to uniform when no
+    store is hosted."""
+    store = ArenaStore()
+    set_arena_store(store)
+    recs = []
+    for i, (away, winner) in enumerate(
+            [("MP0H1", "home")] * 6 + [("MP0H1", "away")] * 2
+            + [("EP0H1", "home")] * 3 + [("EP0H1", "draw")] * 3
+            + [("ME0H1", "away")] * 5):
+        recs.append({"key": f"m{i}", "home": "MP0", "away": away, "round": 0,
+                     "winner": winner, "game_steps": 10, "duration_s": 1.0})
+    out = store.report_batch(recs)
+    assert out["applied"] == len(recs)
+
+    svc = _service()
+    candidates = ["EP0H1", "ME0H1", "MP0H1"]
+    weights = svc.pfsp_weights("MP0", candidates)
+    row = store.pfsp_preview(["MP0"] + candidates)["MP0"]
+    assert weights == [row[c] for c in candidates]
+    assert sum(weights) > 0
+
+    set_arena_store(None)
+    assert svc.pfsp_weights("MP0", candidates) == pytest.approx([1 / 3] * 3)
+    assert svc.pfsp_weights("MP0", []) == []
+
+
+# ------------------------------------------------------------------- minting
+def test_snapshot_minting_idempotent(registry, arena_global):
+    svc = _service()
+    svc.register_learner({"player_id": "MP0", "learner_id": "L1"}, now=1.0)
+    hist0 = len(svc.league.historical_players)
+    body = {"player_id": "MP0", "learner_id": "L1", "seq": 0,
+            "train_steps": 5, "generation_path": "/ckpt/gen1.ckpt"}
+    first = svc.train_info(dict(body), now=2.0)
+    assert first["minted"] and first["snapshot_id"]
+    minted_id = first["snapshot_id"]
+    assert svc.league.historical_players[minted_id].checkpoint_path \
+        == "/ckpt/gen1.ckpt"
+
+    # retry with a fresh seq (ambiguous ack): same generation, no new mint
+    again = svc.train_info({**body, "seq": 1}, now=3.0)
+    assert not again["minted"] and again["snapshot_id"] == minted_id
+    assert len(svc.league.historical_players) == hist0 + 1
+
+    # duplicate seq: watermark absorbs the replay entirely
+    dup = svc.train_info({**body, "seq": 1}, now=4.0)
+    assert dup == {"ok": True, "duplicate": True}
+    step = svc.league.active_players["MP0"].total_agent_step
+    assert step == 10  # two applied train_infos, not three
+
+    # a NEW generation mints a new player
+    nxt = svc.train_info({**body, "seq": 2,
+                          "generation_path": "/ckpt/gen2.ckpt"}, now=5.0)
+    assert nxt["minted"] and nxt["snapshot_id"] != minted_id
+
+
+def test_main_exploiter_reset_rolls_back_to_teacher(registry, arena_global):
+    svc = LeagueService(league_cfg(ROSTER, teacher_path="/ckpt/teacher.ckpt"),
+                        seed=3)
+    svc.register_learner({"player_id": "ME0", "learner_id": "L1"}, now=1.0)
+    out = svc.train_info({"player_id": "ME0", "learner_id": "L1", "seq": 0,
+                          "generation_path": "/ckpt/me0g1.ckpt"}, now=2.0)
+    assert out["minted"]
+    # main exploiters always re-spawn from the teacher after a snapshot
+    assert out["reset_checkpoint_path"] == "/ckpt/teacher.ckpt"
+    assert svc.league.active_players["ME0"].checkpoint_path \
+        == "/ckpt/teacher.ckpt"
+
+
+# ------------------------------------------------- leases, freeze, expiry
+def test_freeze_is_derived_and_thaws_on_reregister(registry, arena_global):
+    svc = _service(lease_s=5.0)
+    svc.register_learner({"player_id": "MP0", "learner_id": "L1"}, now=100.0)
+    assert svc.status(now=101.0)["frozen_players"] == []
+    # lease lapses: the player freezes without any stored tombstone
+    st = svc.status(now=120.0)
+    assert st["frozen_players"] == ["MP0"]
+    assert st["active_learners"] == 0
+    # a supervised restart re-registers (same learner id) and thaws
+    reply = svc.register_learner({"player_id": "MP0", "learner_id": "L1"},
+                                 now=121.0)
+    assert reply["registered"] and reply["train_seq"] == -1
+    assert svc.status(now=122.0)["frozen_players"] == []
+
+
+def test_assignment_expiry_prunes_inside_journaled_routes(registry,
+                                                          arena_global):
+    svc = _service(job_ttl_s=60.0)
+    svc.ask_job({"player_id": "MP0"}, now=100.0)
+    assert svc.status(now=400.0)["assignments_pending"] == 1  # read-only
+    svc.ask_job({"player_id": "EP0"}, now=400.0)  # journaled: prunes
+    st = svc.status(now=401.0)
+    assert st["assignments_pending"] == 1
+    assert st["orphaned_jobs"] == 1
+    # a report against the pruned job is not "completed" but still ingests
+    out = svc.report({"job_id": "J1", "matches": []}, now=402.0)
+    assert out["completed"] is False
+
+
+def test_report_dedups_league_payoff_by_match_key(registry, arena_global):
+    store = ArenaStore()
+    set_arena_store(store)
+    svc = _service()
+    job = svc.ask_job({"player_id": "MP0"}, now=1.0)
+    away = job["player_ids"][1]
+    matches = [{"key": f"{job['job_id']}e0", "home": "MP0", "away": away,
+                "round": 0, "winner": "home", "game_steps": 8,
+                "duration_s": 1.0}]
+    out = svc.report({"job_id": job["job_id"], "matches": matches}, now=2.0)
+    assert out["completed"] and out["applied"] == 1
+    games0 = svc.league.active_players["MP0"].total_game_count
+    # replayed report (ambiguous ack): arena dedups, league view dedups
+    out2 = svc.report({"job_id": job["job_id"], "matches": matches}, now=3.0)
+    assert out2["duplicates"] == 1
+    assert svc.league.active_players["MP0"].total_game_count == games0
+
+
+# ----------------------------------------------------------------- durability
+def _drive(svc: LeagueService, store: ArenaStore):
+    """A scripted mutation sequence; returns the (route, body, ts) journal."""
+    journal = []
+
+    def call(route, method, body, ts):
+        journal.append((route, body, ts))
+        return getattr(svc, method)(body, now=ts)
+
+    for i, pid in enumerate(ROSTER):
+        call("league_register", "register_learner",
+             {"player_id": pid, "learner_id": f"L{i}"}, 10.0 + i)
+    for i in range(6):
+        pid = ROSTER[i % 3]
+        job = call("league_ask", "ask_job",
+                   {"player_id": pid, "learner_id": f"L{i % 3}"}, 20.0 + i)
+        matches = [{"key": f"{job['job_id']}e0", "home": pid,
+                    "away": job["player_ids"][1], "round": 0,
+                    "winner": ("home", "away", "draw")[i % 3],
+                    "game_steps": 9, "duration_s": 0.5}]
+        call("league_report", "report",
+             {"job_id": job["job_id"], "learner_id": f"L{i % 3}",
+              "matches": matches}, 30.0 + i)
+    for i, pid in enumerate(ROSTER):
+        call("league_train_info", "train_info",
+             {"player_id": pid, "learner_id": f"L{i}", "seq": 0,
+              "train_steps": 3,
+              "generation_path": f"/ckpt/{pid}_g1.ckpt"}, 40.0 + i)
+    return journal
+
+
+def test_state_blob_and_journal_replay_reconstruct_digest(registry,
+                                                          arena_global):
+    """The two recovery paths the HA coordinator uses — snapshot install
+    (state_blob/load_state) and WAL replay (apply_record with the record
+    clock) — both land on a bit-identical structural digest."""
+    from distar_tpu.comm.ha import apply_record
+
+    store_a = ArenaStore()
+    set_arena_store(store_a)
+    svc_a = _service(seed=23)
+    journal = _drive(svc_a, store_a)
+    digest_a = svc_a.state_digest()
+    assert digest_a["job_seq"] == 6
+    assert len(digest_a["minted"]) == 3
+
+    # snapshot path
+    svc_b = _service(seed=99)  # wrong seed: load_state must overwrite RNG
+    svc_b.load_state(svc_a.state_blob())
+    assert svc_b.state_digest() == digest_a
+
+    # WAL path: fresh service + fresh arena, replayed record by record
+    store_c = ArenaStore()
+    set_arena_store(store_c)
+    svc_c = _service(seed=23)
+    for route, body, ts in journal:
+        apply_record(None, {"route": route, "body": body, "ts": ts},
+                     league_service=svc_c)
+    assert svc_c.state_digest() == digest_a
+    # the forwarded reports landed in the replica's arena ledger too
+    assert store_c.matches_total == store_a.matches_total
+
+
+def test_league_save_resume_carries_runtime_state(registry, arena_global,
+                                                  tmp_path):
+    """Satellite 6: League.save_resume embeds the runtime leg, so a cold
+    coordinator restore reconstructs roster + assignments + RNG cursor."""
+    store = ArenaStore()
+    set_arena_store(store)
+    svc_a = _service(seed=5)
+    _drive(svc_a, store)
+    path = str(tmp_path / "league.resume")
+    svc_a.league.save_resume(path)
+
+    svc_b = _service(seed=77)
+    svc_b.league.load_resume(path)
+    assert svc_b.state_digest() == svc_a.state_digest()
+    # the restored service keeps matchmaking from where A left off
+    job_a = svc_a.ask_job({"player_id": "MP0"}, now=60.0)
+    job_b = svc_b.ask_job({"player_id": "MP0"}, now=60.0)
+    assert (job_a["branch"], job_a["player_ids"], job_a["job_id"]) \
+        == (job_b["branch"], job_b["player_ids"], job_b["job_id"])
+
+
+# ------------------------------------------------------------------ wire plane
+def test_remote_league_service_roundtrip(registry, arena_global,
+                                         service_global):
+    """Every league route over a real CoordinatorServer, via the proxy the
+    learners use (coordinator_request: retry fabric + HA failover)."""
+    from distar_tpu.comm import Coordinator, CoordinatorServer
+
+    store = ArenaStore()
+    set_arena_store(store)
+    svc = _service()
+    set_league_service(svc)
+    server = CoordinatorServer(coordinator=Coordinator(), port=0)
+    server.start()
+    try:
+        remote = RemoteLeagueService(f"127.0.0.1:{server.port}")
+        reply = remote.register_learner("MP0", learner_id="L1")
+        assert reply["registered"] and reply["train_seq"] == -1
+        job = remote.ask_job("MP0", learner_id="L1")
+        assert job and job["job_id"] == "J1"
+        out = remote.report(job["job_id"], [
+            {"key": "J1e0", "home": "MP0", "away": job["player_ids"][1],
+             "round": 0, "winner": "home", "game_steps": 4,
+             "duration_s": 0.1}], learner_id="L1")
+        assert out["completed"] and out["applied"] == 1
+        info = remote.train_info("MP0", seq=0, train_steps=2,
+                                 generation_path="/ckpt/g1.ckpt",
+                                 learner_id="L1")
+        assert info["minted"]
+        status = remote.status()
+        assert status["snapshot_mints"] == 1
+        assert status["jobs_by_branch"][job["branch"]] == 1
+        # GET mirror (opsctl league reads this)
+        import json
+        import urllib.request
+
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}/league/status",
+                timeout=5) as resp:
+            got = json.loads(resp.read())
+        assert got["snapshot_mints"] == 1
+    finally:
+        server.stop()
+        set_arena_store(None)
+
+
+# --------------------------------------------------------------- elastic half
+def test_largest_remainder_exact_and_deterministic():
+    out = _largest_remainder({"a": 0.0, "b": 0.25, "c": 0.25}, 6, 1)
+    assert sum(out.values()) == 6
+    assert out == {"a": 1, "b": 3, "c": 2}  # tie broken by key order
+    assert _largest_remainder({"a": 1.0, "b": 1.0}, 0, 0) == {"a": 0, "b": 0}
+    # zero weights: even split of the spare
+    assert _largest_remainder({"a": 0.0, "b": 0.0}, 4, 1) == {"a": 2, "b": 2}
+    assert _largest_remainder({}, 5, 1) == {}
+    # floors are granted before weights see anything
+    out = _largest_remainder({"a": 100.0, "b": 0.0}, 3, 1)
+    assert out["b"] >= 1 and sum(out.values()) == 3
+
+
+class _FakeSupervisor:
+    def __init__(self, fleets):
+        self._fleets = dict(fleets)
+        self.calls = []
+
+    def fleets(self):
+        return sorted(self._fleets)
+
+    def actual(self, name):
+        return self._fleets[name]
+
+    def scale_up(self, name, n=1):
+        self._fleets[name] += n
+        self.calls.append(("up", name, n))
+
+    def scale_down(self, name, n=1):
+        self._fleets[name] -= n
+        self.calls.append(("down", name, n))
+
+
+class _FakeService:
+    def __init__(self):
+        self.moved = 0
+
+    def note_reassignment(self, n=1):
+        self.moved += n
+
+
+def test_payoff_reassigner_moves_capacity_to_uncertain_pairs():
+    """Solved pairs (winrate 1.0) starve; a 0.5 pair and an unplayed
+    learner (exploration prior) gain — downscales run before upscales so
+    the pool never exceeds its budget mid-move."""
+    sup = _FakeSupervisor({"actors-MP0": 4, "actors-EP0": 1, "actors-ME0": 1})
+    svc = _FakeService()
+    cells = [
+        {"a": "MP0", "b": "MP0H1", "games": 9, "win_rate": 1.0},
+        {"a": "EP0", "b": "MP0H1", "games": 4, "win_rate": 0.5},
+        # ME0 has no recorded pairs: gets the unplayed-variance prior
+    ]
+    r = PayoffReassigner(sup, {"actors-MP0": "MP0", "actors-EP0": "EP0",
+                               "actors-ME0": "ME0"},
+                         total_actors=6, min_actors=1,
+                         payoff_fn=lambda: {"cells": cells}, service=svc)
+    assert r.learning_weights() == {"actors-MP0": 0.0, "actors-EP0": 0.25,
+                                    "actors-ME0": 0.25}
+    deltas = r.step()
+    assert deltas == {"actors-MP0": -3, "actors-EP0": 2, "actors-ME0": 1}
+    assert sup._fleets == {"actors-MP0": 1, "actors-EP0": 3, "actors-ME0": 2}
+    assert sup.calls[0][0] == "down"  # drain funds the grows
+    assert svc.moved == 3
+    # converged: a second pass is a no-op
+    assert all(d == 0 for d in r.step().values())
+
+
+def test_league_publisher_publishes_and_ignores_unknown_players():
+    from types import SimpleNamespace
+
+    from distar_tpu.serve.mux import GatewayMux
+    from distar_tpu.serve.registry import ModelRegistry
+
+    loaded = []
+
+    def load_fn(source):
+        loaded.append(source)
+        return {"w": source}
+
+    gw = SimpleNamespace(registry=ModelRegistry(load_fn=load_fn))
+    pub = LeaguePublisher(GatewayMux({"MP0": gw}))
+    assert pub.publish("MP0", "gen1", "/ckpt/g1.ckpt")
+    gen, version, params = gw.registry.current()
+    assert version == "gen1" and params == {"w": "/ckpt/g1.ckpt"}
+    assert pub.published == {"MP0": "gen1"}
+    # the league mints players faster than serving reconfigures: no-op
+    assert pub.publish("EP0H1", "gen1", "/ckpt/x.ckpt") is False
+    assert loaded == ["/ckpt/g1.ckpt"]
+
+
+@pytest.mark.slow
+def test_build_actor_fleets_spawns_and_drains(registry):
+    """Real PR 12 fleets: one actor-slot fleet per player, ready-line
+    handshake carries the player id, scale_down drains gracefully."""
+    supervisor, fleet_players = build_actor_fleets(
+        ("MP0", "EP0"), actors_per_player=2)
+    try:
+        assert fleet_players == {"actors-MP0": "MP0", "actors-EP0": "EP0"}
+        assert supervisor.actual("actors-MP0") == 2
+        member = supervisor.fleet("actors-MP0").members()[0]
+        assert member.meta["player"] == "MP0"
+        supervisor.scale_down("actors-EP0", 1)
+        deadline = time.monotonic() + 10.0
+        while supervisor.actual("actors-EP0") > 1 \
+                and time.monotonic() < deadline:
+            time.sleep(0.1)
+        assert supervisor.actual("actors-EP0") == 1
+    finally:
+        supervisor.stop()
+
+
+def test_self_play_opponent_resolves_live_state_each_window():
+    """Live self-play (away == home) must re-read the learner state at every
+    rollout window: the train step donates its state, so a stashed params
+    reference is a deleted pytree after one optimizer step."""
+    from types import SimpleNamespace
+
+    from distar_tpu.league.runtime.runner import LeagueLearnerLoop
+
+    learner = SimpleNamespace(_state={"params": {"w": 1}})
+    loop = LeagueLearnerLoop("MP0", remote=None, learner=learner,
+                             loader=None, learner_id="L1")
+    job = {"job_id": "J1", "player_ids": ["MP0", "MP0"],
+           "checkpoint_paths": ["", ""], "branch": "sp"}
+    assert loop._resolve_opponent(job) == "MP0"
+    assert loop.opponent_params() == {"w": 1}
+    # simulate the donated train step swapping in a fresh state
+    learner._state = {"params": {"w": 2}}
+    assert loop.opponent_params() == {"w": 2}

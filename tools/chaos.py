@@ -1665,6 +1665,373 @@ def cmd_coordinator_drill(args) -> int:
         ha_mod.reset_targets()
 
 
+_LEAGUE_PLANE_CHILD = r"""
+import os, sys, time
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+repo = sys.argv[1]
+if repo not in sys.path:
+    sys.path.insert(0, repo)
+port, journal_dir = int(sys.argv[2]), sys.argv[3]
+players = sys.argv[4].split(",")
+seed, lease_s, job_ttl_s = int(sys.argv[5]), float(sys.argv[6]), float(sys.argv[7])
+from distar_tpu.arena import ArenaStore, set_arena_store
+from distar_tpu.comm.coordinator import Coordinator, CoordinatorServer
+from distar_tpu.league.runtime import LeagueService, set_league_service
+from distar_tpu.league.runtime.runner import league_cfg
+store = ArenaStore()
+set_arena_store(store)
+service = LeagueService(league_cfg(players), seed=seed,
+                        lease_s=lease_s, job_ttl_s=job_ttl_s)
+set_league_service(service)
+co = Coordinator()
+srv = CoordinatorServer(coordinator=co, port=port)
+if journal_dir != "-":
+    from distar_tpu.comm.ha import HAState
+    ha = HAState(co, journal_dir, advertise="127.0.0.1:%d" % srv.port,
+                 role="primary", snapshot_every=64,
+                 arena_store_fn=lambda: store,
+                 league_service_fn=lambda: service)
+    ha.boot()
+    srv.attach_ha(ha)
+srv.start()
+print("READY %d" % srv.port, flush=True)
+while True:
+    time.sleep(1)
+"""
+
+_LEAGUE_LEARNER_CHILD = r"""
+import json, os, sys, time
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+repo = sys.argv[1]
+if repo not in sys.path:
+    sys.path.insert(0, repo)
+addr, player_id, learner_id = sys.argv[2], sys.argv[3], sys.argv[4]
+rounds, sleep_s = int(sys.argv[5]), float(sys.argv[6])
+from distar_tpu.league.remote import RemoteLeagueService
+remote = RemoteLeagueService(addr, timeout=10.0)
+reply = remote.register_learner(player_id, learner_id=learner_id)
+print("REG " + json.dumps(reply), flush=True)
+if not reply.get("registered"):
+    sys.exit(3)
+base = int(reply.get("train_seq", -1)) + 1
+for i in range(rounds):
+    job = remote.ask_job(player_id, learner_id=learner_id)
+    rec = {"key": "%se0" % job["job_id"], "home": player_id,
+           "away": job["player_ids"][1], "round": 0,
+           "winner": ("home", "away", "draw")[i % 3],
+           "game_steps": 8, "duration_s": 0.1}
+    out = remote.report(job["job_id"], [rec], learner_id=learner_id)
+    if out.get("applied"):
+        print("MATCH " + json.dumps(rec), flush=True)
+    seq = base + i
+    gen = "/fake/%s_g%d.ckpt" % (player_id, seq)
+    ti = remote.train_info(player_id, seq=seq, train_steps=1,
+                           checkpoint_path=gen, generation_path=gen,
+                           learner_id=learner_id)
+    print("SEQ %d minted=%d snap=%s" % (
+        seq, 1 if ti.get("minted") else 0, ti.get("snapshot_id", "-")),
+        flush=True)
+    time.sleep(sleep_s)
+print("DONE %d" % rounds, flush=True)
+"""
+
+
+def cmd_league_drill(args) -> int:
+    """SIGKILL one league learner mid-league and prove the matchmaking
+    control plane's failure model (the self-play economy must degrade to a
+    smaller economy, never a corrupted one):
+
+      * the killed learner's player FREEZES (lease-derived, no tombstone)
+        instead of vanishing — it stays on the active roster and its
+        minted historical snapshots stay matchable;
+      * the surviving learners keep drawing and completing jobs;
+      * a supervised restart re-registers under the same learner id and
+        resumes its train-info lineage past the service's seq watermark;
+      * the dead learner's abandoned assignment expires after the job TTL
+        (counted as orphaned) and the assignment map drains to empty —
+        matchmaking state is uncorrupted;
+      * SIGKILL the coordinator afterwards and cold-restart it over its
+        HA journal alone: roster, snapshot lineage, branch counters and
+        arena dedup keys reconstruct exactly — re-reporting every acked
+        match dedups 100% (zero lost, zero double-counted).
+
+      --no-journal is the counter-demo: the same kill against a
+      journal-less control plane provably FORGETS the league (mints gone,
+      seq watermark reset, acked matches double-count on replay)."""
+    import json as _json
+    import socket
+    import subprocess
+    import threading
+    import urllib.request
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.makedirs(args.dir, exist_ok=True)
+
+    from distar_tpu.comm import ha as ha_mod
+    from distar_tpu.league.remote import RemoteLeagueService
+
+    players = ("MP0", "EP0", "ME0")
+    victim = "EP0"
+    lease_s = float(args.lease_s)
+    job_ttl_s = float(args.job_ttl_s)
+    inj = ChaosInjector(seed=args.seed)
+    failures = []
+    children = []
+
+    def free_port() -> int:
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        return port
+
+    def spawn_plane(port: int, jdir: str):
+        proc = subprocess.Popen(
+            [sys.executable, "-c", _LEAGUE_PLANE_CHILD, _REPO, str(port),
+             jdir, ",".join(players), str(args.seed), str(lease_s),
+             str(job_ttl_s)],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+            bufsize=1, cwd=_REPO, env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        assert proc.stdout is not None
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            line = proc.stdout.readline()
+            if line.startswith("READY"):
+                children.append(proc)
+                return proc
+            if proc.poll() is not None:
+                break
+        raise RuntimeError(f"league control plane on :{port} never came up")
+
+    def spawn_learner(addr: str, pid: str, learner_id: str, rounds: int):
+        """Learner child + stdout collector: REG reply, acked match
+        records, acked train-info seqs and minted snapshot ids."""
+        proc = subprocess.Popen(
+            [sys.executable, "-c", _LEAGUE_LEARNER_CHILD, _REPO, addr, pid,
+             learner_id, str(rounds), str(args.round_sleep_s)],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+            bufsize=1, cwd=_REPO, env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        children.append(proc)
+        acked = {"reg": None, "matches": [], "seqs": [], "snaps": [],
+                 "proc": proc}
+        lock = threading.Lock()
+
+        def reader():
+            assert proc.stdout is not None
+            for line in proc.stdout:
+                with lock:
+                    if line.startswith("REG "):
+                        acked["reg"] = _json.loads(line[4:])
+                    elif line.startswith("MATCH "):
+                        acked["matches"].append(_json.loads(line[6:]))
+                    elif line.startswith("SEQ "):
+                        parts = line.split()
+                        acked["seqs"].append(int(parts[1]))
+                        if parts[2] == "minted=1":
+                            acked["snaps"].append(parts[3].split("=", 1)[1])
+
+        threading.Thread(target=reader, daemon=True).start()
+        acked["lock"] = lock
+        return acked
+
+    def league_status(addr: str) -> dict:
+        with urllib.request.urlopen(f"http://{addr}/league/status",
+                                    timeout=5.0) as resp:
+            return _json.loads(resp.read())
+
+    DIGEST_KEYS = ("active_players", "historical_players", "snapshot_mints",
+                   "jobs_by_branch", "orphaned_jobs", "minted")
+
+    ha_mod.reset_targets()
+    port = free_port()
+    try:
+        if args.no_journal:
+            # ---------------------- counter-demo: the league is forgotten
+            plane = spawn_plane(port, "-")
+            addr = f"127.0.0.1:{port}"
+            lrn = spawn_learner(addr, "MP0", "MP0-learner", rounds=5)
+            lrn["proc"].wait(timeout=60)
+            with lrn["lock"]:
+                acked_seqs = list(lrn["seqs"])
+                acked_matches = list(lrn["matches"])
+                mints_acked = len(lrn["snaps"])
+            inj.kill_role(plane.pid, sig=signal.SIGKILL,
+                          name="league-coordinator")
+            plane.wait(timeout=30)
+            spawn_plane(port, "-")
+            st = league_status(addr)
+            remote = RemoteLeagueService(addr, timeout=10.0)
+            reg = remote.register_learner("MP0", learner_id="MP0-learner")
+            resend = remote.report("RESEND", acked_matches)
+            lost_mints = mints_acked - int(st["snapshot_mints"])
+            watermark_lost = int(reg.get("train_seq", -1)) < max(acked_seqs)
+            double_counted = int(resend.get("applied", 0))
+            verdict = {
+                "mode": "no-journal counter-demo",
+                "acked_mints": mints_acked, "mints_after_restart":
+                    st["snapshot_mints"], "lost_mints": lost_mints,
+                "seq_watermark_lost": watermark_lost,
+                "acked_matches_double_counted": double_counted,
+                "failures": [] if (lost_mints > 0 and watermark_lost
+                                   and double_counted > 0) else
+                ["journal-less restart did NOT lose league state?"],
+            }
+            print(_json.dumps(verdict))
+            lost = not verdict["failures"]
+            print("verdict: journal-less control plane forgot "
+                  f"{lost_mints} minted snapshots, reset the seq watermark "
+                  f"and double-counted {double_counted} acked matches "
+                  "across a SIGKILL — the loss the journal exists to prevent"
+                  if lost else "verdict: DRILL FAILED")
+            return 0 if lost else 1
+
+        # ----------------------------------------------- journaled drill
+        jdir = os.path.join(args.dir, "journal")
+        plane = spawn_plane(port, jdir)
+        addr = f"127.0.0.1:{port}"
+        remote = RemoteLeagueService(addr, timeout=10.0)
+
+        survivors = {
+            pid: spawn_learner(addr, pid, f"{pid}-learner",
+                               rounds=args.rounds)
+            for pid in players if pid != victim
+        }
+        vic = spawn_learner(addr, victim, f"{victim}-learner", rounds=100000)
+
+        # -------------------------- SIGKILL the victim mid-league
+        deadline = time.time() + args.timeout_s
+        while time.time() < deadline:
+            with vic["lock"]:
+                if len(vic["seqs"]) >= 3:
+                    break
+            time.sleep(0.05)
+        with vic["lock"]:
+            vic_seqs, vic_snaps = list(vic["seqs"]), list(vic["snaps"])
+            vic_matches = list(vic["matches"])
+        if len(vic_seqs) < 3:
+            failures.append("victim learner never reached 3 acked rounds")
+        t_kill = time.time()
+        inj.kill_role(vic["proc"].pid, sig=signal.SIGKILL,
+                      name=f"league-learner-{victim}")
+        vic["proc"].wait(timeout=30)
+        # a dead actor's ask: dispatched, never reported -> must expire
+        orphan_job = remote.ask_job(victim, learner_id="dead-actor")
+
+        # ------------------------ freeze (not vanish) within one lease
+        frozen_seen = None
+        freeze_deadline = time.time() + lease_s * 3 + 5
+        while time.time() < freeze_deadline:
+            st = league_status(addr)
+            if victim in st["frozen_players"]:
+                frozen_seen = st
+                break
+            time.sleep(0.2)
+        if frozen_seen is None:
+            failures.append(f"{victim} never froze after the kill")
+        else:
+            if victim not in frozen_seen["active_players"]:
+                failures.append(f"{victim} vanished from the active roster")
+            missing = [s for s in vic_snaps
+                       if s not in frozen_seen["historical_players"]]
+            if missing:
+                failures.append(f"killed learner's minted snapshots "
+                                f"disappeared: {missing}")
+        jobs_at_kill = sum((frozen_seen or st)["jobs_by_branch"].values())
+
+        # ------------- supervised restart resumes the train-info lineage
+        vic2 = spawn_learner(addr, victim, f"{victim}-learner",
+                             rounds=max(3, args.rounds // 4))
+        reg_deadline = time.time() + 30
+        reg = None
+        while time.time() < reg_deadline:
+            with vic2["lock"]:
+                reg = vic2["reg"]
+            if reg is not None:
+                break
+            time.sleep(0.1)
+        if reg is None or not reg.get("registered"):
+            failures.append(f"restarted {victim} failed to register: {reg}")
+        elif vic_seqs and int(reg.get("train_seq", -1)) < max(vic_seqs):
+            failures.append(
+                f"restart lost the seq watermark: register returned "
+                f"train_seq={reg.get('train_seq')} < acked {max(vic_seqs)}")
+        thaw_deadline = time.time() + lease_s + 10
+        while time.time() < thaw_deadline:
+            if victim not in league_status(addr)["frozen_players"]:
+                break
+            time.sleep(0.2)
+        else:
+            failures.append(f"{victim} stayed frozen after restart")
+
+        for pid, col in {**survivors, victim: vic2}.items():
+            if col["proc"].wait(timeout=args.timeout_s) != 0:
+                failures.append(f"learner {pid} exited nonzero")
+        st = league_status(addr)
+        if sum(st["jobs_by_branch"].values()) <= jobs_at_kill:
+            failures.append("survivors made no matchmaking progress "
+                            "after the kill")
+
+        # ------------- the abandoned assignment expires, map drains clean
+        time.sleep(max(0.0, job_ttl_s - (time.time() - t_kill)) + 0.5)
+        flush_job = remote.ask_job("MP0", learner_id="drill-flush")
+        remote.report(flush_job["job_id"], [], learner_id="drill-flush")
+        st1 = league_status(addr)
+        if st1["assignments_pending"] != 0:
+            failures.append(f"assignment map did not drain: "
+                            f"{st1['assignments']}")
+        if st1["orphaned_jobs"] < 1:
+            failures.append("dead actor's assignment was never counted "
+                            "as orphaned")
+        if orphan_job and orphan_job["job_id"] in st1["assignments"]:
+            failures.append("dead actor's assignment never expired")
+
+        # -------------- cold journal replay: the league state is exact
+        all_matches = list(vic_matches)
+        for col in list(survivors.values()) + [vic2]:
+            with col["lock"]:
+                all_matches.extend(col["matches"])
+        inj.kill_role(plane.pid, sig=signal.SIGKILL,
+                      name="league-coordinator")
+        plane.wait(timeout=30)
+        spawn_plane(port, jdir)
+        st2 = league_status(addr)
+        for key in DIGEST_KEYS:
+            if st1[key] != st2[key]:
+                failures.append(f"cold journal replay diverged on {key}: "
+                                f"{st1[key]} != {st2[key]}")
+        resend = remote.report("RESEND", all_matches)
+        if resend.get("applied", 1) != 0 \
+                or resend.get("duplicates") != len(all_matches):
+            failures.append(f"acked matches not exactly reconstructed by "
+                            f"journal replay: {resend}")
+
+        verdict = {
+            "acked_matches": len(all_matches),
+            "victim_acked_rounds": len(vic_seqs),
+            "victim_minted_snapshots": len(vic_snaps),
+            "restart_train_seq": reg and reg.get("train_seq"),
+            "snapshot_mints": st2["snapshot_mints"],
+            "jobs_by_branch": st2["jobs_by_branch"],
+            "orphaned_jobs": st2["orphaned_jobs"],
+            "events": [e["kind"] for e in inj.events],
+            "failures": failures,
+        }
+        print(_json.dumps(verdict, default=str))
+        print("verdict: learner SIGKILL'd mid-league; its player froze "
+              "(still matchable), survivors kept training, the supervised "
+              "restart resumed the lineage, the abandoned assignment "
+              "expired cleanly, and a cold journal replay reconstructed "
+              "the league exactly with zero lost / zero double-counted "
+              "acked matches" if not failures
+              else f"verdict: DRILL FAILED {failures}")
+        return 0 if not failures else 1
+    finally:
+        for p_ in children:
+            if p_.poll() is None:
+                p_.kill()
+        ha_mod.reset_targets()
+
+
 def cmd_latest(args) -> int:
     mgr = CheckpointManager(args.dir)
     gens = mgr.generations()
@@ -1813,6 +2180,32 @@ def main() -> int:
     o.add_argument("--timeout-s", type=float, default=120.0,
                    help="load-phase wall budget")
 
+    g = sub.add_parser(
+        "league-drill",
+        help="SIGKILL one league learner mid-league; prove the matchmaker "
+             "freezes (not forgets) its player, survivors keep training, a "
+             "supervised restart resumes the lineage, the abandoned "
+             "assignment expires, and a cold journal replay reconstructs "
+             "the league exactly")
+    g.add_argument("--dir", required=True,
+                   help="scratch directory (the control plane's HA journal)")
+    g.add_argument("--rounds", type=int, default=40,
+                   help="matchmade rounds each SURVIVOR learner completes "
+                        "(the victim runs unbounded until the kill)")
+    g.add_argument("--round-sleep-s", type=float, default=0.2,
+                   help="per-round think time in the toy learner children")
+    g.add_argument("--lease-s", type=float, default=2.0,
+                   help="learner lease TTL; the victim's player must freeze "
+                        "within ~one window of the kill")
+    g.add_argument("--job-ttl-s", type=float, default=5.0,
+                   help="assignment TTL; the dead actor's job must expire")
+    g.add_argument("--no-journal", action="store_true",
+                   help="counter-demo: a journal-less control plane "
+                        "provably forgets the league across a SIGKILL")
+    g.add_argument("--seed", type=int, default=0)
+    g.add_argument("--timeout-s", type=float, default=120.0,
+                   help="per-phase wall budget")
+
     m = sub.add_parser("multichip-drill",
                        help="kill a multichip learner after a sharded save; "
                             "prove resume on a DIFFERENT mesh shape")
@@ -1840,6 +2233,7 @@ def main() -> int:
             "dynamics-drill": cmd_dynamics_drill,
             "arena-drill": cmd_arena_drill,
             "coordinator-drill": cmd_coordinator_drill,
+            "league-drill": cmd_league_drill,
             "multichip-drill": cmd_multichip_drill}[args.command](args)
 
 

@@ -54,6 +54,11 @@ answers "show me a request we refused"); with ``--id`` it fetches one
 trace's spans and renders the waterfall + ranked critical-path table
 (obs/waterfall.py) — client/router/gateway spans joined under one
 trace_id, with queue-wait vs service-time decomposition per process.
+``league`` probes a coordinator hosting the league runtime
+(``rl_train --type league-run``): learner leases, roster freeze state,
+jobs dispatched per matchmaking branch, outstanding assignments, snapshot
+mints and elastic reassignments (GET /league/status, docs/league.md).
+``arena`` prints the ladder the matchmaker feeds on.
 """
 from __future__ import annotations
 
@@ -524,6 +529,55 @@ def cmd_arena(args) -> int:
     return 0
 
 
+def cmd_league(args) -> int:
+    """The self-play economy digest: roster (active/frozen/historical),
+    learner leases, jobs dispatched per matchmaking branch, outstanding
+    assignments, snapshot mints and elastic reassignments — the
+    ``GET /league/status`` surface of the coordinator-hosted
+    ``LeagueService`` (docs/league.md)."""
+    st = _get(args.addr, "/league/status")
+    if args.json:
+        print(json.dumps(st, indent=1))
+        return 0
+    print(f"league  ({st.get('active_learners', 0)}/"
+          f"{st.get('registered_learners', 0)} learners fresh, "
+          f"lease={st.get('lease_s', 0):.0f}s "
+          f"job_ttl={st.get('job_ttl_s', 0):.0f}s)")
+    frozen = set(st.get("frozen_players") or [])
+    learners_by_player = {}
+    for lid, e in (st.get("learners") or {}).items():
+        learners_by_player.setdefault(e.get("player_id", "?"), []).append(
+            (lid, e))
+    print(f"  {'player':<12} {'state':<8} learners")
+    for pid in st.get("active_players") or []:
+        rows = learners_by_player.get(pid, [])
+        detail = ", ".join(
+            f"{lid}(fresh)" if e.get("fresh")
+            else f"{lid}(stale {e.get('age_s', 0.0):.0f}s)"
+            for lid, e in sorted(rows)) or "-"
+        state = "FROZEN" if pid in frozen else "active"
+        print(f"  {pid:<12} {state:<8} {detail}")
+    hist = st.get("historical_players") or []
+    print(f"historical players: {len(hist)}"
+          + (f"  ({', '.join(hist[:8])}{', ...' if len(hist) > 8 else ''})"
+             if hist else ""))
+    jobs = st.get("jobs_by_branch") or {}
+    total = sum(jobs.values())
+    dist = "  ".join(f"{b}={jobs.get(b, 0)}"
+                     for b in ("sp", "pfsp", "vs_main", "eval"))
+    print(f"jobs dispatched: {total}  ({dist})")
+    pending = st.get("assignments") or {}
+    print(f"assignments pending: {len(pending)}"
+          f"  orphaned(ttl-expired): {st.get('orphaned_jobs', 0)}")
+    for jid, a in sorted(pending.items()):
+        print(f"  {jid:<8} {a.get('branch', '?'):<8} "
+              f"{' vs '.join(a.get('player_ids') or [])}  "
+              f"learner={a.get('learner_id') or '?'}")
+    print(f"snapshot mints: {st.get('snapshot_mints', 0)}"
+          f"  reassignments: {st.get('reassignments', 0)}")
+    return 0
+
+
 def _print_actor_digest(addr: str) -> None:
     """Actor-throughput digest from the probed TSDB: env-steps/s, the
     rollout-plane backend serving the fleet, plane sample rates per
@@ -895,7 +949,7 @@ def main() -> int:
                                 formatter_class=argparse.RawDescriptionHelpFormatter)
     p.add_argument("command", choices=("status", "tail-alerts", "query",
                                        "profile", "trace", "dynamics",
-                                       "arena"))
+                                       "arena", "league"))
     p.add_argument("--addr", default="127.0.0.1:8423", help="host:port of a health surface")
     p.add_argument("--interval", type=float, default=2.0, help="tail-alerts poll cadence")
     p.add_argument("--once", action="store_true",
@@ -946,6 +1000,8 @@ def main() -> int:
         return cmd_trace(args)
     if args.command == "arena":
         return cmd_arena(args)
+    if args.command == "league":
+        return cmd_league(args)
     if not args.name:
         p.error("query requires --name")
     return cmd_query(args)
